@@ -1,0 +1,29 @@
+"""Mixed precision for TPU (reference: ``apex/amp``).
+
+The O0–O3 opt levels map onto functional dtype policies
+(:mod:`apex_tpu.amp.policy`), and dynamic loss scaling is fully
+device-side (:mod:`apex_tpu.amp.scaler`), following the reference's
+capturable/CUDA-graph design (``csrc/update_scale_hysteresis.cu``) which
+is the natural XLA semantics.
+"""
+
+from apex_tpu.amp.frontend import Amp, initialize, value_and_grad
+from apex_tpu.amp.policy import Policy, get_policy
+from apex_tpu.amp.scaler import (
+    DynamicLossScaler,
+    ScalerState,
+    StaticLossScaler,
+    all_finite,
+)
+
+__all__ = [
+    "Amp",
+    "initialize",
+    "value_and_grad",
+    "Policy",
+    "get_policy",
+    "DynamicLossScaler",
+    "StaticLossScaler",
+    "ScalerState",
+    "all_finite",
+]

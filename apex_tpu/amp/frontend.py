@@ -1,0 +1,118 @@
+"""The ``amp.initialize``-style front end, re-imagined functionally.
+
+Reference: ``apex/amp/frontend.py:197-404`` and ``apex/amp/handle.py:16``
+(``scale_loss``).  The reference mutates models/optimizers in place and
+installs patched ``forward``/``step``.  Here, :func:`initialize` returns a
+small immutable :class:`Amp` object plus cast params, and
+:func:`value_and_grad` wraps a loss function so one call produces
+(loss, grads, new_scaler_state, grads_finite) with all scaling handled —
+the moral equivalent of ``with amp.scale_loss(...) as scaled: ...``.
+"""
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import Policy, get_policy
+from apex_tpu.amp.scaler import DynamicLossScaler, ScalerState, StaticLossScaler, all_finite
+
+
+class Amp(NamedTuple):
+    """Bundle of policy + scaler (static) — safe to close over in jit."""
+
+    policy: Policy
+    scaler: Any  # DynamicLossScaler | StaticLossScaler | None
+
+    def init_state(self) -> Optional[ScalerState]:
+        return self.scaler.init() if self.scaler is not None else None
+
+    # -------------------------------------------------------------- loss ops
+    def scale_loss(self, scaler_state, loss):
+        """Functional ``with amp.scale_loss(loss, opt)`` (handle.py:16)."""
+        if self.scaler is None:
+            return loss
+        return self.scaler.scale(scaler_state, loss)
+
+    def unscale_grads(self, scaler_state, grads):
+        if self.scaler is None:
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            return g32, all_finite(g32)
+        return self.scaler.unscale(scaler_state, grads)
+
+    def update_scaler(self, scaler_state, grads_finite):
+        if self.scaler is None:
+            return scaler_state
+        return self.scaler.update(scaler_state, grads_finite)
+
+    # ----------------------------------------------------- state dict parity
+    def state_dict(self, scaler_state):
+        """Reference: apex/amp/frontend.py:365-376."""
+        if self.scaler is None:
+            return {}
+        return {"loss_scaler0": self.scaler.state_dict(scaler_state)}
+
+    def load_state_dict(self, d):
+        if self.scaler is None or not d:
+            return None
+        return self.scaler.load_state_dict(d["loss_scaler0"])
+
+
+def initialize(
+    params,
+    opt_level: str = "O1",
+    half_dtype=None,
+    loss_scale=None,
+    init_scale: float = 2.0 ** 16,
+    growth_interval: int = 2000,
+    hysteresis: int = 1,
+):
+    """Build an :class:`Amp` and cast params per the opt level.
+
+    Returns ``(cast_params, amp)``.  Mirrors
+    ``amp.initialize(models, optimizers, opt_level=...)``
+    (apex/amp/frontend.py:197) with models/optimizers replaced by the
+    param pytree (state is the caller's to thread).
+    """
+    policy = get_policy(opt_level, half_dtype=half_dtype, loss_scale=loss_scale)
+    if policy.loss_scale == "dynamic":
+        scaler = DynamicLossScaler(
+            init_scale=init_scale, growth_interval=growth_interval, hysteresis=hysteresis
+        )
+    elif policy.loss_scale is None:
+        scaler = None
+    else:
+        scaler = StaticLossScaler(float(policy.loss_scale))
+    return policy.cast_params(params), Amp(policy=policy, scaler=scaler)
+
+
+def value_and_grad(amp: Amp, loss_fn: Callable, **grad_kwargs):
+    """Mixed-precision ``jax.value_and_grad``.
+
+    ``loss_fn(params, *args)`` is differentiated with the loss scaled by
+    the current scale; grads come back unscaled in fp32 together with the
+    updated scaler state and a finite flag.  The whole train-step pattern
+    of reference §3.2 (SURVEY) in one transform::
+
+        loss, grads, scaler_state, finite = amp_vg(params, scaler_state, batch)
+        new_params, opt_state = opt.update(grads, opt_state, params, grads_finite=finite)
+        scaler_state = amp.update_scaler(scaler_state, finite)
+    """
+
+    def scaled_loss_fn(params, scaler_state, *args, **kwargs):
+        loss = loss_fn(params, *args, **kwargs)
+        return amp.scale_loss(scaler_state, loss)
+
+    vg = jax.value_and_grad(scaled_loss_fn, **grad_kwargs)
+
+    def wrapped(params, scaler_state, *args, **kwargs):
+        scaled_loss, grads = vg(params, scaler_state, *args, **kwargs)
+        grads, finite = amp.unscale_grads(scaler_state, grads)
+        if amp.scaler is not None:
+            loss = scaled_loss / scaler_state.loss_scale.astype(scaled_loss.dtype)
+            new_state = amp.update_scaler(scaler_state, finite)
+        else:
+            loss, new_state = scaled_loss, scaler_state
+        return loss, grads, new_state, finite
+
+    return wrapped

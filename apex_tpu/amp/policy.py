@@ -1,0 +1,122 @@
+"""Mixed-precision dtype policies (the O0–O3 opt levels).
+
+Reference: ``apex/amp/frontend.py:9-193`` — apex expresses mixed precision
+as a ``Properties`` object selected by opt level and then *imperatively
+patches* torch (function-table monkey-patching for O1, model ``.half()``
+for O2/O3).  Patching a function table is non-idiomatic in JAX: everything
+is traced, so the policy is instead applied *functionally* — cast params to
+the compute dtype at the top of the step, keep an fp32 master copy in the
+optimizer, cast outputs back.  The opt-level names, semantics, and defaults
+are preserved:
+
+======  ==========================  =======================================
+level   reference semantics          apex_tpu semantics
+======  ==========================  =======================================
+O0      fp32 everything              compute=param=fp32, no loss scale
+O1      patch functions to fp16      compute=half (bf16 on TPU), params
+        w/ fp32 weights              stay fp32, cast at op boundaries,
+                                     dynamic loss scale (fp16 only)
+O2      model .half(), fp32 master   params cast to half, fp32 master
+        weights, fp32 batchnorm      weights in optimizer, norm layers
+                                     fp32, dynamic loss scale (fp16 only)
+O3      pure fp16                    compute=param=half, no master weights
+======  ==========================  =======================================
+
+On TPU the natural half dtype is **bfloat16**, which needs no loss
+scaling; ``half_dtype=jnp.float16`` recovers exact apex semantics
+(dynamic scaling on).
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_norm_param(path: str) -> bool:
+    """Heuristic used by ``keep_batchnorm_fp32`` to identify norm params.
+
+    Mirrors apex's rule of keeping ``_BatchNorm`` modules in fp32
+    (``apex/fp16_utils/fp16util.py:60-89``): any param whose pytree path
+    mentions a normalization layer stays in fp32.
+    """
+    p = path.lower()
+    return any(k in p for k in ("batchnorm", "bn", "layernorm", "layer_norm", "groupnorm", "norm", "scale_bias"))
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """A functional mixed-precision policy.
+
+    Attributes mirror ``apex.amp.Properties`` (``apex/amp/frontend.py:9-99``):
+    ``cast_model_type`` -> ``param_dtype``, ``patch_torch_functions`` ->
+    ``cast_compute``, ``keep_batchnorm_fp32`` -> ``keep_norm_fp32``,
+    ``master_weights``, ``loss_scale``.
+    """
+
+    opt_level: str
+    param_dtype: Optional[Any]  # dtype params are stored/cast to (None = leave)
+    compute_dtype: Optional[Any]  # dtype for op inputs (None = leave)
+    keep_norm_fp32: bool
+    master_weights: bool
+    loss_scale: Any  # "dynamic" | float | None
+    is_norm_param: Callable[[str], bool] = _is_norm_param
+
+    # ------------------------------------------------------------------ casts
+    def _cast_tree(self, tree, dtype, respect_norm: bool):
+        if dtype is None:
+            return tree
+
+        def cast(path, x):
+            if not hasattr(x, "dtype") or not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            if respect_norm and self.keep_norm_fp32 and self.is_norm_param(path):
+                return x.astype(jnp.float32)
+            return x.astype(dtype)
+
+        flat = jax.tree_util.tree_flatten_with_path(tree)
+        leaves = [cast(jax.tree_util.keystr(kp), x) for kp, x in flat[0]]
+        return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+    def cast_params(self, params):
+        """Cast a param pytree to the storage dtype (O2/O3 ``model.half()``)."""
+        return self._cast_tree(params, self.param_dtype, respect_norm=True)
+
+    def cast_to_compute(self, tree):
+        """Cast activations/inputs to the compute dtype (O1 patching)."""
+        return self._cast_tree(tree, self.compute_dtype, respect_norm=False)
+
+    def cast_to_fp32(self, tree):
+        return self._cast_tree(tree, jnp.float32, respect_norm=False)
+
+    @property
+    def uses_loss_scaling(self) -> bool:
+        return self.loss_scale is not None
+
+
+def _half(half_dtype):
+    return jnp.bfloat16 if half_dtype is None else half_dtype
+
+
+def get_policy(opt_level: str = "O1", half_dtype=None, loss_scale=None) -> Policy:
+    """Build the policy for an opt level (reference: apex/amp/frontend.py:104-193).
+
+    ``half_dtype`` defaults to bfloat16 (TPU-native).  With bfloat16 the
+    default loss scale is ``None`` (not needed); with float16 it is
+    ``"dynamic"``, matching apex.  An explicit ``loss_scale`` always wins.
+    """
+    h = _half(half_dtype)
+    fp16 = h == jnp.float16
+    default_dynamic = "dynamic" if fp16 else None
+    if opt_level == "O0":
+        pol = Policy("O0", jnp.float32, jnp.float32, False, False, None)
+    elif opt_level == "O1":
+        pol = Policy("O1", None, h, True, False, loss_scale if loss_scale is not None else default_dynamic)
+    elif opt_level == "O2":
+        pol = Policy("O2", h, None, True, True, loss_scale if loss_scale is not None else default_dynamic)
+    elif opt_level == "O3":
+        pol = Policy("O3", h, h, False, False, loss_scale)
+    else:
+        raise ValueError(f"Unexpected optimization level {opt_level!r} (expected O0/O1/O2/O3)")
+    return pol

@@ -1,0 +1,190 @@
+"""Dynamic loss scaling — fully device-side, jit-compatible.
+
+Reference: ``apex/amp/scaler.py:33-217`` (python ``LossScaler`` with fused
+``multi_tensor_scale`` unscale and host-side scale update) and
+``csrc/update_scale_hysteresis.cu:5-47`` (the device-side scale-update
+kernel used by capturable optimizers).
+
+The CUDA-graphs-era "capturable" design — overflow predicate, unscale, and
+scale update all device-resident, optimizer step predicated on the
+overflow flag — is the natural fit for XLA, where the whole train step is
+one compiled program.  That design is adopted here wholesale:
+
+- ``ScalerState`` is a small pytree (scale, growth_tracker, hysteresis).
+- ``unscale`` multiplies grads by ``1/scale`` and returns an
+  ``all_finite`` predicate (replaces the noop_flag buffer).
+- ``update`` applies the exact hysteresis semantics of
+  ``update_scale_hysteresis.cu``: on overflow decrement hysteresis and
+  back off only when exhausted; on ``growth_interval`` consecutive good
+  steps multiply by ``growth_factor``.
+- The *caller* predicates the optimizer step with ``jnp.where`` — see
+  :func:`apex_tpu.optimizers.FusedAdam.update`.
+
+No host synchronization ever happens (the reference does a D2H read per
+step, ``apex/amp/scaler.py:197-217``).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ScalerState(NamedTuple):
+    loss_scale: jnp.ndarray  # f32 scalar
+    growth_tracker: jnp.ndarray  # i32 scalar: consecutive finite steps
+    hysteresis: jnp.ndarray  # i32 scalar: remaining tolerated overflows
+
+
+class DynamicLossScaler:
+    """Device-side dynamic loss scaler.
+
+    Defaults mirror ``apex.amp.scaler.LossScaler`` (init 2**16, factor 2,
+    window 2000; ``apex/amp/scaler.py:38-60``) plus the hysteresis knob of
+    ``update_scale_hysteresis.cu`` (hysteresis=1 reproduces the python
+    scaler exactly).
+    """
+
+    def __init__(
+        self,
+        init_scale: float = 2.0 ** 16,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+        hysteresis: int = 1,
+        min_scale: float = 1.0,
+        max_scale: float = 2.0 ** 24,
+    ):
+        self.init_scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.init_hysteresis = int(hysteresis)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+
+    # ------------------------------------------------------------------ state
+    def init(self) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.float32(self.init_scale),
+            growth_tracker=jnp.int32(0),
+            hysteresis=jnp.int32(self.init_hysteresis),
+        )
+
+    # ------------------------------------------------------------------- ops
+    def scale(self, state: ScalerState, loss):
+        """Scale the loss (do this *before* grad; apex handle.py:113)."""
+        return jax.tree.map(lambda l: l * state.loss_scale.astype(l.dtype), loss)
+
+    def unscale(self, state: ScalerState, grads):
+        """Unscale grads in fp32 and detect non-finite values.
+
+        Mirrors ``LossScaler.unscale`` (apex/amp/scaler.py:94-119): the
+        fp16->fp32 unscale-copy into master grads, with inf/nan detection
+        folded into the same pass (multi_tensor_scale's noop_flag).
+        Returns ``(unscaled_grads_fp32, all_finite)``.
+        """
+        inv = 1.0 / state.loss_scale
+
+        def unscale_one(g):
+            return g.astype(jnp.float32) * inv
+
+        out = jax.tree.map(unscale_one, grads)
+        finite = all_finite(out)
+        return out, finite
+
+    def update(self, state: ScalerState, all_finite_flag) -> ScalerState:
+        """Exact ``update_scale_hysteresis.cu:5-47`` semantics, branch-free.
+
+        if !all_finite: hysteresis -= 1; if hysteresis <= 0:
+            scale = max(scale*backoff, min); growth_tracker = 0
+        else: growth_tracker += 1; if growth_tracker == interval:
+            scale = min(scale*growth, max); growth_tracker = 0;
+            hysteresis reset
+        """
+        finite = jnp.asarray(all_finite_flag)
+        scale, tracker, hyst = state
+
+        # Overflow branch.
+        new_hyst_of = hyst - 1
+        do_backoff = new_hyst_of <= 0
+        scale_of = jnp.where(
+            do_backoff,
+            jnp.maximum(scale * self.backoff_factor, self.min_scale),
+            scale,
+        )
+        hyst_of = jnp.where(do_backoff, jnp.int32(self.init_hysteresis), new_hyst_of)
+        tracker_of = jnp.int32(0)
+
+        # Finite branch.
+        new_tracker = tracker + 1
+        do_growth = new_tracker >= self.growth_interval
+        scale_ok = jnp.where(
+            do_growth,
+            jnp.minimum(scale * self.growth_factor, self.max_scale),
+            scale,
+        )
+        tracker_ok = jnp.where(do_growth, jnp.int32(0), new_tracker)
+        hyst_ok = jnp.int32(self.init_hysteresis)
+
+        return ScalerState(
+            loss_scale=jnp.where(finite, scale_ok, scale_of),
+            growth_tracker=jnp.where(finite, tracker_ok, tracker_of),
+            hysteresis=jnp.where(finite, hyst_ok, hyst_of),
+        )
+
+    # ------------------------------------------------------ state_dict parity
+    def state_dict(self, state: ScalerState):
+        """Reference: apex/amp/frontend.py:365-376 (amp.state_dict)."""
+        return {
+            "loss_scale": float(state.loss_scale),
+            "growth_tracker": int(state.growth_tracker),
+            "hysteresis": int(state.hysteresis),
+        }
+
+    def load_state_dict(self, d) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.float32(d["loss_scale"]),
+            growth_tracker=jnp.int32(d["growth_tracker"]),
+            hysteresis=jnp.int32(d.get("hysteresis", self.init_hysteresis)),
+        )
+
+
+class StaticLossScaler:
+    """Constant loss scale (``loss_scale=<float>`` opt; apex frontend)."""
+
+    def __init__(self, scale: float = 1.0):
+        self._scale = float(scale)
+
+    def init(self) -> ScalerState:
+        return ScalerState(jnp.float32(self._scale), jnp.int32(0), jnp.int32(0))
+
+    def scale(self, state, loss):
+        return jax.tree.map(lambda l: l * state.loss_scale.astype(l.dtype), loss)
+
+    def unscale(self, state, grads):
+        inv = 1.0 / state.loss_scale
+        out = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        return out, all_finite(out)
+
+    def update(self, state, all_finite_flag):
+        return state
+
+    def state_dict(self, state):
+        return {"loss_scale": float(state.loss_scale)}
+
+    def load_state_dict(self, d):
+        return ScalerState(jnp.float32(d["loss_scale"]), jnp.int32(0), jnp.int32(0))
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """True iff every element of every leaf is finite (no inf/nan).
+
+    The functional replacement for the reference's ``noop_flag``/
+    ``_overflow_buf`` (``csrc/multi_tensor_scale_kernel.cu``).
+    """
+    leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
+    if not leaves:
+        return jnp.bool_(True)
+    flags = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(flags).all()

@@ -1,0 +1,43 @@
+"""API-parity shim for ``apex.multi_tensor_apply``.
+
+Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py:3-30``.  The
+chunked dispatch machinery is unnecessary under XLA; ``multi_tensor_applier``
+here simply calls the op with the tensor lists.  Kept so reference users
+find the familiar entry point.
+"""
+
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    tree_not_finite,
+)
+
+
+class MultiTensorApply:
+    """Callable matching ``multi_tensor_applier(op, noop_flag, lists, *args)``.
+
+    ``noop_flag`` is ignored on input (XLA is functional); the op's returned
+    ``found_inf`` plays its role.
+    """
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists, *args):
+        return op(*tensor_lists, *args)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)
+
+__all__ = [
+    "MultiTensorApply",
+    "multi_tensor_applier",
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+    "tree_not_finite",
+]

@@ -1,0 +1,20 @@
+"""Kernel/ops layer: pytree multi-tensor primitives, Pallas kernels, and
+fused composites.  Reference: ``csrc/`` (see SURVEY.md §2.2)."""
+
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_norm_blend,
+    multi_tensor_scale,
+    tree_not_finite,
+    tree_where,
+)
+
+__all__ = [
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+    "multi_tensor_norm_blend",
+    "tree_not_finite",
+    "tree_where",
+]

@@ -1,0 +1,27 @@
+"""Fused optimizers (reference: ``apex/optimizers``).
+
+Each optimizer is a pure pytree transform with exact reference numerics
+(fp32 math regardless of storage dtype), device-side predicated updates
+(the capturable/noop_flag design), and optional fp32 master weights.
+"""
+
+from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam
+from apex_tpu.optimizers.fused_adagrad import AdagradState, FusedAdagrad
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, LambState
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad, NovoGradState
+from apex_tpu.optimizers.fused_sgd import FusedSGD, SGDState
+from apex_tpu.optimizers.fused_mixed_precision_lamb import FusedMixedPrecisionLamb
+
+__all__ = [
+    "FusedAdam",
+    "AdamState",
+    "FusedLAMB",
+    "LambState",
+    "FusedSGD",
+    "SGDState",
+    "FusedNovoGrad",
+    "NovoGradState",
+    "FusedAdagrad",
+    "AdagradState",
+    "FusedMixedPrecisionLamb",
+]

@@ -1,0 +1,88 @@
+"""Shared machinery for the fused optimizers.
+
+Reference: ``apex/optimizers/*`` — each optimizer gathers params into
+dtype-grouped flat lists and fires one multi-tensor CUDA kernel.  On TPU
+the whole step is one XLA program, so each optimizer here is a pure
+function over pytrees; "fused" survives as (a) math done in fp32 regardless
+of storage dtype, exactly as the kernels' ``MATH_T=float``, (b) a single
+jit region with no host sync, and (c) the capturable design: the update is
+*predicated* on a device-resident ``grads_finite`` flag instead of a host
+decision (``fused_adam.py:199-263``, ``multi_tensor_adam.cu:130``).
+
+Master weights: when params are stored in half precision and
+``master_weights=True``, an fp32 master copy lives in the optimizer state;
+math reads/writes the master and the returned params are the master cast
+back to storage dtype (reference: ``AdamCapturableMasterFunctor``,
+``multi_tensor_adam.cu:243``; ``fp16_utils/fp16_optimizer.py``).
+"""
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+Tree = Any
+
+
+def is_half(x) -> bool:
+    return x.dtype in (jnp.float16, jnp.bfloat16)
+
+
+def make_master(params: Tree, master_weights: bool) -> Optional[Tree]:
+    """fp32 master copy of half params (None leaves where already fp32)."""
+    if not master_weights:
+        return None
+    return jax.tree.map(lambda p: p.astype(jnp.float32), params)
+
+
+def math_params(params: Tree, master: Optional[Tree]) -> Tree:
+    """The tree the optimizer math should read (master if present)."""
+    return master if master is not None else params
+
+
+def emit_params(new_math_params: Tree, params: Tree, master: Optional[Tree]):
+    """Return (new_params_in_storage_dtype, new_master)."""
+    if master is None:
+        return jax.tree.map(lambda n, p: n.astype(p.dtype), new_math_params, params), None
+    new_params = jax.tree.map(lambda m, p: m.astype(p.dtype), new_math_params, params)
+    return new_params, new_math_params
+
+
+def predicate_step(grads_finite, step: jnp.ndarray) -> jnp.ndarray:
+    """step advances only on finite grads (fused_adam.py:262:
+    ``group['step'] += (_dummy_overflow_buf != 1)``)."""
+    if grads_finite is None:
+        return step + 1
+    return step + jnp.asarray(grads_finite).astype(step.dtype)
+
+
+def select(grads_finite, new: Tree, old: Tree) -> Tree:
+    """Predicated commit: keep old values on overflow (noop_flag set)."""
+    if grads_finite is None:
+        return new
+    pred = jnp.asarray(grads_finite)
+    return jax.tree.map(lambda n, o: jnp.where(pred, n, o.astype(n.dtype)), new, old)
+
+
+def f32(tree: Tree) -> Tree:
+    return jax.tree.map(lambda x: x.astype(jnp.float32), tree)
+
+
+class OptimizerBase:
+    """Common constructor plumbing.  Subclasses define init/update."""
+
+    def __init__(self, lr: float, weight_decay: float = 0.0, master_weights: bool = False):
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.master_weights = master_weights
+
+    # optax-style aliases so these slot into optax training loops
+    def init(self, params):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def update(self, grads, state, params, **kw):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def step(self, grads, state, params, **kw):
+        """Alias matching the reference's ``optimizer.step()`` naming."""
+        return self.update(grads, state, params, **kw)

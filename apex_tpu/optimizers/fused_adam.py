@@ -1,0 +1,104 @@
+"""FusedAdam — Adam/AdamW with exact reference numerics.
+
+Reference: ``apex/optimizers/fused_adam.py:4-276`` (driver) and
+``csrc/multi_tensor_adam.cu`` (AdamFunctor :24, AdamCapturableFunctor
+:130, AdamCapturableMasterFunctor :243).
+
+Numerics (MATH_T = fp32, per element):
+
+- L2 mode (``adam_w_mode=False``, ADAM_MODE_0): ``g += wd*p`` before the
+  moment updates.
+- AdamW mode (default, ADAM_MODE_1): ``update = m̂/(sqrt(v̂)+eps) + wd*p``.
+- ``m̂ = m/(1-β1^t)``, ``v̂ = v/(1-β2^t)`` when ``bias_correction``.
+
+The capturable behavior is default here: pass ``grads_finite`` (from
+:meth:`apex_tpu.amp.DynamicLossScaler.unscale`) and the whole step —
+including the step counter — commits only when grads are finite, exactly
+like the reference's device-side noop_flag path.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers import base
+
+
+class AdamState(NamedTuple):
+    step: jnp.ndarray  # i32 scalar
+    exp_avg: Any  # m, fp32
+    exp_avg_sq: Any  # v, fp32
+    master: Optional[Any] = None  # fp32 master params (if enabled)
+
+
+class FusedAdam(base.OptimizerBase):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+        master_weights: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        super().__init__(lr, weight_decay, master_weights)
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+
+    def init(self, params) -> AdamState:
+        zeros = lambda t: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t)
+        return AdamState(
+            step=jnp.int32(0),
+            exp_avg=zeros(params),
+            exp_avg_sq=zeros(params),
+            master=base.make_master(params, self.master_weights),
+        )
+
+    def update(self, grads, state: AdamState, params, grads_finite=None, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+
+        step = base.predicate_step(grads_finite, state.step)
+        t = step.astype(jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - jnp.power(b1, t)
+            bc2 = 1.0 - jnp.power(b2, t)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        p_math = base.math_params(params, state.master)
+
+        def one(g, p, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if not self.adam_w_mode:  # ADAM_MODE_0: L2 regularization
+                g = g + wd * p32
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            denom = jnp.sqrt(v_new / bc2) + eps
+            update = (m_new / bc1) / denom
+            if self.adam_w_mode:  # ADAM_MODE_1: decoupled weight decay
+                update = update + wd * p32
+            return p32 - lr * update, m_new, v_new
+
+        out = jax.tree.map(one, grads, p_math, state.exp_avg, state.exp_avg_sq)
+        # unzip the 3-tuples
+        treedef = jax.tree.structure(grads)
+        flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
+        p_new = jax.tree.unflatten(treedef, [x[0] for x in flat])
+        m_new = jax.tree.unflatten(treedef, [x[1] for x in flat])
+        v_new = jax.tree.unflatten(treedef, [x[2] for x in flat])
+
+        p_new = base.select(grads_finite, p_new, p_math)
+        m_new = base.select(grads_finite, m_new, state.exp_avg)
+        v_new = base.select(grads_finite, v_new, state.exp_avg_sq)
+
+        new_params, new_master = base.emit_params(p_new, params, state.master)
+        return new_params, AdamState(step, m_new, v_new, new_master)

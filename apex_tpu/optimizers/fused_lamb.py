@@ -1,0 +1,133 @@
+"""FusedLAMB — layerwise-adaptive large-batch optimizer.
+
+Reference: ``apex/optimizers/fused_lamb.py`` (driver: global grad norm
+blended across dtype groups, :120-183) and ``csrc/multi_tensor_lamb.cu``
+(LAMBStage1Functor :41, LAMBStage2Functor :233, host :330-410).
+
+Two-phase semantics reproduced exactly:
+
+1. Global grad-norm clipping: ``clip = gn/max_grad_norm if gn > max else 1``;
+   every grad is divided by ``clip``.
+2. Stage 1 per element: Adam-style moments on the clipped grad
+   (``m = β1·m + β3·g`` with ``β3 = 1-β1`` if ``grad_averaging``), update
+   ``u = m̂/(sqrt(v̂)+eps) (+ wd·p)`` (L2 mode folds wd into g instead).
+3. Stage 2 per tensor: trust ratio ``r = ‖p‖/‖u‖`` applied when
+   ``use_nvlamb or wd != 0`` and both norms are nonzero;
+   ``p -= lr·r·u``.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers import base
+
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+    master: Optional[Any] = None
+
+
+class FusedLAMB(base.OptimizerBase):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        master_weights: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        super().__init__(lr, weight_decay, master_weights)
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def init(self, params) -> LambState:
+        zeros = lambda t: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t)
+        return LambState(
+            step=jnp.int32(0),
+            exp_avg=zeros(params),
+            exp_avg_sq=zeros(params),
+            master=base.make_master(params, self.master_weights),
+        )
+
+    def update(self, grads, state: LambState, params, grads_finite=None, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        b3 = (1.0 - b1) if self.grad_averaging else 1.0
+
+        step = base.predicate_step(grads_finite, state.step)
+        t = step.astype(jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - jnp.power(b1, t)
+            bc2 = 1.0 - jnp.power(b2, t)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        # Global grad norm over every param (fused_lamb.py:121-136).
+        g32 = base.f32(grads)
+        sq = [jnp.sum(jnp.square(g)) for g in jax.tree.leaves(g32)]
+        global_grad_norm = jnp.sqrt(jnp.stack(sq).sum())
+        clip = jnp.where(
+            global_grad_norm > self.max_grad_norm,
+            global_grad_norm / self.max_grad_norm,
+            jnp.float32(1.0),
+        )
+
+        p_math = base.math_params(params, state.master)
+
+        def stage1(g, p, m, v):
+            g = g.astype(jnp.float32) / clip
+            p32 = p.astype(jnp.float32)
+            if not self.adam_w_mode:  # MOMENT_MODE_0: L2 on scaled grad
+                g = g + wd * p32
+            m_new = b1 * m + b3 * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if self.adam_w_mode:  # MOMENT_MODE_1: decoupled
+                u = u + wd * p32
+            return u, m_new, v_new
+
+        out = jax.tree.map(stage1, grads, p_math, state.exp_avg, state.exp_avg_sq)
+        treedef = jax.tree.structure(grads)
+        flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
+        updates = jax.tree.unflatten(treedef, [x[0] for x in flat])
+        m_new = jax.tree.unflatten(treedef, [x[1] for x in flat])
+        v_new = jax.tree.unflatten(treedef, [x[2] for x in flat])
+
+        # Stage 2: per-tensor trust ratio (multi_tensor_lamb.cu:255-262).
+        def stage2(p, u):
+            p32 = p.astype(jnp.float32)
+            if self.use_nvlamb or wd != 0.0:
+                p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+                u_norm = jnp.sqrt(jnp.sum(jnp.square(u)))
+                ratio = jnp.where(
+                    (p_norm != 0.0) & (u_norm != 0.0), lr * (p_norm / u_norm), lr
+                )
+            else:
+                ratio = lr
+            return p32 - ratio * u
+
+        p_new = jax.tree.map(stage2, p_math, updates)
+
+        p_new = base.select(grads_finite, p_new, p_math)
+        m_new = base.select(grads_finite, m_new, state.exp_avg)
+        v_new = base.select(grads_finite, v_new, state.exp_avg_sq)
+
+        new_params, new_master = base.emit_params(p_new, params, state.master)
+        return new_params, LambState(step, m_new, v_new, new_master)

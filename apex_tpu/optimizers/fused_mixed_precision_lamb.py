@@ -1,0 +1,21 @@
+"""FusedMixedPrecisionLamb — LAMB with device-resident hyperparams and
+fp32 master weights.
+
+Reference: ``apex/optimizers/fused_mixed_precision_lamb.py:8`` — the
+fully-capturable LAMB variant (``multi_tensor_lamb_mp.cu``): lr/step live
+on device as tensors, model params are half with fp32 masters, and the
+step is predicated on the overflow flag.
+
+In apex_tpu every optimizer already has those properties (state is a
+device pytree, ``lr`` may be a traced scalar, ``grads_finite`` predicates
+the commit), so this is :class:`~apex_tpu.optimizers.FusedLAMB` with
+``master_weights=True`` by default.  Kept as its own class for API parity.
+"""
+
+from apex_tpu.optimizers.fused_lamb import FusedLAMB
+
+
+class FusedMixedPrecisionLamb(FusedLAMB):
+    def __init__(self, *args, master_weights: bool = True, **kwargs):
+        kwargs["master_weights"] = master_weights
+        super().__init__(*args, **kwargs)

@@ -1,0 +1,12 @@
+"""Shared utilities: logging, math helpers, pytree helpers."""
+
+from apex_tpu.utils.logging import RankInfoFormatter, get_logger, set_logging_level
+from apex_tpu.utils.misc import divide, ensure_divisibility
+
+__all__ = [
+    "RankInfoFormatter",
+    "get_logger",
+    "set_logging_level",
+    "divide",
+    "ensure_divisibility",
+]

@@ -1,0 +1,45 @@
+"""Rank-aware logging.
+
+Reference: ``apex/__init__.py:32-43`` (``RankInfoFormatter``) and
+``apex/transformer/log_util.py``.  On TPU the "rank" is the JAX process
+index plus the local device set, read lazily so logging works before
+``jax.distributed.initialize``.
+"""
+
+import logging
+import sys
+
+
+def _rank_info() -> str:
+    try:
+        import jax
+
+        return f"[p{jax.process_index()}/{jax.process_count()}]"
+    except Exception:
+        return "[p?/?]"
+
+
+class RankInfoFormatter(logging.Formatter):
+    """Prepends JAX process/rank info to every record."""
+
+    def format(self, record):
+        record.rank_info = _rank_info()
+        return super().format(record)
+
+
+_FORMAT = "%(asctime)s %(rank_info)s %(name)s %(levelname)s: %(message)s"
+
+
+def get_logger(name: str = "apex_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(RankInfoFormatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger
+
+
+def set_logging_level(level) -> None:
+    """Reference: apex/transformer/log_util.py (set_logging_level)."""
+    get_logger().setLevel(level)

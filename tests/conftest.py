@@ -1,0 +1,33 @@
+"""Test configuration: run everything on a virtual 8-device CPU platform.
+
+Mirrors the reference's test strategy (SURVEY.md §4): distributed
+correctness is established by comparing a parallel run against a
+single-device oracle.  Multi-chip hardware isn't needed —
+``xla_force_host_platform_device_count=8`` gives 8 CPU devices for
+``jax.sharding.Mesh`` tests.
+"""
+
+import os
+
+# Must be set before the first JAX backend call.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+# Neutralize the axon TPU-tunnel sitecustomize for tests.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("need 8 virtual devices")
+    return devs[:8]

@@ -1,0 +1,145 @@
+"""amp tests — mirrors tests/L0/run_amp of the reference (cast checks,
+loss-scaler dynamics, update_scale_hysteresis parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+class TestPolicy:
+    def test_opt_levels_exist(self):
+        for lvl in ("O0", "O1", "O2", "O3"):
+            p = amp.get_policy(lvl)
+            assert p.opt_level == lvl
+
+    def test_bad_level_raises(self):
+        with pytest.raises(ValueError):
+            amp.get_policy("O4")
+
+    def test_o2_casts_params_keeps_norms_fp32(self):
+        params = {
+            "dense": {"kernel": jnp.ones((4, 4), jnp.float32)},
+            "batchnorm_0": {"scale": jnp.ones((4,), jnp.float32)},
+        }
+        pol = amp.get_policy("O2")
+        cast = pol.cast_params(params)
+        assert cast["dense"]["kernel"].dtype == jnp.bfloat16
+        assert cast["batchnorm_0"]["scale"].dtype == jnp.float32
+
+    def test_o3_casts_everything(self):
+        params = {"bn": jnp.ones((4,), jnp.float32), "w": jnp.ones((4,), jnp.float32)}
+        cast = amp.get_policy("O3").cast_params(params)
+        assert cast["bn"].dtype == jnp.bfloat16
+        assert cast["w"].dtype == jnp.bfloat16
+
+    def test_o0_noop(self):
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        cast = amp.get_policy("O0").cast_params(params)
+        assert cast["w"].dtype == jnp.float32
+
+    def test_fp16_enables_dynamic_scaling(self):
+        p = amp.get_policy("O2", half_dtype=jnp.float16)
+        assert p.loss_scale == "dynamic"
+        p = amp.get_policy("O2")  # bf16 default
+        assert p.loss_scale is None
+
+
+class TestDynamicLossScaler:
+    def test_init(self):
+        s = amp.DynamicLossScaler()
+        st = s.init()
+        assert float(st.loss_scale) == 2.0 ** 16
+
+    def test_backoff_on_overflow(self):
+        s = amp.DynamicLossScaler(init_scale=2.0 ** 10)
+        st = s.init()
+        st = s.update(st, jnp.bool_(False))
+        assert float(st.loss_scale) == 2.0 ** 9
+        assert int(st.growth_tracker) == 0
+
+    def test_growth_after_interval(self):
+        s = amp.DynamicLossScaler(init_scale=4.0, growth_interval=3)
+        st = s.init()
+        for _ in range(2):
+            st = s.update(st, jnp.bool_(True))
+            assert float(st.loss_scale) == 4.0
+        st = s.update(st, jnp.bool_(True))
+        assert float(st.loss_scale) == 8.0
+        assert int(st.growth_tracker) == 0
+
+    def test_hysteresis(self):
+        # hysteresis=2: first overflow tolerated, second backs off
+        s = amp.DynamicLossScaler(init_scale=16.0, hysteresis=2)
+        st = s.init()
+        st = s.update(st, jnp.bool_(False))
+        assert float(st.loss_scale) == 16.0
+        st = s.update(st, jnp.bool_(False))
+        assert float(st.loss_scale) == 8.0
+        # a finite step resets hysteresis
+        st = s.update(st, jnp.bool_(True))
+        st = s.update(st, jnp.bool_(False))
+        assert float(st.loss_scale) == 8.0
+
+    def test_min_scale_floor(self):
+        s = amp.DynamicLossScaler(init_scale=2.0)
+        st = s.init()
+        for _ in range(5):
+            st = s.update(st, jnp.bool_(False))
+        assert float(st.loss_scale) == 1.0
+
+    def test_unscale_detects_inf(self):
+        s = amp.DynamicLossScaler(init_scale=4.0)
+        st = s.init()
+        grads = {"w": jnp.array([1.0, jnp.inf])}
+        out, finite = s.unscale(st, grads)
+        assert not bool(finite)
+        grads = {"w": jnp.array([1.0, 2.0])}
+        out, finite = s.unscale(st, grads)
+        assert bool(finite)
+        np.testing.assert_allclose(np.asarray(out["w"]), [0.25, 0.5])
+
+    def test_update_is_jittable(self):
+        s = amp.DynamicLossScaler()
+        st = s.init()
+        st = jax.jit(s.update)(st, jnp.bool_(True))
+        assert int(st.growth_tracker) == 1
+
+    def test_state_dict_roundtrip(self):
+        s = amp.DynamicLossScaler()
+        st = s.init()
+        st = s.update(st, jnp.bool_(False))
+        st2 = s.load_state_dict(s.state_dict(st))
+        assert float(st2.loss_scale) == float(st.loss_scale)
+
+
+class TestValueAndGrad:
+    def test_fp16_pipeline_skips_on_overflow(self):
+        params = {"w": jnp.array([2.0], jnp.float32)}
+        cast_params, a = amp.initialize(params, opt_level="O1", half_dtype=jnp.float16)
+        st = a.init_state()
+
+        def loss_fn(p, x):
+            return jnp.sum(p["w"] * x)
+
+        vg = amp.value_and_grad(a, loss_fn)
+        loss, grads, st, finite = vg(cast_params, st, jnp.array([3.0]))
+        assert bool(finite)
+        np.testing.assert_allclose(float(loss), 6.0, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(grads["w"]), [3.0], rtol=1e-3)
+
+        # poisoned input → non-finite grads flagged, scale halves
+        loss, grads, st2, finite = vg(cast_params, st, jnp.array([jnp.inf]))
+        assert not bool(finite)
+        assert float(st2.loss_scale) == float(st.loss_scale) / 2
+
+    def test_bf16_no_scaler(self):
+        params = {"w": jnp.array([2.0], jnp.float32)}
+        cast_params, a = amp.initialize(params, opt_level="O2")
+        assert a.scaler is None
+        vg = amp.value_and_grad(a, lambda p, x: jnp.sum(p["w"] * x))
+        loss, grads, st, finite = vg(cast_params, None, jnp.array([3.0]))
+        assert bool(finite)
+        assert grads["w"].dtype == jnp.float32
