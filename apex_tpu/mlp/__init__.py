@@ -1,0 +1,5 @@
+"""Fused whole-MLP (reference: ``apex/mlp``)."""
+
+from apex_tpu.mlp.mlp import MLP, mlp_function
+
+__all__ = ["MLP", "mlp_function"]

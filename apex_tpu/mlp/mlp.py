@@ -1,0 +1,70 @@
+"""Fused MLP: N dense layers with bias + relu/sigmoid epilogues.
+
+Reference: ``apex/mlp/mlp.py`` (MlpFunction :11, MLP module :33) over
+``csrc/mlp_cuda.cu`` (a C++ loop of cuBLAS GEMMs with fused
+bias+activation epilogues and a workspace).  Under XLA the whole chain is
+one compiled program — each dot hits the MXU and bias/activation fuse
+into it — so the TPU-native form is a composite; no workspace management
+is needed.
+
+Weights use the reference layout ``(out, in)``.
+"""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _activation(name):
+    if name == "relu":
+        return jax.nn.relu
+    if name == "sigmoid":
+        return jax.nn.sigmoid
+    if name == "none":
+        return lambda x: x
+    raise ValueError(f"Unsupported activation {name!r} (relu/sigmoid/none)")
+
+
+def mlp_function(x, weights, biases, activation: str = "relu"):
+    """Apply the full MLP (reference MlpFunction semantics: activation on
+    every layer except the last)."""
+    act = _activation(activation)
+    n = len(weights)
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        x = jnp.matmul(x, w.T.astype(x.dtype))
+        if b is not None:
+            x = x + b.astype(x.dtype)
+        if i < n - 1:
+            x = act(x)
+    return x
+
+
+class MLP(nn.Module):
+    """Module parity with ``apex.mlp.MLP(mlp_sizes, bias, activation)``."""
+
+    mlp_sizes: Sequence[int]  # [in, hidden..., out]
+    use_bias: bool = True
+    activation: str = "relu"
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        weights, biases = [], []
+        for i in range(len(self.mlp_sizes) - 1):
+            fan_in, fan_out = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+            w = self.param(
+                f"weight_{i}",
+                nn.initializers.uniform(scale=2.0 / (fan_in + fan_out)),
+                (fan_out, fan_in),
+                self.param_dtype,
+            )
+            b = (
+                self.param(f"bias_{i}", nn.initializers.zeros, (fan_out,), self.param_dtype)
+                if self.use_bias
+                else None
+            )
+            weights.append(w)
+            biases.append(b)
+        return mlp_function(x, weights, biases, self.activation)

@@ -1,0 +1,252 @@
+"""Fused LayerNorm / RMSNorm.
+
+Reference: ``apex/normalization/fused_layer_norm.py`` (autograd Functions
+:32-192, modules :230-468, ``manual_rms_norm`` :16) backed by
+``csrc/layer_norm_cuda_kernel.cu`` (Welford row stats, affine and
+non-affine, mixed input/param dtypes, memory-efficient backward that
+recomputes the input from the output).
+
+TPU design: row statistics and the normalize/affine epilogue are one XLA
+fusion (stats in fp32 regardless of input dtype, matching the kernels'
+accumulation type), wrapped in ``jax.custom_vjp`` so the backward can
+implement the *memory-efficient* variant: when ``memory_efficient=True``
+the residuals are ``(output, invvar)`` and x̂ is recomputed as
+``(y - b)/w`` (LayerNorm) or ``y/w`` (RMSNorm) — the input is never
+saved, halving activation memory, exactly as the reference kernels do.
+A Pallas kernel path (:mod:`apex_tpu.ops.layer_norm_pallas`) is used on
+TPU for long rows; the math here is the specification and fallback.
+"""
+
+import numbers
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _canon_shape(normalized_shape):
+    if isinstance(normalized_shape, numbers.Integral):
+        return (int(normalized_shape),)
+    return tuple(int(s) for s in normalized_shape)
+
+
+def _rows_view(x, normalized_shape):
+    n = int(np.prod(normalized_shape))
+    lead = x.shape[: x.ndim - len(normalized_shape)]
+    return x.reshape((-1, n)), lead, n
+
+
+def manual_rms_norm(x, normalized_shape, weight, eps):
+    """Pure reference (apex/normalization/fused_layer_norm.py:16-29)."""
+    dims = tuple(range(-len(_canon_shape(normalized_shape)), 0))
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=dims, keepdims=True)
+    out = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
+    if weight is None:
+        return out
+    return out * weight
+
+
+# =============================================================== layer norm
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _layer_norm(x, weight, bias, normalized_shape, eps, memory_efficient):
+    out, _, _ = _ln_fwd_impl(x, weight, bias, normalized_shape, eps)
+    return out
+
+
+def _ln_fwd_impl(x, weight, bias, normalized_shape, eps):
+    x2, lead, n = _rows_view(x, normalized_shape)
+    xf = x2.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=1, keepdims=True)
+    invvar = jax.lax.rsqrt(var + eps)
+    xhat = (xf - mean) * invvar
+    y = xhat
+    if weight is not None:
+        y = y * weight.reshape(1, n).astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.reshape(1, n).astype(jnp.float32)
+    out = y.astype(x.dtype).reshape(x.shape)
+    return out, mean[:, 0], invvar[:, 0]
+
+
+def _ln_fwd(x, weight, bias, normalized_shape, eps, memory_efficient):
+    out, mean, invvar = _ln_fwd_impl(x, weight, bias, normalized_shape, eps)
+    if memory_efficient:
+        res = (out, None, invvar, weight, bias)
+    else:
+        res = (x, mean, invvar, weight, bias)
+    return out, res
+
+
+def _ln_bwd(normalized_shape, eps, memory_efficient, res, g):
+    saved, mean, invvar, weight, bias = res
+    g2, lead, n = _rows_view(g, normalized_shape)
+    gf = g2.astype(jnp.float32)
+    inv = invvar[:, None]
+
+    if memory_efficient:
+        yf = saved.reshape((-1, n)).astype(jnp.float32)
+        if bias is not None:
+            yf = yf - bias.reshape(1, n).astype(jnp.float32)
+        if weight is not None:
+            xhat = yf / weight.reshape(1, n).astype(jnp.float32)
+        else:
+            xhat = yf
+    else:
+        xf = saved.reshape((-1, n)).astype(jnp.float32)
+        xhat = (xf - mean[:, None]) * inv
+
+    if weight is not None:
+        gw = gf * weight.reshape(1, n).astype(jnp.float32)
+    else:
+        gw = gf
+
+    m1 = jnp.mean(gw, axis=1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=1, keepdims=True)
+    dx = (gw - m1 - xhat * m2) * inv
+    dx = dx.astype(g.dtype).reshape(g.shape)
+
+    if weight is not None:
+        dw = jnp.sum(gf * xhat, axis=0).reshape(weight.shape).astype(weight.dtype)
+    else:
+        dw = None
+    if bias is not None:
+        db = jnp.sum(gf, axis=0).reshape(bias.shape).astype(bias.dtype)
+    else:
+        db = None
+    return dx, dw, db
+
+
+_layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ================================================================ rms norm
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _rms_norm(x, weight, normalized_shape, eps, memory_efficient):
+    out, _ = _rms_fwd_impl(x, weight, normalized_shape, eps)
+    return out
+
+
+def _rms_fwd_impl(x, weight, normalized_shape, eps):
+    x2, lead, n = _rows_view(x, normalized_shape)
+    xf = x2.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=1, keepdims=True)
+    invvar = jax.lax.rsqrt(var + eps)
+    y = xf * invvar
+    if weight is not None:
+        y = y * weight.reshape(1, n).astype(jnp.float32)
+    return y.astype(x.dtype).reshape(x.shape), invvar[:, 0]
+
+
+def _rms_fwd(x, weight, normalized_shape, eps, memory_efficient):
+    out, invvar = _rms_fwd_impl(x, weight, normalized_shape, eps)
+    res = (out if memory_efficient else x, invvar, weight)
+    return out, res
+
+
+def _rms_bwd(normalized_shape, eps, memory_efficient, res, g):
+    saved, invvar, weight = res
+    g2, lead, n = _rows_view(g, normalized_shape)
+    gf = g2.astype(jnp.float32)
+    inv = invvar[:, None]
+
+    if memory_efficient:
+        yf = saved.reshape((-1, n)).astype(jnp.float32)
+        xhat = yf / weight.reshape(1, n).astype(jnp.float32) if weight is not None else yf
+    else:
+        xhat = saved.reshape((-1, n)).astype(jnp.float32) * inv
+
+    gw = gf * weight.reshape(1, n).astype(jnp.float32) if weight is not None else gf
+    m2 = jnp.mean(gw * xhat, axis=1, keepdims=True)
+    dx = (gw - xhat * m2) * inv
+    dx = dx.astype(g.dtype).reshape(g.shape)
+
+    if weight is not None:
+        dw = jnp.sum(gf * xhat, axis=0).reshape(weight.shape).astype(weight.dtype)
+    else:
+        dw = None
+    return dx, dw
+
+
+_rms_norm.defvjp(_rms_fwd, _rms_bwd)
+
+
+# ======================================================== public functions
+def fused_layer_norm_affine(input, weight, bias, normalized_shape, eps=1e-6, memory_efficient=False):
+    """Reference: FusedLayerNormAffineFunction (fused_layer_norm.py:32)."""
+    return _layer_norm(input, weight, bias, _canon_shape(normalized_shape), eps, memory_efficient)
+
+
+def fused_layer_norm(input, normalized_shape, eps=1e-6, memory_efficient=False):
+    """Reference: FusedLayerNormFunction (non-affine)."""
+    return _layer_norm(input, None, None, _canon_shape(normalized_shape), eps, memory_efficient)
+
+
+def fused_rms_norm_affine(input, weight, normalized_shape, eps=1e-6, memory_efficient=False):
+    """Reference: FusedRMSNormAffineFunction (fused_layer_norm.py:64)."""
+    return _rms_norm(input, weight, _canon_shape(normalized_shape), eps, memory_efficient)
+
+
+def fused_rms_norm(input, normalized_shape, eps=1e-6, memory_efficient=False):
+    """Reference: FusedRMSNormFunction."""
+    return _rms_norm(input, None, _canon_shape(normalized_shape), eps, memory_efficient)
+
+
+def mixed_dtype_fused_layer_norm_affine(input, weight, bias, normalized_shape, eps=1e-6, memory_efficient=False):
+    """Mixed input/param dtype variant (fused_layer_norm.py:94) — params may
+    be fp32 while the input is half; output keeps the input dtype."""
+    return fused_layer_norm_affine(input, weight, bias, normalized_shape, eps, memory_efficient)
+
+
+def mixed_dtype_fused_rms_norm_affine(input, weight, normalized_shape, eps=1e-6, memory_efficient=False):
+    """Mixed dtype RMSNorm (fused_layer_norm.py:117)."""
+    return fused_rms_norm_affine(input, weight, normalized_shape, eps, memory_efficient)
+
+
+# ================================================================= modules
+import flax.linen as nn
+
+
+class FusedLayerNorm(nn.Module):
+    """Module parity with ``apex.normalization.FusedLayerNorm``
+    (fused_layer_norm.py:230).  Param dtype is fp32 (the "mixed" behavior
+    is the TPU default — inputs may be bf16)."""
+
+    normalized_shape: Sequence[int]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _canon_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, shape, jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, shape, jnp.float32)
+            return fused_layer_norm_affine(x, weight, bias, shape, self.eps, self.memory_efficient)
+        return fused_layer_norm(x, shape, self.eps, self.memory_efficient)
+
+
+class FusedRMSNorm(nn.Module):
+    """Module parity with ``apex.normalization.FusedRMSNorm``
+    (fused_layer_norm.py:329)."""
+
+    normalized_shape: Sequence[int]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _canon_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, shape, jnp.float32)
+            return fused_rms_norm_affine(x, weight, shape, self.eps, self.memory_efficient)
+        return fused_rms_norm(x, shape, self.eps, self.memory_efficient)
+
+
+# Mixed variants are the same computation on TPU (params already fp32).
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm

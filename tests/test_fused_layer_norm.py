@@ -1,0 +1,135 @@
+"""LayerNorm/RMSNorm parity — mirrors
+tests/L0/run_fused_layer_norm/test_fused_layer_norm.py:21 of the
+reference: parity vs framework layer_norm / manual_rms_norm across
+shapes, dtypes, affine and memory-efficient flags, forward and backward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+    manual_rms_norm,
+)
+
+SHAPES = [((4, 16), (16,)), ((2, 3, 32), (32,)), ((5, 4, 6), (4, 6))]
+
+
+def ref_layer_norm(x, shape, w=None, b=None, eps=1e-5):
+    dims = tuple(range(-len(shape), 0))
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=dims, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=dims, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y.astype(x.dtype)
+
+
+@pytest.mark.parametrize("xshape,nshape", SHAPES)
+@pytest.mark.parametrize("memory_efficient", [False, True])
+class TestFusedLayerNorm:
+    def test_forward_affine(self, xshape, nshape, memory_efficient):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(*xshape).astype(np.float32))
+        w = jnp.asarray(rng.rand(*nshape).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(*nshape).astype(np.float32))
+        out = fused_layer_norm_affine(x, w, b, nshape, 1e-5, memory_efficient)
+        ref = ref_layer_norm(x, nshape, w, b)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_backward_affine(self, xshape, nshape, memory_efficient):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(*xshape).astype(np.float32))
+        w = jnp.asarray(rng.rand(*nshape).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(*nshape).astype(np.float32))
+
+        def f(x, w, b):
+            return jnp.sum(jnp.sin(fused_layer_norm_affine(x, w, b, nshape, 1e-5, memory_efficient)))
+
+        def fref(x, w, b):
+            return jnp.sum(jnp.sin(ref_layer_norm(x, nshape, w, b)))
+
+        g = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
+        gr = jax.grad(fref, argnums=(0, 1, 2))(x, w, b)
+        for a, r in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-4, atol=1e-4)
+
+    def test_forward_backward_nonaffine(self, xshape, nshape, memory_efficient):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(*xshape).astype(np.float32))
+        out = fused_layer_norm(x, nshape, 1e-5, memory_efficient)
+        ref = ref_layer_norm(x, nshape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+        g = jax.grad(lambda x: jnp.sum(jnp.sin(fused_layer_norm(x, nshape, 1e-5, memory_efficient))))(x)
+        gr = jax.grad(lambda x: jnp.sum(jnp.sin(ref_layer_norm(x, nshape))))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("xshape,nshape", SHAPES)
+@pytest.mark.parametrize("memory_efficient", [False, True])
+class TestFusedRMSNorm:
+    def test_forward_affine(self, xshape, nshape, memory_efficient):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(*xshape).astype(np.float32))
+        w = jnp.asarray(rng.rand(*nshape).astype(np.float32) + 0.5)
+        out = fused_rms_norm_affine(x, w, nshape, 1e-5, memory_efficient)
+        ref = manual_rms_norm(x, nshape, w, 1e-5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_backward_affine(self, xshape, nshape, memory_efficient):
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(*xshape).astype(np.float32))
+        w = jnp.asarray(rng.rand(*nshape).astype(np.float32) + 0.5)
+
+        def f(x, w):
+            return jnp.sum(jnp.sin(fused_rms_norm_affine(x, w, nshape, 1e-5, memory_efficient)))
+
+        def fref(x, w):
+            return jnp.sum(jnp.sin(manual_rms_norm(x, nshape, w, 1e-5)))
+
+        g = jax.grad(f, argnums=(0, 1))(x, w)
+        gr = jax.grad(fref, argnums=(0, 1))(x, w)
+        for a, r in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-4, atol=1e-4)
+
+    def test_nonaffine(self, xshape, nshape, memory_efficient):
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(*xshape).astype(np.float32))
+        out = fused_rms_norm(x, nshape, 1e-5, memory_efficient)
+        ref = manual_rms_norm(x, nshape, None, 1e-5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+class TestDtypes:
+    def test_bf16_input_fp32_params(self):
+        # MixedFused semantics: bf16 input, fp32 params, bf16 out
+        rng = np.random.RandomState(6)
+        x = jnp.asarray(rng.randn(4, 32).astype(np.float32)).astype(jnp.bfloat16)
+        w = jnp.ones((32,), jnp.float32)
+        b = jnp.zeros((32,), jnp.float32)
+        out = fused_layer_norm_affine(x, w, b, (32,), 1e-5)
+        assert out.dtype == jnp.bfloat16
+
+    def test_modules(self):
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+        m = FusedLayerNorm(normalized_shape=(32,))
+        params = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(params, x)
+        ref = ref_layer_norm(x, (32,))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+        m = FusedRMSNorm(normalized_shape=(32,))
+        params = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(params, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(manual_rms_norm(x, (32,), jnp.ones((32,)), 1e-5)), rtol=1e-5, atol=1e-5
+        )
