@@ -1,0 +1,15 @@
+"""Megatron-style model parallelism for TPU (reference: ``apex/transformer``)."""
+
+from apex_tpu.transformer import parallel_state
+
+__all__ = ["parallel_state"]
+
+
+def __getattr__(name):
+    if name in ("tensor_parallel", "pipeline_parallel", "functional", "layers", "amp", "_data", "testing", "enums", "microbatches"):
+        import importlib
+
+        mod = importlib.import_module(f"apex_tpu.transformer.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'apex_tpu.transformer' has no attribute {name!r}")

@@ -1,0 +1,210 @@
+"""Model-parallel state: the mesh/axis registry.
+
+Reference: ``apex/transformer/parallel_state.py`` —
+``initialize_model_parallel`` (:155) builds NCCL process groups for
+TP/PP/DP (+embedding, amax, ...) and ~50 getters expose ranks/sizes/groups.
+
+TPU-native redesign: process groups become **named axes of one
+``jax.sharding.Mesh``**.  Axis order encodes ICI locality — ``tp``
+innermost (highest-bandwidth neighbor links, collectives every layer),
+then ``cp`` (context/sequence parallelism — a capability beyond the
+reference, SURVEY §2.4), then ``pp`` (point-to-point only), ``dp``
+outermost (least-frequent collectives; on multi-slice deployments the
+``dp`` axis is the one to map onto DCN).  Group membership, sub-group
+creation, and rank bookkeeping all disappear: a collective names its axis,
+and XLA routes it over ICI.
+
+Rank/size getters are preserved with reference names.  Sizes are static
+(mesh shape).  Ranks are meaningful per-device: inside ``shard_map`` they
+are ``jax.lax.axis_index`` (traced); outside they are derived from
+``jax.process_index`` for the host-local view.
+"""
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# Canonical axis names (the TPU equivalents of the reference's groups).
+DATA_AXIS = "dp"
+PIPELINE_AXIS = "pp"
+CONTEXT_AXIS = "cp"
+TENSOR_AXIS = "tp"
+AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+
+@dataclasses.dataclass
+class _State:
+    mesh: Mesh
+    tensor_model_parallel_size: int
+    pipeline_model_parallel_size: int
+    context_parallel_size: int
+    data_parallel_size: int
+    virtual_pipeline_model_parallel_size: Optional[int]
+    pipeline_model_parallel_split_rank: Optional[int]
+    # mutable trace-time bookkeeping (mirrors the reference's globals)
+    virtual_pipeline_model_parallel_rank: Optional[int] = None
+
+
+_STATE: Optional[_State] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    context_parallel_size_: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build and register the global device mesh.
+
+    Reference: ``parallel_state.initialize_model_parallel``
+    (parallel_state.py:155) — argument names kept (trailing underscore and
+    all).  ``context_parallel_size_`` is new (ring-attention axis).
+    Returns the mesh (also retrievable via :func:`get_mesh`).
+    """
+    global _STATE
+    devs = list(devices) if devices is not None else jax.devices()
+    world = len(devs)
+    tp, pp, cp = (
+        int(tensor_model_parallel_size_),
+        int(pipeline_model_parallel_size_),
+        int(context_parallel_size_),
+    )
+    if world % (tp * pp * cp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tp ({tp}) x pp ({pp}) x cp ({cp})"
+        )
+    dp = world // (tp * pp * cp)
+    if virtual_pipeline_model_parallel_size_ is not None and pp < 2:
+        raise RuntimeError(
+            "pipeline-model-parallel size should be greater than 2 with interleaved schedule"
+        )
+
+    arr = np.array(devs).reshape(dp, pp, cp, tp)
+    mesh = Mesh(arr, AXIS_ORDER)
+    _STATE = _State(
+        mesh=mesh,
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        context_parallel_size=cp,
+        data_parallel_size=dp,
+        virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size_,
+        pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank_,
+    )
+    return mesh
+
+
+def model_parallel_is_initialized() -> bool:
+    """Reference: parallel_state.py:404."""
+    return _STATE is not None
+
+
+def _state() -> _State:
+    if _STATE is None:
+        raise RuntimeError("model parallel is not initialized (call initialize_model_parallel)")
+    return _STATE
+
+
+def get_mesh() -> Mesh:
+    return _state().mesh
+
+
+def destroy_model_parallel() -> None:
+    """Reference: parallel_state.py:761."""
+    global _STATE
+    _STATE = None
+
+
+# ------------------------------------------------------------------- sizes
+def get_tensor_model_parallel_world_size() -> int:
+    return _state().tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _state().pipeline_model_parallel_size
+
+
+def get_context_parallel_world_size() -> int:
+    return _state().context_parallel_size
+
+
+def get_data_parallel_world_size() -> int:
+    return _state().data_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _state().virtual_pipeline_model_parallel_size
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _state().pipeline_model_parallel_split_rank
+
+
+# ------------------------------------------------------------------- ranks
+# Inside shard_map these return traced per-device indices; the reference's
+# host-side rank bookkeeping has no other TPU analog.
+def get_tensor_model_parallel_rank():
+    return jax.lax.axis_index(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPELINE_AXIS)
+
+
+def get_context_parallel_rank():
+    return jax.lax.axis_index(CONTEXT_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    """Trace-time virtual-stage cursor (reference: parallel_state.py:679)."""
+    return _state().virtual_pipeline_model_parallel_rank
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    _state().virtual_pipeline_model_parallel_rank = rank
+
+
+# ------------------------------------------------- stage predicates (static)
+def is_pipeline_first_stage(ignore_virtual: bool = False, stage: Optional[int] = None):
+    """Static form: pass ``stage`` (the pp index of the program being
+    built).  Reference: parallel_state.py:508."""
+    if not ignore_virtual:
+        vpp = _state().virtual_pipeline_model_parallel_size
+        if vpp is not None and _state().virtual_pipeline_model_parallel_rank not in (None, 0):
+            return False
+    if stage is None:
+        raise ValueError("pass stage= (static pipeline stage index)")
+    return stage == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False, stage: Optional[int] = None):
+    if not ignore_virtual:
+        vpp = _state().virtual_pipeline_model_parallel_size
+        if vpp is not None and _state().virtual_pipeline_model_parallel_rank not in (
+            None,
+            vpp - 1,
+        ):
+            return False
+    if stage is None:
+        raise ValueError("pass stage= (static pipeline stage index)")
+    return stage == _state().pipeline_model_parallel_size - 1
+
+
+def get_rank_info() -> str:
+    """Debug string (reference: parallel_state.py:421)."""
+    if _STATE is None:
+        return "model parallel not initialized"
+    s = _state()
+    return (
+        f"tp={s.tensor_model_parallel_size} pp={s.pipeline_model_parallel_size} "
+        f"cp={s.context_parallel_size} dp={s.data_parallel_size}"
+    )

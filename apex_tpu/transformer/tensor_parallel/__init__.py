@@ -1,0 +1,61 @@
+"""Megatron-style tensor parallelism over a mesh axis.
+
+Reference: ``apex/transformer/tensor_parallel`` (SURVEY.md §2.1).
+"""
+
+from apex_tpu.transformer.tensor_parallel.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data, broadcast_from_rank0
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.random import (
+    RNGStatesTracker,
+    checkpoint,
+    get_rng_state_tracker,
+    model_parallel_cuda_manual_seed,
+    model_parallel_seed,
+)
+from apex_tpu.transformer.tensor_parallel.utils import (
+    VocabUtility,
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "vocab_parallel_cross_entropy",
+    "broadcast_data",
+    "broadcast_from_rank0",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "column_parallel_linear",
+    "row_parallel_linear",
+    "vocab_parallel_embedding",
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "RNGStatesTracker",
+    "checkpoint",
+    "get_rng_state_tracker",
+    "model_parallel_cuda_manual_seed",
+    "model_parallel_seed",
+    "VocabUtility",
+    "split_tensor_along_last_dim",
+]

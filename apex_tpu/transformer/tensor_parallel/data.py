@@ -1,0 +1,36 @@
+"""Data broadcast across the tensor-parallel axis.
+
+Reference: ``apex/transformer/tensor_parallel/data.py:80``
+(``broadcast_data``): rank 0 of each TP group broadcasts the batch so all
+TP ranks compute on identical data.
+
+On TPU, input pipelines usually feed identical host data to the TP group
+already (the sharding of the batch is over ``dp``), so this is a safety
+utility: inside ``shard_map`` it replaces every rank's value with tp-rank
+0's.
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+def broadcast_from_rank0(x, axis_name: str = TENSOR_AXIS):
+    """Value of tp rank 0, on every rank (one all_gather + slice; XLA
+    lowers this to a broadcast on ICI)."""
+    return jax.lax.all_gather(x, axis_name, axis=0)[0]
+
+
+def broadcast_data(keys, data: dict, datatype=None, axis_name: str = TENSOR_AXIS) -> dict:
+    """Reference-parity signature (data.py:80): broadcast ``data[k]`` for
+    k in keys from tp rank 0."""
+    out = {}
+    for k in keys:
+        v = jnp.asarray(data[k])
+        if datatype is not None:
+            v = v.astype(datatype)
+        out[k] = broadcast_from_rank0(v, axis_name)
+    return out

@@ -1,0 +1,216 @@
+"""Tensor-parallel layers: column/row linear, vocab-parallel embedding.
+
+Reference: ``apex/transformer/tensor_parallel/layers.py`` —
+``VocabParallelEmbedding`` (:174), ``LinearWithGradAccumulationAndAsync
+Communication`` (:279-437), ``ColumnParallelLinear`` (:460),
+``RowParallelLinear`` (:645).
+
+TPU redesign notes:
+
+- The reference's *async grad all-reduce overlapped with wgrad* and the
+  *fused wgrad accumulation into main_grad*
+  (``fused_weight_gradient_mlp_cuda``) are scheduling tricks for
+  torch's eager backward; XLA's latency-hiding scheduler overlaps the
+  backward collective with the wgrad dot automatically once they live in
+  one jit region, so no user-facing knobs are needed for them.
+- Sequence parallelism keeps the reference dataflow exactly: activations
+  enter seq-sharded, ``all_gather`` on entry to a column linear
+  (backward: ``reduce_scatter``), ``reduce_scatter`` on exit of the row
+  linear (backward: ``all_gather``)  (layers.py:311-324,386-413).
+- Weight layout follows the reference: column linear holds
+  ``(out_local, in)``, row linear holds ``(out, in_local)``; ``y = x W^T``.
+
+Functional forms run inside ``shard_map`` (weights are the *local*
+shards); flax module wrappers hold locally-shaped params for use with the
+same shard_map pattern.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
+
+
+def column_parallel_linear(
+    x,
+    weight,
+    bias: Optional[jnp.ndarray] = None,
+    *,
+    gather_output: bool = True,
+    sequence_parallel_enabled: bool = False,
+    axis_name: str = TENSOR_AXIS,
+):
+    """Y = XA^T + b with A sharded over rows (output features).
+
+    Reference: ColumnParallelLinear.forward (layers.py:460+).  ``weight``
+    is the local shard ``(out_features/tp, in_features)``.
+    """
+    if sequence_parallel_enabled:
+        # SP: input is seq-sharded; all-gather fwd, reduce-scatter bwd
+        x = gather_from_sequence_parallel_region(x, axis_name)
+    else:
+        # identity fwd, all-reduce bwd
+        x = copy_to_tensor_model_parallel_region(x, axis_name)
+    y = jnp.matmul(x, weight.T.astype(x.dtype))
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    if gather_output:
+        if sequence_parallel_enabled:
+            raise ValueError("gather_output is incompatible with sequence parallelism")
+        y = gather_from_tensor_model_parallel_region(y, axis_name)
+    return y
+
+
+def row_parallel_linear(
+    x,
+    weight,
+    bias: Optional[jnp.ndarray] = None,
+    *,
+    input_is_parallel: bool = True,
+    sequence_parallel_enabled: bool = False,
+    axis_name: str = TENSOR_AXIS,
+):
+    """Y = XA^T + b with A sharded over columns (input features).
+
+    Reference: RowParallelLinear (layers.py:645+).  ``weight`` is the
+    local shard ``(out_features, in_features/tp)``.  Bias is added
+    *after* the reduction (only once, as in the reference).
+    """
+    if not input_is_parallel:
+        if sequence_parallel_enabled:
+            raise ValueError("sequence parallelism requires input_is_parallel")
+        x = scatter_to_tensor_model_parallel_region(x, axis_name)
+    y = jnp.matmul(x, weight.T.astype(x.dtype))
+    if sequence_parallel_enabled:
+        y = reduce_scatter_to_sequence_parallel_region(y, axis_name)
+    else:
+        y = reduce_from_tensor_model_parallel_region(y, axis_name)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+def vocab_parallel_embedding(
+    ids,
+    weight,
+    *,
+    axis_name: str = TENSOR_AXIS,
+):
+    """Embedding with the vocab dimension sharded over ``tp``.
+
+    Reference: VocabParallelEmbedding.forward (layers.py:174-277): mask
+    out-of-range ids, local lookup, zero the masked rows, all-reduce.
+    ``weight`` is the local shard ``(vocab/tp, hidden)``.
+    """
+    per_partition = weight.shape[0]
+    rank = jax.lax.axis_index(axis_name)
+    start = rank * per_partition
+    local = ids - start
+    mask = (local < 0) | (local >= per_partition)
+    local = jnp.clip(local, 0, per_partition - 1)
+    out = jnp.take(weight, local, axis=0)
+    out = jnp.where(mask[..., None], jnp.zeros((), out.dtype), out)
+    return jax.lax.psum(out, axis_name)
+
+
+# ------------------------------------------------------------ flax modules
+import flax.linen as nn
+
+
+class ColumnParallelLinear(nn.Module):
+    """Module form; holds the LOCAL weight shard (use under shard_map).
+
+    ``output_size`` is the GLOBAL output dim; the local param is
+    ``output_size // tp_size`` rows (reference layers.py:460 computes
+    ``output_size_per_partition`` the same way).
+    """
+
+    input_size: int
+    output_size: int
+    tp_size: int
+    use_bias: bool = True
+    gather_output: bool = True
+    sequence_parallel_enabled: bool = False
+    axis_name: str = TENSOR_AXIS
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        out_local = self.output_size // self.tp_size
+        w = self.param(
+            "weight", nn.initializers.lecun_normal(), (out_local, self.input_size), self.param_dtype
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros, (out_local,), self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        return column_parallel_linear(
+            x,
+            w,
+            b,
+            gather_output=self.gather_output,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            axis_name=self.axis_name,
+        )
+
+
+class RowParallelLinear(nn.Module):
+    input_size: int
+    output_size: int
+    tp_size: int
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    sequence_parallel_enabled: bool = False
+    axis_name: str = TENSOR_AXIS
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_local = self.input_size // self.tp_size
+        w = self.param(
+            "weight", nn.initializers.lecun_normal(), (self.output_size, in_local), self.param_dtype
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros, (self.output_size,), self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        return row_parallel_linear(
+            x,
+            w,
+            b,
+            input_is_parallel=self.input_is_parallel,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            axis_name=self.axis_name,
+        )
+
+
+class VocabParallelEmbedding(nn.Module):
+    num_embeddings: int
+    embedding_dim: int
+    tp_size: int
+    axis_name: str = TENSOR_AXIS
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        vocab_local = self.num_embeddings // self.tp_size
+        w = self.param(
+            "weight",
+            nn.initializers.normal(stddev=0.02),
+            (vocab_local, self.embedding_dim),
+            self.param_dtype,
+        )
+        return vocab_parallel_embedding(ids, w, axis_name=self.axis_name)

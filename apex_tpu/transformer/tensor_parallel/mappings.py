@@ -1,0 +1,176 @@
+"""Autograd-visible collectives for tensor/sequence parallelism.
+
+Reference: ``apex/transformer/tensor_parallel/mappings.py:31-302`` — seven
+autograd Functions pairing a forward collective with the Megatron-correct
+backward collective, exposed as ``*_region`` helpers.
+
+These run *inside* ``shard_map`` over the mesh of
+:mod:`apex_tpu.transformer.parallel_state`; each takes the mesh axis name
+(default ``"tp"``).  The forward/backward pairing is expressed with
+``jax.custom_vjp``:
+
+====================================  ============  =====================
+function                              forward       backward
+====================================  ============  =====================
+copy_to_tensor_model_parallel_region  identity      psum
+reduce_from_..._region                psum          identity
+scatter_to_..._region                 split(last)   all_gather(last)
+gather_from_..._region                gather(last)  split(last)
+scatter_to_sequence_parallel_region   split(first)  all_gather(first)
+gather_from_sequence_parallel_region  gather(first) reduce_scatter(first)
+reduce_scatter_to_sequence_..._region rs(first)     all_gather(first)
+====================================  ============  =====================
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+def _split_along(x, axis_name, dim):
+    """Keep this rank's slice of dim (reference ``_split``, mappings.py:69)."""
+    size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[dim] // size
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=dim)
+
+
+def _gather_along(x, axis_name, dim):
+    """Concatenate across the axis (reference ``_gather``, mappings.py:79)."""
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce_scatter_along(x, axis_name, dim):
+    """Reference ``_reduce_scatter`` (mappings.py:122)."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+# ---------------------------------------------------------------- copy
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Identity fwd / all-reduce bwd (mappings.py:141 _CopyToModelParallelRegion)."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# -------------------------------------------------------------- reduce
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """All-reduce fwd / identity bwd (mappings.py:158 _ReduceFromModelParallelRegion)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# ------------------------------------------------------------- scatter
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Split last dim fwd / gather bwd (mappings.py:175 _ScatterToModelParallelRegion)."""
+    return _split_along(x, axis_name, x.ndim - 1)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_along(x, axis_name, x.ndim - 1), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_gather_along(g, axis_name, g.ndim - 1),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+# -------------------------------------------------------------- gather
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Gather last dim fwd / split bwd (mappings.py:192 _GatherFromModelParallelRegion)."""
+    return _gather_along(x, axis_name, x.ndim - 1)
+
+
+def _gather_fwd(x, axis_name):
+    return _gather_along(x, axis_name, x.ndim - 1), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_along(g, axis_name, g.ndim - 1),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ------------------------------------------------- sequence parallelism
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Split first dim fwd / gather bwd (mappings.py:213 _ScatterToSequenceParallelRegion)."""
+    return _split_along(x, axis_name, 0)
+
+
+def _seq_scatter_fwd(x, axis_name):
+    return _split_along(x, axis_name, 0), None
+
+
+def _seq_scatter_bwd(axis_name, _, g):
+    return (_gather_along(g, axis_name, 0),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Gather first dim fwd / reduce-scatter bwd (mappings.py:230
+    _GatherFromSequenceParallelRegion) — the SP entry collective of the
+    TP linears (layers.py:311-324)."""
+    return _gather_along(x, axis_name, 0)
+
+
+def _seq_gather_fwd(x, axis_name):
+    return _gather_along(x, axis_name, 0), None
+
+
+def _seq_gather_bwd(axis_name, _, g):
+    return (_reduce_scatter_along(g, axis_name, 0),)
+
+
+gather_from_sequence_parallel_region.defvjp(_seq_gather_fwd, _seq_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Reduce-scatter first dim fwd / gather bwd (mappings.py:252
+    _ReduceScatterToSequenceParallelRegion) — the SP exit collective of
+    RowParallelLinear."""
+    return _reduce_scatter_along(x, axis_name, 0)
+
+
+def _seq_rs_fwd(x, axis_name):
+    return _reduce_scatter_along(x, axis_name, 0), None
+
+
+def _seq_rs_bwd(axis_name, _, g):
+    return (_gather_along(g, axis_name, 0),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_seq_rs_fwd, _seq_rs_bwd)

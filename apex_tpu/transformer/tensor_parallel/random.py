@@ -1,0 +1,121 @@
+"""Parallel RNG management + activation checkpointing.
+
+Reference: ``apex/transformer/tensor_parallel/random.py`` —
+``CudaRNGStatesTracker`` (:124), ``model_parallel_cuda_manual_seed``
+(:204), ``CheckpointFunction``/``checkpoint`` (:237,308) with the
+``MemoryBuffer`` partitioning option (``memory.py:37``).
+
+TPU redesign: CUDA RNG *state snapshots* become **functional key
+derivation**.  JAX keys are values, so the tracker holds named base keys
+and every ``fork`` is a pure ``fold_in`` — no state capture/restore, and
+checkpoint recompute replays identically by construction (the whole
+reason the reference needs the tracker machinery disappears).
+
+Megatron seeding rule (random.py:204-234): the *model-parallel* RNG
+differs per tp rank (``seed + 2718 + tp_rank``) so dropout on sharded
+activations decorrelates, while the *default* RNG is identical across tp
+ranks.  Both are provided here; pass the traced tp rank from inside
+shard_map.
+
+Activation checkpointing maps to ``jax.checkpoint`` — recompute in
+backward with identical RNG, which is exactly the reference's
+CheckpointFunction contract.  The activation-partitioning option
+(``distribute_saved_activations``) is an XLA rematerialization/sharding
+policy here rather than a manual MemoryBuffer.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+# Reference offsets (random.py:204-220)
+_TP_OFFSET = 2718
+_PP_OFFSET = 100
+
+
+class RNGStatesTracker:
+    """Named RNG streams (reference CudaRNGStatesTracker, random.py:124).
+
+    Functional: ``fork(name)`` returns a fresh key derived from the named
+    base key and an internal counter; no global mutation of randomness
+    outside the returned keys.
+    """
+
+    def __init__(self):
+        self.states_: Dict[str, jnp.ndarray] = {}
+        self.counts_: Dict[str, int] = {}
+
+    def reset(self):
+        self.states_ = {}
+        self.counts_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        if isinstance(seed, (int,)):
+            key = jax.random.PRNGKey(seed)
+        else:
+            key = seed  # already a key (possibly traced, e.g. folded with tp rank)
+        self.states_[name] = key
+        self.counts_[name] = 0
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG):
+        """Return the next key from the named stream."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        k = jax.random.fold_in(self.states_[name], self.counts_[name])
+        self.counts_[name] += 1
+        return k
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    """Reference: get_cuda_rng_tracker (random.py:194)."""
+    return _TRACKER
+
+
+def model_parallel_seed(seed: int, tp_rank, pp_rank=0):
+    """Derive the two Megatron seeds (reference random.py:204
+    model_parallel_cuda_manual_seed).
+
+    Returns ``(data_parallel_key, model_parallel_key)``: the first is
+    identical across tp ranks, the second decorrelated per tp/pp rank.
+    ``tp_rank``/``pp_rank`` may be traced (``jax.lax.axis_index``).
+    """
+    base = jax.random.PRNGKey(seed)
+    dp_key = base
+    mp_key = jax.random.fold_in(jax.random.fold_in(base, _TP_OFFSET + 1), tp_rank)
+    if pp_rank is not None:
+        mp_key = jax.random.fold_in(mp_key, _PP_OFFSET * 1 + pp_rank)
+    return dp_key, mp_key
+
+
+def model_parallel_cuda_manual_seed(seed: int, tp_rank, pp_rank=0) -> None:
+    """API-parity wrapper: installs 'default' and the model-parallel
+    stream into the global tracker."""
+    _TRACKER.reset()
+    dp_key, mp_key = model_parallel_seed(seed, tp_rank, pp_rank)
+    _TRACKER.add("default", dp_key)
+    _TRACKER.add(_MODEL_PARALLEL_RNG, mp_key)
+
+
+def checkpoint(function, distribute_saved_activations: bool = False, *args):
+    """Activation checkpointing (reference random.py:308).
+
+    ``jax.checkpoint`` recomputes the forward during backward; RNG replay
+    is automatic because keys are explicit values.
+    ``distribute_saved_activations=True`` additionally offloads nothing on
+    TPU — XLA decides placement — the flag is accepted for parity.
+    """
+    return jax.checkpoint(function)(*args)

@@ -1,0 +1,35 @@
+"""TP utilities (reference: ``apex/transformer/tensor_parallel/utils.py``)."""
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.utils.misc import divide
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """Reference: utils.py:17 — static split into a tuple."""
+    last = tensor.shape[-1]
+    chunk = divide(last, num_partitions)
+    return tuple(
+        jnp.take(tensor, jnp.arange(i * chunk, (i + 1) * chunk), axis=-1)
+        for i in range(num_partitions)
+    )
+
+
+class VocabUtility:
+    """Vocab partition arithmetic (reference: utils.py:46)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple[int, int]:
+        index_f = rank * per_partition_vocab_size
+        return index_f, index_f + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank, world_size: int):
+        per_partition = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank, world_size
+        )
