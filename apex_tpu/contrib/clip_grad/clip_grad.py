@@ -1,0 +1,36 @@
+"""Fused gradient clipping.
+
+Reference: ``apex/contrib/clip_grad/clip_grad.py:16`` —
+``clip_grad_norm_`` via ``multi_tensor_l2norm`` + ``multi_tensor_scale``.
+
+Functional: returns ``(clipped_grads, total_norm)`` instead of mutating.
+Supports ``norm_type`` 2.0 and inf like the reference.
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.multi_tensor import multi_tensor_l2norm
+
+
+def clip_grad_norm_(grads, max_norm: float, norm_type: float = 2.0, error_if_nonfinite: bool = False):
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return grads, jnp.float32(0.0)
+    if norm_type == 2.0:
+        total_norm = multi_tensor_l2norm(grads)
+    elif norm_type in (float("inf"), jnp.inf):
+        total_norm = jnp.max(jnp.stack([jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves]))
+    else:
+        total_norm = jnp.power(
+            jnp.stack(
+                [jnp.sum(jnp.power(jnp.abs(g.astype(jnp.float32)), norm_type)) for g in leaves]
+            ).sum(),
+            1.0 / norm_type,
+        )
+    # torch semantics: clip_coef = max_norm / (total_norm + 1e-6), applied only when < 1
+    clip_coef = jnp.minimum(max_norm / (total_norm + 1e-6), 1.0)
+    clipped = jax.tree.map(lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads)
+    return clipped, total_norm

@@ -1,0 +1,62 @@
+"""LARC — layerwise adaptive rate control.
+
+Reference: ``apex/parallel/LARC.py:5-107``: wraps any optimizer; before
+``step`` it rescales each param's grad so the effective lr is
+``min(lr, trust_coefficient * ||p|| / (||g|| + wd*||p|| + eps))`` (clip
+mode) or the adaptive lr outright (scale mode).  Weight decay is folded
+into the grad when active (LARC.py:98-104).
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class LARC:
+    """Wrap an apex_tpu optimizer: ``LARC(FusedSGD(...))``.
+
+    Matches reference semantics: per-tensor adaptive lr computed in fp32;
+    params with zero norm (or zero grad norm) keep the base lr.
+    """
+
+    def __init__(self, optimizer, trust_coefficient: float = 0.02, clip: bool = True, eps: float = 1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def init(self, params):
+        return self.optim.init(params)
+
+    def update(self, grads, state, params, lr=None, **kw):
+        base_lr = self.optim.lr if lr is None else lr
+        wd = self.optim.weight_decay
+
+        def adjust(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            adaptive_lr = (
+                self.trust_coefficient * p_norm / (g_norm + p_norm * wd + self.eps)
+            )
+            if self.clip:
+                # reference LARC.py:92: adaptive_lr = min(adaptive_lr/lr, 1)
+                scale = jnp.minimum(adaptive_lr / base_lr, 1.0)
+            else:
+                scale = adaptive_lr
+            # zero-norm params are left completely untouched (LARC.py:89)
+            ok = (p_norm != 0) & (g_norm != 0)
+            g_out = jnp.where(ok, (g32 + wd * p32) * scale, g32)
+            return g_out
+
+        adj = jax.tree.map(adjust, grads, params)
+        # the inner optimizer must not re-apply weight decay (LARC.py:98-104
+        # zeroes group wd); emulate by a wd=0 shadow for the inner update.
+        saved_wd = self.optim.weight_decay
+        try:
+            self.optim.weight_decay = 0.0
+            return self.optim.update(adj, state, params, lr=base_lr, **kw)
+        finally:
+            self.optim.weight_decay = saved_wd

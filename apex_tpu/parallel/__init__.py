@@ -1,0 +1,23 @@
+"""Data parallelism (reference: ``apex/parallel``)."""
+
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    allreduce_gradients,
+)
+from apex_tpu.parallel.LARC import LARC
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    convert_syncbn_model,
+    sync_batch_norm_stats,
+)
+
+__all__ = [
+    "DistributedDataParallel",
+    "Reducer",
+    "allreduce_gradients",
+    "LARC",
+    "SyncBatchNorm",
+    "convert_syncbn_model",
+    "sync_batch_norm_stats",
+]

@@ -1,0 +1,182 @@
+"""DP-layer tests — mirrors tests/distributed/ of the reference
+(synced_batchnorm parity vs torch.nn.BatchNorm2d, amp_master_params,
+DDP gradient averaging) on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.parallel import LARC, SyncBatchNorm, allreduce_gradients
+from apex_tpu.optimizers import FusedSGD
+
+
+def smap(mesh, f, in_specs, out_specs):
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+
+
+class TestAllreduceGradients:
+    def test_gradient_average(self, devices8):
+        mesh = Mesh(np.array(devices8), ("dp",))
+        # rank r holds grad value r → average = 3.5
+        g = jnp.arange(8.0)
+
+        def f(g):
+            return allreduce_gradients({"w": g}, axis_name="dp")["w"]
+
+        out = smap(mesh, f, P("dp"), P("dp"))(g)
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))
+
+    def test_no_average(self, devices8):
+        mesh = Mesh(np.array(devices8), ("dp",))
+        g = jnp.ones(8)
+
+        def f(g):
+            return allreduce_gradients({"w": g}, axis_name="dp", gradient_average=False)["w"]
+
+        out = smap(mesh, f, P("dp"), P("dp"))(g)
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 8.0))
+
+    def test_predivide(self, devices8):
+        mesh = Mesh(np.array(devices8), ("dp",))
+        g = jnp.ones(8)
+
+        def f(g):
+            return allreduce_gradients(
+                {"w": g}, axis_name="dp", gradient_predivide_factor=2.0
+            )["w"]
+
+        out = smap(mesh, f, P("dp"), P("dp"))(g)
+        # sum(1/2 * 1 над 8) / (8/2) = 4 / 4 = 1 → still averages to 1
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 1.0))
+
+    def test_fp32_comm_dtype(self, devices8):
+        mesh = Mesh(np.array(devices8), ("dp",))
+        g = jnp.ones(8, jnp.bfloat16)
+
+        def f(g):
+            return allreduce_gradients({"w": g}, axis_name="dp", allreduce_always_fp32=True)["w"]
+
+        out = smap(mesh, f, P("dp"), P("dp"))(g)
+        assert out.dtype == jnp.bfloat16  # cast back to grad dtype
+
+
+class TestSyncBatchNorm:
+    def _torch_bn(self, x, momentum=0.1, eps=1e-5):
+        bn = torch.nn.BatchNorm2d(x.shape[1], momentum=momentum, eps=eps)
+        bn.train()
+        out = bn(torch.tensor(x))
+        return out.detach().numpy(), bn.running_mean.numpy(), bn.running_var.numpy()
+
+    def test_matches_torch_single_device(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 3, 5, 5).astype(np.float32)
+        m = SyncBatchNorm(num_features=3, axis_name=None)
+        variables = m.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        out, updated = m.apply(variables, jnp.asarray(x), mutable=["batch_stats"])
+        ref_out, ref_mean, ref_var = self._torch_bn(x)
+        np.testing.assert_allclose(np.asarray(out), ref_out, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(updated["batch_stats"]["running_mean"]), ref_mean, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(updated["batch_stats"]["running_var"]), ref_var, rtol=1e-4, atol=1e-5
+        )
+
+    def test_sharded_matches_full_batch(self, devices8):
+        """The reference's core distributed test: stats synced over dp ==
+        single-process full-batch BN (two_gpu_unit_test.py)."""
+        rng = np.random.RandomState(1)
+        x = rng.randn(8, 3, 4, 4).astype(np.float32)
+        m_sync = SyncBatchNorm(num_features=3, axis_name="dp")
+        m_local = SyncBatchNorm(num_features=3, axis_name=None)
+        variables = m_local.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+        mesh = Mesh(np.array(devices8), ("dp",))
+
+        def f(x):
+            out, _ = m_sync.apply(variables, x, mutable=["batch_stats"])
+            return out
+
+        out_sharded = smap(mesh, f, P("dp"), P("dp"))(jnp.asarray(x))
+        out_full, _ = m_local.apply(variables, jnp.asarray(x), mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(out_sharded), np.asarray(out_full), rtol=1e-4, atol=1e-4
+        )
+
+    def test_uneven_not_degenerate_channel_last(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 5, 5, 3).astype(np.float32)
+        m = SyncBatchNorm(num_features=3, axis_name=None, channel_last=True)
+        variables = m.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        out, _ = m.apply(variables, jnp.asarray(x), mutable=["batch_stats"])
+        assert out.shape == x.shape
+        # per-channel normalized: mean≈0 std≈1
+        flat = np.asarray(out).reshape(-1, 3)
+        np.testing.assert_allclose(flat.mean(0), np.zeros(3), atol=1e-4)
+
+    def test_eval_uses_running_stats(self):
+        x = jnp.ones((2, 3, 4, 4))
+        m = SyncBatchNorm(num_features=3, axis_name=None)
+        variables = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(variables, x, use_running_average=True)
+        # running mean 0, var 1 → output == input (affine identity at init)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-5)
+
+
+class TestLARC:
+    def test_larc_clip_matches_reference_math(self):
+        rng = np.random.RandomState(3)
+        p = rng.randn(10).astype(np.float32)
+        g = (rng.randn(10) * 0.01).astype(np.float32)
+        lr, tc, wd = 0.1, 0.02, 0.01
+
+        opt = LARC(FusedSGD(lr=lr, weight_decay=wd), trust_coefficient=tc, clip=True)
+        params = {"w": jnp.asarray(p)}
+        state = opt.init(params)
+        new_params, _ = opt.update({"w": jnp.asarray(g)}, state, params)
+
+        # reference math (apex/parallel/LARC.py:78-104) + plain SGD step
+        p_norm = np.linalg.norm(p)
+        g_norm = np.linalg.norm(g)
+        adaptive = tc * p_norm / (g_norm + p_norm * wd + 1e-8)
+        adaptive = min(adaptive / lr, 1.0)
+        g_adj = (g + wd * p) * adaptive
+        expected = p - lr * g_adj
+        np.testing.assert_allclose(np.asarray(new_params["w"]), expected, rtol=1e-5, atol=1e-6)
+
+    def test_larc_restores_wd(self):
+        inner = FusedSGD(lr=0.1, weight_decay=0.5)
+        opt = LARC(inner)
+        params = {"w": jnp.ones(4)}
+        state = opt.init(params)
+        opt.update({"w": jnp.ones(4)}, state, params)
+        assert inner.weight_decay == 0.5
+
+
+class TestClipGrad:
+    def test_matches_torch_clip_grad_norm(self):
+        rng = np.random.RandomState(4)
+        gs = [rng.randn(5, 3).astype(np.float32), rng.randn(7).astype(np.float32)]
+        tparams = [torch.nn.Parameter(torch.zeros(5, 3)), torch.nn.Parameter(torch.zeros(7))]
+        for p, g in zip(tparams, gs):
+            p.grad = torch.tensor(g)
+        ref_norm = torch.nn.utils.clip_grad_norm_(tparams, max_norm=1.0)
+
+        clipped, norm = clip_grad_norm_([jnp.asarray(g) for g in gs], max_norm=1.0)
+        np.testing.assert_allclose(float(norm), float(ref_norm), rtol=1e-5)
+        for c, t in zip(clipped, tparams):
+            np.testing.assert_allclose(np.asarray(c), t.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_no_clip_when_under(self):
+        gs = {"w": jnp.asarray(np.array([0.1, 0.2], np.float32))}
+        clipped, norm = clip_grad_norm_(gs, max_norm=10.0)
+        np.testing.assert_allclose(np.asarray(clipped["w"]), np.asarray(gs["w"]), rtol=1e-6)
+
+    def test_inf_norm(self):
+        gs = {"w": jnp.asarray(np.array([3.0, -4.0], np.float32))}
+        clipped, norm = clip_grad_norm_(gs, max_norm=2.0, norm_type=float("inf"))
+        np.testing.assert_allclose(float(norm), 4.0)
