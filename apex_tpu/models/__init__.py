@@ -1,0 +1,16 @@
+"""Flagship models (reference: ``apex/transformer/testing/standalone_*.py``
+and ``examples/imagenet``)."""
+
+from apex_tpu.models import gpt
+
+__all__ = ["gpt"]
+
+
+def __getattr__(name):
+    if name in ("resnet", "bert"):
+        import importlib
+
+        mod = importlib.import_module(f"apex_tpu.models.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'apex_tpu.models' has no attribute {name!r}")

@@ -1,0 +1,306 @@
+"""GPT — the flagship transformer LM, Megatron-parallel on TPU.
+
+Reference: ``apex/transformer/testing/standalone_gpt.py`` +
+``standalone_transformer_lm.py`` (the Megatron LM used by the reference's
+transformer tests): vocab-parallel embedding, pre-LN blocks with
+column/row-parallel attention and MLP, causal fused softmax,
+vocab-parallel cross entropy, sequence parallelism.
+
+TPU-first structure:
+- activations are ``(seq, batch, hidden)`` — the Megatron cross-stage
+  contract (SURVEY §3.4) and the natural SP layout (seq is dim 0);
+- layers are stacked with ``lax.scan`` over a leading layer axis so the
+  program compiles once regardless of depth;
+- per-layer activation checkpointing via ``jax.checkpoint`` (reference:
+  tensor_parallel/random.py:237 CheckpointFunction);
+- one code path: ``axis_name=None`` runs dense single-device; with an
+  axis name the same functions run inside ``shard_map`` with
+  q/k/v/fc1 column-sharded and proj/fc2 row-sharded.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.normalization import fused_layer_norm_affine
+from apex_tpu.transformer.functional import scaled_upper_triang_masked_softmax
+from apex_tpu.transformer.tensor_parallel.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_attention_heads: int = 16
+    max_seq_len: int = 1024
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    layernorm_eps: float = 1e-5
+    compute_dtype: Any = jnp.bfloat16
+    checkpoint_layers: bool = True
+    sequence_parallel: bool = False
+
+    @property
+    def ffn(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def init_params(config: GPTConfig, key) -> Dict[str, Any]:
+    """Global (unsharded) fp32 params; shard via PartitionSpecs from
+    :func:`param_specs`."""
+    H, F, L, V = config.hidden_size, config.ffn, config.num_layers, config.vocab_size
+    k = jax.random.split(key, 12)
+    std = 0.02
+    init = lambda k, *s: jax.random.normal(k, s, jnp.float32) * std
+
+    return {
+        "embed": init(k[0], V, H),
+        "pos_embed": init(k[1], config.max_seq_len, H),
+        "layers": {
+            "ln1_scale": jnp.ones((L, H)),
+            "ln1_bias": jnp.zeros((L, H)),
+            "wq": init(k[2], L, H, H),
+            "wk": init(k[3], L, H, H),
+            "wv": init(k[4], L, H, H),
+            "bq": jnp.zeros((L, H)),
+            "bk": jnp.zeros((L, H)),
+            "bv": jnp.zeros((L, H)),
+            "wo": init(k[5], L, H, H) / np.sqrt(2 * L),
+            "bo": jnp.zeros((L, H)),
+            "ln2_scale": jnp.ones((L, H)),
+            "ln2_bias": jnp.zeros((L, H)),
+            "fc1": init(k[6], L, F, H),
+            "fc1_b": jnp.zeros((L, F)),
+            "fc2": init(k[7], L, H, F) / np.sqrt(2 * L),
+            "fc2_b": jnp.zeros((L, H)),
+        },
+        "final_ln_scale": jnp.ones((H,)),
+        "final_ln_bias": jnp.zeros((H,)),
+    }
+
+
+def param_specs(config: GPTConfig):
+    """PartitionSpecs for shard_map in_specs (tp axis named 'tp').
+
+    Column-parallel weights shard the output dim, row-parallel the input
+    dim; embedding shards the vocab (reference layers.py:174,460,645).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    col = P(None, "tp", None)
+    colb = P(None, "tp")
+    row = P(None, None, "tp")
+    rep2 = P(None, None)
+    return {
+        "embed": P("tp", None),
+        "pos_embed": P(None, None),
+        "layers": {
+            "ln1_scale": rep2,
+            "ln1_bias": rep2,
+            "wq": col,
+            "wk": col,
+            "wv": col,
+            "bq": colb,
+            "bk": colb,
+            "bv": colb,
+            "wo": row,
+            "bo": rep2,
+            "ln2_scale": rep2,
+            "ln2_bias": rep2,
+            "fc1": col,
+            "fc1_b": colb,
+            "fc2": row,
+            "fc2_b": rep2,
+        },
+        "final_ln_scale": P(None),
+        "final_ln_bias": P(None),
+    }
+
+
+def _attention(x, p, config: GPTConfig, axis_name, n_local_heads):
+    """Self attention with column-parallel QKV and row-parallel output
+    proj (reference standalone_transformer_lm.py ParallelAttention)."""
+    S = x.shape[0] * (1 if not (axis_name and config.sequence_parallel) else jax.lax.axis_size(axis_name))
+    B = x.shape[1]
+    hd = config.head_dim
+    sp = config.sequence_parallel and axis_name is not None
+
+    def col(x_, w, b):
+        if axis_name is None:
+            return jnp.matmul(x_, w.T.astype(x_.dtype)) + b.astype(x_.dtype)
+        return column_parallel_linear(
+            x_, w, b, gather_output=False, sequence_parallel_enabled=sp, axis_name=axis_name
+        )
+
+    q = col(x, p["wq"], p["bq"])
+    k = col(x, p["wk"], p["bk"])
+    v = col(x, p["wv"], p["bv"])
+
+    # (S, B, local_heads*hd) → (B, nh, S, hd)
+    def heads(t):
+        return t.reshape(S, B, n_local_heads, hd).transpose(1, 2, 0, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(hd)
+    probs = scaled_upper_triang_masked_softmax(scores, 1.0)
+    ctx = jnp.einsum("bnst,bnth->bnsh", probs.astype(v.dtype), v)
+    ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, n_local_heads * hd)
+
+    if axis_name is None:
+        return jnp.matmul(ctx, p["wo"].T.astype(ctx.dtype)) + p["bo"].astype(ctx.dtype)
+    return row_parallel_linear(
+        ctx, p["wo"], p["bo"], input_is_parallel=True,
+        sequence_parallel_enabled=sp, axis_name=axis_name,
+    )
+
+
+def _mlp(x, p, config: GPTConfig, axis_name):
+    sp = config.sequence_parallel and axis_name is not None
+    if axis_name is None:
+        h = jnp.matmul(x, p["fc1"].T.astype(x.dtype)) + p["fc1_b"].astype(x.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        return jnp.matmul(h, p["fc2"].T.astype(h.dtype)) + p["fc2_b"].astype(h.dtype)
+    h = column_parallel_linear(
+        x, p["fc1"], p["fc1_b"], gather_output=False, sequence_parallel_enabled=sp, axis_name=axis_name
+    )
+    h = jax.nn.gelu(h, approximate=True)
+    return row_parallel_linear(
+        h, p["fc2"], p["fc2_b"], input_is_parallel=True, sequence_parallel_enabled=sp, axis_name=axis_name
+    )
+
+
+def _layer(x, p, config: GPTConfig, axis_name, n_local_heads):
+    H = config.hidden_size
+    ln1 = fused_layer_norm_affine(x, p["ln1_scale"], p["ln1_bias"], (H,), config.layernorm_eps)
+    x = x + _attention(ln1.astype(config.compute_dtype), p, config, axis_name, n_local_heads)
+    ln2 = fused_layer_norm_affine(x, p["ln2_scale"], p["ln2_bias"], (H,), config.layernorm_eps)
+    x = x + _mlp(ln2.astype(config.compute_dtype), p, config, axis_name)
+    return x
+
+
+def gpt_forward(params, tokens, config: GPTConfig, axis_name: Optional[str] = None):
+    """tokens (B, S) → logits.
+
+    With ``axis_name``: runs inside shard_map; returns vocab-LOCAL logits
+    ``(S, B, V/tp)``.  Without: dense logits ``(S, B, V)``.
+    """
+    B, S = tokens.shape
+    tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    n_local_heads = config.num_attention_heads // tp
+
+    if axis_name is None:
+        emb = jnp.take(params["embed"], tokens, axis=0)  # (B, S, H)
+    else:
+        emb = vocab_parallel_embedding(tokens, params["embed"], axis_name=axis_name)
+    x = emb.transpose(1, 0, 2) + params["pos_embed"][:S][:, None, :]
+    x = x.astype(config.compute_dtype)
+
+    if config.sequence_parallel and axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            scatter_to_sequence_parallel_region,
+        )
+
+        x = scatter_to_sequence_parallel_region(x, axis_name)
+
+    layer = partial(_layer, config=config, axis_name=axis_name, n_local_heads=n_local_heads)
+    if config.checkpoint_layers:
+        layer = jax.checkpoint(layer)
+
+    def scan_body(carry, lp):
+        return layer(carry, lp), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+
+    if config.sequence_parallel and axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            gather_from_sequence_parallel_region,
+        )
+
+        x = gather_from_sequence_parallel_region(x, axis_name)
+
+    x = fused_layer_norm_affine(
+        x, params["final_ln_scale"], params["final_ln_bias"], (config.hidden_size,), config.layernorm_eps
+    )
+    # tied LM head over the (local) vocab shard.  The copy-to-region is
+    # load-bearing: its backward all-reduces dx across vocab shards
+    # (Megatron parallel_lm_logits; reference layers.py:141-156 pairing).
+    if axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            copy_to_tensor_model_parallel_region,
+        )
+
+        x = copy_to_tensor_model_parallel_region(x, axis_name)
+    logits = jnp.matmul(x.astype(jnp.float32), params["embed"].T.astype(jnp.float32))
+    return logits  # (S, B, V_local)
+
+
+def make_train_step(
+    config: GPTConfig,
+    optimizer,
+    mesh,
+    tp_axis: str = "tp",
+    dp_axis: Optional[str] = "dp",
+):
+    """Build a jitted tp×dp train step over ``mesh``.
+
+    The TPU shape of reference §3.2's iteration: value_and_grad inside
+    ``shard_map`` (TP collectives via the mappings), gradient ``pmean``
+    over ``dp`` (the DDP allreduce, ``apex/parallel/distributed.py:429``),
+    then the fused optimizer update on local shards.
+    Returns ``step(params, opt_state, tokens, targets) -> (params, opt_state, loss)``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    specs = param_specs(config)
+
+    def local_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(gpt_loss)(params, tokens, targets, config, tp_axis)
+        if dp_axis is not None:
+            loss = jax.lax.pmean(loss, dp_axis)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+        new_params, new_state = optimizer.update(grads, opt_state, params)
+        return new_params, new_state, loss
+
+    # optimizer state mirrors param sharding for m/v/master; scalars replicated
+    def state_spec_of(params_spec):
+        from apex_tpu.optimizers.fused_adam import AdamState
+
+        return AdamState(step=P(), exp_avg=params_spec, exp_avg_sq=params_spec, master=None)
+
+    sspec = state_spec_of(specs)
+    data_spec = P(dp_axis, None) if dp_axis is not None else P()
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(specs, sspec, data_spec, data_spec),
+        out_specs=(specs, sspec, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def gpt_loss(params, tokens, targets, config: GPTConfig, axis_name: Optional[str] = None):
+    """Mean causal-LM cross entropy.  Uses vocab-parallel CE on a mesh."""
+    logits = gpt_forward(params, tokens, config, axis_name)  # (S, B, V?)
+    t = targets.transpose(1, 0)  # (S, B)
+    if axis_name is None:
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        loss = lse - tgt
+    else:
+        loss = vocab_parallel_cross_entropy(logits, t, 0.0, axis_name)
+    return jnp.mean(loss)

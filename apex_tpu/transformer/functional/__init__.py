@@ -1,0 +1,17 @@
+"""Fused functional ops (reference: ``apex/transformer/functional``)."""
+
+from apex_tpu.transformer.functional.fused_softmax import (
+    FusedScaleMaskSoftmax,
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "scaled_masked_softmax",
+    "scaled_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "generic_scaled_masked_softmax",
+]

@@ -1,0 +1,109 @@
+"""Fused scaled/masked softmax family.
+
+Reference: ``apex/transformer/functional/fused_softmax.py`` — four CUDA
+kernel wrappers (ScaledUpperTriangMaskedSoftmax :21, ScaledMaskedSoftmax
+:71, GenericScaledMaskedSoftmax :106, ScaledSoftmax :133) and the
+``FusedScaleMaskSoftmax`` module (:164) whose ``is_kernel_available``
+(:222-246) decides kernel vs torch fallback based on dtype/shape/mask.
+
+TPU: scale + mask-fill + row softmax is a single XLA fusion (one VPU pass
+over the attention scores), so every variant is "fused" and the
+availability heuristics collapse to "always".  Shapes follow the
+reference: scores are ``(b, np, sq, sk)``; causal masking uses the upper
+triangle; padding masks are boolean with True = masked, filled with
+-10000.0 before the softmax (reference kernel semantics).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.enums import AttnMaskType
+
+MASK_FILL_VALUE = -10000.0
+
+
+def _softmax(x, softmax_in_fp32: bool = True):
+    dt = x.dtype
+    if softmax_in_fp32:
+        x = x.astype(jnp.float32)
+    out = jax.nn.softmax(x, axis=-1)
+    return out.astype(dt)
+
+
+def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):
+    """Causal softmax (reference csrc/megatron/scaled_upper_triang_...).
+
+    Input ``(b, sq, sk)`` or ``(b, np, sq, sk)``; masks j > i.
+    """
+    sq, sk = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((sq, sk), bool))
+    scores = x * scale
+    scores = jnp.where(causal, scores, MASK_FILL_VALUE)
+    return _softmax(scores)
+
+
+def scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """Arbitrary-mask softmax (reference csrc/megatron/scaled_masked_...).
+
+    ``mask`` boolean broadcastable to ``x`` with True = masked out.
+    """
+    scores = x * scale
+    if mask is not None:
+        scores = jnp.where(mask, MASK_FILL_VALUE, scores)
+    return _softmax(scores)
+
+
+def scaled_softmax(x, scale: float = 1.0):
+    """Unmasked scaled softmax (reference csrc/megatron/scaled_softmax.cpp)."""
+    return _softmax(x * scale)
+
+
+# the generic (non-power-of-2) variant is the same computation under XLA
+generic_scaled_masked_softmax = scaled_masked_softmax
+
+
+class FusedScaleMaskSoftmax:
+    """Module parity with ``FusedScaleMaskSoftmax`` (fused_softmax.py:164).
+
+    Callable: ``softmax(input, mask)`` with scores ``(b, np, sq, sk)``.
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = True,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError("both fp16 and bf16 flags cannot be active at the same time.")
+        if scale is not None and not softmax_in_fp32:
+            raise RuntimeError("softmax should be in fp32 when scaled")
+        self.attn_mask_type = attn_mask_type
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """Always true on TPU — XLA fuses any shape (reference :222-246
+        gates on seqlen ≤ 4096, pow2 batching, dtype)."""
+        return True
+
+    def __call__(self, input, mask=None):
+        scale = self.scale if self.scale is not None else 1.0
+        if self.attn_mask_type == AttnMaskType.causal:
+            return scaled_upper_triang_masked_softmax(input, scale)
+        if mask is not None and self.mask_func is not None:
+            scores = self.mask_func(input * scale, mask)
+            return _softmax(scores, self.softmax_in_fp32)
+        return scaled_masked_softmax(input, mask, scale)
+
+    @staticmethod
+    def get_batch_per_block(sq, sk, b, np_) -> int:
+        """Kernel tiling detail with no TPU meaning (reference :271)."""
+        return 1

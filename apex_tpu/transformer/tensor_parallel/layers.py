@@ -121,7 +121,10 @@ def vocab_parallel_embedding(
     local = jnp.clip(local, 0, per_partition - 1)
     out = jnp.take(weight, local, axis=0)
     out = jnp.where(mask[..., None], jnp.zeros((), out.dtype), out)
-    return jax.lax.psum(out, axis_name)
+    # psum fwd / identity bwd via the custom_vjp mapping — a raw psum's
+    # autodiff transpose would double-count the embedding gradient
+    # (reference layers.py:270: output_parallel → reduce_from_...).
+    return reduce_from_tensor_model_parallel_region(out, axis_name)
 
 
 # ------------------------------------------------------------ flax modules

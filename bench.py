@@ -1,0 +1,114 @@
+"""Headline benchmark: FusedAdam step time vs eager (op-by-op) Adam.
+
+BASELINE.json metric: "FusedAdam step-time vs torch-xla eager Adam",
+north star >= 1.5x.  torch-xla does not exist on this image; the honest
+stand-in for "eager" is unjitted per-op JAX dispatch, which is the same
+execution model (one device op per python op).  The fused side is the
+apex_tpu FusedAdam: the whole multi-tensor update in one compiled XLA
+program, the TPU equivalent of the one-kernel multi_tensor_adam launch.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_params(seed=0):
+    """ResNet-50-scale parameter set: ~25.6M params over 161 tensors."""
+    rng = np.random.RandomState(seed)
+    params = {}
+    shapes = []
+    shapes.append(("conv1", (64, 3, 7, 7)))
+    widths = [(64, 256, 3), (128, 512, 4), (256, 1024, 6), (512, 2048, 3)]
+    for si, (w, wout, blocks) in enumerate(widths):
+        for b in range(blocks):
+            shapes.append((f"s{si}b{b}c1", (w, wout if b else wout // 2, 1, 1)))
+            shapes.append((f"s{si}b{b}c2", (w, w, 3, 3)))
+            shapes.append((f"s{si}b{b}c3", (wout, w, 1, 1)))
+            shapes.append((f"s{si}b{b}bn1", (w,)))
+            shapes.append((f"s{si}b{b}bn2", (w,)))
+            shapes.append((f"s{si}b{b}bn3", (wout,)))
+    shapes.append(("fc", (1000, 2048)))
+    shapes.append(("fc_b", (1000,)))
+    for name, s in shapes:
+        params[name] = jnp.asarray(rng.randn(*s).astype(np.float32) * 0.01)
+    return params
+
+
+def eager_adam_step(params, m, v, grads, step, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, wd=0.01):
+    """Op-by-op Adam: one dispatched op per line per tensor (the eager
+    execution model torch-xla Adam has)."""
+    new_p, new_m, new_v = {}, {}, {}
+    bc1 = 1 - b1 ** step
+    bc2 = 1 - b2 ** step
+    for k in params:
+        g = grads[k]
+        m_k = b1 * m[k] + (1 - b1) * g
+        v_k = b2 * v[k] + (1 - b2) * (g * g)
+        update = (m_k / bc1) / (jnp.sqrt(v_k / bc2) + eps) + wd * params[k]
+        new_p[k] = params[k] - lr * update
+        new_m[k] = m_k
+        new_v[k] = v_k
+    return new_p, new_m, new_v
+
+
+def block(tree):
+    for x in jax.tree.leaves(tree):
+        x.block_until_ready()
+
+
+def main():
+    from apex_tpu.optimizers import FusedAdam
+
+    params = make_params()
+    grads = jax.tree.map(lambda p: p * 0.001 + 0.0001, params)
+
+    opt = FusedAdam(lr=1e-3, weight_decay=0.01)
+    state = opt.init(params)
+
+    fused = jax.jit(lambda g, s, p: opt.update(g, s, p), donate_argnums=(1, 2))
+
+    # warmup / compile
+    p2, s2 = fused(grads, state, params)
+    block(p2)
+    state, params = s2, p2
+
+    n_iters = 50
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        params, state = fused(grads, state, params)
+    block(params)
+    fused_time = (time.perf_counter() - t0) / n_iters
+
+    # eager baseline
+    m = jax.tree.map(jnp.zeros_like, params)
+    v = jax.tree.map(jnp.zeros_like, params)
+    p, mm, vv = eager_adam_step(params, m, v, grads, 1)
+    block(p)
+    n_eager = 10
+    t0 = time.perf_counter()
+    for i in range(n_eager):
+        p, mm, vv = eager_adam_step(p, mm, vv, grads, i + 2)
+    block(p)
+    eager_time = (time.perf_counter() - t0) / n_eager
+
+    speedup = eager_time / fused_time
+    print(
+        json.dumps(
+            {
+                "metric": "fused_adam_step_speedup_vs_eager",
+                "value": round(speedup, 3),
+                "unit": "x",
+                "vs_baseline": round(speedup / 1.5, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
