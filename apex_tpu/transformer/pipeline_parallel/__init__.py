@@ -1,0 +1,19 @@
+"""Pipeline parallelism (reference: ``apex/transformer/pipeline_parallel``)."""
+
+from apex_tpu.transformer.pipeline_parallel import p2p_communication
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    build_model,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    pipelined_apply,
+)
+
+__all__ = [
+    "p2p_communication",
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "pipelined_apply",
+    "build_model",
+]

@@ -1,0 +1,66 @@
+"""Pipeline stage-to-stage communication.
+
+Reference: ``apex/transformer/pipeline_parallel/p2p_communication.py`` —
+``_communicate`` (:168) with NCCL ``batch_isend_irecv``, shape/dtype
+handshakes, scatter-gather optimization, and 9 send/recv wrappers
+(:385-690).
+
+TPU-native: stage p2p is ``jax.lax.ppermute`` on the ``pp`` mesh axis —
+a collective-permute over ICI neighbor links, which is *exactly* the
+hardware pattern the reference builds by hand.  No handshake is needed
+(shapes are static under jit); async overlap is XLA's job.  The 9
+wrappers reduce to forward/backward shifts; "FutureTensor" disappears
+(XLA programs are data-flow graphs already).
+
+These helpers are differentiable; ppermute's autodiff transpose is the
+inverse permutation, which is the correct backward-communication
+pairing.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+
+
+def _ring(axis_name, shift):
+    n = jax.lax.axis_size(axis_name)
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def send_forward_recv_forward(x, axis_name: str = PIPELINE_AXIS):
+    """Shift activations one stage forward (stage s → s+1); the fused
+    equivalent of send_forward + recv_forward (reference :385,:410)."""
+    return jax.lax.ppermute(x, axis_name, _ring(axis_name, +1))
+
+
+def send_backward_recv_backward(g, axis_name: str = PIPELINE_AXIS):
+    """Shift gradients one stage backward (stage s → s-1) (reference :437,:463)."""
+    return jax.lax.ppermute(g, axis_name, _ring(axis_name, -1))
+
+
+# aliases matching the reference's vocabulary
+recv_forward = send_forward_recv_forward
+recv_backward = send_backward_recv_backward
+
+
+def send_forward(x, axis_name: str = PIPELINE_AXIS):
+    return send_forward_recv_forward(x, axis_name)
+
+
+def send_backward(g, axis_name: str = PIPELINE_AXIS):
+    return send_backward_recv_backward(g, axis_name)
+
+
+def send_forward_recv_backward(x, axis_name: str = PIPELINE_AXIS):
+    """In the reference (:490) this is one fused NCCL op used in the 1F1B
+    steady state; under XLA the two shifts are independent collectives the
+    scheduler may overlap, so this returns the forward shift (backward
+    values travel in the autodiff graph)."""
+    return send_forward_recv_forward(x, axis_name)
+
+
+def send_backward_recv_forward(g, axis_name: str = PIPELINE_AXIS):
+    return send_backward_recv_backward(g, axis_name)

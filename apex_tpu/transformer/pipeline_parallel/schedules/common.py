@@ -1,0 +1,102 @@
+"""Pipeline schedule building blocks.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/common.py`` —
+``build_model`` (:30), ``forward_step``/``backward_step`` (:253,:325),
+``custom_backward`` (:219).
+
+The TPU-native core is :func:`pipelined_apply`: a ``lax.scan`` over
+``num_microbatches + P - 1`` ticks where every tick each stage applies
+its local layer chunk and ``ppermute`` shifts activations one stage
+forward — the software-pipeline shape of 1F1B's steady state, expressed
+as one compiled program.  Differentiating through the scan yields the
+backward pipeline automatically (ppermute's transpose is the reverse
+shift), replacing the reference's hand-scheduled
+warmup/steady/cooldown phases and ``custom_backward``.
+"""
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+
+
+def pipelined_apply(stage_fn, stage_params, mb_inputs, axis_name: str = PIPELINE_AXIS):
+    """Run microbatched inputs through the P-stage pipeline.
+
+    ``mb_inputs``: ``(M, ...)`` microbatch activations fed to stage 0.
+    ``stage_fn(stage_params, x) -> y`` is this stage's chunk (same
+    activation shape in/out — the transformer block contract of reference
+    §3.4, shape ``(seq, mbs, hidden)``).
+
+    Returns ``(M, ...)`` outputs, valid on the LAST stage (zeros
+    elsewhere); combine with :func:`broadcast_from_last_stage`.
+    """
+    P = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    M = mb_inputs.shape[0]
+    T = M + P - 1
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    zero = jnp.zeros_like(mb_inputs[0])
+    out_buf = jnp.zeros_like(mb_inputs)
+
+    def tick(carry, t):
+        incoming, out_buf = carry
+        m = t - stage  # microbatch index this stage processes at tick t
+        x = jnp.where(stage == 0, mb_inputs[jnp.clip(t, 0, M - 1)], incoming)
+        y = stage_fn(stage_params, x)
+        active = (m >= 0) & (m < M)
+        written = jax.lax.dynamic_update_index_in_dim(
+            out_buf, y, jnp.clip(m, 0, M - 1), 0
+        )
+        out_buf = jnp.where(active & (stage == P - 1), written, out_buf)
+        incoming = jax.lax.ppermute(y, axis_name, perm)
+        return (incoming, out_buf), None
+
+    (_, out_buf), _ = jax.lax.scan(tick, (zero, out_buf), jnp.arange(T))
+    return out_buf
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def broadcast_from_last_stage(x, axis_name: str = PIPELINE_AXIS):
+    """Last stage's value on every stage; backward routes the cotangent
+    to the last stage only (the pp analog of the embedding-group
+    broadcast in reference parallel_state.py:50-56)."""
+    P = jax.lax.axis_size(axis_name)
+    return jax.lax.all_gather(x, axis_name, axis=0)[P - 1]
+
+
+def _bcast_fwd(x, axis_name):
+    P = jax.lax.axis_size(axis_name)
+    return jax.lax.all_gather(x, axis_name, axis=0)[P - 1], None
+
+
+def _bcast_bwd(axis_name, _, g):
+    P = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    return (jnp.where(stage == P - 1, g, jnp.zeros_like(g)),)
+
+
+broadcast_from_last_stage.defvjp(_bcast_fwd, _bcast_bwd)
+
+
+def build_model(
+    model_provider_func: Callable,
+    wrap_with_ddp: bool = True,
+    virtual_pipeline_model_parallel_size=None,
+    **kwargs,
+):
+    """Reference: schedules/common.py:30 — builds (a list of) model
+    chunks with pre_process/post_process flags per stage.  In the TPU
+    design the per-stage split is a *sharding of stacked layer params*
+    over the ``pp`` mesh axis, so this returns the provider's result; the
+    virtual-chunk list shape is kept for interleaved scheduling."""
+    if virtual_pipeline_model_parallel_size is None:
+        return [model_provider_func(**kwargs)]
+    return [
+        model_provider_func(**kwargs)
+        for _ in range(virtual_pipeline_model_parallel_size)
+    ]

@@ -1,0 +1,56 @@
+"""No-pipelining schedule: sequential microbatches with grad accumulation.
+
+Reference: ``schedules/fwd_bwd_no_pipelining.py:23`` — run each
+microbatch's forward+backward in turn, accumulating grads, with the loss
+divided by the number of microbatches (common.py:305-309).
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def forward_backward_no_pipelining(
+    forward_step_func: Callable,
+    batch,
+    model=None,
+    *,
+    forward_only: bool = False,
+    **kwargs,
+):
+    """``forward_step_func(params, microbatch) -> loss`` (scalar).
+
+    ``batch`` is a pytree whose leaves have a leading microbatch dim
+    ``(M, ...)``; ``model`` is the param pytree.  Returns
+    ``(per_microbatch_losses, accumulated_grads_or_None)``; each
+    microbatch's contribution is scaled by 1/M exactly as the reference
+    scales the loss before backward.
+    """
+    params = model
+    leaves = jax.tree.leaves(batch)
+    M = leaves[0].shape[0]
+
+    def one(params, mb):
+        if forward_only:
+            return forward_step_func(params, mb), None
+        loss, grads = jax.value_and_grad(forward_step_func)(params, mb)
+        return loss, grads
+
+    def body(carry, mb):
+        acc = carry
+        loss, grads = one(params, mb)
+        if grads is not None:
+            acc = jax.tree.map(lambda a, g: a + g / M, acc, grads)
+        return acc, loss
+
+    if forward_only:
+        losses = []
+        for i in range(M):
+            mb = jax.tree.map(lambda x: x[i], batch)
+            losses.append(forward_step_func(params, mb))
+        return jnp.stack(losses), None
+
+    acc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    acc, losses = jax.lax.scan(body, acc0, batch)
+    return losses, acc

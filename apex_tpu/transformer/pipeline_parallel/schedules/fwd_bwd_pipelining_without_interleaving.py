@@ -1,0 +1,88 @@
+"""Pipelined schedule over the ``pp`` mesh axis (the 1F1B equivalent).
+
+Reference: ``schedules/fwd_bwd_pipelining_without_interleaving.py:241`` —
+warmup (P-rank-1 forwards), 1F1B steady state, cooldown, with p2p
+send/recv at every boundary and grad accumulation across microbatches.
+
+TPU-native: the whole schedule is ONE jitted program built from
+:func:`~...schedules.common.pipelined_apply` (scan over ticks +
+ppermute).  The forward pipeline is explicit; the backward pipeline is
+obtained by differentiation — the transpose of a tick-scan with
+forward ppermutes IS the cooldown/steady/warmup backward order, and
+XLA's scheduler overlaps the shifted collectives with compute the way
+the reference overlaps NCCL with the backward kernels.
+
+Model contract (replaces torch's ``model.set_input_tensor``):
+- ``pre_fn(shared_params, microbatch) -> activation``   (embedding; stage 0)
+- ``stage_fn(stage_params, activation) -> activation``  (this stage's layer chunk)
+- ``post_fn(shared_params, activation, microbatch) -> scalar loss`` (head; last stage)
+
+``stage_params`` leaves are sharded over ``pp`` on their leading
+(stacked-layer) axis; ``shared_params`` are replicated over ``pp`` and
+their grads are psum'd across stages (the reference's
+embedding-group allreduce, parallel_state.py:50).
+"""
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    broadcast_from_last_stage,
+    pipelined_apply,
+)
+
+
+def make_pipeline_loss_fn(
+    pre_fn: Callable,
+    stage_fn: Callable,
+    post_fn: Callable,
+    axis_name: str = PIPELINE_AXIS,
+):
+    """Compose pre/pipeline/post into ``loss_fn(shared, stages, microbatches)``.
+
+    ``microbatches``: pytree with leading (M, ...) dim.  Returns the mean
+    over microbatches of ``post_fn``'s scalar.
+    """
+
+    def loss_fn(shared_params, stage_params, microbatches):
+        acts = jax.vmap(lambda mb: pre_fn(shared_params, mb))(microbatches)
+        outs = pipelined_apply(stage_fn, stage_params, acts, axis_name)
+        # post/loss on the raw outputs (valid on the LAST stage only), then
+        # broadcast the scalar.  This keeps each shared-param contribution
+        # on exactly one stage — pre on stage 0, post on stage P-1 — so the
+        # cross-stage psum of shared grads counts it once (the reference's
+        # first/last-stage embedding-grad allreduce).
+        losses = jax.vmap(lambda y, mb: post_fn(shared_params, y, mb))(outs, microbatches)
+        return broadcast_from_last_stage(jnp.mean(losses), axis_name)
+
+    return loss_fn
+
+
+def forward_backward_pipelining_without_interleaving(
+    pre_fn: Callable,
+    stage_fn: Callable,
+    post_fn: Callable,
+    shared_params,
+    stage_params,
+    microbatches,
+    *,
+    forward_only: bool = False,
+    axis_name: str = PIPELINE_AXIS,
+):
+    """Run the pipelined schedule; returns ``(loss, (shared_grads, stage_grads))``.
+
+    Shared-param grads are psum'd over the pipeline axis (different
+    stages own different contributions — reference's embedding-grad
+    allreduce between first and last stage).
+    """
+    loss_fn = make_pipeline_loss_fn(pre_fn, stage_fn, post_fn, axis_name)
+    if forward_only:
+        return loss_fn(shared_params, stage_params, microbatches), None
+    loss, (g_shared, g_stage) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        shared_params, stage_params, microbatches
+    )
+    g_shared = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_shared)
+    return loss, (g_shared, g_stage)
