@@ -1,0 +1,167 @@
+"""Flash (blockwise, online-softmax) attention.
+
+Reference: ``apex/contrib/fmha`` (flash-style fused MHA for BERT,
+seqlen ≤ 512, ``fmhalib``) and ``apex/contrib/multihead_attn`` (fused
+self/enc-dec attention kernels).  The reference kernels exist to avoid
+materializing the (sq, sk) score matrix in HBM; this implementation does
+the same thing TPU-style: k-blockwise ``lax.scan`` with online softmax
+(running max + running sum), O(seq) activation memory, and a custom
+blockwise backward (the flash-attention recompute recipe) — all shapes
+static so XLA tiles every block matmul onto the MXU.
+
+Layout: ``(batch, heads, seq, head_dim)``.  No seqlen-512 limit.
+
+Returns optionally the per-row logsumexp so ring attention
+(:mod:`apex_tpu.transformer.context_parallel`) can merge partial results
+across devices.
+"""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _block_sizes(sk, block_k):
+    bk = min(block_k, sk)
+    while sk % bk:
+        bk -= 1
+    return bk
+
+
+def _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k):
+    """Online-softmax forward.  q: (B,H,Sq,D), k/v: (B,H,Sk,D).
+    Returns (out, lse) with lse = log Σ exp(s·scale) per row."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bk = _block_sizes(Sk, block_k)
+    nblocks = Sk // bk
+
+    kb = k.reshape(B, H, nblocks, bk, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, nblocks, bk, D).transpose(2, 0, 1, 3, 4)
+
+    q_pos = q_offset + jnp.arange(Sq)
+
+    def body(carry, inp):
+        m, l, acc = carry
+        kblk, vblk, blk_idx = inp
+        k_pos = k_offset + blk_idx * bk + jnp.arange(bk)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vblk)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0), (kb.astype(jnp.float32), vb.astype(jnp.float32), jnp.arange(nblocks))
+    )
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (causal ring blocks)
+    out = acc / l[..., None]
+    lse = m + jnp.log(l)
+    return out, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, q_offset, k_offset, block_k):
+    out, _ = _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k)
+    return out.astype(q.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, q_offset, k_offset, block_k):
+    out, lse = _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k)
+    return out.astype(q.dtype), (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
+    """Blockwise flash backward: dV = PᵀdO; dS = P∘(dOVᵀ − D);
+    dQ = dS·K·scale; dK = dSᵀ·Q·scale with D = rowsum(dO∘O)."""
+    q, k, v, out, lse = res
+    B, H, Sq, Dd = q.shape
+    Sk = k.shape[2]
+    bk = _block_sizes(Sk, block_k)
+    nblocks = Sk // bk
+
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    Drow = jnp.sum(gf * out, axis=-1)  # (B,H,Sq)
+    q_pos = q_offset + jnp.arange(Sq)
+
+    kb = k.reshape(B, H, nblocks, bk, Dd).transpose(2, 0, 1, 3, 4).astype(jnp.float32)
+    vb = v.reshape(B, H, nblocks, bk, Dd).transpose(2, 0, 1, 3, 4).astype(jnp.float32)
+
+    def body(dq, inp):
+        kblk, vblk, blk_idx = inp
+        k_pos = k_offset + blk_idx * bk + jnp.arange(bk)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # (B,H,Sq,bk)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vblk)
+        ds = p * (dp - Drow[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk) * scale
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, (kb, vb, jnp.arange(nblocks)))
+    dk = dks.transpose(1, 2, 0, 3, 4).reshape(B, H, Sk, Dd)
+    dv = dvs.transpose(1, 2, 0, 3, 4).reshape(B, H, Sk, Dd)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    block_k: int = 256,
+    q_offset: int = 0,
+    k_offset: int = 0,
+):
+    """Memory-efficient attention, (B, H, S, D) layout.
+
+    ``q_offset``/``k_offset`` give the global sequence positions of the
+    local blocks (used by ring attention for cross-device causal masks).
+    """
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    return _flash(q, k, v, scale, causal, q_offset, k_offset, block_k)
+
+
+def flash_attention_with_lse(
+    q, k, v, causal=True, softmax_scale=None, block_k: int = 256, q_offset=0, k_offset=0
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward returning (out, lse) for cross-device merging (no vjp —
+    ring attention differentiates through its own scan)."""
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    out, lse = _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k)
+    return out, lse
+
+
+def mha_reference(q, k, v, causal=True, softmax_scale=None):
+    """Naive O(S²)-memory oracle for tests."""
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        Sq, Sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

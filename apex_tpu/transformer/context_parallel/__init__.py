@@ -1,0 +1,25 @@
+"""Context parallelism: ring attention over a mesh axis.
+
+The reference has **no** sequence-length scaling beyond Megatron SP
+(SURVEY §2.4: "CP/ring-attention/Ulysses: No").  Long-context is
+first-class here: the sequence is sharded over the ``cp`` mesh axis and
+attention runs as a **ring** — each step every device computes blockwise
+attention of its local queries against the currently-held k/v chunk,
+then rotates k/v one neighbor over ICI with ``ppermute`` — overlapping
+the ICI transfer of the next chunk with the current block's matmuls (the
+TPU analog of ring-attention's compute/comm overlap).  Partial results
+merge with the online-softmax (out, logsumexp) rule, so the math is
+exactly full attention.
+
+Causality across devices falls out of global position offsets: chunk j
+attending from query chunk i is fully masked when j > i, fully visible
+when j < i, and triangular when i == j.
+"""
+
+from apex_tpu.transformer.context_parallel.ring_attention import (
+    ring_attention,
+    shard_sequence,
+    unshard_sequence,
+)
+
+__all__ = ["ring_attention", "shard_sequence", "unshard_sequence"]

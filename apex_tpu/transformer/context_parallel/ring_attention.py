@@ -1,0 +1,88 @@
+"""Ring attention over the ``cp`` mesh axis (see package docstring)."""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops.attention import NEG_INF
+from apex_tpu.transformer.parallel_state import CONTEXT_AXIS
+
+
+def shard_sequence(x, axis_name: str = CONTEXT_AXIS, seq_axis: int = 2):
+    """Take this device's sequence chunk (helper for tests/pipelines)."""
+    size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[seq_axis] // size
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=seq_axis)
+
+
+def unshard_sequence(x, axis_name: str = CONTEXT_AXIS, seq_axis: int = 2):
+    return jax.lax.all_gather(x, axis_name, axis=seq_axis, tiled=True)
+
+
+def _block_attend(q, k, v, scale, causal, q_pos, k_pos):
+    """One chunk-vs-chunk blockwise attention returning (acc, m, l) in the
+    online-softmax accumulator format (unnormalized)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return acc, m, l
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str = CONTEXT_AXIS,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    q/k/v: local chunks ``(B, H, S_local, D)`` (global position =
+    rank * S_local + i).  Runs cp ring steps; each step rotates k/v one
+    neighbor backward around the ring so every device eventually sees
+    every chunk.  Differentiable (scan + ppermute transpose is the
+    reverse ring — the backward pass is itself a ring).
+    """
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(D)
+    perm = [(i, (i - 1) % cp) for i in range(cp)]  # chunks flow backward
+
+    qf = q.astype(jnp.float32)
+    q_pos = rank * S + jnp.arange(S)
+
+    def step(carry, r):
+        kc, vc, m, l, acc = carry
+        src = (rank + r) % cp  # whose chunk we hold at step r
+        k_pos = src * S + jnp.arange(S)
+        a, m_b, l_b = _block_attend(qf, kc, vc, scale, causal, q_pos, k_pos)
+        m_new = jnp.maximum(m, m_b)
+        c_old = jnp.exp(m - m_new)
+        c_b = jnp.exp(m_b - m_new)
+        l_new = l * c_old + l_b * c_b
+        acc_new = acc * c_old[..., None] + a * c_b[..., None]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step,
+        (k.astype(jnp.float32), v.astype(jnp.float32), m0, l0, acc0),
+        jnp.arange(cp),
+    )
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
