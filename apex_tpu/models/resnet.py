@@ -1,0 +1,100 @@
+"""ResNet-50 — the canonical amp-O2 workload.
+
+Reference: ``examples/imagenet/main_amp.py`` trains torchvision
+ResNet-50 under ``amp.initialize(O2)`` + apex DDP; the reference's SyncBN
+and DDP tests all use this model family.
+
+TPU-first: NHWC layout (TPU conv layout), bf16 compute with fp32
+BatchNorm (the O2 ``keep_batchnorm_fp32`` rule), flax modules, and
+:class:`apex_tpu.parallel.SyncBatchNorm` when stats must sync across the
+``dp`` axis under shard_map.
+"""
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    projection: bool = False
+    norm: Callable = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = self.norm()(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1))(y)
+        y = self.norm()(y, use_running_average=not train)
+        if self.projection:
+            residual = conv(self.features * 4, (1, 1), strides=(self.strides, self.strides))(x)
+            residual = self.norm()(residual, use_running_average=not train)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    sync_bn_axis: Optional[str] = None  # "dp" to sync under shard_map
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def norm_factory(features=None):
+            # SyncBatchNorm with axis=None degrades to local BN; stats fp32
+            # (O2 keep_batchnorm_fp32 semantics)
+            class _N(nn.Module):
+                feats: int
+
+                @nn.compact
+                def __call__(self_inner, h, use_running_average=False):
+                    return SyncBatchNorm(
+                        num_features=h.shape[-1],
+                        axis_name=self.sync_bn_axis,
+                        channel_last=True,
+                        momentum=0.1,
+                    )(h, use_running_average=use_running_average)
+
+            return _N(feats=features or 0)
+
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = norm_factory()(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for b in range(block_count):
+                strides = 2 if i > 0 and b == 0 else 1
+                x = Bottleneck(
+                    features=self.width * 2 ** i,
+                    strides=strides,
+                    projection=(b == 0),
+                    norm=norm_factory,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32)(x)
+        return x
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], **kw)
+
+
+def ResNet18ish(**kw) -> ResNet:
+    """Small variant for tests."""
+    return ResNet(stage_sizes=[1, 1], width=16, **kw)
