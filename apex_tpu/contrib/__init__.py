@@ -1,0 +1,33 @@
+"""Optional extensions (reference: ``apex/contrib``, SURVEY §2.3).
+
+Each subpackage is independent, mirroring the reference's layout:
+``optimizers`` (ZeRO DistributedFusedAdam/LAMB), ``xentropy``,
+``clip_grad``, ``focal_loss``, ``group_norm``, ``layer_norm``,
+``index_mul_2d``, ``fmha``, ``multihead_attn``, ``sparsity``,
+``transducer``, ``conv_bias_relu``.
+"""
+
+_SUBS = (
+    "optimizers",
+    "xentropy",
+    "clip_grad",
+    "focal_loss",
+    "group_norm",
+    "layer_norm",
+    "index_mul_2d",
+    "fmha",
+    "multihead_attn",
+    "sparsity",
+    "transducer",
+    "conv_bias_relu",
+)
+
+
+def __getattr__(name):
+    if name in _SUBS:
+        import importlib
+
+        mod = importlib.import_module(f"apex_tpu.contrib.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'apex_tpu.contrib' has no attribute {name!r}")

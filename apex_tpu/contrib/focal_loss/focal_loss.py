@@ -1,0 +1,40 @@
+"""Fused focal loss for detection.
+
+Reference: ``apex/contrib/focal_loss/focal_loss.py:6``
+(``focal_loss_cuda`` ext): sigmoid focal loss over anchor
+classification logits with one-hot targets, normalized by
+``num_positives_sum``.
+
+FL(p_t) = -alpha_t (1-p_t)^gamma log(p_t), computed in one fusion.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def focal_loss(
+    cls_output,
+    cls_targets_at_level,
+    num_positives_sum,
+    num_real_classes: int,
+    alpha: float = 0.25,
+    gamma: float = 2.0,
+    label_smoothing: float = 0.0,
+):
+    """cls_output (..., C) raw logits; targets integer class ids with
+    -1 = ignore, 0 = background (reference semantics: one-hot of id-1
+    over num_real_classes)."""
+    t = cls_targets_at_level
+    C = num_real_classes
+    onehot = jax.nn.one_hot(t - 1, C, dtype=jnp.float32)  # -1/0 → all-zero rows
+    valid = (t >= 0).astype(jnp.float32)[..., None]
+    if label_smoothing > 0:
+        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / C
+
+    x = cls_output.astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.logaddexp(0.0, x) - x * onehot  # BCE-with-logits
+    p_t = p * onehot + (1 - p) * (1 - onehot)
+    alpha_t = alpha * onehot + (1 - alpha) * (1 - onehot)
+    loss = alpha_t * jnp.power(1 - p_t, gamma) * ce * valid
+    return jnp.sum(loss) / jnp.maximum(num_positives_sum, 1.0)

@@ -1,0 +1,3 @@
+from apex_tpu.contrib.group_norm.group_norm import GroupNorm, cuda_group_norm_nhwc_forward
+
+__all__ = ["GroupNorm", "cuda_group_norm_nhwc_forward"]

@@ -1,0 +1,13 @@
+"""Fused indexed multiply (OpenFold hot op).
+
+Reference: ``apex/contrib/index_mul_2d`` — ``out[idx] = in1[idx] * in2``
+fwd/bwd fused kernels.  One XLA gather+multiply fusion here; autodiff
+produces the fused scatter backward.
+"""
+
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1, in2, idx):
+    """in1 (N, D), idx (K,), in2 (K, D) → (K, D) = in1[idx] * in2."""
+    return jnp.take(in1, idx, axis=0) * in2

@@ -1,0 +1,8 @@
+"""Fast LayerNorm (reference: ``apex/contrib/layer_norm/layer_norm.py:8``
+— tuned persistent kernels for specific hidden sizes).  The fused norm
+covers all sizes on TPU; re-exported under the contrib name."""
+
+from apex_tpu.normalization import FusedLayerNorm as FastLayerNorm
+from apex_tpu.normalization import fused_layer_norm_affine as fast_layer_norm
+
+__all__ = ["FastLayerNorm", "fast_layer_norm"]

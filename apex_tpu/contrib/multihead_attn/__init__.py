@@ -1,0 +1,6 @@
+from apex_tpu.contrib.multihead_attn.self_multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+
+__all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]

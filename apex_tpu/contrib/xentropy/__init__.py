@@ -1,0 +1,3 @@
+from apex_tpu.contrib.xentropy.softmax_xentropy import SoftmaxCrossEntropyLoss, softmax_xentropy
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_xentropy"]

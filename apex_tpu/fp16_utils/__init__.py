@@ -1,0 +1,30 @@
+"""Manual mixed-precision utilities (reference: ``apex/fp16_utils``).
+
+The pre-amp API: explicit half conversion, master-weight bookkeeping,
+and a wrapping ``FP16_Optimizer``.  On TPU these are thin functional
+forms over the same machinery :mod:`apex_tpu.amp` and the fused
+optimizers already use.
+"""
+
+from apex_tpu.fp16_utils.fp16util import (
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    tofp16,
+)
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+
+__all__ = [
+    "network_to_half",
+    "convert_network",
+    "tofp16",
+    "prep_param_lists",
+    "model_grads_to_master_grads",
+    "master_params_to_model_params",
+    "FP16_Optimizer",
+    "LossScaler",
+    "DynamicLossScaler",
+]

@@ -1,0 +1,84 @@
+"""FP16_Optimizer — master-weight wrapper around any optimizer.
+
+Reference: ``apex/fp16_utils/fp16_optimizer.py:13`` — holds fp32 master
+params, scales the loss, unscales grads into the master, optionally
+clips, steps the wrapped optimizer on the master, and copies back to the
+fp16 model params, skipping on overflow.
+
+Functional form: state = (inner_state, scaler_state); ``step`` does the
+whole reference sequence in one jittable call.
+"""
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import DynamicLossScaler, StaticLossScaler
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+
+class FP16OptimizerState(NamedTuple):
+    inner: Any
+    scaler: Any
+
+
+class FP16_Optimizer:
+    def __init__(
+        self,
+        init_optimizer,
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        dynamic_loss_args: Optional[dict] = None,
+        verbose: bool = False,
+    ):
+        self.optimizer = init_optimizer
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = StaticLossScaler(static_loss_scale)
+
+    def init(self, params) -> FP16OptimizerState:
+        # force master weights in the inner optimizer
+        self.optimizer.master_weights = True
+        inner = self.optimizer.init(params)
+        if inner.master is None:
+            inner = inner._replace(
+                master=jax.tree.map(lambda p: p.astype(jnp.float32), params)
+            )
+        return FP16OptimizerState(inner=inner, scaler=self.loss_scaler.init())
+
+    def scale_loss(self, state: FP16OptimizerState, loss):
+        """Use instead of the reference's ``optimizer.backward(loss)``."""
+        return self.loss_scaler.scale(state.scaler, loss)
+
+    def step(self, grads, state: FP16OptimizerState, params, max_grad_norm: Optional[float] = None):
+        """unscale → (clip) → inner step on master → copy to model dtype,
+        with the whole commit predicated on grad finiteness."""
+        g32, finite = self.loss_scaler.unscale(state.scaler, grads)
+        if max_grad_norm is not None:
+            g32, _ = clip_grad_norm_(g32, max_grad_norm)
+        new_params, new_inner = self.optimizer.update(
+            g32, state.inner, params, grads_finite=finite
+        )
+        new_scaler = self.loss_scaler.update(state.scaler, finite)
+        return new_params, FP16OptimizerState(inner=new_inner, scaler=new_scaler), finite
+
+    # ----- state dict parity (fp16_optimizer.py state_dict/load_state_dict)
+    def state_dict(self, state: FP16OptimizerState):
+        import numpy as np
+
+        return {
+            "loss_scaler": self.loss_scaler.state_dict(state.scaler),
+            "inner": jax.tree.map(
+                lambda x: np.asarray(x) if x is not None else None, state.inner
+            ),
+        }
+
+    def load_state_dict(self, d) -> FP16OptimizerState:
+        inner = jax.tree.map(
+            lambda x: jnp.asarray(x) if x is not None else None, d["inner"]
+        )
+        return FP16OptimizerState(
+            inner=inner, scaler=self.loss_scaler.load_state_dict(d["loss_scaler"])
+        )

@@ -1,0 +1,64 @@
+"""Conversion + master-param helpers (reference: ``apex/fp16_utils/fp16util.py``)."""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_norm(path: str) -> bool:
+    p = path.lower()
+    return any(k in p for k in ("batchnorm", "bn", "layernorm", "layer_norm", "norm"))
+
+
+def tofp16(tree, half_dtype=jnp.bfloat16):
+    """Cast all float leaves to half (reference fp16util.py:25 tofp16)."""
+    return jax.tree.map(
+        lambda x: x.astype(half_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree
+    )
+
+
+def network_to_half(params, half_dtype=jnp.bfloat16):
+    """Half-cast params, keeping norm layers fp32 (fp16util.py:35 — BN
+    exemption via convert_network)."""
+    return convert_network(params, half_dtype)
+
+
+def convert_network(params, dtype=jnp.bfloat16):
+    """Reference fp16util.py:60: cast all but _BatchNorm-style params."""
+    flat = jax.tree_util.tree_flatten_with_path(params)
+
+    def cast(kp, x):
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if _is_norm(jax.tree_util.keystr(kp)):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    leaves = [cast(kp, x) for kp, x in flat[0]]
+    return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+
+def prep_param_lists(params, flat_master: bool = False) -> Tuple[Any, Any]:
+    """Half model params + fp32 master copy (fp16util.py:92).
+
+    ``flat_master=True`` concatenates the master into one flat vector
+    (the reference's single-tensor option).
+    """
+    master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+    if flat_master:
+        leaves = jax.tree.leaves(master)
+        flat = jnp.concatenate([l.reshape(-1) for l in leaves])
+        return params, flat
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads, master_grads=None):
+    """fp16 grads → fp32 grads (fp16util.py:138)."""
+    return jax.tree.map(lambda g: g.astype(jnp.float32), model_grads)
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Copy master values into model dtype (fp16util.py:160) — the
+    post-step sync of the O2 flow."""
+    return jax.tree.map(lambda p, m: m.astype(p.dtype), model_params, master_params)

@@ -229,7 +229,10 @@ def gpt_forward(params, tokens, config: GPTConfig, axis_name: Optional[str] = No
             gather_from_sequence_parallel_region,
         )
 
-        x = gather_from_sequence_parallel_region(x, axis_name)
+        # tensor_parallel_output_grad=False: the head's dx is psum'd by the
+        # copy-to-region below, so the backward here must split, not
+        # reduce-scatter (reference mappings.py:236-250)
+        x = gather_from_sequence_parallel_region(x, axis_name, False)
 
     x = fused_layer_norm_affine(
         x, params["final_ln_scale"], params["final_ln_bias"], (config.hidden_size,), config.layernorm_eps
@@ -245,6 +248,19 @@ def gpt_forward(params, tokens, config: GPTConfig, axis_name: Optional[str] = No
         x = copy_to_tensor_model_parallel_region(x, axis_name)
     logits = jnp.matmul(x.astype(jnp.float32), params["embed"].T.astype(jnp.float32))
     return logits  # (S, B, V_local)
+
+
+def sp_grad_sync(grads, axis_name: str):
+    """Sequence-parallel gradient sync: params consumed in the
+    seq-sharded region (LN scales/biases and row-parallel biases) see only
+    this rank's tokens in backward, so their grads must be summed over tp
+    (reference: apex/transformer/layers/layer_norm.py:26 marking +
+    Megatron's allreduce_sequence_parallel_gradients)."""
+    sp_keys = {"ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias", "bo", "fc2_b"}
+    layers = dict(grads["layers"])
+    for k in sp_keys:
+        layers[k] = jax.lax.psum(layers[k], axis_name)
+    return {**grads, "layers": layers}
 
 
 def make_train_step(
@@ -268,6 +284,8 @@ def make_train_step(
 
     def local_step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(gpt_loss)(params, tokens, targets, config, tp_axis)
+        if config.sequence_parallel:
+            grads = sp_grad_sync(grads, tp_axis)
         if dp_axis is not None:
             loss = jax.lax.pmean(loss, dp_axis)
             grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)

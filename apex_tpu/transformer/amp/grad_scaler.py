@@ -1,0 +1,44 @@
+"""Model-parallel-aware grad scaler.
+
+Reference: ``apex/transformer/amp/grad_scaler.py:21-126`` — a
+``torch.cuda.amp.GradScaler`` subclass whose only delta is all-reducing
+``found_inf`` over the model-parallel group in ``unscale_`` and
+``update`` so every TP/PP rank agrees on skipping a step.
+
+Here: :class:`apex_tpu.amp.DynamicLossScaler` with the finite-flag
+combined across the model-parallel mesh axes via ``psum`` of the
+not-finite indicator (inside shard_map).
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import DynamicLossScaler, ScalerState
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS, TENSOR_AXIS
+
+
+def sync_found_inf(grads_finite, axis_names: Sequence[str] = (TENSOR_AXIS, PIPELINE_AXIS)):
+    """All ranks agree: finite iff finite on EVERY model-parallel rank
+    (reference grad_scaler.py:49,102 MAX-allreduce of found_inf)."""
+    not_finite = 1.0 - jnp.asarray(grads_finite).astype(jnp.float32)
+    for ax in axis_names:
+        not_finite = jax.lax.pmax(not_finite, ax)
+    return not_finite == 0.0
+
+
+class GradScaler(DynamicLossScaler):
+    """DynamicLossScaler that syncs the finite flag over model-parallel
+    axes before unscale/update decisions."""
+
+    def __init__(self, *args, model_parallel_axes: Sequence[str] = (TENSOR_AXIS,), **kw):
+        super().__init__(*args, **kw)
+        self.model_parallel_axes = tuple(model_parallel_axes)
+
+    def unscale(self, state: ScalerState, grads):
+        out, finite = super().unscale(state, grads)
+        return out, sync_found_inf(finite, self.model_parallel_axes)
+
+    def update(self, state: ScalerState, all_finite_flag):
+        return super().update(state, all_finite_flag)

@@ -1,0 +1,3 @@
+from apex_tpu.transformer.layers.layer_norm import FastLayerNorm, FusedLayerNorm
+
+__all__ = ["FusedLayerNorm", "FastLayerNorm"]

@@ -1,0 +1,56 @@
+"""LayerNorm variants that mark params as sequence-parallel.
+
+Reference: ``apex/transformer/layers/layer_norm.py:26-99`` — subclasses
+of the fused norms whose single job is setting
+``param.sequence_parallel_enabled = True`` so the Megatron trainer knows
+these grads need an extra all-reduce over the TP group when SP is on.
+
+In JAX that marking is metadata on the param pytree: flax's
+``nn.with_partitioning``/axis metadata, or simply the treepath-based
+helper :func:`sequence_parallel_param_mask` used by
+:func:`allreduce_sequence_parallel_grads`.
+"""
+
+from typing import Sequence
+
+import jax
+
+import apex_tpu.normalization as _norm
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+class FusedLayerNorm(_norm.FusedLayerNorm):
+    """LayerNorm whose params are replicated over TP but live outside the
+    TP-sharded linears; with SP enabled their grads must be summed over
+    the tp axis (reference layer_norm.py:26)."""
+
+    sequence_parallel_enabled: bool = False
+
+
+# reference layer_norm.py:73 FastLayerNorm = tuned-hidden-size kernels;
+# the Pallas/XLA fused norm covers all sizes
+class FastLayerNorm(FusedLayerNorm):
+    pass
+
+
+def sequence_parallel_param_mask(params, norm_keywords: Sequence[str] = ("ln", "norm", "layernorm")):
+    """Boolean pytree: True for params that need the SP grad allreduce."""
+
+    def is_sp(path):
+        p = path.lower()
+        return any(k in p for k in norm_keywords)
+
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [is_sp(jax.tree_util.keystr(kp)) for kp, _ in flat[0]]
+    return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+
+def allreduce_sequence_parallel_grads(grads, mask, axis_name: str = TENSOR_AXIS):
+    """Sum SP-marked grads over the tp axis (the trainer-side loop the
+    reference expects; see layer_norm.py:26-99 + Megatron's
+    allreduce_sequence_parallel_gradients)."""
+
+    def one(g, m):
+        return jax.lax.psum(g, axis_name) if m else g
+
+    return jax.tree.map(one, grads, mask)

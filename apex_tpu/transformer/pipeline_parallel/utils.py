@@ -1,0 +1,213 @@
+"""Pipeline-parallel utilities.
+
+Reference: ``apex/transformer/pipeline_parallel/utils.py`` —
+``setup_microbatch_calculator`` (:58), ``get_kth_microbatch`` (:122),
+``_Timers`` (:146 via _timers.py), ``print_rank_0`` (:159),
+``calc_params_l2_norm`` (:213), ``report_memory`` (:253),
+``get_ltor_masks_and_position_ids`` (:303).
+"""
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.multi_tensor import multi_tensor_l2norm
+from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
+from apex_tpu.utils.logging import get_logger
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TIMERS = None
+
+
+def setup_microbatch_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> None:
+    """Reference: utils.py:58."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    assert _GLOBAL_NUM_MICROBATCHES_CALCULATOR is None, "num microbatches calculator is already initialized."
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size, data_parallel_size
+    )
+
+
+def _reconfigure_microbatch_calculator(
+    rank, rampup_batch_size, global_batch_size, micro_batch_size, data_parallel_size
+) -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size, data_parallel_size
+    )
+
+
+def get_micro_batch_size():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def get_num_microbatches():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True):
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples, consistency_check)
+
+
+def destroy_num_microbatches_calculator():
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_kth_microbatch(batch, k: int):
+    """Slice microbatch k out of a pytree batch (reference utils.py:122)."""
+    if batch is None:
+        return batch
+    mbs = get_micro_batch_size()
+    return jax.tree.map(lambda x: x[k * mbs : (k + 1) * mbs], batch)
+
+
+def listify_model(model):
+    if isinstance(model, list):
+        return model
+    return [model]
+
+
+def calc_params_l2_norm(params, bf16: bool = False):
+    """Reference: utils.py:213 — global L2 norm over params (the
+    multi_tensor_l2norm kernel)."""
+    return multi_tensor_l2norm(params)
+
+
+def print_rank_0(message: str) -> None:
+    """Reference: utils.py:159 — only process 0 prints."""
+    if jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def print_rank_last(message: str) -> None:
+    if jax.process_index() == jax.process_count() - 1:
+        print(message, flush=True)
+
+
+def report_memory(name: str) -> None:
+    """Reference: utils.py:253 — device memory stats."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        mega = 1024 * 1024
+        print_rank_0(
+            f"[{name}] memory: {stats.get('bytes_in_use', 0) / mega:.1f}MB in use / "
+            f"{stats.get('bytes_limit', 0) / mega:.1f}MB limit"
+        )
+    except Exception:
+        print_rank_0(f"[{name}] memory stats unavailable on this backend")
+
+
+def get_ltor_masks_and_position_ids(
+    data,
+    eod_token: int,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Left-to-right (causal) masks + position ids (reference utils.py:303).
+
+    The document-reset variants rebuild positions/masks at EOD tokens.
+    Returns (attention_mask [b,1,s,s] bool True=masked, loss_mask [b,s],
+    position_ids [b,s]).
+    """
+    b, s = data.shape
+    att = ~jnp.tril(jnp.ones((s, s), bool))  # True above diagonal = masked
+    attention_mask = jnp.broadcast_to(att, (b, 1, s, s))
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+    if reset_position_ids or reset_attention_mask:
+        # positions restart after each EOD; attention cannot cross EOD
+        is_eod = (data == eod_token).astype(jnp.int32)
+        doc_id = jnp.cumsum(is_eod, axis=1) - is_eod  # doc index per token
+        if reset_position_ids:
+            # position = index - index_of_doc_start
+            idx = jnp.broadcast_to(jnp.arange(s), (b, s))
+            doc_start = jax.vmap(
+                lambda d, i: jax.vmap(lambda dd: jnp.min(jnp.where(d == dd, i, s)))(d)
+            )(doc_id, idx)
+            position_ids = idx - jnp.take_along_axis(doc_start, doc_id, axis=1)
+        if reset_attention_mask:
+            cross_doc = doc_id[:, :, None] != doc_id[:, None, :]
+            attention_mask = attention_mask | cross_doc[:, None, :, :]
+    return attention_mask, loss_mask, position_ids
+
+
+class _Timer:
+    """CUDA-sync timers → block_until_ready timers (reference _timers.py:1-40)."""
+
+    def __init__(self, name):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = time.time()
+
+    def start(self):
+        assert not self.started_, "timer has already been started"
+        (jax.device_put(0.0) + 0).block_until_ready()
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self):
+        assert self.started_, "timer is not started"
+        (jax.device_put(0.0) + 0).block_until_ready()
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset=True):
+        started_ = self.started_
+        if self.started_:
+            self.stop()
+        elapsed_ = self.elapsed_
+        if reset:
+            self.reset()
+        if started_:
+            self.start()
+        return elapsed_
+
+
+class _Timers:
+    """Named timer group (reference _timers.py:43-83)."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            string += " | {}: {:.2f}".format(name, elapsed_time)
+        print_rank_last(string)
+
+
+def get_timers():
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = _Timers()
+    return _GLOBAL_TIMERS

@@ -138,20 +138,28 @@ def _seq_scatter_bwd(axis_name, _, g):
 scatter_to_sequence_parallel_region.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def gather_from_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
-    """Gather first dim fwd / reduce-scatter bwd (mappings.py:230
-    _GatherFromSequenceParallelRegion) — the SP entry collective of the
-    TP linears (layers.py:311-324)."""
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis_name=TENSOR_AXIS, tensor_parallel_output_grad=True):
+    """Gather first dim fwd (mappings.py:230 _GatherFromSequenceParallelRegion)
+    — the SP entry collective of the TP linears (layers.py:311-324).
+
+    ``tensor_parallel_output_grad`` (reference mappings.py:236-250):
+    True (default) = downstream produces rank-PARTIAL cotangents (a TP
+    linear) → backward reduce-scatters.  False = downstream cotangent is
+    already complete/replicated (e.g. after the psum of the LM-head's
+    copy-to-region) → backward just splits.
+    """
     return _gather_along(x, axis_name, 0)
 
 
-def _seq_gather_fwd(x, axis_name):
+def _seq_gather_fwd(x, axis_name, tensor_parallel_output_grad):
     return _gather_along(x, axis_name, 0), None
 
 
-def _seq_gather_bwd(axis_name, _, g):
-    return (_reduce_scatter_along(g, axis_name, 0),)
+def _seq_gather_bwd(axis_name, tensor_parallel_output_grad, _, g):
+    if tensor_parallel_output_grad:
+        return (_reduce_scatter_along(g, axis_name, 0),)
+    return (_split_along(g, axis_name, 0),)
 
 
 gather_from_sequence_parallel_region.defvjp(_seq_gather_fwd, _seq_gather_bwd)

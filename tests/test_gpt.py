@@ -98,6 +98,39 @@ def test_tp_loss_and_grads_match(batch, devices8):
         )
 
 
+def test_tp_sp_grads_match_after_sync(batch, devices8):
+    """SP-mode grads (with the sequence-parallel psum) must equal the
+    single-device grads — the SP analog of the reference's
+    test_layers.py parity."""
+    from apex_tpu.models.gpt import sp_grad_sync
+
+    cfg = GPTConfig(**{**CFG.__dict__, "sequence_parallel": True})
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    targets = jnp.roll(batch, -1, axis=1)
+    ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(params, batch, targets, CFG)
+
+    mesh = Mesh(np.array(devices8[:4]), ("tp",))
+    specs = param_specs(cfg)
+
+    def local(p, t, y):
+        loss, grads = jax.value_and_grad(lambda p: gpt_loss(p, t, y, cfg, axis_name="tp"))(p)
+        return loss, sp_grad_sync(grads, "tp")
+
+    f = jax.shard_map(
+        local, mesh=mesh, in_specs=(specs, P(), P()), out_specs=(P(), specs), check_vma=False
+    )
+    loss, grads = f(params, batch, targets)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    for (ka, a), (kb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(grads),
+        jax.tree_util.tree_leaves_with_path(ref_grads),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4,
+            err_msg=f"{jax.tree_util.keystr(ka)}",
+        )
+
+
 def test_training_reduces_loss(batch):
     params = init_params(CFG, jax.random.PRNGKey(0))
     targets = jnp.roll(batch, -1, axis=1)

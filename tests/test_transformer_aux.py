@@ -1,0 +1,156 @@
+"""Aux transformer subsystems: TP-aware GradScaler, microbatch
+calculators (incl. rampup — mirrors test_microbatches.py), batch
+samplers (test_batch_sampler.py), pipeline utils, fp16_utils."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    master_params_to_model_params,
+    network_to_half,
+    prep_param_lists,
+)
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.transformer._data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+from apex_tpu.transformer.amp import GradScaler
+from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
+from apex_tpu.transformer.pipeline_parallel.utils import get_ltor_masks_and_position_ids
+
+
+class TestGradScaler:
+    def test_found_inf_syncs_across_tp(self, devices8):
+        mesh = Mesh(np.array(devices8[:4]), ("tp",))
+        scaler = GradScaler(init_scale=4.0, model_parallel_axes=("tp",))
+        state = scaler.init()
+
+        def f(g):
+            # only rank 0's grads overflow; all ranks must agree
+            out, finite = scaler.unscale(state, {"w": g})
+            return jnp.asarray(finite, jnp.int32).reshape(1)
+
+        g = jnp.asarray([np.inf, 1.0, 1.0, 1.0])  # rank 0 gets inf
+        finite = jax.shard_map(
+            f, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"), check_vma=False
+        )(g)
+        assert np.asarray(finite).sum() == 0  # all ranks saw not-finite
+
+
+class TestMicrobatches:
+    def test_constant(self):
+        c = build_num_microbatches_calculator(0, None, 64, 4, 2)
+        assert c.get() == 8
+        assert c.get_current_global_batch_size() == 64
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            build_num_microbatches_calculator(0, None, 30, 4, 2)
+
+    def test_rampup(self):
+        # start 16, +16 per increment, over 64 samples, target 64
+        c = build_num_microbatches_calculator(0, [16, 16, 64], 64, 4, 1)
+        assert c.get_current_global_batch_size() == 16
+        assert c.get() == 4
+        # num_increments = 3, samples_per_increment = 64/3 ≈ 21.33
+        c.update(45, True)  # int(45/21.33) = 2 increments
+        assert c.get_current_global_batch_size() == 48
+        c.update(100, True)
+        assert c.get_current_global_batch_size() == 64
+        assert c.get() == 16
+
+
+class TestBatchSamplers:
+    def test_sequential_shards_by_rank(self):
+        s0 = MegatronPretrainingSampler(20, 0, 2, data_parallel_rank=0, data_parallel_size=2)
+        s1 = MegatronPretrainingSampler(20, 0, 2, data_parallel_rank=1, data_parallel_size=2)
+        b0 = next(iter(s0))
+        b1 = next(iter(s1))
+        assert b0 == [0, 1]
+        assert b1 == [2, 3]
+
+    def test_sequential_resume(self):
+        s = MegatronPretrainingSampler(20, 8, 2, 0, 2)
+        assert next(iter(s)) == [8, 9]
+
+    def test_random_sampler_deterministic_epoch(self):
+        a = list(MegatronPretrainingRandomSampler(32, 0, 2, 0, 2))
+        b = list(MegatronPretrainingRandomSampler(32, 0, 2, 0, 2))
+        assert a == b
+        assert all(len(x) == 2 for x in a)
+
+    def test_random_sampler_rank_disjoint(self):
+        a = {i for batch in MegatronPretrainingRandomSampler(32, 0, 2, 0, 2) for i in batch}
+        b = {i for batch in MegatronPretrainingRandomSampler(32, 0, 2, 1, 2) for i in batch}
+        assert not (a & b)
+
+
+class TestLtorMasks:
+    def test_basic_causal(self):
+        data = jnp.asarray([[1, 2, 3, 0]])
+        att, loss_mask, pos = get_ltor_masks_and_position_ids(data, eod_token=0, eod_mask_loss=True)
+        assert att.shape == (1, 1, 4, 4)
+        assert bool(att[0, 0, 0, 1])  # future masked
+        assert not bool(att[0, 0, 1, 0])  # past visible
+        np.testing.assert_allclose(np.asarray(loss_mask), [[1, 1, 1, 0]])
+        np.testing.assert_allclose(np.asarray(pos), [[0, 1, 2, 3]])
+
+    def test_reset_attention_mask(self):
+        data = jnp.asarray([[5, 0, 6, 7]])  # EOD at position 1
+        att, _, pos = get_ltor_masks_and_position_ids(
+            data, eod_token=0, reset_attention_mask=True, reset_position_ids=True
+        )
+        # token 2 (new doc) must not attend to token 0 (previous doc)
+        assert bool(att[0, 0, 2, 0])
+
+
+class TestFp16Utils:
+    def test_network_to_half_keeps_norms(self):
+        params = {"dense": jnp.ones((2, 2)), "bn_scale": jnp.ones((2,))}
+        half = network_to_half(params)
+        assert half["dense"].dtype == jnp.bfloat16
+        assert half["bn_scale"].dtype == jnp.float32
+
+    def test_prep_param_lists_flat(self):
+        params = {"a": jnp.ones((2, 2)), "b": jnp.ones((3,))}
+        model, master = prep_param_lists(params, flat_master=True)
+        assert master.shape == (7,)
+
+    def test_master_to_model_roundtrip(self):
+        model = {"w": jnp.ones((2,), jnp.bfloat16)}
+        master = {"w": jnp.asarray([1.5, 2.5], jnp.float32)}
+        out = master_params_to_model_params(model, master)
+        assert out["w"].dtype == jnp.bfloat16
+
+    def test_fp16_optimizer_end_to_end(self):
+        params = {"w": jnp.asarray([1.0, 2.0], jnp.bfloat16)}
+        opt = FP16_Optimizer(FusedAdam(lr=0.1), dynamic_loss_scale=True)
+        state = opt.init(params)
+        grads = {"w": jnp.asarray([0.1, 0.1], jnp.bfloat16)}
+        scaled = opt.scale_loss(state, jnp.float32(1.0))
+        assert float(scaled) == 2.0 ** 16
+        # pretend grads are scaled
+        sg = jax.tree.map(lambda g: g * state.scaler.loss_scale.astype(g.dtype), grads)
+        new_params, state, finite = opt.step(sg, state, params)
+        assert bool(finite)
+        assert new_params["w"].dtype == jnp.bfloat16
+        # overflow path: params unchanged
+        bad = {"w": jnp.asarray([jnp.inf, 0.0], jnp.bfloat16)}
+        p2, state, finite = opt.step(bad, state, new_params)
+        assert not bool(finite)
+        np.testing.assert_array_equal(
+            np.asarray(p2["w"], np.float32), np.asarray(new_params["w"], np.float32)
+        )
+
+    def test_fp16_optimizer_state_dict_roundtrip(self):
+        params = {"w": jnp.ones((3,), jnp.bfloat16)}
+        opt = FP16_Optimizer(FusedAdam(lr=0.1), dynamic_loss_scale=True)
+        state = opt.init(params)
+        sd = opt.state_dict(state)
+        state2 = opt.load_state_dict(sd)
+        assert float(state2.scaler.loss_scale) == float(state.scaler.loss_scale)
