@@ -1,0 +1,11 @@
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    DistributedFusedAdam,
+    DistributedFusedAdamState,
+)
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import DistributedFusedLAMB
+
+__all__ = [
+    "DistributedFusedAdam",
+    "DistributedFusedAdamState",
+    "DistributedFusedLAMB",
+]

@@ -1,0 +1,206 @@
+"""DistributedFusedAdam — ZeRO-2 optimizer-state sharding over ``dp``.
+
+Reference: ``apex/contrib/optimizers/distributed_fused_adam.py:266``
+(3,078 LoC): params flattened into fixed-size buckets; optimizer state
+sharded over the process grid; reduce-scatter grad sync overlapped with
+backward; all-gather param sync optionally overlapped with forward
+(``ParameterFragment``/``StateBucket`` dataclasses :370-504, ``step``
+:2158).
+
+TPU-native collapse of that machinery:
+
+- the *bucketing* (fixed-size flat buffers, fragment maps) exists to
+  batch NCCL calls and kernel launches; XLA needs neither — one
+  ``psum_scatter`` on the concatenated grads and one ``all_gather`` on
+  the updated flat params, with overlap scheduled by the compiler;
+- the *sharding grid* (distributed_process_group × redundant_process_
+  group) is the ``dp`` mesh axis (a redundant axis would map to a
+  second mesh axis with ``psum`` — multi-slice DCN deployments);
+- optimizer state (m, v, fp32 master) lives ONLY for the local 1/dp
+  shard — the ZeRO-2 memory saving;
+- Adam math is exactly :class:`apex_tpu.optimizers.FusedAdam`'s
+  (AdamFunctor numerics), applied to the local shard, step predicated on
+  the synced finite flag.
+
+Use inside ``shard_map`` with params replicated over ``dp``.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+
+class DistributedFusedAdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: jnp.ndarray  # (local_shard,) fp32
+    exp_avg_sq: jnp.ndarray  # (local_shard,) fp32
+    master_shard: jnp.ndarray  # (local_shard,) fp32 — fp32 master of owned params
+
+
+def _flatten(tree):
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
+    return flat
+
+
+def _unflatten_into(tree, flat):
+    leaves, treedef = jax.tree.flatten(tree)
+    out = []
+    off = 0
+    for l in leaves:
+        n = int(np.prod(l.shape))
+        out.append(flat[off : off + n].reshape(l.shape).astype(l.dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+class DistributedFusedAdam:
+    """ZeRO-2 AdamW with the reference's constructor vocabulary."""
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        axis_name: str = DATA_AXIS,
+        grad_average: bool = True,
+        # accepted-for-parity knobs (overlap is XLA's):
+        overlap_grad_sync: bool = True,
+        overlap_param_sync: bool = False,
+        bucket_cap_mb: float = 100.0,
+        dtype=jnp.float32,
+        grad_sync_dtype=None,
+        param_sync_dtype=None,
+        process_group=None,
+        distributed_process_group=None,
+        redundant_process_group=None,
+    ):
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+        self.axis_name = axis_name
+        self.grad_average = grad_average
+
+    # -------------------------------------------------------------- helpers
+    def _total_and_pad(self, params):
+        total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        return total
+
+    def init(self, params, world_size: Optional[int] = None) -> DistributedFusedAdamState:
+        """Build the GLOBAL flat state: arrays of shape (padded_total,),
+        to be sharded over ``dp`` — pass
+        ``DistributedFusedAdamState(P(), P("dp"), P("dp"), P("dp"))`` as
+        the shard_map spec so each rank holds its 1/dp shard (the ZeRO
+        memory saving comes from the sharding, stated explicitly rather
+        than via per-device local arrays).  The fp32 master is lazily
+        sliced from params on the first update (step==0)."""
+        if world_size is None:
+            raise ValueError("pass world_size= (the dp axis size)")
+        total = self._total_and_pad(params)
+        padded = ((total + world_size - 1) // world_size) * world_size
+        self._padded = padded
+        self._world = world_size
+        zeros = jnp.zeros((padded,), jnp.float32)
+        return DistributedFusedAdamState(
+            step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=zeros, master_shard=zeros
+        )
+
+    def state_partition_spec(self):
+        """The shard_map / pjit PartitionSpec tree for the state."""
+        from jax.sharding import PartitionSpec as P
+
+        return DistributedFusedAdamState(
+            step=P(), exp_avg=P(self.axis_name), exp_avg_sq=P(self.axis_name),
+            master_shard=P(self.axis_name),
+        )
+
+    def update(self, grads, state: DistributedFusedAdamState, params, grads_finite=None, lr=None):
+        """One ZeRO-2 step (inside shard_map, params/grads replicated or
+        dp-identical).  Returns (new_params, new_state)."""
+        lr = self.lr if lr is None else lr
+        ax = self.axis_name
+        world = jax.lax.axis_size(ax)
+        rank = jax.lax.axis_index(ax)
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+
+        flat_g = _flatten(grads)
+        total = flat_g.shape[0]
+        padded = ((total + world - 1) // world * world) if total % world else total
+        if padded != total:
+            flat_g = jnp.pad(flat_g, (0, padded - total))
+        shard = padded // world
+
+        # ZeRO grad sync: reduce-scatter — each rank owns one shard
+        g_local = jax.lax.psum_scatter(flat_g, ax, scatter_dimension=0, tiled=True)
+        if self.grad_average:
+            g_local = g_local / world
+
+        # lazily materialize the fp32 master shard from params on step 0
+        flat_p = _flatten(params)
+        if padded != total:
+            flat_p = jnp.pad(flat_p, (0, padded - total))
+        p_owned = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard, shard)
+        master = jnp.where(state.step == 0, p_owned, state.master_shard)
+
+        step = state.step + (
+            jnp.asarray(grads_finite).astype(jnp.int32) if grads_finite is not None else 1
+        )
+        t = step.astype(jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - jnp.power(b1, t)
+            bc2 = 1.0 - jnp.power(b2, t)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        g = g_local
+        if not self.adam_w_mode:
+            g = g + wd * master
+        m_new = b1 * state.exp_avg + (1.0 - b1) * g
+        v_new = b2 * state.exp_avg_sq + (1.0 - b2) * g * g
+        update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        if self.adam_w_mode:
+            update = update + wd * master
+        master_new = master - lr * update
+
+        if grads_finite is not None:
+            pred = jnp.asarray(grads_finite)
+            m_new = jnp.where(pred, m_new, state.exp_avg)
+            v_new = jnp.where(pred, v_new, state.exp_avg_sq)
+            master_new = jnp.where(pred, master_new, master)
+
+        # ZeRO param sync: all-gather the updated shards
+        flat_new = jax.lax.all_gather(master_new, ax, axis=0, tiled=True)
+        new_params = _unflatten_into(params, flat_new[:total])
+
+        return new_params, DistributedFusedAdamState(
+            step=step, exp_avg=m_new, exp_avg_sq=v_new, master_shard=master_new
+        )
+
+    # ----------------------------------------------------- state dict parity
+    def state_dict(self, state: DistributedFusedAdamState):
+        """Sharded state dict (reference :2527 — each rank saves its own
+        shard)."""
+        return {
+            "step": int(state.step),
+            "exp_avg": np.asarray(state.exp_avg),
+            "exp_avg_sq": np.asarray(state.exp_avg_sq),
+            "master_shard": np.asarray(state.master_shard),
+        }
+
+    def load_state_dict(self, d) -> DistributedFusedAdamState:
+        return DistributedFusedAdamState(
+            step=jnp.int32(d["step"]),
+            exp_avg=jnp.asarray(d["exp_avg"]),
+            exp_avg_sq=jnp.asarray(d["exp_avg_sq"]),
+            master_shard=jnp.asarray(d["master_shard"]),
+        )
