@@ -57,6 +57,13 @@ def _layer_norm(x, weight, bias, normalized_shape, eps, memory_efficient):
 
 def _ln_fwd_impl(x, weight, bias, normalized_shape, eps):
     x2, lead, n = _rows_view(x, normalized_shape)
+    from apex_tpu.ops.layer_norm_pallas import layer_norm_fwd_pallas, pallas_available
+
+    if pallas_available(x2, n):
+        w = weight.reshape(n) if weight is not None else None
+        b = bias.reshape(n) if bias is not None else None
+        y, mean, rstd = layer_norm_fwd_pallas(x2, w, b, eps)
+        return y.reshape(x.shape), mean[:, 0], rstd[:, 0]
     xf = x2.astype(jnp.float32)
     mean = jnp.mean(xf, axis=1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=1, keepdims=True)
@@ -83,6 +90,20 @@ def _ln_fwd(x, weight, bias, normalized_shape, eps, memory_efficient):
 def _ln_bwd(normalized_shape, eps, memory_efficient, res, g):
     saved, mean, invvar, weight, bias = res
     g2, lead, n = _rows_view(g, normalized_shape)
+
+    from apex_tpu.ops.layer_norm_pallas import layer_norm_bwd_pallas, pallas_available
+
+    if not memory_efficient and pallas_available(g2, n):
+        x2 = saved.reshape((-1, n))
+        w = weight.reshape(n) if weight is not None else None
+        dx, dw_p, db_p = layer_norm_bwd_pallas(
+            x2, w, g2, mean[:, None], invvar[:, None], with_bias=bias is not None
+        )
+        dx = dx.reshape(g.shape).astype(g.dtype)
+        dw = dw_p.sum(0).reshape(weight.shape).astype(weight.dtype) if weight is not None else None
+        db = db_p.sum(0).reshape(bias.shape).astype(bias.dtype) if (bias is not None and db_p is not None) else None
+        return dx, dw, db
+
     gf = g2.astype(jnp.float32)
     inv = invvar[:, None]
 
@@ -131,6 +152,12 @@ def _rms_norm(x, weight, normalized_shape, eps, memory_efficient):
 
 def _rms_fwd_impl(x, weight, normalized_shape, eps):
     x2, lead, n = _rows_view(x, normalized_shape)
+    from apex_tpu.ops.layer_norm_pallas import layer_norm_fwd_pallas, pallas_available
+
+    if pallas_available(x2, n):
+        w = weight.reshape(n) if weight is not None else None
+        y, _, rstd = layer_norm_fwd_pallas(x2, w, None, eps, rms=True)
+        return y.reshape(x.shape), rstd[:, 0]
     xf = x2.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=1, keepdims=True)
     invvar = jax.lax.rsqrt(var + eps)
@@ -149,6 +176,20 @@ def _rms_fwd(x, weight, normalized_shape, eps, memory_efficient):
 def _rms_bwd(normalized_shape, eps, memory_efficient, res, g):
     saved, invvar, weight = res
     g2, lead, n = _rows_view(g, normalized_shape)
+
+    from apex_tpu.ops.layer_norm_pallas import layer_norm_bwd_pallas, pallas_available
+
+    if not memory_efficient and pallas_available(g2, n):
+        x2 = saved.reshape((-1, n))
+        w = weight.reshape(n) if weight is not None else None
+        dx, dw_p, _ = layer_norm_bwd_pallas(
+            x2, w, g2, jnp.zeros_like(invvar)[:, None], invvar[:, None],
+            rms=True, with_bias=False,
+        )
+        dx = dx.reshape(g.shape).astype(g.dtype)
+        dw = dw_p.sum(0).reshape(weight.shape).astype(weight.dtype) if weight is not None else None
+        return dx, dw
+
     gf = g2.astype(jnp.float32)
     inv = invvar[:, None]
 

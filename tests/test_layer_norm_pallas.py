@@ -1,0 +1,60 @@
+"""Pallas LN kernel tests (interpret mode on CPU; the real-TPU run is
+exercised by bench/driver).  Parity vs the jnp specification."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.layer_norm_pallas import (
+    _pick_block_r,
+    layer_norm_bwd_pallas,
+    layer_norm_fwd_pallas,
+)
+
+
+def test_pick_block_r_fits_vmem():
+    assert _pick_block_r(8192, 4096, 256) * 4096 * 32 <= 8 * 1024 * 1024
+    assert _pick_block_r(1024, 1024, 256) == 256
+    assert 8192 % _pick_block_r(8192, 4096, 256) == 0
+
+
+@pytest.mark.parametrize("rms", [False, True])
+def test_fwd_interpret_matches_reference(rms):
+    R, H = 32, 128
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(R, H).astype(np.float32))
+    w = jnp.asarray(rng.rand(H).astype(np.float32) + 0.5)
+    b = None if rms else jnp.asarray(rng.randn(H).astype(np.float32))
+    y, mean, rstd = layer_norm_fwd_pallas(x, w, b, 1e-5, rms=rms, block_r=16, interpret=True)
+
+    if rms:
+        var = jnp.mean(x * x, 1, keepdims=True)
+        ref = x * jax.lax.rsqrt(var + 1e-5) * w
+    else:
+        mu = jnp.mean(x, 1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, 1, keepdims=True)
+        ref = (x - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_bwd_interpret_matches_autodiff():
+    R, H = 32, 128
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(R, H).astype(np.float32))
+    w = jnp.asarray(rng.rand(H).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(H).astype(np.float32))
+    dy = jnp.asarray(rng.randn(R, H).astype(np.float32))
+
+    _, mean, rstd = layer_norm_fwd_pallas(x, w, b, 1e-5, block_r=16, interpret=True)
+    dx, dw_acc, db_acc = layer_norm_bwd_pallas(x, w, dy, mean, rstd, block_r=16, interpret=True)
+
+    def f(x, w, b):
+        mu = jnp.mean(x, 1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, 1, keepdims=True)
+        return jnp.sum(((x - mu) * jax.lax.rsqrt(var + 1e-5) * w + b) * dy)
+
+    gx, gw, gb = jax.grad(f, (0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(gx), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dw_acc.sum(0)), np.asarray(gw), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db_acc.sum(0)), np.asarray(gb), rtol=1e-4, atol=1e-4)
