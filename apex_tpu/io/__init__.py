@@ -1,0 +1,8 @@
+"""Host-side I/O runtime: native flatten/unflatten, fast checkpointing,
+input prefetch."""
+
+from apex_tpu.io import native
+from apex_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+from apex_tpu.io.prefetch import PrefetchIterator
+
+__all__ = ["native", "save_checkpoint", "load_checkpoint", "PrefetchIterator"]

@@ -1,0 +1,73 @@
+"""Fast single-blob checkpointing for param/state pytrees.
+
+Reference: apex's checkpoint story (``apex/amp/frontend.py:365-404``
+scaler state, ``fp16_utils/fp16_optimizer.py`` optimizer state,
+``DistributedFusedAdam`` sharded state dicts :2527) plus the recommended
+save/load recipe in the reference README.
+
+TPU-native addition: the pytree's leaves are gathered into ONE
+contiguous blob with the native multithreaded flatten
+(:mod:`apex_tpu.io.native`) — one write() syscall, no per-leaf pickle
+overhead — with a JSON header carrying structure/shapes/dtypes.  Orbax
+remains the right answer for multi-host async checkpointing; this is
+the dependency-free fast path the reference's users had with
+``torch.save``.
+"""
+
+import json
+import struct
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from apex_tpu.io import native
+
+_MAGIC = b"APEXTPU1"
+
+
+def save_checkpoint(path, tree: Any) -> None:
+    """Serialize a pytree of arrays (+ scalars/None) to ``path``."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = []
+    meta = []
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        arrays.append(np.ascontiguousarray(a))
+        meta.append({"shape": list(a.shape), "dtype": a.dtype.str})
+    blob = native.flatten(arrays) if arrays else np.empty(0, np.uint8)
+    header = json.dumps(
+        {"treedef": str(treedef), "leaves": meta}
+    ).encode()
+    # structure is rebuilt from an example tree on load; the treedef
+    # string is stored for sanity checking only
+    import pickle
+
+    treedef_bytes = pickle.dumps(treedef)
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with open(p, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<QQ", len(header), len(treedef_bytes)))
+        f.write(header)
+        f.write(treedef_bytes)
+        f.write(blob.tobytes())
+
+
+def load_checkpoint(path) -> Any:
+    """Load a pytree saved by :func:`save_checkpoint` (numpy leaves)."""
+    import pickle
+
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise ValueError(f"{path} is not an apex_tpu checkpoint")
+        hlen, tlen = struct.unpack("<QQ", f.read(16))
+        header = json.loads(f.read(hlen))
+        treedef = pickle.loads(f.read(tlen))
+        blob = np.frombuffer(f.read(), np.uint8)
+    shapes = [tuple(m["shape"]) for m in header["leaves"]]
+    dtypes = [np.dtype(m["dtype"]) for m in header["leaves"]]
+    leaves = native.unflatten(blob, shapes, dtypes)
+    return jax.tree_util.tree_unflatten(treedef, leaves)

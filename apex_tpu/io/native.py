@@ -1,0 +1,138 @@
+"""ctypes loader for the native runtime library.
+
+Builds ``native/apex_tpu_native.cpp`` with g++ on first use (cached in
+``native/build/``) and exposes flatten/unflatten/gather_rows.  Falls
+back to NumPy loops when no compiler is available — all callers must
+work either way (the reference's lazy-and-tolerant extension import
+pattern, ``apex/multi_tensor_apply/multi_tensor_apply.py:8-14``).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parents[2]
+_SRC = _REPO / "native" / "apex_tpu_native.cpp"
+_SO = _REPO / "native" / "build" / "libapex_tpu_native.so"
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+DEFAULT_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    _SO.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        str(_SRC), "-o", str(_SO),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    return ctypes.CDLL(str(_SO))
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if _SO.exists():
+            try:
+                _lib = ctypes.CDLL(str(_SO))
+            except OSError:
+                _lib = _build()
+        else:
+            _lib = _build()
+        if _lib is not None:
+            _lib.apex_tpu_native_abi_version.restype = ctypes.c_int
+            if _lib.apex_tpu_native_abi_version() != 1:
+                _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def flatten(arrays: List[np.ndarray], threads: int = DEFAULT_THREADS) -> np.ndarray:
+    """Concatenate arbitrary-dtype arrays into one byte buffer
+    (apex_C.flatten, csrc/flatten_unflatten.cpp:16)."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    sizes = np.array([a.nbytes for a in arrays], np.int64)
+    total = int(sizes.sum())
+    out = np.empty(total, np.uint8)
+    lib = get_lib()
+    if lib is None:
+        off = 0
+        for a, s in zip(arrays, sizes):
+            out[off : off + s] = a.view(np.uint8).reshape(-1)
+            off += int(s)
+        return out
+    srcs = (ctypes.c_void_p * len(arrays))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays]
+    )
+    lib.apex_tpu_flatten(
+        srcs,
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(len(arrays)),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(threads),
+    )
+    return out
+
+
+def unflatten(buf: np.ndarray, shapes, dtypes, threads: int = DEFAULT_THREADS) -> List[np.ndarray]:
+    """Split a flat byte buffer back into arrays (apex_C.unflatten)."""
+    outs = [np.empty(s, d) for s, d in zip(shapes, dtypes)]
+    sizes = np.array([o.nbytes for o in outs], np.int64)
+    lib = get_lib()
+    if lib is None:
+        off = 0
+        for o, s in zip(outs, sizes):
+            o.view(np.uint8).reshape(-1)[:] = buf[off : off + s]
+            off += int(s)
+        return outs
+    buf = np.ascontiguousarray(buf)
+    dsts = (ctypes.c_void_p * len(outs))(
+        *[o.ctypes.data_as(ctypes.c_void_p) for o in outs]
+    )
+    lib.apex_tpu_unflatten(
+        buf.ctypes.data_as(ctypes.c_void_p),
+        dsts,
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(len(outs)),
+        ctypes.c_int(threads),
+    )
+    return outs
+
+
+def gather_rows(src: np.ndarray, indices: np.ndarray, threads: int = DEFAULT_THREADS) -> np.ndarray:
+    """dst[i] = src[indices[i]] — batch assembly for input pipelines."""
+    src = np.ascontiguousarray(src)
+    indices = np.ascontiguousarray(indices.astype(np.int64))
+    n = len(indices)
+    out = np.empty((n,) + src.shape[1:], src.dtype)
+    lib = get_lib()
+    if lib is None:
+        np.take(src, indices, axis=0, out=out)
+        return out
+    row_bytes = src[0].nbytes if src.shape[0] else 0
+    lib.apex_tpu_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(n),
+        ctypes.c_int64(row_bytes),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(threads),
+    )
+    return out

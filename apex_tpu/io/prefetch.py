@@ -1,0 +1,47 @@
+"""Input prefetch: overlap host batch assembly with device compute.
+
+The role DALI/torch DataLoader workers play for the reference's imagenet
+example (``examples/imagenet/main_amp.py`` uses torch DataLoader +
+prefetcher).  Here: a background thread assembles batches (optionally
+with the native ``gather_rows``) and keeps a bounded queue ahead of the
+training loop; ``jax.device_put`` on the consumer side overlaps H2D with
+the previous step's compute (XLA dispatch is async).
+"""
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+
+class PrefetchIterator:
+    """Wrap any iterator with a depth-``size`` background prefetch queue."""
+
+    _SENTINEL = object()
+
+    def __init__(self, it: Iterator, size: int = 2, transform: Optional[Callable] = None):
+        self._q: "queue.Queue" = queue.Queue(maxsize=size)
+        self._transform = transform
+        self._err = None
+
+        def worker():
+            try:
+                for item in it:
+                    self._q.put(self._transform(item) if self._transform else item)
+            except BaseException as e:  # surface errors on the consumer side
+                self._err = e
+            finally:
+                self._q.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._SENTINEL:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

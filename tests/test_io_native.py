@@ -1,0 +1,84 @@
+"""Native runtime + checkpoint I/O tests (the apex_C flatten/unflatten
+parity of reference tests, host-side)."""
+
+import numpy as np
+import pytest
+
+from apex_tpu.io import PrefetchIterator, load_checkpoint, native, save_checkpoint
+
+
+class TestNativeLib:
+    def test_builds_and_reports_abi(self):
+        assert native.available(), "g++ build of the native library failed"
+
+    def test_flatten_unflatten_roundtrip(self):
+        rng = np.random.RandomState(0)
+        arrays = [
+            rng.randn(13, 7).astype(np.float32),
+            rng.randn(5).astype(np.float64),
+            rng.randint(0, 100, size=(3, 2)).astype(np.int32),
+            rng.randn(2, 2).astype(np.float16),
+        ]
+        blob = native.flatten(arrays)
+        assert blob.nbytes == sum(a.nbytes for a in arrays)
+        back = native.unflatten(blob, [a.shape for a in arrays], [a.dtype for a in arrays])
+        for a, b in zip(arrays, back):
+            np.testing.assert_array_equal(a, b)
+
+    def test_flatten_matches_numpy_fallback(self):
+        rng = np.random.RandomState(1)
+        arrays = [rng.randn(11).astype(np.float32) for _ in range(5)]
+        blob = native.flatten(arrays)
+        ref = np.concatenate([a.view(np.uint8) for a in arrays])
+        np.testing.assert_array_equal(blob, ref)
+
+    def test_gather_rows(self):
+        rng = np.random.RandomState(2)
+        src = rng.randn(20, 6).astype(np.float32)
+        idx = np.array([3, 3, 0, 19, 7])
+        out = native.gather_rows(src, idx)
+        np.testing.assert_array_equal(out, src[idx])
+
+
+class TestCheckpoint:
+    def test_roundtrip_pytree(self, tmp_path):
+        import jax.numpy as jnp
+
+        tree = {
+            "params": {"w": jnp.ones((4, 3)), "b": jnp.zeros((3,))},
+            "step": jnp.int32(7),
+            "nested": [jnp.arange(5.0), jnp.asarray([True, False])],
+        }
+        p = tmp_path / "ck.apex"
+        save_checkpoint(p, tree)
+        back = load_checkpoint(p)
+        assert back["params"]["w"].shape == (4, 3)
+        np.testing.assert_array_equal(np.asarray(back["step"]), 7)
+        np.testing.assert_array_equal(back["nested"][0], np.arange(5.0))
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = tmp_path / "bad.bin"
+        p.write_bytes(b"NOTAPEX!xxxx")
+        with pytest.raises(ValueError):
+            load_checkpoint(p)
+
+
+class TestPrefetch:
+    def test_yields_all_in_order(self):
+        out = list(PrefetchIterator(iter(range(10)), size=3))
+        assert out == list(range(10))
+
+    def test_transform_applied(self):
+        out = list(PrefetchIterator(iter([1, 2, 3]), transform=lambda x: x * 2))
+        assert out == [2, 4, 6]
+
+    def test_error_propagates(self):
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+
+        it = PrefetchIterator(gen())
+        assert next(it) == 1
+        with pytest.raises(RuntimeError):
+            for _ in it:
+                pass
