@@ -1,0 +1,46 @@
+"""RNN (deprecated in the reference: ``apex/RNN`` — fp16-able
+RNN/LSTM/GRU reimplementations from the pre-amp era).
+
+On TPU use ``flax.linen`` recurrent cells under ``nn.scan``; thin
+factories with the reference's names are provided for discovery.
+"""
+
+import warnings
+
+import flax.linen as nn
+
+
+def _deprecated(name):
+    warnings.warn(
+        f"apex_tpu.RNN.{name} mirrors the deprecated apex.RNN API; prefer "
+        "flax.linen recurrent cells directly",
+        DeprecationWarning,
+        stacklevel=3,
+    )
+
+
+def LSTM(input_size, hidden_size, num_layers=1, **kw):
+    _deprecated("LSTM")
+    return nn.RNN(nn.LSTMCell(features=hidden_size))
+
+
+def GRU(input_size, hidden_size, num_layers=1, **kw):
+    _deprecated("GRU")
+    return nn.RNN(nn.GRUCell(features=hidden_size))
+
+
+def ReLU(input_size, hidden_size, num_layers=1, **kw):
+    _deprecated("ReLU")
+    return nn.RNN(nn.SimpleCell(features=hidden_size, activation_fn=nn.relu))
+
+
+def Tanh(input_size, hidden_size, num_layers=1, **kw):
+    _deprecated("Tanh")
+    return nn.RNN(nn.SimpleCell(features=hidden_size))
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, **kw):
+    raise NotImplementedError(
+        "mLSTM (multiplicative LSTM) was deprecated in the reference; "
+        "no TPU port is provided"
+    )

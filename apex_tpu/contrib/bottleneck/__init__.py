@@ -1,0 +1,6 @@
+from apex_tpu.contrib.bottleneck.halo_exchangers import (
+    HaloExchanger,
+    halo_exchange_1d,
+)
+
+__all__ = ["HaloExchanger", "halo_exchange_1d"]

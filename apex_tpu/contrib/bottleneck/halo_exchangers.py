@@ -1,0 +1,55 @@
+"""Spatial-parallel halo exchange.
+
+Reference: ``apex/contrib/bottleneck/halo_exchangers.py:11-127``
+(``HaloExchangerAllGather``/``SendRecv``/``Peer``) +
+``peer_memory/peer_halo_exchanger_1d.py`` — CNNs with the spatial (H)
+dimension split across GPUs exchange boundary rows with neighbors via
+NCCL p2p or CUDA-IPC peer memory.
+
+TPU: neighbor exchange over a mesh axis is one ``ppermute`` pair riding
+ICI neighbor links — the exact communication pattern peer memory
+emulates on NVLink.  Edge ranks keep zero halos (same as the
+reference's non-periodic boundary handling).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def halo_exchange_1d(x, halo: int, axis_name: str, spatial_axis: int = 1):
+    """Exchange ``halo`` rows with ring neighbors along ``spatial_axis``.
+
+    x: local NHWC shard (split along H).  Returns x padded with the
+    received halos: shape grows by 2*halo along ``spatial_axis``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+
+    top = jax.lax.slice_in_dim(x, 0, halo, axis=spatial_axis)
+    bot = jax.lax.slice_in_dim(x, x.shape[spatial_axis] - halo, x.shape[spatial_axis], axis=spatial_axis)
+
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    from_above = jax.lax.ppermute(bot, axis_name, fwd)  # neighbor above's bottom rows
+    from_below = jax.lax.ppermute(top, axis_name, bwd)  # neighbor below's top rows
+
+    # zero halos at the non-periodic boundary (reference edge handling)
+    from_above = jnp.where(rank == 0, jnp.zeros_like(from_above), from_above)
+    from_below = jnp.where(rank == n - 1, jnp.zeros_like(from_below), from_below)
+    return jnp.concatenate([from_above, x, from_below], axis=spatial_axis)
+
+
+class HaloExchanger:
+    """Object parity with the reference exchangers; one implementation
+    (ppermute) covers AllGather/SendRecv/Peer — they differ only in the
+    NCCL/IPC transport."""
+
+    def __init__(self, axis_name: str, halo: int = 1, spatial_axis: int = 1):
+        self.axis_name = axis_name
+        self.halo = halo
+        self.spatial_axis = spatial_axis
+
+    def __call__(self, x):
+        return halo_exchange_1d(x, self.halo, self.axis_name, self.spatial_axis)

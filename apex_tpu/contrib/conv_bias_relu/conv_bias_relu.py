@@ -1,0 +1,32 @@
+"""Fused Conv+Bias(+Mask)+ReLU.
+
+Reference: ``apex/contrib/conv_bias_relu/conv_bias_relu.py:12-78``
+(cuDNN-frontend fused graphs).  XLA fuses the conv epilogue natively;
+these are the callable composites with the reference's names.  NHWC
+layout (TPU conv layout); weights (KH, KW, Cin, Cout).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _conv(x, w, stride, padding):
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def ConvBias(x, weight, bias, stride: int = 1, padding="SAME"):
+    return _conv(x, weight, stride, padding) + bias
+
+
+def ConvBiasReLU(x, weight, bias, stride: int = 1, padding="SAME"):
+    return jax.nn.relu(ConvBias(x, weight, bias, stride, padding))
+
+
+def ConvBiasMaskReLU(x, weight, bias, mask, stride: int = 1, padding="SAME"):
+    return jax.nn.relu(ConvBias(x, weight, bias, stride, padding) * mask)

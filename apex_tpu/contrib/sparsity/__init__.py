@@ -1,0 +1,3 @@
+from apex_tpu.contrib.sparsity.asp import ASP, compute_sparse_masks, m4n2_mask
+
+__all__ = ["ASP", "compute_sparse_masks", "m4n2_mask"]

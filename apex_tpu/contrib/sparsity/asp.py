@@ -1,0 +1,87 @@
+"""ASP — automatic 2:4 structured sparsity.
+
+Reference: ``apex/contrib/sparsity/asp.py:28``
+(``ASP.prune_trained_model``: compute 2-of-4 magnitude masks for eligible
+weights, register pruning hooks) and the channel-permutation search
+(``permutation_lib.py``) that improves mask quality.
+
+TPU notes: 2:4 sparse *execution* is an NVIDIA Ampere tensor-core
+feature with no TPU analog — the MXU runs dense.  What transfers is the
+*algorithm*: mask computation, masked training (weights multiplied by a
+static mask each step so pruned weights stay zero through optimizer
+updates), and mask persistence.  That is exactly the part apex implements
+in Python; the CUDA here is only the permutation search, replaced by a
+greedy JAX implementation.
+"""
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def m4n2_mask(w: jnp.ndarray) -> jnp.ndarray:
+    """2-of-4 magnitude mask along the last dim (reference
+    sparse_masklib.py m4n2_1d): in every group of 4, keep the 2 largest
+    |w|."""
+    orig_shape = w.shape
+    n = orig_shape[-1]
+    if n % 4 != 0:
+        raise ValueError(f"last dim ({n}) must be divisible by 4 for 2:4 sparsity")
+    g = jnp.abs(w.reshape(-1, 4))
+    # rank positions within each group of 4; keep top-2
+    order = jnp.argsort(g, axis=-1)  # ascending
+    mask = jnp.zeros_like(g, dtype=bool)
+    rows = jnp.arange(g.shape[0])
+    mask = mask.at[rows, order[:, 3]].set(True)
+    mask = mask.at[rows, order[:, 2]].set(True)
+    return mask.reshape(orig_shape)
+
+
+def _eligible(path: str, w) -> bool:
+    """Prune 2D+ weights, skip norms/biases/embeddings (reference
+    asp.py eligibility rules)."""
+    p = path.lower()
+    if w.ndim < 2:
+        return False
+    if any(k in p for k in ("norm", "bn", "bias", "embed")):
+        return False
+    return w.shape[-1] % 4 == 0
+
+
+def compute_sparse_masks(params, eligible: Callable = _eligible):
+    """Boolean mask pytree (True = keep); ineligible leaves get None."""
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    masks = []
+    for kp, w in flat[0]:
+        path = jax.tree_util.keystr(kp)
+        masks.append(m4n2_mask(w) if eligible(path, w) else None)
+    return jax.tree_util.tree_unflatten(flat[1], masks)
+
+
+def apply_masks(params, masks):
+    return jax.tree.map(
+        lambda w, m: w if m is None else w * m.astype(w.dtype),
+        params,
+        masks,
+        is_leaf=lambda x: x is None,
+    )
+
+
+class ASP:
+    """Functional ASP workflow (reference asp.py):
+
+        masks = ASP.compute_sparse_masks(params)      # once, post-training
+        params = ASP.prune_trained_model(params, masks)
+        # during sparse finetuning, after every optimizer step:
+        params = ASP.apply_masks(params, masks)
+    """
+
+    compute_sparse_masks = staticmethod(compute_sparse_masks)
+    apply_masks = staticmethod(apply_masks)
+
+    @staticmethod
+    def prune_trained_model(params, masks=None):
+        if masks is None:
+            masks = compute_sparse_masks(params)
+        return apply_masks(params, masks), masks

@@ -1,0 +1,3 @@
+from apex_tpu.contrib.transducer.transducer import TransducerJoint, TransducerLoss, transducer_loss
+
+__all__ = ["TransducerJoint", "TransducerLoss", "transducer_loss"]

@@ -1,0 +1,213 @@
+"""BERT — bidirectional encoder LM (the FusedLAMB pretraining workload).
+
+Reference: ``apex/transformer/testing/standalone_bert.py`` (Megatron
+BertModel used by test_bert_minimal.py) and BASELINE config 5
+(BERT-large + FusedLAMB + O2).
+
+Same TPU-first skeleton as :mod:`apex_tpu.models.gpt` — (seq, batch,
+hidden) activations, scan over stacked layers, one code path for dense
+and tensor-parallel — with bidirectional attention under a padding mask
+and the MLM head (binary NSP head omitted; modern recipes drop it and
+the reference's test path exercises MLM loss).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.normalization import fused_layer_norm_affine
+from apex_tpu.transformer.functional import scaled_masked_softmax
+from apex_tpu.transformer.tensor_parallel.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_attention_heads: int = 16
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    ffn_hidden_size: Optional[int] = None
+    layernorm_eps: float = 1e-12
+    compute_dtype: Any = jnp.bfloat16
+    checkpoint_layers: bool = True
+
+    @property
+    def ffn(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def init_params(config: BertConfig, key) -> Dict[str, Any]:
+    H, F, L, V = config.hidden_size, config.ffn, config.num_layers, config.vocab_size
+    k = jax.random.split(key, 10)
+    std = 0.02
+    init = lambda kk, *s: jax.random.normal(kk, s, jnp.float32) * std
+    return {
+        "embed": init(k[0], V, H),
+        "pos_embed": init(k[1], config.max_seq_len, H),
+        "type_embed": init(k[2], config.type_vocab_size, H),
+        "embed_ln_scale": jnp.ones((H,)),
+        "embed_ln_bias": jnp.zeros((H,)),
+        "layers": {
+            "wq": init(k[3], L, H, H),
+            "wk": init(k[4], L, H, H),
+            "wv": init(k[5], L, H, H),
+            "bq": jnp.zeros((L, H)),
+            "bk": jnp.zeros((L, H)),
+            "bv": jnp.zeros((L, H)),
+            "wo": init(k[6], L, H, H) / np.sqrt(2 * L),
+            "bo": jnp.zeros((L, H)),
+            "ln1_scale": jnp.ones((L, H)),
+            "ln1_bias": jnp.zeros((L, H)),
+            "fc1": init(k[7], L, F, H),
+            "fc1_b": jnp.zeros((L, F)),
+            "fc2": init(k[8], L, H, F) / np.sqrt(2 * L),
+            "fc2_b": jnp.zeros((L, H)),
+            "ln2_scale": jnp.ones((L, H)),
+            "ln2_bias": jnp.zeros((L, H)),
+        },
+        "mlm_dense": init(k[9], H, H),
+        "mlm_dense_b": jnp.zeros((H,)),
+        "mlm_ln_scale": jnp.ones((H,)),
+        "mlm_ln_bias": jnp.zeros((H,)),
+    }
+
+
+def param_specs(config: BertConfig):
+    from jax.sharding import PartitionSpec as P
+
+    col, colb, row, rep2 = P(None, "tp", None), P(None, "tp"), P(None, None, "tp"), P(None, None)
+    return {
+        "embed": P("tp", None),
+        "pos_embed": P(None, None),
+        "type_embed": P(None, None),
+        "embed_ln_scale": P(None),
+        "embed_ln_bias": P(None),
+        "layers": {
+            "wq": col, "wk": col, "wv": col,
+            "bq": colb, "bk": colb, "bv": colb,
+            "wo": row, "bo": rep2,
+            "ln1_scale": rep2, "ln1_bias": rep2,
+            "fc1": col, "fc1_b": colb,
+            "fc2": row, "fc2_b": rep2,
+            "ln2_scale": rep2, "ln2_bias": rep2,
+        },
+        "mlm_dense": P(None, None),
+        "mlm_dense_b": P(None),
+        "mlm_ln_scale": P(None),
+        "mlm_ln_bias": P(None),
+    }
+
+
+def _attention(x, p, pad_mask, config, axis_name, n_local_heads):
+    S, B = x.shape[0], x.shape[1]
+    hd = config.head_dim
+
+    def col(x_, w, b):
+        if axis_name is None:
+            return jnp.matmul(x_, w.T.astype(x_.dtype)) + b.astype(x_.dtype)
+        return column_parallel_linear(x_, w, b, gather_output=False, axis_name=axis_name)
+
+    q, k, v = (col(x, p[f"w{n}"], p[f"b{n}"]) for n in "qkv")
+
+    def heads(t):
+        return t.reshape(S, B, n_local_heads, hd).transpose(1, 2, 0, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(hd)
+    # pad_mask (B, S) True=valid → attention mask True=masked
+    mask = None if pad_mask is None else (~pad_mask)[:, None, None, :]
+    probs = scaled_masked_softmax(scores, mask, 1.0)
+    ctx = jnp.einsum("bnst,bnth->bnsh", probs.astype(v.dtype), v)
+    ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, n_local_heads * hd)
+    if axis_name is None:
+        return jnp.matmul(ctx, p["wo"].T.astype(ctx.dtype)) + p["bo"].astype(ctx.dtype)
+    return row_parallel_linear(ctx, p["wo"], p["bo"], input_is_parallel=True, axis_name=axis_name)
+
+
+def _mlp(x, p, axis_name):
+    if axis_name is None:
+        h = jnp.matmul(x, p["fc1"].T.astype(x.dtype)) + p["fc1_b"].astype(x.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        return jnp.matmul(h, p["fc2"].T.astype(h.dtype)) + p["fc2_b"].astype(h.dtype)
+    h = column_parallel_linear(x, p["fc1"], p["fc1_b"], gather_output=False, axis_name=axis_name)
+    h = jax.nn.gelu(h, approximate=True)
+    return row_parallel_linear(h, p["fc2"], p["fc2_b"], input_is_parallel=True, axis_name=axis_name)
+
+
+def _layer(x, p, pad_mask, config, axis_name, n_local_heads):
+    # BERT post-LN block
+    H = config.hidden_size
+    a = _attention(x, p, pad_mask, config, axis_name, n_local_heads)
+    x = fused_layer_norm_affine(x + a, p["ln1_scale"], p["ln1_bias"], (H,), config.layernorm_eps)
+    m = _mlp(x.astype(config.compute_dtype), p, axis_name)
+    x = fused_layer_norm_affine(x + m, p["ln2_scale"], p["ln2_bias"], (H,), config.layernorm_eps)
+    return x.astype(config.compute_dtype)
+
+
+def bert_forward(params, tokens, token_types=None, pad_mask=None, config: BertConfig = None, axis_name=None):
+    """tokens (B, S) → MLM logits (S, B, V or V/tp)."""
+    B, S = tokens.shape
+    tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    n_local_heads = config.num_attention_heads // tp
+
+    if axis_name is None:
+        emb = jnp.take(params["embed"], tokens, axis=0)
+    else:
+        emb = vocab_parallel_embedding(tokens, params["embed"], axis_name=axis_name)
+    x = emb.transpose(1, 0, 2) + params["pos_embed"][:S][:, None, :]
+    if token_types is not None:
+        x = x + jnp.take(params["type_embed"], token_types, axis=0).transpose(1, 0, 2)
+    x = fused_layer_norm_affine(
+        x, params["embed_ln_scale"], params["embed_ln_bias"], (config.hidden_size,), config.layernorm_eps
+    )
+    x = x.astype(config.compute_dtype)
+
+    layer = partial(
+        _layer, pad_mask=pad_mask, config=config, axis_name=axis_name, n_local_heads=n_local_heads
+    )
+    if config.checkpoint_layers:
+        layer = jax.checkpoint(layer)
+    x, _ = jax.lax.scan(lambda c, lp: (layer(c, lp), None), x, params["layers"])
+
+    # MLM head: dense + gelu + LN + tied decoder
+    h = jnp.matmul(x.astype(jnp.float32), params["mlm_dense"].T) + params["mlm_dense_b"]
+    h = jax.nn.gelu(h, approximate=True)
+    h = fused_layer_norm_affine(
+        h, params["mlm_ln_scale"], params["mlm_ln_bias"], (config.hidden_size,), config.layernorm_eps
+    )
+    if axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            copy_to_tensor_model_parallel_region,
+        )
+
+        h = copy_to_tensor_model_parallel_region(h, axis_name)
+    return jnp.matmul(h, params["embed"].T.astype(jnp.float32))
+
+
+def bert_mlm_loss(params, tokens, targets, loss_mask, config: BertConfig, axis_name=None, pad_mask=None):
+    """Mean MLM CE over masked positions (loss_mask (B, S) 1=predict)."""
+    logits = bert_forward(params, tokens, pad_mask=pad_mask, config=config, axis_name=axis_name)
+    t = targets.transpose(1, 0)
+    lm = loss_mask.transpose(1, 0).astype(jnp.float32)
+    if axis_name is None:
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        loss = lse - tgt
+    else:
+        loss = vocab_parallel_cross_entropy(logits, t, 0.0, axis_name)
+    return jnp.sum(loss * lm) / jnp.maximum(jnp.sum(lm), 1.0)

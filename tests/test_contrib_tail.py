@@ -1,0 +1,155 @@
+"""Contrib tail tests: ASP 2:4 masks, transducer loss (vs brute-force
+DP oracle), conv_bias_relu (vs torch), halo exchange (vs full-tensor
+conv), RNN factories."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.bottleneck import halo_exchange_1d
+from apex_tpu.contrib.conv_bias_relu import ConvBias, ConvBiasReLU
+from apex_tpu.contrib.sparsity import ASP, compute_sparse_masks, m4n2_mask
+from apex_tpu.contrib.transducer import TransducerJoint, transducer_loss
+
+
+class TestASP:
+    def test_m4n2_keeps_two_of_four(self):
+        w = jnp.asarray(np.random.RandomState(0).randn(8, 16).astype(np.float32))
+        m = m4n2_mask(w)
+        groups = np.asarray(m).reshape(-1, 4)
+        assert (groups.sum(1) == 2).all()
+
+    def test_mask_keeps_largest(self):
+        w = jnp.asarray([[1.0, -5.0, 0.1, 3.0]])
+        m = m4n2_mask(w)
+        np.testing.assert_array_equal(np.asarray(m), [[False, True, False, True]])
+
+    def test_prune_trained_model(self):
+        params = {
+            "dense": jnp.asarray(np.random.RandomState(1).randn(4, 8).astype(np.float32)),
+            "bias": jnp.ones((4,)),
+            "layernorm": jnp.ones((4, 8)),
+        }
+        pruned, masks = ASP.prune_trained_model(params)
+        assert masks["bias"] is None  # 1D skipped
+        assert masks["layernorm"] is None  # norm skipped
+        dense = np.asarray(pruned["dense"]).reshape(-1, 4)
+        assert ((dense != 0).sum(1) <= 2).all()
+
+    def test_masked_training_stays_sparse(self):
+        params = {"w": jnp.asarray(np.random.RandomState(2).randn(4, 8).astype(np.float32))}
+        pruned, masks = ASP.prune_trained_model(params)
+        stepped = jax.tree.map(lambda p: p + 0.1, pruned)  # optimizer densifies
+        remasked = ASP.apply_masks(stepped, masks)
+        assert (np.asarray(remasked["w"]).reshape(-1, 4) != 0).sum() <= 2 * 8
+
+
+class TestTransducer:
+    def test_joint_broadcast_add(self):
+        f = jnp.ones((2, 3, 4))
+        g = jnp.full((2, 5, 4), 2.0)
+        out = TransducerJoint()(f, g)
+        assert out.shape == (2, 3, 5, 4)
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+
+    def test_loss_matches_bruteforce(self):
+        rng = np.random.RandomState(3)
+        B, T, U, V = 2, 4, 3, 5  # targets length U-1=2, vocab incl blank
+        logits = rng.randn(B, T, U, V).astype(np.float32)
+        targets = rng.randint(0, V - 1, size=(B, U - 1))
+        loss = transducer_loss(
+            jnp.asarray(logits),
+            jnp.asarray(targets) ,
+            jnp.full((B,), T),
+            jnp.full((B,), U - 1),
+            blank_idx=0,
+        )
+        logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+        for b in range(B):
+            ref = rnnt_oracle_full(logp[b], targets[b], T, U)
+            np.testing.assert_allclose(float(loss[b]), ref, rtol=1e-4)
+
+    def test_loss_is_differentiable(self):
+        rng = np.random.RandomState(4)
+        logits = jnp.asarray(rng.randn(1, 3, 2, 4).astype(np.float32))
+        g = jax.grad(
+            lambda l: jnp.sum(
+                transducer_loss(l, jnp.asarray([[1]]), jnp.asarray([3]), jnp.asarray([1]))
+            )
+        )(logits)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+def rnnt_oracle_full(logp, targets, T, U):
+    """Brute-force alpha DP (blank=0, labels are raw vocab ids)."""
+    alpha = np.full((T, U), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U):
+            if t == 0 and u == 0:
+                continue
+            cands = []
+            if t > 0:
+                cands.append(alpha[t - 1, u] + logp[t - 1, u, 0])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + logp[t, u - 1, targets[u - 1]])
+            alpha[t, u] = np.logaddexp.reduce(cands)
+    return -(alpha[T - 1, U - 1] + logp[T - 1, U - 1, 0])
+
+
+class TestConvBiasReLU:
+    def test_matches_torch(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(1, 8, 8, 3).astype(np.float32)  # NHWC
+        w = rng.randn(3, 3, 3, 6).astype(np.float32)  # HWIO
+        b = rng.randn(6).astype(np.float32)
+        out = ConvBiasReLU(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        ref = torch.nn.functional.relu(
+            torch.nn.functional.conv2d(
+                torch.tensor(x).permute(0, 3, 1, 2),
+                torch.tensor(w).permute(3, 2, 0, 1),
+                torch.tensor(b),
+                padding=1,
+            )
+        ).permute(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+class TestHaloExchange:
+    def test_sharded_conv_matches_full(self, devices8):
+        """The spatial-parallelism correctness test: conv over H-sharded
+        tensor with halo exchange == conv over the full tensor."""
+        rng = np.random.RandomState(6)
+        N, H, W, C = 1, 16, 8, 3
+        x = rng.randn(N, H, W, C).astype(np.float32)
+        w = rng.randn(3, 3, C, 4).astype(np.float32)
+
+        ref = ConvBias(jnp.asarray(x), jnp.asarray(w), jnp.zeros(4), padding="SAME")
+
+        mesh = Mesh(np.array(devices8[:4]), ("spatial",))
+
+        def f(x, w):
+            padded = halo_exchange_1d(x, 1, "spatial", spatial_axis=1)
+            out = ConvBias(padded, w, jnp.zeros(4), padding=[(0, 0), (1, 1)])
+            return out  # VALID in H after halo, SAME in W
+
+        out = jax.shard_map(
+            f, mesh=mesh, in_specs=(P(None, "spatial"), P()), out_specs=P(None, "spatial"),
+            check_vma=False,
+        )(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+class TestRNN:
+    def test_factories_emit_deprecation(self):
+        import apex_tpu.RNN as RNN
+
+        with pytest.warns(DeprecationWarning):
+            m = RNN.LSTM(8, 16)
+        x = jnp.ones((2, 5, 8))
+        params = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(params, x)
+        assert out.shape == (2, 5, 16)
