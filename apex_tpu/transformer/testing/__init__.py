@@ -1,0 +1,26 @@
+"""Test scaffolding (reference: ``apex/transformer/testing``).
+
+The reference ships standalone Megatron GPT/BERT clones
+(``standalone_gpt.py``/``standalone_bert.py``) and multiprocess test
+bases (``distributed_test_base.py``).  Here the standalone models ARE
+the library models, and the distributed base is a mesh helper: JAX's
+virtual multi-device CPU platform replaces process spawning.
+"""
+
+from apex_tpu.transformer.testing.commons import (
+    DistributedTestContext,
+    make_mesh,
+    smap,
+    toy_stage_fn,
+)
+from apex_tpu.models import bert as standalone_bert
+from apex_tpu.models import gpt as standalone_gpt
+
+__all__ = [
+    "DistributedTestContext",
+    "make_mesh",
+    "smap",
+    "toy_stage_fn",
+    "standalone_gpt",
+    "standalone_bert",
+]

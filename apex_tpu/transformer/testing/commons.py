@@ -1,0 +1,70 @@
+"""Common distributed-test helpers.
+
+Reference: ``apex/transformer/testing/commons.py`` (toy models,
+``fwd_step_func``) and ``distributed_test_base.py:22-96``
+(``DistributedTestBase`` spawning NCCL/UCC process groups).
+
+TPU: no processes to spawn — a ``Mesh`` over the virtual CPU devices is
+the "cluster".  ``DistributedTestContext`` mirrors the setup/teardown
+shape of the reference base class for tests that want parallel_state
+initialized.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from apex_tpu.transformer import parallel_state
+
+
+def make_mesh(axis_sizes: dict, devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh from {"axis": size} in the given order."""
+    devs = list(devices) if devices is not None else jax.devices()
+    names = tuple(axis_sizes)
+    shape = tuple(axis_sizes[n] for n in names)
+    n = int(np.prod(shape))
+    return Mesh(np.array(devs[:n]).reshape(shape), names)
+
+
+def smap(mesh, f, in_specs, out_specs):
+    """shard_map with check_vma=False (custom_vjp collectives hide
+    replication info from the static checker)."""
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+
+
+class DistributedTestContext:
+    """``with DistributedTestContext(tp=2, pp=2): ...`` — initializes and
+    tears down parallel_state around a test (the reference's
+    setUp/tearDown, distributed_test_base.py:40-77)."""
+
+    def __init__(self, tp: int = 1, pp: int = 1, cp: int = 1, devices=None):
+        self.tp, self.pp, self.cp = tp, pp, cp
+        self.devices = devices
+        self.mesh = None
+
+    def __enter__(self):
+        self.mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=self.tp,
+            pipeline_model_parallel_size_=self.pp,
+            context_parallel_size_=self.cp,
+            devices=self.devices,
+        )
+        return self
+
+    def __exit__(self, *exc):
+        parallel_state.destroy_model_parallel()
+        return False
+
+
+def toy_stage_fn(stage_params, x):
+    """Stacked tanh layers — the toy pipeline stage used in schedule
+    tests (reference commons.py toy models)."""
+
+    def body(carry, lp):
+        return jnp.tanh(carry @ lp["w"] + lp["b"]), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
