@@ -311,6 +311,121 @@ def make_train_step(
     return jax.jit(sharded)
 
 
+def make_pp_train_step(
+    config: GPTConfig,
+    optimizer,
+    mesh,
+    num_microbatches: int,
+    tp_axis: str = "tp",
+    pp_axis: str = "pp",
+    dp_axis: Optional[str] = "dp",
+):
+    """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
+
+    Layer-stacked params shard over ``pp`` on their leading axis and over
+    ``tp`` on their weight axes (the layout of reference §3.4: each
+    pipeline stage owns L/pp layers, each TP rank a weight shard).  The
+    batch splits into ``num_microbatches`` microbatches driven through
+    :func:`...schedules.forward_backward_pipelining_without_interleaving`.
+    Returns ``step(params, opt_state, tokens, targets) -> (params,
+    opt_state, loss)`` (jitted).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_without_interleaving,
+    )
+
+    H = config.hidden_size
+    tp = mesh.shape[tp_axis]
+    n_local_heads = config.num_attention_heads // tp
+    sp = config.sequence_parallel
+
+    base = param_specs(config)
+
+    def pp_spec(spec):
+        # prepend pp sharding on the stacked-layer axis
+        return P(pp_axis, *spec[1:])
+
+    specs = dict(base)
+    specs["layers"] = {k: pp_spec(s) for k, s in base["layers"].items()}
+
+    def pre_fn(shared, mb):
+        tokens = mb["tokens"]
+        B, S = tokens.shape
+        emb = vocab_parallel_embedding(tokens, shared["embed"], axis_name=tp_axis)
+        x = emb.transpose(1, 0, 2) + shared["pos_embed"][:S][:, None, :]
+        x = x.astype(config.compute_dtype)
+        if sp:
+            from apex_tpu.transformer.tensor_parallel.mappings import (
+                scatter_to_sequence_parallel_region,
+            )
+
+            x = scatter_to_sequence_parallel_region(x, tp_axis)
+        return x
+
+    def stage_fn(stage_params, x):
+        layer = partial(_layer, config=config, axis_name=tp_axis, n_local_heads=n_local_heads)
+        if config.checkpoint_layers:
+            layer = jax.checkpoint(layer)
+        out, _ = jax.lax.scan(lambda c, lp: (layer(c, lp), None), x, stage_params)
+        return out
+
+    def post_fn(shared, x, mb):
+        if sp:
+            from apex_tpu.transformer.tensor_parallel.mappings import (
+                gather_from_sequence_parallel_region,
+            )
+
+            x = gather_from_sequence_parallel_region(x, tp_axis, False)
+        x = fused_layer_norm_affine(
+            x, shared["final_ln_scale"], shared["final_ln_bias"], (H,), config.layernorm_eps
+        )
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            copy_to_tensor_model_parallel_region,
+        )
+
+        x = copy_to_tensor_model_parallel_region(x, tp_axis)
+        logits = jnp.matmul(x.astype(jnp.float32), shared["embed"].T.astype(jnp.float32))
+        t = mb["targets"].transpose(1, 0)
+        loss = vocab_parallel_cross_entropy(logits, t, 0.0, tp_axis)
+        return jnp.mean(loss)
+
+    def local_step(params, opt_state, tokens, targets):
+        shared = {k: v for k, v in params.items() if k != "layers"}
+        stages = params["layers"]
+        B = tokens.shape[0]
+        mb = {
+            "tokens": tokens.reshape(num_microbatches, B // num_microbatches, -1),
+            "targets": targets.reshape(num_microbatches, B // num_microbatches, -1),
+        }
+        loss, (g_shared, g_stage) = forward_backward_pipelining_without_interleaving(
+            pre_fn, stage_fn, post_fn, shared, stages, mb, axis_name=pp_axis
+        )
+        grads = {**g_shared, "layers": g_stage}
+        if sp:
+            grads = sp_grad_sync(grads, tp_axis)
+        if dp_axis is not None:
+            loss = jax.lax.pmean(loss, dp_axis)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+        new_params, new_state = optimizer.update(grads, opt_state, params)
+        return new_params, new_state, loss
+
+    from apex_tpu.optimizers.fused_adam import AdamState
+
+    sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
+    data_spec = P(dp_axis, None) if dp_axis is not None else P()
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(specs, sspec, data_spec, data_spec),
+        out_specs=(specs, sspec, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def gpt_loss(params, tokens, targets, config: GPTConfig, axis_name: Optional[str] = None):
     """Mean causal-LM cross entropy.  Uses vocab-parallel CE on a mesh."""
     logits = gpt_forward(params, tokens, config, axis_name)  # (S, B, V?)

@@ -1,0 +1,77 @@
+"""3D-parallel GPT: tp×pp×dp pipelined training must match the
+single-device model exactly (the reference's
+test_pipeline_parallel_fwd_bwd.py parity standard, applied to the full
+flagship stack)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from apex_tpu.models.gpt import (
+    GPTConfig,
+    gpt_loss,
+    init_params,
+    make_pp_train_step,
+)
+from apex_tpu.optimizers import FusedAdam
+
+CFG = GPTConfig(
+    vocab_size=64,
+    hidden_size=32,
+    num_layers=4,
+    num_attention_heads=4,
+    max_seq_len=16,
+    compute_dtype=jnp.float32,
+    checkpoint_layers=False,
+)
+
+
+@pytest.mark.parametrize("sp", [False, True])
+def test_tp_pp_dp_matches_single_device(devices8, sp):
+    cfg = GPTConfig(**{**CFG.__dict__, "sequence_parallel": sp})
+    mesh = Mesh(np.array(devices8).reshape(2, 2, 2), ("dp", "pp", "tp"))
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(8, 16)))
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    step = make_pp_train_step(cfg, opt, mesh, num_microbatches=2)
+    new_params, new_state, loss = step(params, state, tokens, targets)
+
+    # single-device oracle: same global batch (dp shards see tokens[i::2]?
+    # data_spec P("dp", None) splits the batch over dp; total loss is the
+    # dp-mean of per-shard means == global mean over the batch)
+    ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(params, tokens, targets, CFG)
+    ref_params, _ = opt.update(ref_grads, opt.init(params), params)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    for (ka, a), (kb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(new_params),
+        jax.tree_util.tree_leaves_with_path(ref_params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-5,
+            err_msg=jax.tree_util.keystr(ka),
+        )
+
+
+def test_pp_training_reduces_loss(devices8):
+    mesh = Mesh(np.array(devices8).reshape(1, 4, 2), ("dp", "pp", "tp"))
+    params = init_params(CFG, jax.random.PRNGKey(1))
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)
+    rng = np.random.RandomState(1)
+    tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(4, 16)))
+    targets = jnp.roll(tokens, -1, axis=1)
+    step = make_pp_train_step(CFG, opt, mesh, num_microbatches=4)
+    losses = []
+    for _ in range(6):
+        params, state, loss = step(params, state, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
