@@ -12,21 +12,17 @@ from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without
     forward_backward_pipelining_without_interleaving,
     make_pipeline_loss_fn,
 )
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_with_interleaving import (
+    forward_backward_pipelining_with_interleaving,
+    interleaved_pipelined_apply,
+)
 
 
 def get_forward_backward_func(virtual_pipeline_model_parallel_size, pipeline_model_parallel_size):
-    """Reference: schedules/__init__.py:22 — pick the schedule.
-
-    The interleaved (virtual-pipeline) schedule lowers to the same
-    tick-scan machinery with stage chunks; until it lands, requesting it
-    raises.
-    """
+    """Reference: schedules/__init__.py:22 — pick the schedule."""
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
-            raise NotImplementedError(
-                "interleaved virtual-pipeline schedule: planned (use "
-                "forward_backward_pipelining_without_interleaving)"
-            )
+            return forward_backward_pipelining_with_interleaving
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining
 
@@ -35,6 +31,8 @@ __all__ = [
     "get_forward_backward_func",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "interleaved_pipelined_apply",
     "make_pipeline_loss_fn",
     "pipelined_apply",
     "broadcast_from_last_stage",

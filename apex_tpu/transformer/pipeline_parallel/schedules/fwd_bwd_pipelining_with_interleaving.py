@@ -1,0 +1,92 @@
+"""Interleaved (virtual-pipeline) schedule.
+
+Reference: ``schedules/fwd_bwd_pipelining_with_interleaving.py:27`` —
+each physical stage owns ``vpp`` non-contiguous layer chunks (stage s
+holds chunks s, s+pp, s+2pp, ...) and round-robins microbatches over
+chunks to shrink the pipeline bubble from (P-1)/M to (P-1)/(M·vpp).
+
+TPU form: virtual chunk v of the model is a second leading axis of the
+stacked stage params; the forward is ``vpp`` chained
+:func:`~..common.pipelined_apply` passes — after pass v the
+activations of each microbatch sit on the LAST stage, and the next
+chunk's first layer lives on the FIRST stage, so a single forward
+ppermute rotation re-feeds the ring.  All passes live in one jit
+region, so XLA's scheduler overlaps pass v+1's early ticks with pass
+v's late ticks where dependencies allow — the compiler-scheduled analog
+of the reference's hand-interleaved 1F1B.  Gradients come from
+differentiating the whole composition (exact, like the
+non-interleaved schedule).
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    broadcast_from_last_stage,
+    pipelined_apply,
+)
+
+
+def interleaved_pipelined_apply(stage_fn, stage_params, mb_inputs, vpp: int, axis_name=PIPELINE_AXIS):
+    """Run microbatches through ``vpp`` virtual chunks × P stages.
+
+    ``stage_params``: this stage's layers, leaves shaped
+    ``(vpp * layers_per_chunk, ...)`` with chunk v at
+    ``leaf[v*lpc:(v+1)*lpc]`` (so a GLOBAL array sharded ``P("pp")`` on
+    the layer axis must be ordered stage-major, then chunk, then layer —
+    the reference's assignment of chunks s, s+pp, s+2pp to stage s,
+    fwd_bwd_pipelining_with_interleaving.py:27).  Global execution order
+    is chunk-major: (v=0, s=0..P-1), (v=1, s=0..P-1), ...
+    """
+    P = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def chunk_of(v):
+        return jax.tree.map(
+            lambda l: l.reshape(vpp, l.shape[0] // vpp, *l.shape[1:])[v], stage_params
+        )
+
+    outs = mb_inputs
+    for v in range(vpp):
+        outs = pipelined_apply(stage_fn, chunk_of(v), outs, axis_name)
+        if v < vpp - 1:
+            # results live on the last stage; rotate them to stage 0 to
+            # feed the next virtual chunk (one ppermute — the cross-chunk
+            # p2p of the reference's interleaved schedule)
+            outs = jax.lax.ppermute(outs, axis_name, perm)
+    return outs
+
+
+def forward_backward_pipelining_with_interleaving(
+    pre_fn: Callable,
+    stage_fn: Callable,
+    post_fn: Callable,
+    shared_params,
+    stage_params,
+    microbatches,
+    *,
+    virtual_pipeline_model_parallel_size: int = 2,
+    forward_only: bool = False,
+    axis_name: str = PIPELINE_AXIS,
+):
+    """Interleaved analog of the non-interleaved fwd_bwd; stage params
+    hold ``vpp`` chunks stacked on the layer axis (see
+    :func:`interleaved_pipelined_apply` for the layout)."""
+    vpp = virtual_pipeline_model_parallel_size
+
+    def loss_fn(shared, stages, mbs):
+        acts = jax.vmap(lambda mb: pre_fn(shared, mb))(mbs)
+        outs = interleaved_pipelined_apply(stage_fn, stages, acts, vpp, axis_name)
+        losses = jax.vmap(lambda y, mb: post_fn(shared, y, mb))(outs, mbs)
+        return broadcast_from_last_stage(jnp.mean(losses), axis_name)
+
+    if forward_only:
+        return loss_fn(shared_params, stage_params, microbatches), None
+    loss, (g_shared, g_stage) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        shared_params, stage_params, microbatches
+    )
+    g_shared = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_shared)
+    return loss, (g_shared, g_stage)
