@@ -48,6 +48,9 @@ class GPTConfig:
     compute_dtype: Any = jnp.bfloat16
     checkpoint_layers: bool = True
     sequence_parallel: bool = False
+    # memory-efficient attention core (ops.attention.flash_attention);
+    # automatic when context parallelism is active
+    use_flash_attention: bool = False
 
     @property
     def ffn(self):
@@ -130,9 +133,12 @@ def param_specs(config: GPTConfig):
     }
 
 
-def _attention(x, p, config: GPTConfig, axis_name, n_local_heads):
+def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
     """Self attention with column-parallel QKV and row-parallel output
-    proj (reference standalone_transformer_lm.py ParallelAttention)."""
+    proj (reference standalone_transformer_lm.py ParallelAttention).
+    The core is selectable: fused-softmax einsum (default), flash
+    attention, or ring attention when the sequence is sharded over
+    ``cp_axis``."""
     S = x.shape[0] * (1 if not (axis_name and config.sequence_parallel) else jax.lax.axis_size(axis_name))
     B = x.shape[1]
     hd = config.head_dim
@@ -154,9 +160,18 @@ def _attention(x, p, config: GPTConfig, axis_name, n_local_heads):
         return t.reshape(S, B, n_local_heads, hd).transpose(1, 2, 0, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(hd)
-    probs = scaled_upper_triang_masked_softmax(scores, 1.0)
-    ctx = jnp.einsum("bnst,bnth->bnsh", probs.astype(v.dtype), v)
+    if cp_axis is not None:
+        from apex_tpu.transformer.context_parallel import ring_attention
+
+        ctx = ring_attention(q, k, v, cp_axis, causal=True).astype(v.dtype)
+    elif config.use_flash_attention:
+        from apex_tpu.ops.attention import flash_attention
+
+        ctx = flash_attention(q, k, v, causal=True)
+    else:
+        scores = jnp.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(hd)
+        probs = scaled_upper_triang_masked_softmax(scores, 1.0)
+        ctx = jnp.einsum("bnst,bnth->bnsh", probs.astype(v.dtype), v)
     ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, n_local_heads * hd)
 
     if axis_name is None:
@@ -182,21 +197,29 @@ def _mlp(x, p, config: GPTConfig, axis_name):
     )
 
 
-def _layer(x, p, config: GPTConfig, axis_name, n_local_heads):
+def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
     H = config.hidden_size
     ln1 = fused_layer_norm_affine(x, p["ln1_scale"], p["ln1_bias"], (H,), config.layernorm_eps)
-    x = x + _attention(ln1.astype(config.compute_dtype), p, config, axis_name, n_local_heads)
+    x = x + _attention(ln1.astype(config.compute_dtype), p, config, axis_name, n_local_heads, cp_axis)
     ln2 = fused_layer_norm_affine(x, p["ln2_scale"], p["ln2_bias"], (H,), config.layernorm_eps)
     x = x + _mlp(ln2.astype(config.compute_dtype), p, config, axis_name)
     return x
 
 
-def gpt_forward(params, tokens, config: GPTConfig, axis_name: Optional[str] = None):
+def gpt_forward(
+    params, tokens, config: GPTConfig, axis_name: Optional[str] = None, cp_axis: Optional[str] = None
+):
     """tokens (B, S) → logits.
 
     With ``axis_name``: runs inside shard_map; returns vocab-LOCAL logits
     ``(S, B, V/tp)``.  Without: dense logits ``(S, B, V)``.
+    With ``cp_axis`` (context parallelism — a capability beyond the
+    reference): tokens are the LOCAL sequence chunk, attention is ring
+    attention over the axis, positions are globally offset.
     """
+    if cp_axis is not None and config.sequence_parallel:
+        raise ValueError("sequence_parallel (tp) and context parallelism both shard "
+                         "the sequence; enable one")
     B, S = tokens.shape
     tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
     n_local_heads = config.num_attention_heads // tp
@@ -205,7 +228,12 @@ def gpt_forward(params, tokens, config: GPTConfig, axis_name: Optional[str] = No
         emb = jnp.take(params["embed"], tokens, axis=0)  # (B, S, H)
     else:
         emb = vocab_parallel_embedding(tokens, params["embed"], axis_name=axis_name)
-    x = emb.transpose(1, 0, 2) + params["pos_embed"][:S][:, None, :]
+    if cp_axis is not None:
+        start = jax.lax.axis_index(cp_axis) * S
+        pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], start, S, axis=0)
+    else:
+        pos = params["pos_embed"][:S]
+    x = emb.transpose(1, 0, 2) + pos[:, None, :]
     x = x.astype(config.compute_dtype)
 
     if config.sequence_parallel and axis_name is not None:
@@ -215,7 +243,9 @@ def gpt_forward(params, tokens, config: GPTConfig, axis_name: Optional[str] = No
 
         x = scatter_to_sequence_parallel_region(x, axis_name)
 
-    layer = partial(_layer, config=config, axis_name=axis_name, n_local_heads=n_local_heads)
+    layer = partial(
+        _layer, config=config, axis_name=axis_name, n_local_heads=n_local_heads, cp_axis=cp_axis
+    )
     if config.checkpoint_layers:
         layer = jax.checkpoint(layer)
 
@@ -269,6 +299,7 @@ def make_train_step(
     mesh,
     tp_axis: str = "tp",
     dp_axis: Optional[str] = "dp",
+    cp_axis: Optional[str] = None,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
 
@@ -283,12 +314,18 @@ def make_train_step(
     specs = param_specs(config)
 
     def local_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(gpt_loss)(params, tokens, targets, config, tp_axis)
+        loss, grads = jax.value_and_grad(gpt_loss)(
+            params, tokens, targets, config, tp_axis, cp_axis
+        )
         if config.sequence_parallel:
             grads = sp_grad_sync(grads, tp_axis)
-        if dp_axis is not None:
-            loss = jax.lax.pmean(loss, dp_axis)
-            grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+        # cp behaves as a data axis for grads: each rank differentiated
+        # its local-chunk loss (ring-travelled k/v cotangents included),
+        # so pmean over cp (and dp) recovers the global-mean-loss grads
+        for ax in (cp_axis, dp_axis):
+            if ax is not None:
+                loss = jax.lax.pmean(loss, ax)
+                grads = jax.tree.map(lambda g: jax.lax.pmean(g, ax), grads)
         new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
 
@@ -299,7 +336,7 @@ def make_train_step(
         return AdamState(step=P(), exp_avg=params_spec, exp_avg_sq=params_spec, master=None)
 
     sspec = state_spec_of(specs)
-    data_spec = P(dp_axis, None) if dp_axis is not None else P()
+    data_spec = P(dp_axis, cp_axis)  # batch over dp, sequence over cp
 
     sharded = jax.shard_map(
         local_step,
@@ -426,9 +463,14 @@ def make_pp_train_step(
     return jax.jit(sharded)
 
 
-def gpt_loss(params, tokens, targets, config: GPTConfig, axis_name: Optional[str] = None):
-    """Mean causal-LM cross entropy.  Uses vocab-parallel CE on a mesh."""
-    logits = gpt_forward(params, tokens, config, axis_name)  # (S, B, V?)
+def gpt_loss(
+    params, tokens, targets, config: GPTConfig, axis_name: Optional[str] = None,
+    cp_axis: Optional[str] = None,
+):
+    """Mean causal-LM cross entropy.  Uses vocab-parallel CE on a mesh.
+    With ``cp_axis`` the mean is over the LOCAL sequence chunk — combine
+    across chunks with a pmean (the data-axis gradient calculus)."""
+    logits = gpt_forward(params, tokens, config, axis_name, cp_axis)  # (S, B, V?)
     t = targets.transpose(1, 0)  # (S, B)
     if axis_name is None:
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
