@@ -1,0 +1,48 @@
+"""Autocast helpers.
+
+Reference: ``apex/_autocast_utils.py:22`` (``_cast_if_autocast_enabled``
+— custom autograd Functions respect ``torch.cuda.amp.autocast`` by
+casting their inputs to the autocast dtype).
+
+JAX has no ambient autocast state; the functional analog is an explicit
+policy-scoped cast applied at a function boundary.
+"""
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_SUPPORTED = (jnp.float16, jnp.bfloat16, jnp.float32)
+
+
+def _cast_if_autocast_enabled(*args, dtype=jnp.bfloat16):
+    """Cast floating args to ``dtype`` (parity helper)."""
+    if dtype not in _SUPPORTED:
+        raise RuntimeError(f"Unsupported autocast dtype: {dtype}")
+    return tuple(
+        a.astype(dtype)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a
+        for a in args
+    )
+
+
+def autocast(fn: Callable, dtype=jnp.bfloat16, output_dtype=None) -> Callable:
+    """Wrap ``fn`` so floating inputs are cast to ``dtype`` and floating
+    outputs to ``output_dtype`` (the O1 cast-at-op-boundaries pattern,
+    reference apex/amp/wrap.py cached_cast, made explicit)."""
+
+    def wrapped(*args, **kwargs):
+        args = _cast_if_autocast_enabled(*args, dtype=dtype)
+        out = fn(*args, **kwargs)
+        if output_dtype is not None:
+            out = jax.tree.map(
+                lambda x: x.astype(output_dtype)
+                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                out,
+            )
+        return out
+
+    return wrapped
