@@ -1,0 +1,75 @@
+"""The amp decorator/registry API.
+
+Reference: ``apex/amp/amp.py:30-183`` — ``half_function`` /
+``float_function`` / ``promote_function`` decorators and
+``register_half_function(module, name)`` etc., which monkey-patch
+functions into the O1 cast tables.
+
+JAX functions are values, not attributes to patch, so the registry
+returns *wrapped* functions instead of mutating modules; the cast
+semantics (inputs to half / to fp32 / promote to widest) are identical.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu._autocast_utils import autocast
+
+_HALF = jnp.bfloat16
+
+
+def set_half_dtype(dtype) -> None:
+    """Choose the 'half' dtype used by the decorators (bf16 default)."""
+    global _HALF
+    _HALF = dtype
+
+
+def half_function(fn: Callable) -> Callable:
+    """Run fn's floating inputs in half precision (reference amp.py:30)."""
+    return autocast(fn, dtype=_HALF)
+
+
+def float_function(fn: Callable) -> Callable:
+    """Run fn's floating inputs in fp32 (reference amp.py:34)."""
+    return autocast(fn, dtype=jnp.float32)
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Promote mixed inputs to the widest floating dtype (reference
+    amp.py:38 / wrap.py promote)."""
+
+    def wrapped(*args, **kwargs):
+        floats = [
+            a.dtype
+            for a in args
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        ]
+        if not floats:
+            return fn(*args, **kwargs)
+        widest = jnp.result_type(*floats)
+        args = tuple(
+            a.astype(widest)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a
+            for a in args
+        )
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def register_half_function(module, name: str) -> None:
+    """Wrap ``module.name`` in a half cast (reference amp.py:50).  The
+    one place apex-style in-place registration is still meaningful —
+    user-owned modules."""
+    setattr(module, name, half_function(getattr(module, name)))
+
+
+def register_float_function(module, name: str) -> None:
+    setattr(module, name, float_function(getattr(module, name)))
+
+
+def register_promote_function(module, name: str) -> None:
+    setattr(module, name, promote_function(getattr(module, name)))

@@ -1,0 +1,23 @@
+"""``scale_loss`` — the context-manager entry point, functionally.
+
+Reference: ``apex/amp/handle.py:16`` — ``with amp.scale_loss(loss,
+optimizer) as scaled_loss: scaled_loss.backward()``.
+
+There is no ambient autograd tape to scale into in JAX; the idiomatic
+form is :func:`apex_tpu.amp.value_and_grad` (frontend.py), which scales
+the loss before differentiation and unscales the grads after.  This
+module keeps the name for discovery: ``scale_loss`` returns the scaled
+loss for code that threads gradients manually.
+"""
+
+from apex_tpu.amp.frontend import Amp
+
+
+def scale_loss(loss, amp: Amp, scaler_state):
+    """Scaled loss (reference handle.py:113 ``loss.float()*loss_scale``).
+
+    Pair with ``amp.unscale_grads(scaler_state, grads)`` after
+    ``jax.grad`` — or use :func:`apex_tpu.amp.value_and_grad`, which does
+    both around one differentiation.
+    """
+    return amp.scale_loss(scaler_state, loss)

@@ -13,6 +13,8 @@ _SUBS = (
     "clip_grad",
     "focal_loss",
     "group_norm",
+    "groupbn",
+    "cudnn_gbn",
     "layer_norm",
     "index_mul_2d",
     "fmha",
@@ -20,6 +22,9 @@ _SUBS = (
     "sparsity",
     "transducer",
     "conv_bias_relu",
+    "bottleneck",
+    "peer_memory",
+    "openfold_triton",
 )
 
 

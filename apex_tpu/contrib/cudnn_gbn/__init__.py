@@ -1,0 +1,9 @@
+"""Group BatchNorm via cuDNN v8 (reference: ``apex/contrib/cudnn_gbn``).
+TPU: same as :mod:`apex_tpu.contrib.groupbn` — SyncBatchNorm over a
+subgroup mesh axis."""
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC, GroupBatchNorm2d
+
+GroupBatchNorm = GroupBatchNorm2d
+
+__all__ = ["GroupBatchNorm", "GroupBatchNorm2d", "BatchNorm2d_NHWC"]

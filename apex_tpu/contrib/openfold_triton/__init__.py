@@ -1,0 +1,16 @@
+"""OpenFold kernels (reference: ``apex/contrib/openfold_triton`` —
+Triton LayerNorm fwd/bwd with autotune, MHA, fused Adam+SWA).
+
+TPU mapping: the Triton LayerNorm is the Pallas fused norm
+(:mod:`apex_tpu.ops.layer_norm_pallas`); Triton MHA is
+:func:`apex_tpu.ops.attention.flash_attention`; the autotune-cache
+broadcast machinery has no analog (XLA/Mosaic compile deterministically
+per shape).  The genuinely distinct piece — fused AdamW + stochastic
+weight averaging — is implemented here.
+"""
+
+from apex_tpu.contrib.openfold_triton.fused_adam_swa import AdamSWAState, FusedAdamSWA
+from apex_tpu.normalization import FusedLayerNorm as LayerNormSmallShapeOptImpl
+from apex_tpu.ops.attention import flash_attention as _attention_core
+
+__all__ = ["FusedAdamSWA", "AdamSWAState", "LayerNormSmallShapeOptImpl"]

@@ -1,0 +1,55 @@
+"""Fused AdamW + stochastic weight averaging.
+
+Reference: ``apex/contrib/openfold_triton/fused_adam_swa.py`` — one
+kernel applying the AdamW update and folding the result into an SWA
+(exponential/equal-average) copy, used by OpenFold training.
+
+TPU: one jit region over :class:`apex_tpu.optimizers.FusedAdam` plus the
+SWA blend; the SWA params live in the optimizer state.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam
+
+
+class AdamSWAState(NamedTuple):
+    adam: AdamState
+    swa_params: Any
+    n_averaged: jnp.ndarray  # i32
+
+
+class FusedAdamSWA(FusedAdam):
+    """AdamW whose update also maintains an SWA average.
+
+    ``swa_decay_rate``: EMA coefficient; ``None`` = equal average
+    (reference swa_decay semantics).
+    """
+
+    def __init__(self, *args, swa_decay_rate: Optional[float] = None, **kw):
+        super().__init__(*args, **kw)
+        self.swa_decay_rate = swa_decay_rate
+
+    def init(self, params) -> AdamSWAState:
+        return AdamSWAState(
+            adam=super().init(params),
+            swa_params=jax.tree.map(lambda p: p.astype(jnp.float32), params),
+            n_averaged=jnp.int32(0),
+        )
+
+    def update(self, grads, state: AdamSWAState, params, grads_finite=None, lr=None):
+        new_params, adam_state = super().update(
+            grads, state.adam, params, grads_finite=grads_finite, lr=lr
+        )
+        n = state.n_averaged + 1
+        if self.swa_decay_rate is None:
+            w = 1.0 / n.astype(jnp.float32)  # equal average
+        else:
+            w = 1.0 - self.swa_decay_rate
+        swa = jax.tree.map(
+            lambda s, p: s + w * (p.astype(jnp.float32) - s), state.swa_params, new_params
+        )
+        return new_params, AdamSWAState(adam=adam_state, swa_params=swa, n_averaged=n)

@@ -1,0 +1,26 @@
+"""Peer-memory halo exchange (reference: ``apex/contrib/peer_memory`` —
+CUDA-IPC peer pools + ``peer_halo_exchanger_1d``).
+
+On TPU, neighbor transfers are ``ppermute`` over ICI — there is no
+user-managed peer memory; the halo exchange lives in
+:mod:`apex_tpu.contrib.bottleneck`.  Re-exported here for discovery.
+"""
+
+from apex_tpu.contrib.bottleneck.halo_exchangers import (
+    HaloExchanger as PeerHaloExchanger1d,
+    halo_exchange_1d,
+)
+
+
+class PeerMemoryPool:
+    """No TPU analog: ICI transfers need no pinned peer pools.  Raises
+    with guidance (reference peer_memory.py:5)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "TPU has no peer-memory pools; use "
+            "apex_tpu.contrib.bottleneck.halo_exchange_1d (ppermute over ICI)"
+        )
+
+
+__all__ = ["PeerHaloExchanger1d", "halo_exchange_1d", "PeerMemoryPool"]

@@ -1,0 +1,41 @@
+"""Multi-process launcher shim.
+
+Reference: ``apex/parallel/multiproc.py`` — a pre-torchrun process
+launcher (superseded even in the reference by
+``torch.distributed.launch``).
+
+On TPU pods, process-per-host launch is owned by the infrastructure
+(GKE/xmanager/`gcloud compute tpus tpu-vm ssh --worker=all`); inside
+each process call :func:`initialize_distributed` —
+``jax.distributed.initialize`` + mesh construction — instead of a
+python launcher.
+"""
+
+from typing import Optional
+
+import jax
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize multi-host JAX (the ``init_process_group`` analog)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def main():  # pragma: no cover - parity stub
+    raise SystemExit(
+        "apex_tpu has no process launcher: launch one process per host via "
+        "your TPU orchestration and call "
+        "apex_tpu.parallel.multiproc.initialize_distributed() in each."
+    )
+
+
+if __name__ == "__main__":
+    main()
