@@ -15,6 +15,12 @@ import jax.numpy as jnp
 
 _SUPPORTED = (jnp.float16, jnp.bfloat16, jnp.float32)
 
+# Global cast kill-switch, toggled by apex_tpu.amp.handle.disable_casts
+# (reference: apex/amp/handle.py disable_casts — temporarily suspends the
+# O1 patched-function casting).  Checked at *trace* time, so use it
+# around a traced region, not inside jit.
+_casts_disabled = False
+
 
 def _cast_if_autocast_enabled(*args, dtype=jnp.bfloat16):
     """Cast floating args to ``dtype`` (parity helper)."""
@@ -34,6 +40,8 @@ def autocast(fn: Callable, dtype=jnp.bfloat16, output_dtype=None) -> Callable:
     reference apex/amp/wrap.py cached_cast, made explicit)."""
 
     def wrapped(*args, **kwargs):
+        if _casts_disabled:
+            return fn(*args, **kwargs)
         args = _cast_if_autocast_enabled(*args, dtype=dtype)
         out = fn(*args, **kwargs)
         if output_dtype is not None:

@@ -5,9 +5,32 @@ The O0–O3 opt levels map onto functional dtype policies
 device-side (:mod:`apex_tpu.amp.scaler`), following the reference's
 capturable/CUDA-graph design (``csrc/update_scale_hysteresis.cu``) which
 is the natural XLA semantics.
+
+Export surface mirrors ``apex/amp/__init__.py``: the decorator/registry
+API from ``amp.py``, ``scale_loss``/``disable_casts`` from ``handle.py``,
+``initialize``/``state_dict``/``load_state_dict`` from ``frontend.py``,
+and ``master_params``.
 """
 
-from apex_tpu.amp.frontend import Amp, initialize, value_and_grad
+from apex_tpu.amp.amp import (
+    float_function,
+    half_function,
+    init,
+    promote_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+    set_half_dtype,
+)
+from apex_tpu.amp.frontend import (
+    Amp,
+    initialize,
+    load_state_dict,
+    master_params,
+    state_dict,
+    value_and_grad,
+)
+from apex_tpu.amp.handle import disable_casts, scale_loss
 from apex_tpu.amp.policy import Policy, get_policy
 from apex_tpu.amp.scaler import (
     DynamicLossScaler,
@@ -20,6 +43,19 @@ __all__ = [
     "Amp",
     "initialize",
     "value_and_grad",
+    "state_dict",
+    "load_state_dict",
+    "master_params",
+    "scale_loss",
+    "disable_casts",
+    "init",
+    "half_function",
+    "float_function",
+    "promote_function",
+    "register_half_function",
+    "register_float_function",
+    "register_promote_function",
+    "set_half_dtype",
     "Policy",
     "get_policy",
     "DynamicLossScaler",

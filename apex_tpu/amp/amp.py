@@ -27,8 +27,17 @@ def set_half_dtype(dtype) -> None:
 
 
 def half_function(fn: Callable) -> Callable:
-    """Run fn's floating inputs in half precision (reference amp.py:30)."""
-    return autocast(fn, dtype=_HALF)
+    """Run fn's floating inputs in half precision (reference amp.py:30).
+
+    The half dtype is read at call time, so ``set_half_dtype`` /
+    ``amp.init(half_dtype=...)`` affect functions decorated earlier
+    (matching the reference, where the dtype lives in global amp state).
+    """
+
+    def wrapped(*args, **kwargs):
+        return autocast(fn, dtype=_HALF)(*args, **kwargs)
+
+    return wrapped
 
 
 def float_function(fn: Callable) -> Callable:
@@ -40,21 +49,18 @@ def promote_function(fn: Callable) -> Callable:
     """Promote mixed inputs to the widest floating dtype (reference
     amp.py:38 / wrap.py promote)."""
 
+    def _is_float(a):
+        return hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+
     def wrapped(*args, **kwargs):
-        floats = [
-            a.dtype
-            for a in args
-            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
-        ]
+        floats = [a.dtype for a in (*args, *kwargs.values()) if _is_float(a)]
         if not floats:
             return fn(*args, **kwargs)
         widest = jnp.result_type(*floats)
-        args = tuple(
-            a.astype(widest)
-            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
-            else a
-            for a in args
-        )
+        args = tuple(a.astype(widest) if _is_float(a) else a for a in args)
+        kwargs = {
+            k: (v.astype(widest) if _is_float(v) else v) for k, v in kwargs.items()
+        }
         return fn(*args, **kwargs)
 
     return wrapped
@@ -73,3 +79,31 @@ def register_float_function(module, name: str) -> None:
 
 def register_promote_function(module, name: str) -> None:
     setattr(module, name, promote_function(getattr(module, name)))
+
+
+def init(enabled: bool = True, loss_scale: str = "dynamic", half_dtype=None, **kwargs):
+    """Legacy ``amp.init`` entry point (reference apex/amp/amp.py:74).
+
+    The reference patches the torch function tables and returns an
+    ``AmpHandle``; here there is no global function table to patch, so
+    this configures the decorator half-dtype and returns an O1
+    :class:`~apex_tpu.amp.frontend.Amp` whose ``scale_loss`` /
+    ``state_dict`` match the old handle surface.  ``enabled=False``
+    returns a no-op O0 Amp (reference NoOpHandle).
+
+    Legacy apex ``init`` kwargs with no TPU meaning (``verbose``,
+    ``enable_caching``, ``allow_banned``, ...) are accepted and ignored.
+    """
+    from apex_tpu.amp import frontend
+
+    if half_dtype is not None:
+        set_half_dtype(half_dtype)
+    known = {"init_scale", "growth_interval", "hysteresis"}
+    fwd = {k: v for k, v in kwargs.items() if k in known}
+    _, amp = frontend.initialize(
+        {}, opt_level="O1" if enabled else "O0",
+        half_dtype=half_dtype,
+        loss_scale=loss_scale if enabled else None,
+        **fwd,
+    )
+    return amp

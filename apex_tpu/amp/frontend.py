@@ -83,7 +83,52 @@ def initialize(
         scaler = None
     else:
         scaler = StaticLossScaler(float(policy.loss_scale))
-    return policy.cast_params(params), Amp(policy=policy, scaler=scaler)
+    amp = Amp(policy=policy, scaler=scaler)
+    global _last_amp
+    _last_amp = amp
+    return policy.cast_params(params), amp
+
+
+# ------------------------------------------------------------------ module API
+# The reference keeps a process-global ``_amp_state`` so that
+# ``amp.state_dict()`` / ``amp.load_state_dict()`` work without a handle
+# (apex/amp/frontend.py:365-404).  We track the last-initialized Amp for
+# the same call shape; the scaler *state* stays functional and is passed in.
+_last_amp: Optional[Amp] = None
+
+
+def state_dict(scaler_state, destination=None):
+    """Checkpointable amp state (reference frontend.py:365)."""
+    if _last_amp is None:
+        raise RuntimeError("amp.initialize() has not been called")
+    d = _last_amp.state_dict(scaler_state)
+    if destination is not None:
+        destination.update(d)
+        return destination
+    return d
+
+
+def load_state_dict(d):
+    """Restore scaler state from :func:`state_dict` (frontend.py:377).
+
+    Returns the restored scaler state (functional — thread it back into
+    your train step)."""
+    if _last_amp is None:
+        raise RuntimeError("amp.initialize() has not been called")
+    return _last_amp.load_state_dict(d)
+
+
+def master_params(opt_state):
+    """Iterate fp32 master params out of an optimizer state
+    (reference: apex/amp/_amp_state.py ``master_params(optimizer)``).
+
+    Works with any apex_tpu fused-optimizer state carrying a ``master``
+    field; falls back to nothing when master weights are disabled."""
+    master = getattr(opt_state, "master", None)
+    if master is None:
+        return
+    for leaf in jax.tree.leaves(master):
+        yield leaf
 
 
 def value_and_grad(amp: Amp, loss_fn: Callable, **grad_kwargs):

@@ -10,7 +10,32 @@ module keeps the name for discovery: ``scale_loss`` returns the scaled
 loss for code that threads gradients manually.
 """
 
+import contextlib
+
+from apex_tpu import _autocast_utils
 from apex_tpu.amp.frontend import Amp
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Suspend decorator/registry casting inside the block.
+
+    Reference: ``apex/amp/handle.py`` ``disable_casts`` — regions that
+    must run in true fp32 (e.g. loss computation) under O1.
+
+    **Trace-time only.** The flag is read when a function is traced, and
+    jit caches traces: a jitted function called once *outside* this
+    context keeps casting on later calls made inside it (and vice
+    versa).  Use it around eager calls or first traces; for a region
+    inside an already-jitted step, make the dtype an explicit argument
+    (e.g. ``float_function``) instead.
+    """
+    prev = _autocast_utils._casts_disabled
+    _autocast_utils._casts_disabled = True
+    try:
+        yield
+    finally:
+        _autocast_utils._casts_disabled = prev
 
 
 def scale_loss(loss, amp: Amp, scaler_state):

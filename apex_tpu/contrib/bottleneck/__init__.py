@@ -1,6 +1,23 @@
+from apex_tpu.contrib.bottleneck.bottleneck import (
+    Bottleneck,
+    FrozenScaleBias,
+    SpatialBottleneck,
+)
 from apex_tpu.contrib.bottleneck.halo_exchangers import (
     HaloExchanger,
+    HaloExchangerAllGather,
+    HaloExchangerPeer,
+    HaloExchangerSendRecv,
     halo_exchange_1d,
 )
 
-__all__ = ["HaloExchanger", "halo_exchange_1d"]
+__all__ = [
+    "Bottleneck",
+    "FrozenScaleBias",
+    "SpatialBottleneck",
+    "HaloExchanger",
+    "HaloExchangerAllGather",
+    "HaloExchangerPeer",
+    "HaloExchangerSendRecv",
+    "halo_exchange_1d",
+]

@@ -53,3 +53,22 @@ class HaloExchanger:
 
     def __call__(self, x):
         return halo_exchange_1d(x, self.halo, self.axis_name, self.spatial_axis)
+
+
+# Reference class names (halo_exchangers.py:11-127).  On GPU these pick a
+# transport (NCCL allgather vs send/recv vs CUDA-IPC peer memory); on TPU
+# every neighbor exchange is the same ppermute over ICI, so they are one
+# implementation under three names.
+class HaloExchangerAllGather(HaloExchanger):
+    pass
+
+
+class HaloExchangerSendRecv(HaloExchanger):
+    pass
+
+
+class HaloExchangerPeer(HaloExchanger):
+    def __init__(self, axis_name: str, halo: int = 1, spatial_axis: int = 1, peer_pool=None):
+        # peer_pool (a PeerMemoryPool on GPU) has no TPU role; accepted
+        # for signature parity.
+        super().__init__(axis_name, halo, spatial_axis)

@@ -44,11 +44,17 @@ class FusedAdamSWA(FusedAdam):
         new_params, adam_state = super().update(
             grads, state.adam, params, grads_finite=grads_finite, lr=lr
         )
-        n = state.n_averaged + 1
+        # Overflow-skipped steps (grads_finite=False) leave params
+        # untouched; they must not be counted as SWA samples either.
+        took_step = (
+            jnp.bool_(True) if grads_finite is None else jnp.asarray(grads_finite)
+        )
+        n = state.n_averaged + took_step.astype(jnp.int32)
         if self.swa_decay_rate is None:
-            w = 1.0 / n.astype(jnp.float32)  # equal average
+            w = 1.0 / jnp.maximum(n, 1).astype(jnp.float32)  # equal average
         else:
             w = 1.0 - self.swa_decay_rate
+        w = jnp.where(took_step, w, 0.0)
         swa = jax.tree.map(
             lambda s, p: s + w * (p.astype(jnp.float32) - s), state.swa_params, new_params
         )

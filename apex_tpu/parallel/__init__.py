@@ -7,8 +7,10 @@ from apex_tpu.parallel.distributed import (
 )
 from apex_tpu.parallel.LARC import LARC
 from apex_tpu.parallel.sync_batchnorm import (
+    SYNCBN_AXIS,
     SyncBatchNorm,
     convert_syncbn_model,
+    create_syncbn_process_group,
     sync_batch_norm_stats,
 )
 
@@ -17,7 +19,9 @@ __all__ = [
     "Reducer",
     "allreduce_gradients",
     "LARC",
+    "SYNCBN_AXIS",
     "SyncBatchNorm",
     "convert_syncbn_model",
+    "create_syncbn_process_group",
     "sync_batch_norm_stats",
 ]

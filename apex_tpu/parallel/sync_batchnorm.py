@@ -93,6 +93,32 @@ class SyncBatchNorm(nn.Module):
         return y.astype(x.dtype)
 
 
+SYNCBN_AXIS = "dp_sync"
+
+
+def create_syncbn_process_group(group_size: int, world_size: Optional[int] = None):
+    """Subgroup BN sync (reference: apex/parallel/__init__.py:60).
+
+    The reference carves ``torch.distributed`` world into consecutive
+    groups of ``group_size`` ranks and returns the current rank's group.
+    TPU: groups are a mesh-axis split — shape the data-parallel devices
+    as ``('dp', SYNCBN_AXIS)`` with sizes ``(world//group_size,
+    group_size)`` and pass ``axis_name=SYNCBN_AXIS`` to
+    :class:`SyncBatchNorm`; stats then psum only within the subgroup,
+    exactly the reference's group semantics but riding ICI neighbors.
+
+    Returns ``(axis_name, (num_groups, group_size))`` — the axis name to
+    give SyncBatchNorm and the dp-axis split to build the Mesh with.
+    """
+    if world_size is None:
+        world_size = jax.device_count()
+    if group_size <= 0 or world_size % group_size != 0:
+        raise ValueError(
+            f"group_size {group_size} must evenly divide world size {world_size}"
+        )
+    return SYNCBN_AXIS, (world_size // group_size, group_size)
+
+
 def convert_syncbn_model(module, process_group=None, channel_last: bool = False):
     """Reference: apex/parallel/__init__.py:21.  In flax, modules are
     declarative — use :class:`SyncBatchNorm` in the model definition; this

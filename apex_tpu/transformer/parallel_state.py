@@ -164,6 +164,20 @@ def get_data_parallel_rank():
     return jax.lax.axis_index(DATA_AXIS)
 
 
+def get_pipeline_model_parallel_next_rank():
+    """Ring-next stage index (reference: parallel_state.py:730).
+
+    On TPU the "rank" is the position on the ``pp`` mesh axis; the p2p
+    module turns (rank → rank+1) into a ``ppermute`` permutation, so this
+    is mainly for parity/debug inside shard_map."""
+    return (jax.lax.axis_index(PIPELINE_AXIS) + 1) % _state().pipeline_model_parallel_size
+
+
+def get_pipeline_model_parallel_prev_rank():
+    """Ring-previous stage index (reference: parallel_state.py:739)."""
+    return (jax.lax.axis_index(PIPELINE_AXIS) - 1) % _state().pipeline_model_parallel_size
+
+
 def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
     """Trace-time virtual-stage cursor (reference: parallel_state.py:679)."""
     return _state().virtual_pipeline_model_parallel_rank

@@ -22,9 +22,16 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
+from apex_tpu.transformer.tensor_parallel.memory import (
+    MemoryBuffer,
+    RingMemBuffer,
+    allocate_mem_buff,
+    get_mem_buffs,
+)
 from apex_tpu.transformer.tensor_parallel.random import (
     RNGStatesTracker,
     checkpoint,
+    get_cuda_rng_tracker,
     get_rng_state_tracker,
     model_parallel_cuda_manual_seed,
     model_parallel_seed,
@@ -51,8 +58,13 @@ __all__ = [
     "reduce_scatter_to_sequence_parallel_region",
     "scatter_to_sequence_parallel_region",
     "scatter_to_tensor_model_parallel_region",
+    "MemoryBuffer",
+    "RingMemBuffer",
+    "allocate_mem_buff",
+    "get_mem_buffs",
     "RNGStatesTracker",
     "checkpoint",
+    "get_cuda_rng_tracker",
     "get_rng_state_tracker",
     "model_parallel_cuda_manual_seed",
     "model_parallel_seed",

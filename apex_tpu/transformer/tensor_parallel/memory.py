@@ -1,0 +1,131 @@
+"""Contiguous activation memory buffers.
+
+Reference: ``apex/transformer/tensor_parallel/memory.py`` —
+``MemoryBuffer`` (:37), ``RingMemBuffer`` (:138), ``allocate_mem_buff``
+(:25) — a preallocated flat tensor that activation-partitioning copies
+checkpointed activations into, to avoid allocator fragmentation.
+
+TPU redesign: XLA owns device memory, so the fragmentation problem the
+reference solves does not exist under jit — but the *capacity-budgeting*
+role does.  The buffer here is a flat ``jnp`` array reused across
+``add`` calls via functional donation: ``add`` packs a flattened tensor
+at the bump-allocator cursor (pure ``lax.dynamic_update_slice``, fusible
+by XLA), ``get`` slices it back out.  Under jit with buffer donation the
+updates are in-place, giving the same single-arena behavior.  Usage
+tracking mirrors the reference so code ported from Megatron can budget
+identically.
+"""
+
+from typing import Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+# All allocated buffers, by name (reference memory.py:22 ``_MEM_BUFFS``).
+_MEM_BUFFS: Dict[str, "MemoryBuffer"] = {}
+
+
+def allocate_mem_buff(name: str, numel: int, dtype, track_usage: bool = False):
+    """Allocate a named buffer (reference memory.py:25)."""
+    if name in _MEM_BUFFS:
+        raise AssertionError(f"memory buffer {name} already allocated.")
+    _MEM_BUFFS[name] = MemoryBuffer(name, numel, dtype, track_usage)
+    return _MEM_BUFFS[name]
+
+
+def get_mem_buff(name: str):
+    """Look up a named buffer (reference memory.py:32)."""
+    return _MEM_BUFFS[name]
+
+
+def get_mem_buffs():
+    """All buffers (test/debug helper)."""
+    return dict(_MEM_BUFFS)
+
+
+def reset_mem_buffs():
+    _MEM_BUFFS.clear()
+
+
+class MemoryBuffer:
+    """Bump-allocated contiguous buffer (reference memory.py:37).
+
+    ``add(tensor)`` copies the flattened tensor into the arena at the
+    current cursor and returns the packed view reshaped to the tensor's
+    shape; ``reset()`` rewinds the cursor so the arena is reused next
+    microbatch — the exact usage pattern of the reference's
+    activation partitioning.
+    """
+
+    def __init__(self, name: str, numel: int, dtype, track_usage: bool = False):
+        self.name = name
+        self.numel = int(numel)
+        self.dtype = dtype
+        self.data = jnp.zeros((self.numel,), dtype=dtype)
+        self._start = 0
+        # usage tracking (reference memory.py:70-77,122)
+        self.track_usage = track_usage
+        self.in_use_value = 0.0
+        self.total_value = 0.0
+
+    def reset(self):
+        """Rewind the cursor; arena contents become dead (memory.py:79)."""
+        self._start = 0
+
+    def is_in_use(self) -> bool:
+        return self._start > 0
+
+    def numel_in_use(self) -> int:
+        return self._start
+
+    def add(self, tensor):
+        """Pack ``tensor`` into the arena; returns the packed copy
+        reshaped to ``tensor.shape`` (reference memory.py:91)."""
+        if tensor.dtype != self.dtype:
+            raise AssertionError(
+                f"Input tensor dtype {tensor.dtype} != buffer dtype {self.dtype}"
+            )
+        n = tensor.size
+        new_start = self._start + n
+        if new_start > self.numel:
+            raise AssertionError(f"Not enough memory buffer ({self.name})")
+        self.data = lax.dynamic_update_slice(
+            self.data, tensor.reshape(-1), (self._start,)
+        )
+        view = lax.dynamic_slice(self.data, (self._start,), (n,)).reshape(tensor.shape)
+        self._start = new_start
+        if self.track_usage:
+            self.in_use_value += float(n)
+            self.total_value += float(self.numel)
+        return view
+
+    def get_data(self):
+        """The live prefix of the arena (reference memory.py:115)."""
+        return self.data[: self._start]
+
+    def print_average_usage(self):
+        if not self.track_usage:
+            raise AssertionError("You need to enable usage tracking")
+        print(
+            f" > usage of {self.name} memory buffer: "
+            f"{self.in_use_value * 100.0 / max(self.total_value, 1.0):.2f} %"
+        )
+
+
+class RingMemBuffer:
+    """Ring of N buffers rotated per call (reference memory.py:138) —
+    double-buffering for overlapping microbatches."""
+
+    def __init__(self, name: str, num_buffers: int, numel: int, dtype, track_usage=False):
+        self.num_buffers = num_buffers
+        self.buffers = [
+            allocate_mem_buff(f"{name} {i}", numel, dtype, track_usage)
+            for i in range(num_buffers)
+        ]
+        self._index = -1
+
+    def get_next_buffer(self):
+        self._index = (self._index + 1) % self.num_buffers
+        buff = self.buffers[self._index]
+        buff.reset()
+        return buff

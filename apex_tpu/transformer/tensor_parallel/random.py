@@ -85,6 +85,11 @@ def get_rng_state_tracker() -> RNGStatesTracker:
     return _TRACKER
 
 
+# Reference import-name parity ("cuda" kept so Megatron-style code ports
+# with a one-line import change).
+get_cuda_rng_tracker = get_rng_state_tracker
+
+
 def model_parallel_seed(seed: int, tp_rank, pp_rank=0):
     """Derive the two Megatron seeds (reference random.py:204
     model_parallel_cuda_manual_seed).

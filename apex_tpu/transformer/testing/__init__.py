@@ -7,17 +7,30 @@ the library models, and the distributed base is a mesh helper: JAX's
 virtual multi-device CPU platform replaces process spawning.
 """
 
+from apex_tpu.transformer.testing import arguments, global_vars
 from apex_tpu.transformer.testing.commons import (
     DistributedTestContext,
     make_mesh,
     smap,
     toy_stage_fn,
 )
+from apex_tpu.transformer.testing.distributed_test_base import (
+    DistributedTestBase,
+    NcclDistributedTestBase,
+    UccDistributedTestBase,
+    XlaDistributedTestBase,
+)
 from apex_tpu.models import bert as standalone_bert
 from apex_tpu.models import gpt as standalone_gpt
 
 __all__ = [
+    "arguments",
+    "global_vars",
     "DistributedTestContext",
+    "DistributedTestBase",
+    "XlaDistributedTestBase",
+    "NcclDistributedTestBase",
+    "UccDistributedTestBase",
     "make_mesh",
     "smap",
     "toy_stage_fn",

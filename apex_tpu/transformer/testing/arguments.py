@@ -1,0 +1,222 @@
+"""Megatron-style argument parser for the test/benchmark harness.
+
+Reference: ``apex/transformer/testing/arguments.py`` (977 LoC argparse
+clone of Megatron-LM's ``parse_args``).  That parser exists only so the
+standalone GPT/BERT test models and the pipeline tests can be configured
+the Megatron way; this is the TPU port of the same contract — the core
+argument groups, the derived-value logic (ffn size, kv channels,
+consistency checks), and the same flag spellings — sized to what the
+apex test-suite actually reads rather than all 188 flags.
+
+GPU-only flags that have no TPU meaning (``--no-gradient-accumulation-
+fusion``, NCCL/IB toggles, ...) are accepted and ignored so Megatron
+launch scripts parse unchanged.
+"""
+
+import argparse
+import os
+
+
+def parse_args(extra_args_provider=None, defaults=None, override_args=None,
+               ignore_unknown_args=False, args=None):
+    """Parse Megatron-style flags (reference arguments.py:30 parse_args).
+
+    ``args`` (list of strings) defaults to an empty list — tests build
+    configs programmatically; pass ``sys.argv[1:]`` for CLI use.
+    """
+    parser = argparse.ArgumentParser(description="apex_tpu arguments",
+                                     allow_abbrev=False)
+    _add_network_size_args(parser)
+    _add_regularization_args(parser)
+    _add_training_args(parser)
+    _add_learning_rate_args(parser)
+    _add_mixed_precision_args(parser)
+    _add_distributed_args(parser)
+    _add_validation_args(parser)
+    _add_data_args(parser)
+    _add_logging_args(parser)
+
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+
+    if args is None:
+        args = []
+    if ignore_unknown_args:
+        parsed, _ = parser.parse_known_args(args)
+    else:
+        parsed = parser.parse_args(args)
+
+    for key, value in (defaults or {}).items():
+        if getattr(parsed, key, None) is None:
+            setattr(parsed, key, value)
+    for key, value in (override_args or {}).items():
+        setattr(parsed, key, value)
+
+    return validate_args(parsed)
+
+
+def validate_args(args):
+    """Derived values + consistency checks (reference arguments.py:160)."""
+    # world-size bookkeeping: on TPU "rank"/"world size" are device counts.
+    if args.world_size is None:
+        args.world_size = int(os.environ.get("WORLD_SIZE", "1"))
+    model_parallel = args.tensor_model_parallel_size * args.pipeline_model_parallel_size
+    if args.world_size % model_parallel != 0:
+        raise ValueError(
+            f"world size {args.world_size} not divisible by tp*pp {model_parallel}"
+        )
+    args.data_parallel_size = args.world_size // model_parallel
+    if args.ffn_hidden_size is None and args.hidden_size is not None:
+        args.ffn_hidden_size = 4 * args.hidden_size
+    if args.kv_channels is None and args.hidden_size is not None:
+        if args.num_attention_heads:
+            args.kv_channels = args.hidden_size // args.num_attention_heads
+    if args.global_batch_size is None and args.micro_batch_size is not None:
+        args.global_batch_size = args.micro_batch_size * args.data_parallel_size
+    if args.fp16 and args.bf16:
+        raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    args.params_dtype = "float32"
+    if args.fp16:
+        args.params_dtype = "float16"
+    if args.bf16:
+        args.params_dtype = "bfloat16"
+    if args.sequence_parallel and args.tensor_model_parallel_size == 1:
+        args.sequence_parallel = False
+    if args.virtual_pipeline_model_parallel_size is not None:
+        if args.pipeline_model_parallel_size <= 1:
+            raise ValueError("virtual pipeline requires pipeline_model_parallel_size > 1")
+        if args.num_layers is not None and args.num_layers % (
+            args.pipeline_model_parallel_size
+            * args.virtual_pipeline_model_parallel_size
+        ) != 0:
+            raise ValueError("num_layers must divide pp*vpp chunks")
+    return args
+
+
+def _add_network_size_args(parser):
+    group = parser.add_argument_group(title="network size")
+    group.add_argument("--num-layers", type=int, default=None)
+    group.add_argument("--hidden-size", type=int, default=None)
+    group.add_argument("--ffn-hidden-size", type=int, default=None)
+    group.add_argument("--num-attention-heads", type=int, default=None)
+    group.add_argument("--kv-channels", type=int, default=None)
+    group.add_argument("--max-position-embeddings", type=int, default=None)
+    group.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
+    group.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    group.add_argument("--padded-vocab-size", type=int, default=None)
+    return parser
+
+
+def _add_regularization_args(parser):
+    group = parser.add_argument_group(title="regularization")
+    group.add_argument("--attention-dropout", type=float, default=0.1)
+    group.add_argument("--hidden-dropout", type=float, default=0.1)
+    group.add_argument("--weight-decay", type=float, default=0.01)
+    group.add_argument("--clip-grad", type=float, default=1.0)
+    group.add_argument("--adam-beta1", type=float, default=0.9)
+    group.add_argument("--adam-beta2", type=float, default=0.999)
+    group.add_argument("--adam-eps", type=float, default=1e-8)
+    group.add_argument("--sgd-momentum", type=float, default=0.9)
+    return parser
+
+
+def _add_training_args(parser):
+    group = parser.add_argument_group(title="training")
+    group.add_argument("--micro-batch-size", type=int, default=None)
+    group.add_argument("--global-batch-size", type=int, default=None)
+    group.add_argument("--rampup-batch-size", nargs="*", default=None)
+    group.add_argument("--train-iters", type=int, default=None)
+    group.add_argument("--train-samples", type=int, default=None)
+    group.add_argument("--log-interval", type=int, default=100)
+    group.add_argument("--exit-interval", type=int, default=None)
+    group.add_argument("--optimizer", type=str, default="adam",
+                       choices=["adam", "sgd", "lamb"])
+    group.add_argument("--recompute-activations", action="store_true")
+    group.add_argument("--checkpoint-activations", action="store_true")
+    group.add_argument("--distribute-saved-activations", action="store_true")
+    group.add_argument("--seed", type=int, default=1234)
+    # GPU fusion toggles — parsed for parity, TPU fusion is XLA's call.
+    group.add_argument("--no-masked-softmax-fusion", action="store_false",
+                       dest="masked_softmax_fusion")
+    group.add_argument("--no-bias-gelu-fusion", action="store_false",
+                       dest="bias_gelu_fusion")
+    group.add_argument("--no-bias-dropout-fusion", action="store_false",
+                       dest="bias_dropout_fusion")
+    group.add_argument("--no-gradient-accumulation-fusion", action="store_false",
+                       dest="gradient_accumulation_fusion")
+    return parser
+
+
+def _add_learning_rate_args(parser):
+    group = parser.add_argument_group(title="learning rate")
+    group.add_argument("--lr", type=float, default=None)
+    group.add_argument("--lr-decay-style", type=str, default="linear",
+                       choices=["constant", "linear", "cosine"])
+    group.add_argument("--lr-decay-iters", type=int, default=None)
+    group.add_argument("--lr-warmup-fraction", type=float, default=None)
+    group.add_argument("--min-lr", type=float, default=0.0)
+    return parser
+
+
+def _add_mixed_precision_args(parser):
+    group = parser.add_argument_group(title="mixed precision")
+    group.add_argument("--fp16", action="store_true")
+    group.add_argument("--bf16", action="store_true")
+    group.add_argument("--loss-scale", type=float, default=None)
+    group.add_argument("--initial-loss-scale", type=float, default=2 ** 32)
+    group.add_argument("--min-loss-scale", type=float, default=1.0)
+    group.add_argument("--loss-scale-window", type=float, default=1000)
+    group.add_argument("--hysteresis", type=int, default=2)
+    group.add_argument("--accumulate-allreduce-grads-in-fp32", action="store_true")
+    return parser
+
+
+def _add_distributed_args(parser):
+    group = parser.add_argument_group(title="distributed")
+    group.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    group.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    group.add_argument("--pipeline-model-parallel-split-rank", type=int, default=None)
+    group.add_argument("--num-layers-per-virtual-pipeline-stage", type=int, default=None)
+    group.add_argument("--virtual-pipeline-model-parallel-size", type=int, default=None)
+    group.add_argument("--context-parallel-size", type=int, default=1)
+    group.add_argument("--sequence-parallel", action="store_true")
+    group.add_argument("--world-size", type=int, default=None)
+    group.add_argument("--rank", type=int, default=0)
+    group.add_argument("--local-rank", type=int, default=0)
+    group.add_argument("--distributed-backend", type=str, default="xla",
+                       choices=["xla", "nccl", "gloo", "ucc"])
+    group.add_argument("--use-cpu-initialization", action="store_true")
+    return parser
+
+
+def _add_validation_args(parser):
+    group = parser.add_argument_group(title="validation")
+    group.add_argument("--eval-iters", type=int, default=100)
+    group.add_argument("--eval-interval", type=int, default=1000)
+    return parser
+
+
+def _add_data_args(parser):
+    group = parser.add_argument_group(title="data")
+    group.add_argument("--data-path", nargs="*", default=None)
+    group.add_argument("--seq-length", type=int, default=None)
+    group.add_argument("--encoder-seq-length", type=int, default=None)
+    group.add_argument("--decoder-seq-length", type=int, default=None)
+    group.add_argument("--vocab-size", type=int, default=None)
+    group.add_argument("--num-workers", type=int, default=2)
+    group.add_argument("--reset-position-ids", action="store_true")
+    group.add_argument("--reset-attention-mask", action="store_true")
+    group.add_argument("--eod-mask-loss", action="store_true")
+    group.add_argument("--dataloader-type", type=str, default=None,
+                       choices=[None, "single", "cyclic"])
+    return parser
+
+
+def _add_logging_args(parser):
+    group = parser.add_argument_group(title="logging")
+    group.add_argument("--log-params-norm", action="store_true")
+    group.add_argument("--log-num-zeros-in-grad", action="store_true")
+    group.add_argument("--tensorboard-dir", type=str, default=None)
+    group.add_argument("--tensorboard-log-interval", type=int, default=1)
+    group.add_argument("--timing-log-level", type=int, default=0, choices=range(3))
+    return parser

@@ -1,0 +1,58 @@
+"""Distributed test base classes.
+
+Reference: ``apex/transformer/testing/distributed_test_base.py:22-96`` —
+``DistributedTestBase`` spawns ``world_size`` processes with file-store
+rendezvous (``MultiProcessTestCase``), with NCCL and UCC subclasses.
+
+TPU redesign: multi-device correctness is tested on one process against
+a virtual device mesh (``--xla_force_host_platform_device_count``),
+comparing shard_map-parallel runs with a single-device oracle — the same
+parallel-vs-oracle pattern the reference uses, minus process spawning
+(which tests the transport, not the math; XLA's collectives are the
+transport here).  ``world_size``/``DISTRIBUTED_BACKEND`` attributes are
+kept so reference-style test bodies port over unchanged.
+"""
+
+import unittest
+
+import jax
+
+from apex_tpu.transformer.testing.commons import DistributedTestContext
+
+
+class DistributedTestBase(unittest.TestCase):
+    """Per-test mesh lifecycle (reference distributed_test_base.py:22).
+
+    Subclasses set ``TP``/``PP``/``CP`` (defaults 1) — the analog of the
+    reference's world_size carve-up; remaining devices become ``dp``.
+    """
+
+    DISTRIBUTED_BACKEND = "xla"
+    TP = 1
+    PP = 1
+    CP = 1
+
+    @property
+    def world_size(self) -> int:
+        return jax.device_count()
+
+    def setUp(self):
+        super().setUp()
+        self._ctx = DistributedTestContext(tp=self.TP, pp=self.PP, cp=self.CP)
+        self.mesh = self._ctx.__enter__().mesh
+
+    def tearDown(self):
+        self._ctx.__exit__(None, None, None)
+        super().tearDown()
+
+
+class XlaDistributedTestBase(DistributedTestBase):
+    """Name parity with NcclDistributedTestBase (:80) — XLA collectives
+    are the only backend on TPU, so there is exactly one subclass."""
+
+    DISTRIBUTED_BACKEND = "xla"
+
+
+# The reference parametrizes NCCL vs UCC; both map to XLA here.
+NcclDistributedTestBase = XlaDistributedTestBase
+UccDistributedTestBase = XlaDistributedTestBase

@@ -1,0 +1,103 @@
+"""Megatron-style global variables for the test harness.
+
+Reference: ``apex/transformer/testing/global_vars.py`` —
+``set_global_variables`` parses args once and installs process-global
+args / microbatch calculator / timers / tensorboard writer, read back by
+``get_args()`` etc.  Test-harness-only state (the library itself is
+functional); kept process-global here for the same reason the reference
+does it: Megatron-style training scripts expect these accessors.
+"""
+
+from typing import Optional
+
+from apex_tpu.transformer import microbatches as _microbatches
+from apex_tpu.transformer.pipeline_parallel import utils as _pp_utils
+from apex_tpu.transformer.testing.arguments import parse_args
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_AUTORESUME = None
+_GLOBAL_TIMERS = None
+
+
+def _ensure_var_is_initialized(var, name):
+    if var is None:
+        raise AssertionError(f"{name} is not initialized.")
+
+
+def _ensure_var_is_not_initialized(var, name):
+    if var is not None:
+        raise AssertionError(f"{name} is already initialized.")
+
+
+def get_args():
+    """Reference: global_vars.py:34."""
+    _ensure_var_is_initialized(_GLOBAL_ARGS, "args")
+    return _GLOBAL_ARGS
+
+
+def get_num_microbatches() -> int:
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *, consistency_check: bool = True) -> None:
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples, consistency_check)
+
+
+def get_tensorboard_writer():
+    """May be None (reference global_vars.py:69)."""
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_adlr_autoresume():
+    """Always None on TPU — no ADLR cluster (reference global_vars.py:75)."""
+    return _GLOBAL_AUTORESUME
+
+
+def get_timers():
+    _ensure_var_is_initialized(_GLOBAL_TIMERS, "timers")
+    return _GLOBAL_TIMERS
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         override_args=None, ignore_unknown_args=False,
+                         args=None):
+    """Parse args and install all globals (reference global_vars.py:87)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    _ensure_var_is_not_initialized(_GLOBAL_ARGS, "args")
+    _GLOBAL_ARGS = parse_args(
+        extra_args_provider=extra_args_provider,
+        defaults=args_defaults or {},
+        override_args=override_args or {},
+        ignore_unknown_args=ignore_unknown_args,
+        args=args,
+    )
+    if _GLOBAL_ARGS.micro_batch_size is not None:
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR = _microbatches.build_num_microbatches_calculator(
+            rank=_GLOBAL_ARGS.rank,
+            rampup_batch_size=_GLOBAL_ARGS.rampup_batch_size,
+            global_batch_size=_GLOBAL_ARGS.global_batch_size,
+            micro_batch_size=_GLOBAL_ARGS.micro_batch_size,
+            data_parallel_size=_GLOBAL_ARGS.data_parallel_size,
+        )
+    _GLOBAL_TIMERS = _pp_utils.get_timers()
+    return _GLOBAL_ARGS
+
+
+def destroy_global_vars():
+    """Reset for test isolation (no reference analog; their process dies)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    global _GLOBAL_TENSORBOARD_WRITER, _GLOBAL_AUTORESUME, _GLOBAL_TIMERS
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_TENSORBOARD_WRITER = None
+    _GLOBAL_AUTORESUME = None
+    _GLOBAL_TIMERS = None

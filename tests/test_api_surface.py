@@ -1,0 +1,312 @@
+"""API-surface parity additions: amp module-level functions, disable_casts,
+MemoryBuffer, syncbn subgroup helper, pipeline next/prev rank, bottleneck
+blocks, Megatron-style arguments/global_vars, DistributedTestBase."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import apex_tpu.amp as amp
+from apex_tpu.contrib.bottleneck import (
+    Bottleneck,
+    HaloExchangerPeer,
+    SpatialBottleneck,
+)
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel import create_syncbn_process_group, SYNCBN_AXIS
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import (
+    MemoryBuffer,
+    RingMemBuffer,
+    get_cuda_rng_tracker,
+    get_rng_state_tracker,
+)
+from apex_tpu.transformer.tensor_parallel import memory as tp_memory
+from apex_tpu.transformer.testing import global_vars
+from apex_tpu.transformer.testing.arguments import parse_args
+from apex_tpu.transformer.testing.distributed_test_base import DistributedTestBase
+
+
+class TestAmpModuleSurface:
+    def test_scale_loss_and_state_dict_roundtrip(self):
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        cast, a = amp.initialize(params, opt_level="O2", half_dtype=jnp.float16)
+        state = a.init_state()
+        loss = jnp.float32(2.0)
+        scaled = amp.scale_loss(loss, a, state)
+        assert float(scaled) == float(loss) * float(state.loss_scale)
+        d = amp.state_dict(state)
+        restored = amp.load_state_dict(d)
+        assert float(restored.loss_scale) == float(state.loss_scale)
+
+    def test_master_params_iterates_fp32(self):
+        params = {"w": jnp.ones((4,), jnp.bfloat16)}
+        opt = FusedAdam(lr=1e-3, master_weights=True)
+        st = opt.init(params)
+        masters = list(amp.master_params(st))
+        assert masters and all(m.dtype == jnp.float32 for m in masters)
+
+    def test_disable_casts(self):
+        @amp.half_function
+        def f(x):
+            return x.dtype
+
+        x = jnp.ones((2,), jnp.float32)
+        assert f(x) == jnp.bfloat16
+        with amp.disable_casts():
+            assert f(x) == jnp.float32
+        assert f(x) == jnp.bfloat16
+
+    def test_legacy_init(self):
+        handle = amp.init(enabled=True)
+        st = handle.init_state()
+        assert st is not None
+        noop = amp.init(enabled=False)
+        assert noop.scaler is None
+        # legacy kwargs are accepted and ignored
+        amp.init(enabled=True, verbose=False, enable_caching=True)
+
+    def test_set_half_dtype_affects_existing_decorations(self):
+        @amp.half_function
+        def f(x):
+            return x.dtype
+
+        x = jnp.ones((2,), jnp.float32)
+        assert f(x) == jnp.bfloat16
+        try:
+            amp.set_half_dtype(jnp.float16)
+            assert f(x) == jnp.float16
+        finally:
+            amp.set_half_dtype(jnp.bfloat16)
+
+    def test_promote_function_casts_kwargs(self):
+        @amp.promote_function
+        def f(x, y=None):
+            return x.dtype, y.dtype
+
+        dx, dy = f(jnp.ones(2, jnp.bfloat16), y=jnp.ones(2, jnp.float32))
+        assert dx == jnp.float32 and dy == jnp.float32
+
+    def test_adam_swa_skips_overflow_steps(self):
+        from apex_tpu.contrib.openfold_triton import FusedAdamSWA
+
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        opt = FusedAdamSWA(lr=0.1)
+        st = opt.init(params)
+        grads = {"w": jnp.full((4,), 0.5)}
+        p1, st = opt.update(grads, st, params, grads_finite=jnp.bool_(False))
+        np.testing.assert_array_equal(np.asarray(p1["w"]), np.asarray(params["w"]))
+        assert int(st.n_averaged) == 0
+        np.testing.assert_array_equal(
+            np.asarray(st.swa_params["w"]), np.asarray(params["w"])
+        )
+        p2, st = opt.update(grads, st, p1, grads_finite=jnp.bool_(True))
+        assert int(st.n_averaged) == 1
+        np.testing.assert_allclose(
+            np.asarray(st.swa_params["w"]), np.asarray(p2["w"]), rtol=1e-6
+        )
+
+
+class TestMemoryBuffer:
+    def setup_method(self, method):
+        tp_memory.reset_mem_buffs()
+
+    def test_add_get_reset(self):
+        buf = MemoryBuffer("act", 64, jnp.float32, track_usage=True)
+        a = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        view = buf.add(a)
+        np.testing.assert_array_equal(np.asarray(view), np.asarray(a))
+        assert buf.numel_in_use() == 12
+        b = jnp.ones((8,), jnp.float32)
+        buf.add(b)
+        assert buf.numel_in_use() == 20
+        np.testing.assert_array_equal(
+            np.asarray(buf.get_data()[:12]), np.asarray(a).ravel()
+        )
+        buf.reset()
+        assert not buf.is_in_use()
+
+    def test_overflow_and_dtype_checks(self):
+        buf = MemoryBuffer("small", 4, jnp.float32)
+        with pytest.raises(AssertionError):
+            buf.add(jnp.ones((8,), jnp.float32))
+        with pytest.raises(AssertionError):
+            buf.add(jnp.ones((2,), jnp.bfloat16))
+
+    def test_ring(self):
+        ring = RingMemBuffer("ring", 2, 16, jnp.float32)
+        b0 = ring.get_next_buffer()
+        b0.add(jnp.ones((4,), jnp.float32))
+        b1 = ring.get_next_buffer()
+        assert b1 is not b0
+        b0_again = ring.get_next_buffer()
+        assert b0_again is b0 and not b0.is_in_use()  # reset on rotation
+
+    def test_named_registry(self):
+        buf = tp_memory.allocate_mem_buff("x", 8, jnp.float32)
+        assert tp_memory.get_mem_buff("x") is buf
+        with pytest.raises(AssertionError):
+            tp_memory.allocate_mem_buff("x", 8, jnp.float32)
+
+
+class TestSyncbnGroups:
+    def test_split(self):
+        axis, (outer, inner) = create_syncbn_process_group(2, world_size=8)
+        assert axis == SYNCBN_AXIS and (outer, inner) == (4, 2)
+        with pytest.raises(ValueError):
+            create_syncbn_process_group(3, world_size=8)
+
+    def test_subgroup_stats_differ_across_groups(self, devices8):
+        # Two groups of 4: stats must sync within, not across.
+        from apex_tpu.parallel.sync_batchnorm import sync_batch_norm_stats
+
+        axis, (outer, inner) = create_syncbn_process_group(4, world_size=8)
+        mesh = Mesh(np.array(devices8).reshape(outer, inner), ("dp", axis))
+        x = jnp.concatenate(
+            [jnp.zeros((4, 2, 2, 3)), jnp.ones((4, 2, 2, 3))]
+        )  # group 0 all-zero, group 1 all-one
+
+        def f(xs):
+            mean, var, n = sync_batch_norm_stats(xs, (0, 1, 2), axis)
+            return mean
+
+        means = jax.shard_map(
+            f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False
+        )(x)
+        np.testing.assert_allclose(np.asarray(means[0]), 0.0)
+        np.testing.assert_allclose(np.asarray(means[-1]), 1.0)
+
+
+class TestPipelineRankGetters:
+    def test_next_prev(self, devices8):
+        with parallel_state_ctx(pp=4):
+            mesh = parallel_state.get_mesh()
+
+            def f():
+                nxt = parallel_state.get_pipeline_model_parallel_next_rank()
+                prv = parallel_state.get_pipeline_model_parallel_prev_rank()
+                return jnp.reshape(nxt, (1,)), jnp.reshape(prv, (1,))
+
+            nxt, prv = jax.shard_map(
+                f, mesh=mesh, in_specs=(), out_specs=P(parallel_state.PIPELINE_AXIS),
+                check_vma=False,
+            )()
+            np.testing.assert_array_equal(np.asarray(nxt), [1, 2, 3, 0])
+            np.testing.assert_array_equal(np.asarray(prv), [3, 0, 1, 2])
+
+
+def parallel_state_ctx(**kw):
+    from apex_tpu.transformer.testing.commons import DistributedTestContext
+
+    return DistributedTestContext(**kw)
+
+
+class TestRngTrackerAlias:
+    def test_alias(self):
+        assert get_cuda_rng_tracker is get_rng_state_tracker
+
+
+class TestBottleneck:
+    def test_forward_shapes(self):
+        m = Bottleneck(in_channels=8, bottleneck_channels=4, out_channels=16, stride=2)
+        x = jnp.ones((2, 8, 8, 8), jnp.bfloat16)
+        params = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(params, x)
+        assert y.shape == (2, 4, 4, 16)
+
+    def test_spatial_matches_single_device(self, devices8):
+        # H split over 4 devices + halo exchange == unsharded block.
+        mesh = Mesh(np.array(devices8[:4]), ("spatial",))
+        m = SpatialBottleneck(
+            in_channels=6, bottleneck_channels=4, out_channels=6, axis_name="spatial",
+            dtype=jnp.float32,
+        )
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 8, 6), jnp.float32)
+        # Oracle params from the unsharded block (identical param structure:
+        # Conv_0..2 + FrozenScaleBias_0..2 in the same order).
+        ref_m = Bottleneck(
+            in_channels=6, bottleneck_channels=4, out_channels=6, stride=1,
+            dtype=jnp.float32,
+        )
+        params = ref_m.init(jax.random.PRNGKey(0), x)
+        y_ref = ref_m.apply(params, x)
+
+        def shard_fn(xs):
+            return m.apply(params, xs)
+
+        y_sharded = jax.shard_map(
+            shard_fn, mesh=mesh, in_specs=P(None, "spatial"),
+            out_specs=P(None, "spatial"), check_vma=False,
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(y_sharded), np.asarray(y_ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_halo_peer_alias(self):
+        ex = HaloExchangerPeer("spatial", halo=1, peer_pool=object())
+        assert ex.halo == 1
+
+
+class TestArguments:
+    def test_derived_values(self):
+        args = parse_args(args=[
+            "--num-layers", "4", "--hidden-size", "64",
+            "--num-attention-heads", "4", "--micro-batch-size", "2",
+            "--tensor-model-parallel-size", "2", "--world-size", "8", "--bf16",
+        ])
+        assert args.ffn_hidden_size == 256
+        assert args.kv_channels == 16
+        assert args.data_parallel_size == 4
+        assert args.global_batch_size == 8
+        assert args.params_dtype == "bfloat16"
+
+    def test_consistency_errors(self):
+        with pytest.raises(ValueError):
+            parse_args(args=["--tensor-model-parallel-size", "3", "--world-size", "8"])
+        with pytest.raises(ValueError):
+            parse_args(args=["--fp16", "--bf16", "--world-size", "1"])
+
+    def test_extra_args_provider_and_overrides(self):
+        def extra(parser):
+            parser.add_argument("--my-flag", type=int, default=1)
+            return parser
+
+        args = parse_args(
+            extra_args_provider=extra,
+            defaults={"hidden_size": 32},
+            override_args={"seq_length": 128},
+            args=["--world-size", "1"],
+        )
+        assert args.my_flag == 1 and args.hidden_size == 32 and args.seq_length == 128
+
+
+class TestGlobalVars:
+    def teardown_method(self, method):
+        global_vars.destroy_global_vars()
+        from apex_tpu.transformer.pipeline_parallel import utils as ppu
+        ppu.destroy_num_microbatches_calculator()
+
+    def test_set_and_get(self):
+        global_vars.destroy_global_vars()
+        args = global_vars.set_global_variables(args=[
+            "--micro-batch-size", "2", "--global-batch-size", "8",
+            "--world-size", "1",
+        ])
+        assert global_vars.get_args() is args
+        assert global_vars.get_num_microbatches() == 4
+        assert global_vars.get_current_global_batch_size() == 8
+        assert global_vars.get_timers() is not None
+        assert global_vars.get_adlr_autoresume() is None
+        with pytest.raises(AssertionError):
+            global_vars.set_global_variables(args=["--world-size", "1"])
+
+
+class TestDistributedTestBase(DistributedTestBase):
+    TP = 2
+
+    def test_mesh_built(self):
+        assert self.mesh is not None
+        assert parallel_state.get_tensor_model_parallel_world_size() == 2
+        assert self.world_size == 8
