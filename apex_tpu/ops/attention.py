@@ -55,7 +55,11 @@ def _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k):
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # exp(NEG_INF - NEG_INF) = 1 would give fully-masked rows (ring
+        # warmup blocks) a spurious uniform distribution; re-mask.
         p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vblk)
@@ -109,6 +113,8 @@ def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[..., None])  # (B,H,Sq,bk)
+        if causal:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
         dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
         dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vblk)
         ds = p * (dp - Drow[..., None])
@@ -132,17 +138,38 @@ def flash_attention(
     v,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
-    block_k: int = 256,
+    block_k: Optional[int] = None,
     q_offset: int = 0,
     k_offset: int = 0,
+    impl: str = "auto",
+    block_q: Optional[int] = None,
 ):
     """Memory-efficient attention, (B, H, S, D) layout.
 
     ``q_offset``/``k_offset`` give the global sequence positions of the
     local blocks (used by ring attention for cross-device causal masks).
+
+    ``impl``: "pallas" (TPU kernel), "scan" (lax.scan composite), or
+    "auto" — the Pallas kernel on TPU with kernel-friendly shapes, the
+    scan path everywhere else.  ``block_q``/``block_k`` default to each
+    implementation's tuned tile size (scan: 256; pallas: 1024 fwd).
     """
+    if impl not in ("auto", "pallas", "scan"):
+        raise ValueError(f"impl must be 'auto', 'pallas', or 'scan'; got {impl!r}")
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    return _flash(q, k, v, scale, causal, q_offset, k_offset, block_k)
+    if impl != "scan":
+        from apex_tpu.ops.flash_attention_pallas import (
+            flash_attention_pallas,
+            pallas_flash_available,
+        )
+
+        if impl == "pallas" or pallas_flash_available(q, k):
+            return flash_attention_pallas(
+                q, k, v, causal=causal, softmax_scale=scale,
+                q_offset=q_offset, k_offset=k_offset,
+                block_q=block_q, block_k=block_k,
+            )
+    return _flash(q, k, v, scale, causal, q_offset, k_offset, block_k or 256)
 
 
 def flash_attention_with_lse(

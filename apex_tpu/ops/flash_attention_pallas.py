@@ -1,0 +1,359 @@
+"""Pallas TPU flash attention (fwd + bwd kernels).
+
+Reference: ``apex/contrib/fmha`` (CUDA flash-style fused MHA, seqlen
+≤512) and ``apex/contrib/multihead_attn`` fused attention.  TPU
+redesign: one VMEM-resident online-softmax kernel — the (bq, bk) score
+tile never touches HBM, running max/sum live in VMEM scratch across the
+sequential k-block grid steps, and the causal upper triangle is skipped
+block-wholesale via ``pl.when`` on grid indices.
+
+Three kernels, the standard flash decomposition:
+
+- forward: grid ``(batch·heads, q_blocks, k_blocks)``, out block revisited
+  across the k dimension, accumulator/max/sum in f32 scratch, writes
+  ``out`` and the per-row logsumexp.
+- dq backward: same grid; recomputes the score tile from (q, k, lse),
+  accumulates ``dq`` in scratch.
+- dk/dv backward: grid ``(batch·heads, k_blocks, q_blocks)`` (k outer),
+  accumulates ``dk``/``dv`` in scratch.
+
+``delta = rowsum(dout · out)`` is precomputed by XLA (it fuses into the
+preceding op).  ``q_offset``/``k_offset`` place the local blocks in the
+global sequence so ring attention's cross-device causal masks work.
+
+The ``lax.scan`` composite in :mod:`apex_tpu.ops.attention` remains the
+numerics specification and the universal fallback (CPU, odd shapes).
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _pick_block(seq, target):
+    """Largest divisor of ``seq`` ≤ target, preferring lane multiples."""
+    b = min(target, seq)
+    while seq % b:
+        b -= 1
+    return b
+
+
+def _causal_mask(bq, bk, qi, kj, block_q, block_k, q_offset, k_offset):
+    row = q_offset + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = k_offset + kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return row >= col
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, q_offset, k_offset, block_q, block_k, nk):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Fully-masked (above-diagonal) blocks contribute nothing.
+    diag_ok = (
+        (q_offset + (i + 1) * block_q - 1) >= (k_offset + j * block_k)
+        if causal
+        else True
+    )
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            mask = _causal_mask(q.shape[0], k.shape[0], i, j, block_q, block_k,
+                                q_offset, k_offset)
+            s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # exp(NEG_INF - NEG_INF) = 1 would give fully-masked rows a
+        # spurious uniform distribution; re-mask after the exp.
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)  # fully-masked rows (ring blocks)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0:1] + jnp.log(l)
+
+
+def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
+                     block_q=1024, block_k=1024, interpret=False):
+    """q: (BH, Sq, D); k/v: (BH, Sk, D).  Returns (out, lse (BH, Sq, 1))."""
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Sk, block_k)
+    nq, nk = Sq // bq, Sk // bk
+    grid = (BH, nq, nk)
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, q_offset=q_offset,
+            k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ----------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+               *, scale, causal, q_offset, k_offset, block_q, block_k, nk):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    diag_ok = (
+        (q_offset + (i + 1) * block_q - 1) >= (k_offset + j * block_k)
+        if causal
+        else True
+    )
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            mask = _causal_mask(q.shape[0], k.shape[0], i, j, block_q, block_k,
+                                q_offset, k_offset)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
+        do = do_ref[0]
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0])
+        acc_ref[:] += scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_acc, dv_acc, *, scale, causal, q_offset, k_offset,
+                block_q, block_k, nq):
+    j, i = pl.program_id(1), pl.program_id(2)  # k-block outer, q-block inner
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    diag_ok = (
+        (q_offset + (i + 1) * block_q - 1) >= (k_offset + j * block_k)
+        if causal
+        else True
+    )
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            mask = _causal_mask(q.shape[0], k.shape[0], i, j, block_q, block_k,
+                                q_offset, k_offset)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
+        do = do_ref[0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0])
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
+                     block_q=512, block_k=512, interpret=False):
+    # 512 (not the forward's 1024): the bwd kernels keep ~4 (bq, bk) f32
+    # score-sized temporaries live, so smaller tiles stay inside VMEM.
+    """All (BH, S, D); lse (BH, Sq, 1).  Returns (dq, dk, dv)."""
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Sk, block_k)
+    nq, nk = Sq // bq, Sk // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM)
+    r_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, q_offset=q_offset,
+            k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
+        ),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # k-outer grid: index maps see (b, j, i).
+    qT_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM)
+    kT_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM)
+    rT_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, q_offset=q_offset,
+            k_offset=k_offset, block_q=bq, block_k=bk, nq=nq,
+        ),
+        grid=(BH, nk, nq),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rT_spec, rT_spec],
+        out_specs=[kT_spec, kT_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- dispatch
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_pallas(q, k, v, scale, causal, q_offset, k_offset, block_q, block_k,
+                  interpret):
+    out, _ = flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret)
+    return out
+
+
+def _flash_pallas_fwd(q, k, v, scale, causal, q_offset, k_offset, block_q,
+                      block_k, interpret):
+    out, lse = flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
+                                block_q=block_q, block_k=block_k,
+                                interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_pallas_bwd(scale, causal, q_offset, k_offset, block_q, block_k,
+                      interpret, res, g):
+    q, k, v, out, lse = res
+    # bwd keeps more score-sized f32 temporaries live; cap tiles at 512
+    return flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
+                            q_offset, k_offset,
+                            block_q=min(block_q, 512), block_k=min(block_k, 512),
+                            interpret=interpret)
+
+
+_flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
+
+
+def flash_attention_pallas(q, k, v, causal=True, softmax_scale=None,
+                           q_offset=0, k_offset=0, block_q=None, block_k=None,
+                           interpret=False):
+    """(B, H, S, D) flash attention via the Pallas kernels."""
+    B, H, Sq, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(D)
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, k.shape[2], D)
+    vf = v.reshape(B * H, v.shape[2], D)
+    out = _flash_pallas(qf, kf, vf, scale, causal, q_offset, k_offset,
+                        block_q or 1024, block_k or 1024, interpret)
+    return out.reshape(B, H, Sq, D)
+
+
+def pallas_flash_available(q, k) -> bool:
+    """Kernel path: real TPU, lane-aligned sequence blocks, ≥8 head dim.
+    Disable with APEX_TPU_PALLAS_ATTN=0."""
+    if os.environ.get("APEX_TPU_PALLAS_ATTN", "1") == "0":
+        return False
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+    return (
+        on_tpu
+        and q.shape[2] % 128 == 0
+        and k.shape[2] % 128 == 0
+        and q.shape[3] % 8 == 0
+        and q.dtype in (jnp.float32, jnp.bfloat16)
+    )

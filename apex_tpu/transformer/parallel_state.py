@@ -145,6 +145,106 @@ def get_pipeline_model_parallel_split_rank() -> Optional[int]:
     return _state().pipeline_model_parallel_split_rank
 
 
+# ------------------------------------------------------------------- groups
+class AxisGroup(str):
+    """A "process group" handle: the name of a mesh axis.
+
+    Reference groups (``parallel_state.py:444-506``) are NCCL communicators;
+    the TPU equivalent is a named mesh axis.  ``AxisGroup`` subclasses
+    ``str`` so it can be passed straight to ``jax.lax.psum``/``all_gather``
+    etc. as the ``axis_name``.  ``size()`` and ``mesh`` mirror the
+    ``torch.distributed`` group API surface.
+    """
+
+    members: Optional[tuple] = None
+
+    def __new__(cls, axis: str, size: int, mesh: Mesh, members: Optional[tuple] = None):
+        self = super().__new__(cls, axis)
+        self._size = size
+        self.mesh = mesh
+        self.members = members
+        return self
+
+    def size(self) -> int:
+        return self._size
+
+
+def get_tensor_model_parallel_group() -> AxisGroup:
+    """Reference: parallel_state.py:444 — here, the ``tp`` mesh axis."""
+    s = _state()
+    return AxisGroup(TENSOR_AXIS, s.tensor_model_parallel_size, s.mesh)
+
+
+def get_pipeline_model_parallel_group() -> AxisGroup:
+    """Reference: parallel_state.py:453 — here, the ``pp`` mesh axis."""
+    s = _state()
+    return AxisGroup(PIPELINE_AXIS, s.pipeline_model_parallel_size, s.mesh)
+
+
+def get_context_parallel_group() -> AxisGroup:
+    s = _state()
+    return AxisGroup(CONTEXT_AXIS, s.context_parallel_size, s.mesh)
+
+
+def get_data_parallel_group() -> AxisGroup:
+    """Reference: parallel_state.py:462 — here, the ``dp`` mesh axis."""
+    s = _state()
+    return AxisGroup(DATA_AXIS, s.data_parallel_size, s.mesh)
+
+
+class MultiAxisGroup(tuple):
+    """A "process group" spanning several mesh axes.
+
+    Subclasses ``tuple`` of axis-name strings so it is accepted verbatim
+    as ``axis_name`` by ``jax.lax.psum``-family collectives, like
+    :class:`AxisGroup` is for a single axis."""
+
+    def __new__(cls, axes, size: int, mesh: Mesh):
+        self = super().__new__(cls, axes)
+        self._size = size
+        self.mesh = mesh
+        return self
+
+    def size(self) -> int:
+        return self._size
+
+
+def get_model_parallel_group() -> MultiAxisGroup:
+    """The combined (pp, tp) axes — collectives over every non-dp axis;
+    used for found-inf reductions (reference:
+    ``transformer/amp/grad_scaler.py``)."""
+    s = _state()
+    return MultiAxisGroup(
+        (PIPELINE_AXIS, TENSOR_AXIS),
+        s.pipeline_model_parallel_size * s.tensor_model_parallel_size,
+        s.mesh,
+    )
+
+
+def get_embedding_group() -> AxisGroup:
+    """First+last pipeline stages (tied embedding grad sync).
+
+    Reference: parallel_state.py:471.  On TPU the tied-embedding gradient
+    exchange is a masked ``psum`` over the ``pp`` axis done inside the
+    pipeline schedule; ``members`` records which stage indices take part.
+    """
+    s = _state()
+    members = tuple(sorted({0, s.pipeline_model_parallel_size - 1}))
+    return AxisGroup(PIPELINE_AXIS, len(members), s.mesh, members=members)
+
+
+def get_position_embedding_group() -> AxisGroup:
+    """Reference: parallel_state.py:480 — stage 0 only (position embeddings)."""
+    s = _state()
+    return AxisGroup(PIPELINE_AXIS, 1, s.mesh, members=(0,))
+
+
+def get_amax_reduction_group() -> AxisGroup:
+    """Reference: parallel_state.py:489 — fp8 amax reductions ride tp."""
+    s = _state()
+    return AxisGroup(TENSOR_AXIS, s.tensor_model_parallel_size, s.mesh)
+
+
 # ------------------------------------------------------------------- ranks
 # Inside shard_map these return traced per-device indices; the reference's
 # host-side rank bookkeeping has no other TPU analog.

@@ -8,11 +8,14 @@ checkpointed activations into, to avoid allocator fragmentation.
 TPU redesign: XLA owns device memory, so the fragmentation problem the
 reference solves does not exist under jit — but the *capacity-budgeting*
 role does.  The buffer here is a flat ``jnp`` array reused across
-``add`` calls via functional donation: ``add`` packs a flattened tensor
-at the bump-allocator cursor (pure ``lax.dynamic_update_slice``, fusible
-by XLA), ``get`` slices it back out.  Under jit with buffer donation the
-updates are in-place, giving the same single-arena behavior.  Usage
-tracking mirrors the reference so code ported from Megatron can budget
+``add`` calls: ``add`` packs a flattened tensor at the bump-allocator
+cursor (``lax.dynamic_update_slice``) and slices it back out.  This is a
+**host-side** compatibility shim: the cursor and arena live in Python
+state, so ``add`` must be called outside ``jit`` (it raises on tracers).
+Inside jit the idiomatic equivalents are ``jax.checkpoint`` policies
+(:mod:`apex_tpu.transformer.tensor_parallel.random`) — XLA already
+arena-allocates.  Usage tracking mirrors the reference (accumulated at
+``reset``, memory.py:79-88) so code ported from Megatron can budget
 identically.
 """
 
@@ -69,7 +72,11 @@ class MemoryBuffer:
         self.total_value = 0.0
 
     def reset(self):
-        """Rewind the cursor; arena contents become dead (memory.py:79)."""
+        """Rewind the cursor; arena contents become dead (memory.py:79).
+        Usage is sampled here, once per fill cycle, as in the reference."""
+        if self.track_usage:
+            self.in_use_value += float(self._start)
+            self.total_value += float(self.numel)
         self._start = 0
 
     def is_in_use(self) -> bool:
@@ -81,6 +88,14 @@ class MemoryBuffer:
     def add(self, tensor):
         """Pack ``tensor`` into the arena; returns the packed copy
         reshaped to ``tensor.shape`` (reference memory.py:91)."""
+        import jax
+
+        if isinstance(tensor, jax.core.Tracer):
+            raise TypeError(
+                "MemoryBuffer.add called under jit tracing: the arena cursor is "
+                "host-side Python state. Use jax.checkpoint policies for in-jit "
+                "activation memory management."
+            )
         if tensor.dtype != self.dtype:
             raise AssertionError(
                 f"Input tensor dtype {tensor.dtype} != buffer dtype {self.dtype}"
@@ -94,9 +109,6 @@ class MemoryBuffer:
         )
         view = lax.dynamic_slice(self.data, (self._start,), (n,)).reshape(tensor.shape)
         self._start = new_start
-        if self.track_usage:
-            self.in_use_value += float(n)
-            self.total_value += float(self.numel)
         return view
 
     def get_data(self):

@@ -60,10 +60,14 @@ def validate_args(args):
     # world-size bookkeeping: on TPU "rank"/"world size" are device counts.
     if args.world_size is None:
         args.world_size = int(os.environ.get("WORLD_SIZE", "1"))
-    model_parallel = args.tensor_model_parallel_size * args.pipeline_model_parallel_size
+    model_parallel = (
+        args.tensor_model_parallel_size
+        * args.pipeline_model_parallel_size
+        * getattr(args, "context_parallel_size", 1)
+    )
     if args.world_size % model_parallel != 0:
         raise ValueError(
-            f"world size {args.world_size} not divisible by tp*pp {model_parallel}"
+            f"world size {args.world_size} not divisible by tp*pp*cp {model_parallel}"
         )
     args.data_parallel_size = args.world_size // model_parallel
     if args.ffn_hidden_size is None and args.hidden_size is not None:

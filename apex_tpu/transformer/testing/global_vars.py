@@ -10,12 +10,10 @@ does it: Megatron-style training scripts expect these accessors.
 
 from typing import Optional
 
-from apex_tpu.transformer import microbatches as _microbatches
 from apex_tpu.transformer.pipeline_parallel import utils as _pp_utils
 from apex_tpu.transformer.testing.arguments import parse_args
 
 _GLOBAL_ARGS = None
-_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
 _GLOBAL_TENSORBOARD_WRITER = None
 _GLOBAL_AUTORESUME = None
 _GLOBAL_TIMERS = None
@@ -37,19 +35,24 @@ def get_args():
     return _GLOBAL_ARGS
 
 
+# The calculator lives in pipeline_parallel.utils (the module the
+# pipeline schedules read); these accessors delegate so both views agree.
+def _calculator():
+    calc = _pp_utils._GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _ensure_var_is_initialized(calc, "num microbatches calculator")
+    return calc
+
+
 def get_num_microbatches() -> int:
-    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator")
-    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+    return _calculator().get()
 
 
 def get_current_global_batch_size() -> int:
-    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator")
-    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+    return _calculator().get_current_global_batch_size()
 
 
 def update_num_microbatches(consumed_samples: int, *, consistency_check: bool = True) -> None:
-    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator")
-    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples, consistency_check)
+    _calculator().update(consumed_samples, consistency_check)
 
 
 def get_tensorboard_writer():
@@ -71,7 +74,7 @@ def set_global_variables(extra_args_provider=None, args_defaults=None,
                          override_args=None, ignore_unknown_args=False,
                          args=None):
     """Parse args and install all globals (reference global_vars.py:87)."""
-    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    global _GLOBAL_ARGS, _GLOBAL_TIMERS
     _ensure_var_is_not_initialized(_GLOBAL_ARGS, "args")
     _GLOBAL_ARGS = parse_args(
         extra_args_provider=extra_args_provider,
@@ -81,7 +84,10 @@ def set_global_variables(extra_args_provider=None, args_defaults=None,
         args=args,
     )
     if _GLOBAL_ARGS.micro_batch_size is not None:
-        _GLOBAL_NUM_MICROBATCHES_CALCULATOR = _microbatches.build_num_microbatches_calculator(
+        # Install where the pipeline schedules read it (reference
+        # global_vars.py:95 builds the one calculator the whole process
+        # shares via pipeline_parallel.utils).
+        _pp_utils.setup_microbatch_calculator(
             rank=_GLOBAL_ARGS.rank,
             rampup_batch_size=_GLOBAL_ARGS.rampup_batch_size,
             global_batch_size=_GLOBAL_ARGS.global_batch_size,
@@ -94,10 +100,10 @@ def set_global_variables(extra_args_provider=None, args_defaults=None,
 
 def destroy_global_vars():
     """Reset for test isolation (no reference analog; their process dies)."""
-    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    global _GLOBAL_ARGS
     global _GLOBAL_TENSORBOARD_WRITER, _GLOBAL_AUTORESUME, _GLOBAL_TIMERS
     _GLOBAL_ARGS = None
-    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _pp_utils.destroy_num_microbatches_calculator()
     _GLOBAL_TENSORBOARD_WRITER = None
     _GLOBAL_AUTORESUME = None
     _GLOBAL_TIMERS = None

@@ -310,3 +310,104 @@ class TestDistributedTestBase(DistributedTestBase):
         assert self.mesh is not None
         assert parallel_state.get_tensor_model_parallel_world_size() == 2
         assert self.world_size == 8
+
+
+class TestGroupGetters:
+    """Group handles are mesh-axis names usable directly as axis_name."""
+
+    def test_groups_are_axis_names(self):
+        with parallel_state_ctx(tp=2, pp=2):
+            tp_g = parallel_state.get_tensor_model_parallel_group()
+            pp_g = parallel_state.get_pipeline_model_parallel_group()
+            dp_g = parallel_state.get_data_parallel_group()
+            assert tp_g == parallel_state.TENSOR_AXIS and tp_g.size() == 2
+            assert pp_g == parallel_state.PIPELINE_AXIS and pp_g.size() == 2
+            assert dp_g == parallel_state.DATA_AXIS and dp_g.size() == 2
+            emb = parallel_state.get_embedding_group()
+            assert emb.members == (0, 1)
+            assert parallel_state.get_position_embedding_group().members == (0,)
+            assert parallel_state.get_amax_reduction_group() == parallel_state.TENSOR_AXIS
+
+    def test_group_usable_in_collective(self):
+        from jax.experimental.shard_map import shard_map
+
+        with parallel_state_ctx(tp=4):
+            mesh = parallel_state.get_mesh()
+            g = parallel_state.get_tensor_model_parallel_group()
+
+            def f(x):
+                return jax.lax.psum(x, g)
+
+            x = jnp.arange(4, dtype=jnp.float32)
+            out = shard_map(
+                f, mesh=mesh,
+                in_specs=P(parallel_state.TENSOR_AXIS),
+                out_specs=P(parallel_state.TENSOR_AXIS),
+            )(x)
+            np.testing.assert_array_equal(np.asarray(out), [6.0, 6.0, 6.0, 6.0])
+
+    def test_model_parallel_group_is_axis_tuple(self):
+        from jax.experimental.shard_map import shard_map
+
+        with parallel_state_ctx(tp=2, pp=2):
+            mesh = parallel_state.get_mesh()
+            g = parallel_state.get_model_parallel_group()
+            assert tuple(g) == (parallel_state.PIPELINE_AXIS, parallel_state.TENSOR_AXIS)
+            assert g.size() == 4
+
+            def f(x):
+                return jax.lax.psum(x, g)
+
+            x = jnp.arange(4, dtype=jnp.float32)
+            out = shard_map(
+                f, mesh=mesh,
+                in_specs=P(None, (parallel_state.PIPELINE_AXIS, parallel_state.TENSOR_AXIS)),
+                out_specs=P(None, (parallel_state.PIPELINE_AXIS, parallel_state.TENSOR_AXIS)),
+            )(x.reshape(1, 4))
+            np.testing.assert_array_equal(np.asarray(out), [[6.0, 6.0, 6.0, 6.0]])
+
+    def test_embedding_group_pp1_dedup(self):
+        with parallel_state_ctx(tp=2):
+            assert parallel_state.get_embedding_group().members == (0,)
+
+    def test_usage_tracked_per_reset_cycle(self):
+        buf = MemoryBuffer("cyc", 100, jnp.float32, track_usage=True)
+        for _ in range(10):
+            buf.add(jnp.ones((10,), jnp.float32))
+        buf.reset()
+        assert buf.in_use_value == 100.0 and buf.total_value == 100.0
+
+    def test_add_rejects_tracers(self):
+        buf = MemoryBuffer("tr", 16, jnp.float32)
+        with pytest.raises(TypeError, match="jit"):
+            jax.jit(lambda t: buf.add(t))(jnp.ones((4,), jnp.float32))
+
+
+class TestGlobalVarsCalculatorWiring:
+    def test_set_global_variables_installs_pp_calculator(self):
+        from apex_tpu.transformer.pipeline_parallel import utils as ppu
+
+        global_vars.destroy_global_vars()
+        try:
+            global_vars.set_global_variables(args=[
+                "--world-size", "8", "--tensor-model-parallel-size", "2",
+                "--micro-batch-size", "2",
+            ])
+            # the pipeline schedules read this module-global; it must be set
+            assert ppu.get_num_microbatches() == global_vars.get_num_microbatches()
+        finally:
+            global_vars.destroy_global_vars()
+
+    def test_validate_args_accounts_for_cp(self):
+        from apex_tpu.transformer.testing.arguments import parse_args
+
+        a = parse_args(args=[
+            "--world-size", "8", "--tensor-model-parallel-size", "2",
+            "--context-parallel-size", "2", "--micro-batch-size", "2",
+        ])
+        assert a.data_parallel_size == 2
+        with pytest.raises(ValueError):
+            parse_args(args=[
+                "--world-size", "4", "--tensor-model-parallel-size", "2",
+                "--context-parallel-size", "4", "--micro-batch-size", "1",
+            ])
