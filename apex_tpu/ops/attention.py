@@ -88,10 +88,13 @@ def _flash_fwd(q, k, v, scale, causal, q_offset, k_offset, block_k):
     return out.astype(q.dtype), (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
-    """Blockwise flash backward: dV = PᵀdO; dS = P∘(dOVᵀ − D);
-    dQ = dS·K·scale; dK = dSᵀ·Q·scale with D = rowsum(dO∘O)."""
-    q, k, v, out, lse = res
+def flash_bwd_from_lse(q, k, v, g, lse, delta, scale, causal, q_offset=0,
+                       k_offset=0, block_k=256):
+    """Blockwise flash backward from (lse, delta): dV = PᵀdO;
+    dS = P∘(dOVᵀ − Δ); dQ = dS·K·scale; dK = dSᵀ·Q·scale with
+    Δ = rowsum(dO∘O) over the FULL row — pass it in when this call sees
+    only a slice of the keys (ring attention's per-chunk backward).
+    Returns f32 (dq, dk, dv); memory O(Sq·block_k)."""
     B, H, Sq, Dd = q.shape
     Sk = k.shape[2]
     bk = _block_sizes(Sk, block_k)
@@ -99,7 +102,6 @@ def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
 
     qf = q.astype(jnp.float32)
     gf = g.astype(jnp.float32)
-    Drow = jnp.sum(gf * out, axis=-1)  # (B,H,Sq)
     q_pos = q_offset + jnp.arange(Sq)
 
     kb = k.reshape(B, H, nblocks, bk, Dd).transpose(2, 0, 1, 3, 4).astype(jnp.float32)
@@ -117,7 +119,7 @@ def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
             p = jnp.where(s > NEG_INF / 2, p, 0.0)
         dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
         dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vblk)
-        ds = p * (dp - Drow[..., None])
+        ds = p * (dp - delta[..., None])
         dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk) * scale
         dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
         return dq, (dk, dv)
@@ -126,6 +128,15 @@ def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
     dq, (dks, dvs) = jax.lax.scan(body, dq0, (kb, vb, jnp.arange(nblocks)))
     dk = dks.transpose(1, 2, 0, 3, 4).reshape(B, H, Sk, Dd)
     dv = dvs.transpose(1, 2, 0, 3, 4).reshape(B, H, Sk, Dd)
+    return dq, dk, dv
+
+
+def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
+    q, k, v, out, lse = res
+    delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1)  # (B,H,Sq)
+    dq, dk, dv = flash_bwd_from_lse(
+        q, k, v, g, lse, delta, scale, causal, q_offset, k_offset, block_k
+    )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -107,10 +107,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
 
 
 def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
-                     block_q=1024, block_k=1024, interpret=False):
-    """q: (BH, Sq, D); k/v: (BH, Sk, D).  Returns (out, lse (BH, Sq, 1))."""
+                     block_q=1024, block_k=1024, interpret=False,
+                     out_dtype=None):
+    """q: (BH, Sq, D); k/v: (BH, Sk, D).  Returns (out, lse (BH, Sq, 1)).
+
+    ``out_dtype`` defaults to q.dtype; ring attention requests f32 so
+    cross-chunk accumulation never rounds through bf16."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    out_dtype = out_dtype or q.dtype
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
@@ -132,7 +137,7 @@ def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, D), out_dtype),
             jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -240,18 +245,29 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
-                     block_q=512, block_k=512, interpret=False):
+                     block_q=512, block_k=512, interpret=False, delta=None,
+                     out_dtype=None):
     # 512 (not the forward's 1024): the bwd kernels keep ~4 (bq, bk) f32
     # score-sized temporaries live, so smaller tiles stay inside VMEM.
-    """All (BH, S, D); lse (BH, Sq, 1).  Returns (dq, dk, dv)."""
+    """All (BH, S, D); lse (BH, Sq, 1).  Returns (dq, dk, dv).
+
+    ``delta`` (rowsum of do·out over the FULL row) may be passed in when
+    ``out`` covers more keys than this call sees — ring attention's
+    backward, where each chunk-pair call sees only the local k/v chunk.
+    ``out_dtype`` defaults to the input dtypes; ring passes f32.
+    """
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    dq_dtype = out_dtype or q.dtype
+    dk_dtype = out_dtype or k.dtype
+    dv_dtype = out_dtype or v.dtype
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)
 
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM)
@@ -265,7 +281,7 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
         grid=(BH, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), dq_dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -284,8 +300,8 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rT_spec, rT_spec],
         out_specs=[kT_spec, kT_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), dk_dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), dv_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),

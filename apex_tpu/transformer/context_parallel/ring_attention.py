@@ -1,4 +1,20 @@
-"""Ring attention over the ``cp`` mesh axis (see package docstring)."""
+"""Ring attention over the ``cp`` mesh axis (see package docstring).
+
+Flash-style memory discipline end to end: the forward ring carries the
+normalized ``(out, logsumexp)`` pair and merges chunk results with the
+online-softmax rule; the backward pass is its own ring (``custom_vjp``)
+that recomputes per-chunk-pair scores from the saved ``(q, k, v, out,
+lse)`` — no probability matrices are ever saved across steps, so
+activation memory is O(S_local) regardless of the global sequence.
+
+Causality across devices reduces each chunk pair to one of three static
+cases — fully visible (src < rank), diagonal-triangular (src == rank),
+fully masked (src > rank) — selected with ``lax.switch``, so the masked
+case costs nothing and the other two run with *static* zero offsets,
+which lets the per-pair math dispatch onto the Pallas flash kernels
+(:mod:`apex_tpu.ops.flash_attention_pallas`) on TPU.  The ``lax.scan``
+composite remains the universal fallback and numerics oracle.
+"""
 
 from functools import partial
 from typing import Optional
@@ -7,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.ops.attention import NEG_INF
+from apex_tpu.ops.attention import NEG_INF, _attend_fwd_scan, flash_bwd_from_lse
 from apex_tpu.transformer.parallel_state import CONTEXT_AXIS
 
 
@@ -23,18 +39,156 @@ def unshard_sequence(x, axis_name: str = CONTEXT_AXIS, seq_axis: int = 2):
     return jax.lax.all_gather(x, axis_name, axis=seq_axis, tiled=True)
 
 
-def _block_attend(q, k, v, scale, causal, q_pos, k_pos):
-    """One chunk-vs-chunk blockwise attention returning (acc, m, l) in the
-    online-softmax accumulator format (unnormalized)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if causal:
-        mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask, s, NEG_INF)
-    m = jnp.max(s, axis=-1)
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    return acc, m, l
+def _use_pallas(q, k, impl: str) -> bool:
+    if impl == "scan":
+        return False
+    if impl == "pallas":
+        return True
+    from apex_tpu.ops.flash_attention_pallas import pallas_flash_available
+
+    return pallas_flash_available(q, k)
+
+
+def _chunk_fwd(q, k, v, scale, causal, impl, interpret):
+    """(out f32, lse f32 (B,H,S)) for one chunk pair, zero offsets."""
+    B, H, S, D = q.shape
+    if _use_pallas(q, k, impl):
+        from apex_tpu.ops.flash_attention_pallas import flash_fwd_pallas
+
+        out, lse = flash_fwd_pallas(
+            q.reshape(B * H, S, D), k.reshape(B * H, k.shape[2], D),
+            v.reshape(B * H, v.shape[2], D), scale, causal, 0, 0,
+            interpret=interpret, out_dtype=jnp.float32,
+        )
+        return out.reshape(B, H, S, D), lse.reshape(B, H, S)
+    return _attend_fwd_scan(q, k, v, scale, causal, 0, 0, block_k=256)
+
+
+def _chunk_bwd(q, k, v, do, lse, delta, scale, causal, impl, interpret):
+    """Per-chunk-pair flash backward from global (lse, delta); f32 outputs
+    so ring accumulation never rounds through bf16."""
+    B, H, S, D = q.shape
+    if _use_pallas(q, k, impl):
+        from apex_tpu.ops.flash_attention_pallas import flash_bwd_pallas
+
+        dq, dk, dv = flash_bwd_pallas(
+            q.reshape(B * H, S, D), k.reshape(B * H, k.shape[2], D),
+            v.reshape(B * H, v.shape[2], D), None,
+            lse.reshape(B * H, S, 1), do.reshape(B * H, S, D).astype(q.dtype),
+            scale, causal, 0, 0, interpret=interpret,
+            delta=delta.reshape(B * H, S, 1), out_dtype=jnp.float32,
+        )
+        shp = (B, H, S, D)
+        return dq.reshape(shp), dk.reshape(shp), dv.reshape(shp)
+    return flash_bwd_from_lse(q, k, v, do, lse, delta, scale, causal)
+
+
+def _merge(out, lse, out_b, lse_b):
+    """Online-softmax merge of two normalized partials."""
+    lse_new = jnp.logaddexp(lse, lse_b)
+    w_old = jnp.exp(lse - lse_new)[..., None]
+    w_b = jnp.exp(lse_b - lse_new)[..., None]
+    return out * w_old + out_b * w_b, lse_new
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, axis_name, causal, scale, impl, interpret):
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl, interpret)
+    return out.astype(q.dtype)
+
+
+def _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl, interpret):
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    perm = [(i, (i - 1) % cp) for i in range(cp)]  # chunks flow backward
+
+    def full_case(kc, vc):
+        return _chunk_fwd(q, kc, vc, scale, False, impl, interpret)
+
+    def diag_case(kc, vc):
+        return _chunk_fwd(q, kc, vc, scale, True, impl, interpret)
+
+    def masked_case(kc, vc):
+        return (jnp.zeros((B, H, S, D), jnp.float32),
+                jnp.full((B, H, S), NEG_INF, jnp.float32))
+
+    def step(carry, r):
+        kc, vc, out, lse = carry
+        src = (rank + r) % cp  # whose chunk we hold at step r
+        if causal:
+            # 0: src < rank (full), 1: src == rank (diag), 2: masked
+            case = jnp.clip(jnp.sign(src - rank) + 1, 0, 2)
+            out_b, lse_b = jax.lax.switch(
+                case, (full_case, diag_case, masked_case), kc, vc
+            )
+        else:
+            out_b, lse_b = full_case(kc, vc)
+        out, lse = _merge(out, lse, out_b, lse_b)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, out, lse), None
+
+    out0 = jnp.zeros((B, H, S, D), jnp.float32)
+    lse0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    (_, _, out, lse), _ = jax.lax.scan(step, (k, v, out0, lse0), jnp.arange(cp))
+    return out, lse
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, impl, interpret):
+    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl, interpret)
+    return out.astype(q.dtype), (q, k, v, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, scale, impl, interpret, res, g):
+    """The backward ring: q/do/lse/delta stay home; (k, v, dk, dv)
+    travel the ring and arrive home after cp steps with every device's
+    contribution accumulated."""
+    q, k, v, out, lse = res
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    perm = [(i, (i - 1) % cp) for i in range(cp)]
+
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * out, axis=-1)  # global-row rowsum(dO·O)
+
+    def full_case(kc, vc):
+        return _chunk_bwd(q, kc, vc, do, lse, delta, scale, False, impl, interpret)
+
+    def diag_case(kc, vc):
+        return _chunk_bwd(q, kc, vc, do, lse, delta, scale, True, impl, interpret)
+
+    def masked_case(kc, vc):
+        z = jnp.zeros((B, H, S, D), jnp.float32)
+        return z, z, z
+
+    def step(carry, r):
+        kc, vc, dk_acc, dv_acc, dq_acc = carry
+        src = (rank + r) % cp
+        if causal:
+            case = jnp.clip(jnp.sign(src - rank) + 1, 0, 2)
+            dq_b, dk_b, dv_b = jax.lax.switch(
+                case, (full_case, diag_case, masked_case), kc, vc
+            )
+        else:
+            dq_b, dk_b, dv_b = full_case(kc, vc)
+        dq_acc = dq_acc + dq_b
+        dk_acc = dk_acc + dk_b
+        dv_acc = dv_acc + dv_b
+        kc, vc, dk_acc, dv_acc = (
+            jax.lax.ppermute(t, axis_name, perm) for t in (kc, vc, dk_acc, dv_acc)
+        )
+        return (kc, vc, dk_acc, dv_acc, dq_acc), None
+
+    z = jnp.zeros((B, H, S, D), jnp.float32)
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        step, (k, v, z, z, z), jnp.arange(cp)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ring_attention(
@@ -44,45 +198,21 @@ def ring_attention(
     axis_name: str = CONTEXT_AXIS,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
+    impl: str = "auto",
+    interpret: bool = False,
 ):
     """Exact attention with sequence sharded over ``axis_name``.
 
     q/k/v: local chunks ``(B, H, S_local, D)`` (global position =
-    rank * S_local + i).  Runs cp ring steps; each step rotates k/v one
-    neighbor backward around the ring so every device eventually sees
-    every chunk.  Differentiable (scan + ppermute transpose is the
-    reverse ring — the backward pass is itself a ring).
+    rank * S_local + i).  Call inside ``shard_map``.  Differentiable:
+    the backward pass is its own ring (dk/dv accumulate while circling
+    home), so per-device grads of a local loss shard sum to the
+    total-loss gradient.
+
+    ``impl``: "pallas" / "scan" / "auto" (Pallas kernels per chunk pair
+    on TPU when shapes allow).
     """
-    cp = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
-    B, H, S, D = q.shape
-    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(D)
-    perm = [(i, (i - 1) % cp) for i in range(cp)]  # chunks flow backward
-
-    qf = q.astype(jnp.float32)
-    q_pos = rank * S + jnp.arange(S)
-
-    def step(carry, r):
-        kc, vc, m, l, acc = carry
-        src = (rank + r) % cp  # whose chunk we hold at step r
-        k_pos = src * S + jnp.arange(S)
-        a, m_b, l_b = _block_attend(qf, kc, vc, scale, causal, q_pos, k_pos)
-        m_new = jnp.maximum(m, m_b)
-        c_old = jnp.exp(m - m_new)
-        c_b = jnp.exp(m_b - m_new)
-        l_new = l * c_old + l_b * c_b
-        acc_new = acc * c_old[..., None] + a * c_b[..., None]
-        kc = jax.lax.ppermute(kc, axis_name, perm)
-        vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (kc, vc, m_new, l_new, acc_new), None
-
-    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, S), jnp.float32)
-    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
-    (_, _, m, l, acc), _ = jax.lax.scan(
-        step,
-        (k.astype(jnp.float32), v.astype(jnp.float32), m0, l0, acc0),
-        jnp.arange(cp),
-    )
-    l = jnp.maximum(l, 1e-30)
-    return (acc / l[..., None]).astype(q.dtype)
+    if impl not in ("auto", "pallas", "scan"):
+        raise ValueError(f"impl must be 'auto', 'pallas', or 'scan'; got {impl!r}")
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    return _ring(q, k, v, axis_name, causal, scale, impl, interpret)

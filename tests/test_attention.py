@@ -203,3 +203,37 @@ class TestPallasFlashAttention:
         q, k, v = self._inputs(Sq=128, Sk=128)
         with pytest.raises(ValueError, match="impl"):
             flash_attention(q, k, v, impl="pallaz")
+
+
+class TestRingAttentionPallas:
+    """Ring with per-chunk-pair Pallas kernels (interpret mode)."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_full_attention_with_grads(self, causal, devices8):
+        B, H, S, D = 1, 2, 512, 8  # S_local = 128: kernel-eligible
+        q, k, v = qkv(7, B=B, H=H, S=S, D=D)
+        mesh = Mesh(np.array(devices8[:4]), ("cp",))
+
+        def fr(q, k, v):
+            return jnp.sum(jnp.sin(mha_reference(q, k, v, causal=causal)))
+
+        ref = mha_reference(q, k, v, causal=causal)
+        gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+
+        def f(q, k, v):
+            return ring_attention(q, k, v, "cp", causal=causal,
+                                  impl="pallas", interpret=True)
+
+        out = jax.shard_map(
+            f, mesh=mesh, in_specs=(P(None, None, "cp", None),) * 3,
+            out_specs=P(None, None, "cp", None), check_vma=False,
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+        g = jax.shard_map(
+            jax.grad(lambda q, k, v: jnp.sum(jnp.sin(f(q, k, v))), argnums=(0, 1, 2)),
+            mesh=mesh, in_specs=(P(None, None, "cp", None),) * 3,
+            out_specs=(P(None, None, "cp", None),) * 3, check_vma=False,
+        )(q, k, v)
+        for a, r in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=2e-4, atol=2e-5)
