@@ -51,10 +51,21 @@ class GPTConfig:
     # memory-efficient attention core (ops.attention.flash_attention);
     # automatic when context parallelism is active
     use_flash_attention: bool = False
+    # mixture-of-experts FFN (beyond the reference — SURVEY §2.4 "EP: No").
+    # 0 = dense MLP.  Experts shard over the dp axis (EP rides DP) with
+    # all_to_all token exchange; see transformer/expert_parallel.py.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def ffn(self):
         return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def moe(self):
+        return self.moe_num_experts > 0
 
     @property
     def head_dim(self):
@@ -69,7 +80,7 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     std = 0.02
     init = lambda k, *s: jax.random.normal(k, s, jnp.float32) * std
 
-    return {
+    params = {
         "embed": init(k[0], V, H),
         "pos_embed": init(k[1], config.max_seq_len, H),
         "layers": {
@@ -85,21 +96,34 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
             "bo": jnp.zeros((L, H)),
             "ln2_scale": jnp.ones((L, H)),
             "ln2_bias": jnp.zeros((L, H)),
-            "fc1": init(k[6], L, F, H),
-            "fc1_b": jnp.zeros((L, F)),
-            "fc2": init(k[7], L, H, F) / np.sqrt(2 * L),
-            "fc2_b": jnp.zeros((L, H)),
         },
         "final_ln_scale": jnp.ones((H,)),
         "final_ln_bias": jnp.zeros((H,)),
     }
+    if config.moe:
+        from apex_tpu.transformer.expert_parallel import moe_init
+
+        params["layers"]["moe"] = moe_init(
+            k[8], H, F, config.moe_num_experts, layers=L
+        )
+    else:
+        params["layers"].update(
+            {
+                "fc1": init(k[6], L, F, H),
+                "fc1_b": jnp.zeros((L, F)),
+                "fc2": init(k[7], L, H, F) / np.sqrt(2 * L),
+                "fc2_b": jnp.zeros((L, H)),
+            }
+        )
+    return params
 
 
-def param_specs(config: GPTConfig):
+def param_specs(config: GPTConfig, ep_axis: Optional[str] = None):
     """PartitionSpecs for shard_map in_specs (tp axis named 'tp').
 
     Column-parallel weights shard the output dim, row-parallel the input
     dim; embedding shards the vocab (reference layers.py:174,460,645).
+    With MoE, expert weights shard over ``ep_axis`` (usually 'dp').
     """
     from jax.sharding import PartitionSpec as P
 
@@ -107,27 +131,31 @@ def param_specs(config: GPTConfig):
     colb = P(None, "tp")
     row = P(None, None, "tp")
     rep2 = P(None, None)
+    layers = {
+        "ln1_scale": rep2,
+        "ln1_bias": rep2,
+        "wq": col,
+        "wk": col,
+        "wv": col,
+        "bq": colb,
+        "bk": colb,
+        "bv": colb,
+        "wo": row,
+        "bo": rep2,
+        "ln2_scale": rep2,
+        "ln2_bias": rep2,
+    }
+    if config.moe:
+        from apex_tpu.transformer.expert_parallel import moe_param_specs
+
+        # ep_axis None = replicated (single-device / no EP)
+        layers["moe"] = moe_param_specs(ep_axis, layers=True)
+    else:
+        layers.update({"fc1": col, "fc1_b": colb, "fc2": row, "fc2_b": rep2})
     return {
         "embed": P("tp", None),
         "pos_embed": P(None, None),
-        "layers": {
-            "ln1_scale": rep2,
-            "ln1_bias": rep2,
-            "wq": col,
-            "wk": col,
-            "wv": col,
-            "bq": colb,
-            "bk": colb,
-            "bv": colb,
-            "wo": row,
-            "bo": rep2,
-            "ln2_scale": rep2,
-            "ln2_bias": rep2,
-            "fc1": col,
-            "fc1_b": colb,
-            "fc2": row,
-            "fc2_b": rep2,
-        },
+        "layers": layers,
         "final_ln_scale": P(None),
         "final_ln_bias": P(None),
     }
@@ -197,17 +225,40 @@ def _mlp(x, p, config: GPTConfig, axis_name):
     )
 
 
-def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
+def _moe_mlp(x, p, config: GPTConfig, ep_axis):
+    """Expert-parallel FFN (beyond the reference); x: (S, B, H).
+    Experts shard over ``ep_axis``; tp ranks compute replicated."""
+    from apex_tpu.transformer.expert_parallel import moe_ffn
+
+    out, aux = moe_ffn(
+        x,
+        p["moe"],
+        top_k=config.moe_top_k,
+        capacity_factor=config.moe_capacity_factor,
+        ep_axis=ep_axis,
+    )
+    return out, aux
+
+
+def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None, ep_axis=None):
+    """Returns (x, aux) — aux is the MoE load-balancing loss (0 when dense)."""
     H = config.hidden_size
     ln1 = fused_layer_norm_affine(x, p["ln1_scale"], p["ln1_bias"], (H,), config.layernorm_eps)
     x = x + _attention(ln1.astype(config.compute_dtype), p, config, axis_name, n_local_heads, cp_axis)
     ln2 = fused_layer_norm_affine(x, p["ln2_scale"], p["ln2_bias"], (H,), config.layernorm_eps)
-    x = x + _mlp(ln2.astype(config.compute_dtype), p, config, axis_name)
-    return x
+    if config.moe:
+        h, aux = _moe_mlp(ln2.astype(config.compute_dtype), p, config, ep_axis)
+    else:
+        h = _mlp(ln2.astype(config.compute_dtype), p, config, axis_name)
+        aux = jnp.float32(0.0)
+    x = x + h
+    return x, aux
 
 
 def gpt_forward(
-    params, tokens, config: GPTConfig, axis_name: Optional[str] = None, cp_axis: Optional[str] = None
+    params, tokens, config: GPTConfig, axis_name: Optional[str] = None,
+    cp_axis: Optional[str] = None, ep_axis: Optional[str] = None,
+    return_aux: bool = False,
 ):
     """tokens (B, S) → logits.
 
@@ -216,10 +267,16 @@ def gpt_forward(
     With ``cp_axis`` (context parallelism — a capability beyond the
     reference): tokens are the LOCAL sequence chunk, attention is ring
     attention over the axis, positions are globally offset.
+    With MoE (``config.moe_num_experts > 0``), ``ep_axis`` shards the
+    experts (EP rides DP); ``return_aux=True`` additionally returns the
+    summed load-balancing loss.
     """
     if cp_axis is not None and config.sequence_parallel:
         raise ValueError("sequence_parallel (tp) and context parallelism both shard "
                          "the sequence; enable one")
+    if config.moe and config.sequence_parallel:
+        raise ValueError("MoE with Megatron sequence parallelism is not supported: "
+                         "expert grads would need an extra tp-psum; use cp instead")
     B, S = tokens.shape
     tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
     n_local_heads = config.num_attention_heads // tp
@@ -244,15 +301,15 @@ def gpt_forward(
         x = scatter_to_sequence_parallel_region(x, axis_name)
 
     layer = partial(
-        _layer, config=config, axis_name=axis_name, n_local_heads=n_local_heads, cp_axis=cp_axis
+        _layer, config=config, axis_name=axis_name, n_local_heads=n_local_heads,
+        cp_axis=cp_axis, ep_axis=ep_axis,
     )
     if config.checkpoint_layers:
         layer = jax.checkpoint(layer)
 
-    def scan_body(carry, lp):
-        return layer(carry, lp), None
-
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    # _layer's (carry, lp) -> (x, aux) is exactly the scan contract
+    x, aux_per_layer = jax.lax.scan(layer, x, params["layers"])
+    aux = jnp.sum(aux_per_layer)
 
     if config.sequence_parallel and axis_name is not None:
         from apex_tpu.transformer.tensor_parallel.mappings import (
@@ -277,6 +334,8 @@ def gpt_forward(
 
         x = copy_to_tensor_model_parallel_region(x, axis_name)
     logits = jnp.matmul(x.astype(jnp.float32), params["embed"].T.astype(jnp.float32))
+    if return_aux:
+        return logits, aux  # (S, B, V_local), scalar
     return logits  # (S, B, V_local)
 
 
@@ -311,11 +370,41 @@ def make_train_step(
     """
     from jax.sharding import PartitionSpec as P
 
-    specs = param_specs(config)
+    ep_axis = dp_axis if config.moe else None  # EP rides DP
+    if ep_axis is not None:
+        ep = mesh.shape[ep_axis]
+        if config.moe_num_experts % ep != 0:
+            raise ValueError(
+                f"moe_num_experts ({config.moe_num_experts}) must be divisible "
+                f"by the '{ep_axis}' mesh axis size ({ep}): experts shard over "
+                "dp (EP rides DP)"
+            )
+    specs = param_specs(config, ep_axis=ep_axis)
+
+    def pmean_grads(grads, ax, skip_experts):
+        """pmean over a data axis.  Expert grads are dp-SHARDED, not
+        replicated: the all_to_all transpose already delivered every
+        rank's cotangents (a sum over dp), so the mean-loss gradient is
+        that sum divided by dp — never pmean'd (which would mix grads of
+        *different* experts)."""
+        if not (skip_experts and config.moe):
+            return jax.tree.map(lambda g: jax.lax.pmean(g, ax), grads)
+        from apex_tpu.transformer.expert_parallel import EXPERT_PARAM_KEYS
+
+        inv = 1.0 / jax.lax.axis_size(ax)
+        moe = grads["layers"]["moe"]
+        rest = {**grads, "layers": {k: v for k, v in grads["layers"].items() if k != "moe"}}
+        rest = jax.tree.map(lambda g: jax.lax.pmean(g, ax), rest)
+        moe = {
+            k: (v * inv if k in EXPERT_PARAM_KEYS else jax.lax.pmean(v, ax))
+            for k, v in moe.items()
+        }
+        rest["layers"]["moe"] = moe
+        return rest
 
     def local_step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(gpt_loss)(
-            params, tokens, targets, config, tp_axis, cp_axis
+            params, tokens, targets, config, tp_axis, cp_axis, ep_axis
         )
         if config.sequence_parallel:
             grads = sp_grad_sync(grads, tp_axis)
@@ -325,7 +414,7 @@ def make_train_step(
         for ax in (cp_axis, dp_axis):
             if ax is not None:
                 loss = jax.lax.pmean(loss, ax)
-                grads = jax.tree.map(lambda g: jax.lax.pmean(g, ax), grads)
+                grads = pmean_grads(grads, ax, skip_experts=(ax == dp_axis))
         new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
 
@@ -373,6 +462,11 @@ def make_pp_train_step(
         forward_backward_pipelining_without_interleaving,
     )
 
+    if config.moe:
+        raise NotImplementedError(
+            "MoE with pipeline parallelism is not wired yet; use the tp×dp "
+            "train step (make_train_step), where EP rides the dp axis"
+        )
     H = config.hidden_size
     tp = mesh.shape[tp_axis]
     n_local_heads = config.num_attention_heads // tp
@@ -405,7 +499,7 @@ def make_pp_train_step(
         layer = partial(_layer, config=config, axis_name=tp_axis, n_local_heads=n_local_heads)
         if config.checkpoint_layers:
             layer = jax.checkpoint(layer)
-        out, _ = jax.lax.scan(lambda c, lp: (layer(c, lp), None), x, stage_params)
+        out, _ = jax.lax.scan(lambda c, lp: (layer(c, lp)[0], None), x, stage_params)
         return out
 
     def post_fn(shared, x, mb):
@@ -465,12 +559,15 @@ def make_pp_train_step(
 
 def gpt_loss(
     params, tokens, targets, config: GPTConfig, axis_name: Optional[str] = None,
-    cp_axis: Optional[str] = None,
+    cp_axis: Optional[str] = None, ep_axis: Optional[str] = None,
 ):
-    """Mean causal-LM cross entropy.  Uses vocab-parallel CE on a mesh.
-    With ``cp_axis`` the mean is over the LOCAL sequence chunk — combine
-    across chunks with a pmean (the data-axis gradient calculus)."""
-    logits = gpt_forward(params, tokens, config, axis_name, cp_axis)  # (S, B, V?)
+    """Mean causal-LM cross entropy (+ MoE aux loss when enabled).
+    Uses vocab-parallel CE on a mesh.  With ``cp_axis`` the mean is over
+    the LOCAL sequence chunk — combine across chunks with a pmean (the
+    data-axis gradient calculus)."""
+    out = gpt_forward(params, tokens, config, axis_name, cp_axis, ep_axis,
+                      return_aux=config.moe)
+    logits, aux = out if config.moe else (out, None)
     t = targets.transpose(1, 0)  # (S, B)
     if axis_name is None:
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -478,4 +575,7 @@ def gpt_loss(
         loss = lse - tgt
     else:
         loss = vocab_parallel_cross_entropy(logits, t, 0.0, axis_name)
-    return jnp.mean(loss)
+    loss = jnp.mean(loss)
+    if aux is not None:
+        loss = loss + config.moe_aux_coef * aux
+    return loss

@@ -6,7 +6,7 @@ __all__ = ["parallel_state"]
 
 
 def __getattr__(name):
-    if name in ("tensor_parallel", "pipeline_parallel", "functional", "layers", "amp", "_data", "testing", "enums", "microbatches"):
+    if name in ("tensor_parallel", "pipeline_parallel", "functional", "layers", "amp", "_data", "testing", "enums", "microbatches", "context_parallel", "expert_parallel"):
         import importlib
 
         mod = importlib.import_module(f"apex_tpu.transformer.{name}")

@@ -1,0 +1,169 @@
+"""Expert parallelism: mixture-of-experts FFN over a mesh axis.
+
+**Beyond the reference**: apex has no MoE/expert parallelism (SURVEY
+§2.4 "EP: No").  TPU-native design, GShard/Switch style:
+
+- top-k router with capacity-factor token dropping — everything static
+  shapes, so the whole layer jits: dispatch/combine are one-hot einsum
+  tensors, never data-dependent gathers;
+- experts sharded over a mesh axis (``ep_axis``, usually the ``dp``
+  axis — "expert parallelism rides data parallelism"): tokens travel to
+  their expert's device and back with two ``jax.lax.all_to_all`` over
+  ICI, compute runs as batched per-expert matmuls on the MXU;
+- auxiliary load-balancing loss (Switch Transformer eq. 4).
+
+Expert weights are *sharded, not replicated*, over ``ep_axis``: each
+device computes full gradients for its own experts (the all-to-all
+brings every token routed to them), so data-parallel gradient sync must
+SKIP expert parameters — :func:`is_expert_param` tells the train step
+which ones.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def moe_init(key, hidden_size: int, ffn_size: int, num_experts: int,
+             layers: Optional[int] = None, std: float = 0.02):
+    """Router + expert FFN params.  With ``layers``, adds a leading L dim
+    (for scan-over-layers models)."""
+    k = jax.random.split(key, 3)
+    ld = () if layers is None else (layers,)
+    init = lambda kk, *s: jax.random.normal(kk, ld + s, jnp.float32) * std
+    return {
+        "router": init(k[0], hidden_size, num_experts),
+        "w1": init(k[1], num_experts, ffn_size, hidden_size),
+        "b1": jnp.zeros(ld + (num_experts, ffn_size)),
+        "w2": init(k[2], num_experts, hidden_size, ffn_size) / np.sqrt(2.0),
+        "b2": jnp.zeros(ld + (num_experts, hidden_size)),
+    }
+
+
+EXPERT_PARAM_KEYS = ("w1", "b1", "w2", "b2")
+
+
+def is_expert_param(path_keys) -> bool:
+    """True for params sharded over the expert axis (their grads are
+    device-local and must not be averaged over dp)."""
+    names = [getattr(p, "key", getattr(p, "name", None)) for p in path_keys]
+    return any(n in EXPERT_PARAM_KEYS for n in names) and any(
+        n in ("moe", "experts") for n in names
+    )
+
+
+def _top_k_mask(probs, top_k: int, capacity: int):
+    """Static-shape top-k dispatch with capacity dropping.
+
+    probs: (T, E) f32.  Returns (dispatch (T, E, C) one-hot,
+    combine (T, E, C) gate-weighted, aux-loss ingredients).
+    Slot priority is GShard's: all slot-0 assignments claim capacity
+    before any slot-1 assignment."""
+    T, E = probs.shape
+    masks = []
+    p = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        masks.append(m)
+        p = p * (1.0 - m)  # knock out the chosen expert for the next slot
+
+    # capacity accounting, slot-major: (K*T, E) running count per expert
+    stacked = jnp.concatenate(masks, axis=0)  # (K*T, E)
+    pos = jnp.cumsum(stacked, axis=0) - stacked  # tokens ahead of me
+    keep = (pos < capacity).astype(probs.dtype) * stacked
+    loc = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=probs.dtype)
+
+    dispatch = (keep[..., None] * loc).reshape(len(masks), T, E, capacity).sum(0)
+    gate = (probs[None] * jnp.stack(masks)).sum(0)  # (T, E) chosen probs
+    if top_k == 1:
+        # Switch Transformer: weight by the raw router prob — the output
+        # path is what carries the router gradient for top-1
+        weights = gate
+    else:
+        # GShard: renormalize over the chosen experts
+        weights = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+    combine = dispatch * weights[..., None]
+    return dispatch, combine, masks[0]
+
+
+def load_balancing_loss(probs, mask1):
+    """Switch Transformer aux loss: E · Σ_e f_e · P_e (eq. 4)."""
+    E = probs.shape[-1]
+    f = jnp.mean(mask1, axis=0)  # fraction of tokens per expert (top-1)
+    P = jnp.mean(probs, axis=0)  # mean router prob per expert
+    return E * jnp.sum(f * P)
+
+
+def moe_ffn(
+    x,
+    params,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    ep_axis: Optional[str] = None,
+    activation=partial(jax.nn.gelu, approximate=True),
+):
+    """MoE FFN.  x: (..., H) — leading dims are flattened to tokens.
+
+    With ``ep_axis`` (inside shard_map): ``params`` hold the LOCAL
+    expert shard (E_local = E/ep on the expert dim) and tokens exchange
+    over the axis with all_to_all.  Without: dense (all experts local).
+
+    Returns (out, aux_loss).
+    """
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    xf = x.reshape(-1, H)
+    T = xf.shape[0]
+
+    ep = 1 if ep_axis is None else jax.lax.axis_size(ep_axis)
+    E_local = params["w1"].shape[0]
+    E = E_local * ep
+
+    logits = jnp.matmul(xf.astype(jnp.float32), params["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+
+    capacity = max(1, int(np.ceil(top_k * capacity_factor * T / E)))
+    dispatch, combine, mask1 = _top_k_mask(probs, top_k, capacity)
+    aux = load_balancing_loss(probs, mask1)
+
+    cd = x.dtype
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(cd), xf)  # (E, C, H)
+
+    if ep_axis is not None:
+        # (E, C, H) -> (E_local, ep·C, H): expert-major blocks scatter to
+        # their owners, received capacity blocks stack source-major
+        expert_in = jax.lax.all_to_all(
+            expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    h = jnp.einsum("ech,efh->ecf", expert_in, params["w1"].astype(cd))
+    h = activation(h + params["b1"].astype(cd)[:, None, :])
+    y = jnp.einsum("ecf,ehf->ech", h, params["w2"].astype(cd))
+    y = y + params["b2"].astype(cd)[:, None, :]
+
+    if ep_axis is not None:
+        # (E_local, ep·C, H) -> (E, C, H): the exact transpose of the way in
+        y = jax.lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0, tiled=True)
+
+    out = jnp.einsum("tec,ech->th", combine.astype(cd), y)
+    return out.reshape(orig_shape), aux.astype(jnp.float32)
+
+
+def moe_param_specs(ep_axis: Optional[str] = "dp", layers: bool = True):
+    """PartitionSpecs for :func:`moe_init` params: experts sharded over
+    ``ep_axis`` (None = replicated), router replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    ld = (None,) if layers else ()
+    return {
+        "router": P(*ld, None, None),
+        "w1": P(*ld, ep_axis, None, None),
+        "b1": P(*ld, ep_axis, None),
+        "w2": P(*ld, ep_axis, None, None),
+        "b2": P(*ld, ep_axis, None),
+    }
