@@ -30,6 +30,10 @@ def main():
     ap.add_argument("--vocab", type=int, default=50304)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--flash", action="store_true", default=True)
+    ap.add_argument("--no-remat", action="store_true",
+                    help="disable per-layer rematerialization (fits in HBM "
+                         "for GPT-124M-class models; ~frees the second "
+                         "forward pass)")
     args = ap.parse_args()
 
     from apex_tpu.models.gpt import GPTConfig, gpt_loss, init_params
@@ -43,7 +47,7 @@ def main():
         max_seq_len=args.seq,
         compute_dtype=jnp.bfloat16,
         use_flash_attention=args.flash,
-        checkpoint_layers=True,
+        checkpoint_layers=not args.no_remat,
     )
     params = init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
