@@ -437,6 +437,48 @@ def make_train_step(
     return jax.jit(sharded)
 
 
+def params_to_vpp_layout(params, pp: int, vpp: int):
+    """Permute layer-stacked params from execution order to the
+    stage-major layout the interleaved schedule shards.
+
+    Execution order is virtual-stage-major: global block ``j = v·pp + s``
+    (reference fwd_bwd_pipelining_with_interleaving.py:27 assigns stage s
+    chunks s, s+pp, ...).  Sharding ``P("pp")`` slices axis 0 into
+    contiguous per-stage blocks, so stage s's slice must hold its vpp
+    chunks back to back: ``out[(s·vpp + v)·lpc + i] = in[(v·pp + s)·lpc + i]``.
+    Train in this layout (element-wise optimizers are layout-blind);
+    invert with :func:`params_from_vpp_layout` for canonical checkpoints.
+    """
+    def perm(a):
+        L = a.shape[0]
+        lpc = L // (pp * vpp)
+        return (
+            a.reshape(vpp, pp, lpc, *a.shape[1:])
+            .transpose(1, 0, *range(2, a.ndim + 2))
+            .reshape(a.shape)
+        )
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(perm, params["layers"])
+    return out
+
+
+def params_from_vpp_layout(params, pp: int, vpp: int):
+    """Inverse of :func:`params_to_vpp_layout`."""
+    def unperm(a):
+        L = a.shape[0]
+        lpc = L // (pp * vpp)
+        return (
+            a.reshape(pp, vpp, lpc, *a.shape[1:])
+            .transpose(1, 0, *range(2, a.ndim + 2))
+            .reshape(a.shape)
+        )
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(unperm, params["layers"])
+    return out
+
+
 def make_pp_train_step(
     config: GPTConfig,
     optimizer,
@@ -445,6 +487,7 @@ def make_pp_train_step(
     tp_axis: str = "tp",
     pp_axis: str = "pp",
     dp_axis: Optional[str] = "dp",
+    virtual_pipeline_size: int = 1,
 ):
     """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
 
@@ -452,13 +495,17 @@ def make_pp_train_step(
     ``tp`` on their weight axes (the layout of reference §3.4: each
     pipeline stage owns L/pp layers, each TP rank a weight shard).  The
     batch splits into ``num_microbatches`` microbatches driven through
-    :func:`...schedules.forward_backward_pipelining_without_interleaving`.
+    the 1F1B schedule, or the interleaved schedule when
+    ``virtual_pipeline_size > 1`` — in that case ``params["layers"]``
+    (and the matching optimizer state) must be in the stage-major vpp
+    layout from :func:`params_to_vpp_layout`.
     Returns ``step(params, opt_state, tokens, targets) -> (params,
     opt_state, loss)`` (jitted).
     """
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_with_interleaving,
         forward_backward_pipelining_without_interleaving,
     )
 
@@ -471,6 +518,22 @@ def make_pp_train_step(
     tp = mesh.shape[tp_axis]
     n_local_heads = config.num_attention_heads // tp
     sp = config.sequence_parallel
+    vpp = virtual_pipeline_size
+    if vpp > 1:
+        if config.num_layers % (mesh.shape[pp_axis] * vpp) != 0:
+            raise ValueError(
+                f"num_layers ({config.num_layers}) must divide into "
+                f"pp ({mesh.shape[pp_axis]}) x vpp ({vpp}) chunks"
+            )
+        if num_microbatches % mesh.shape[pp_axis] != 0:
+            # the interleaved slot decode pads M up to a multiple of pp and
+            # masks the padding — every padding slot still costs a full
+            # tick, so reject rather than silently burn pipeline throughput
+            # (the reference's interleaved schedule has the same constraint)
+            raise ValueError(
+                f"num_microbatches ({num_microbatches}) must be a multiple of "
+                f"pp ({mesh.shape[pp_axis]}) when virtual_pipeline_size > 1"
+            )
 
     base = param_specs(config)
 
@@ -530,9 +593,15 @@ def make_pp_train_step(
             "tokens": tokens.reshape(num_microbatches, B // num_microbatches, -1),
             "targets": targets.reshape(num_microbatches, B // num_microbatches, -1),
         }
-        loss, (g_shared, g_stage) = forward_backward_pipelining_without_interleaving(
-            pre_fn, stage_fn, post_fn, shared, stages, mb, axis_name=pp_axis
-        )
+        if vpp > 1:
+            loss, (g_shared, g_stage) = forward_backward_pipelining_with_interleaving(
+                pre_fn, stage_fn, post_fn, shared, stages, mb,
+                virtual_pipeline_model_parallel_size=vpp, axis_name=pp_axis,
+            )
+        else:
+            loss, (g_shared, g_stage) = forward_backward_pipelining_without_interleaving(
+                pre_fn, stage_fn, post_fn, shared, stages, mb, axis_name=pp_axis
+            )
         grads = {**g_shared, "layers": g_stage}
         if sp:
             grads = sp_grad_sync(grads, tp_axis)

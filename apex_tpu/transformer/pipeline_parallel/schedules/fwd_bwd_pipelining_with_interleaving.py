@@ -5,17 +5,16 @@ each physical stage owns ``vpp`` non-contiguous layer chunks (stage s
 holds chunks s, s+pp, s+2pp, ...) and round-robins microbatches over
 chunks to shrink the pipeline bubble from (P-1)/M to (P-1)/(M·vpp).
 
-TPU form: virtual chunk v of the model is a second leading axis of the
-stacked stage params; the forward is ``vpp`` chained
-:func:`~..common.pipelined_apply` passes — after pass v the
-activations of each microbatch sit on the LAST stage, and the next
-chunk's first layer lives on the FIRST stage, so a single forward
-ppermute rotation re-feeds the ring.  All passes live in one jit
-region, so XLA's scheduler overlaps pass v+1's early ticks with pass
-v's late ticks where dependencies allow — the compiler-scheduled analog
-of the reference's hand-interleaved 1F1B.  Gradients come from
-differentiating the whole composition (exact, like the
-non-interleaved schedule).
+TPU form: the same explicit fwd+bwd tick schedule as the
+non-interleaved case (:func:`~..tick_schedule.pipelined_fwd_bwd`) with
+``num_chunks=vpp``: the forward ``ppermute`` ring's wraparound (stage
+P-1 → 0) is the cross-chunk hop, so one ring drives all vpp chunks; a
+reverse ring carries cotangents.  The dense per-stage slot ordering
+(group of P microbatches → chunk-major within the group) gives the
+Megatron bubble reduction analytically: total ticks =
+vpp·M + (P-1) + (V-1) at 1/vpp per-tick cost → bubble (P-1)/vpp
+microbatch-equivalents instead of (P-1).  Live activations are
+O(vpp·P), the interleaved schedule's usual memory premium over 1F1B.
 """
 
 from typing import Callable
@@ -77,16 +76,22 @@ def forward_backward_pipelining_with_interleaving(
     :func:`interleaved_pipelined_apply` for the layout)."""
     vpp = virtual_pipeline_model_parallel_size
 
-    def loss_fn(shared, stages, mbs):
-        acts = jax.vmap(lambda mb: pre_fn(shared, mb))(mbs)
-        outs = interleaved_pipelined_apply(stage_fn, stages, acts, vpp, axis_name)
-        losses = jax.vmap(lambda y, mb: post_fn(shared, y, mb))(outs, mbs)
-        return broadcast_from_last_stage(jnp.mean(losses), axis_name)
-
     if forward_only:
+        def loss_fn(shared, stages, mbs):
+            acts = jax.vmap(lambda mb: pre_fn(shared, mb))(mbs)
+            outs = interleaved_pipelined_apply(stage_fn, stages, acts, vpp, axis_name)
+            losses = jax.vmap(lambda y, mb: post_fn(shared, y, mb))(outs, mbs)
+            return broadcast_from_last_stage(jnp.mean(losses), axis_name)
+
         return loss_fn(shared_params, stage_params, microbatches), None
-    loss, (g_shared, g_stage) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-        shared_params, stage_params, microbatches
+
+    from apex_tpu.transformer.pipeline_parallel.schedules.tick_schedule import (
+        pipelined_fwd_bwd,
+    )
+
+    loss, (g_shared, g_stage) = pipelined_fwd_bwd(
+        pre_fn, stage_fn, post_fn, shared_params, stage_params, microbatches,
+        num_chunks=vpp, axis_name=axis_name,
     )
     g_shared = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_shared)
     return loss, (g_shared, g_stage)

@@ -1,16 +1,19 @@
-"""Pipelined schedule over the ``pp`` mesh axis (the 1F1B equivalent).
+"""Pipelined schedule over the ``pp`` mesh axis (true 1F1B).
 
 Reference: ``schedules/fwd_bwd_pipelining_without_interleaving.py:241`` —
 warmup (P-rank-1 forwards), 1F1B steady state, cooldown, with p2p
 send/recv at every boundary and grad accumulation across microbatches.
 
 TPU-native: the whole schedule is ONE jitted program built from
-:func:`~...schedules.common.pipelined_apply` (scan over ticks +
-ppermute).  The forward pipeline is explicit; the backward pipeline is
-obtained by differentiation — the transpose of a tick-scan with
-forward ppermutes IS the cooldown/steady/warmup backward order, and
-XLA's scheduler overlaps the shifted collectives with compute the way
-the reference overlaps NCCL with the backward kernels.
+:func:`~...schedules.tick_schedule.pipelined_fwd_bwd` — three scans
+(fwd-only warmup, one-forward-one-backward steady state, bwd-only
+cooldown) with a forward activation ring and a reverse cotangent ring
+(``ppermute``), and a circular buffer bounding live activations to
+O(P) microbatches independent of M — the memory property 1F1B exists
+for.  The backward of each microbatch recomputes its stage forward from
+the saved stage input (per-microbatch checkpointing, reference
+``:351-361``).  ``forward_only`` uses the lighter forward-only scan
+(:func:`~...schedules.common.pipelined_apply`).
 
 Model contract (replaces torch's ``model.set_input_tensor``):
 - ``pre_fn(shared_params, microbatch) -> activation``   (embedding; stage 0)
@@ -78,11 +81,16 @@ def forward_backward_pipelining_without_interleaving(
     stages own different contributions — reference's embedding-grad
     allreduce between first and last stage).
     """
-    loss_fn = make_pipeline_loss_fn(pre_fn, stage_fn, post_fn, axis_name)
     if forward_only:
+        loss_fn = make_pipeline_loss_fn(pre_fn, stage_fn, post_fn, axis_name)
         return loss_fn(shared_params, stage_params, microbatches), None
-    loss, (g_shared, g_stage) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-        shared_params, stage_params, microbatches
+    from apex_tpu.transformer.pipeline_parallel.schedules.tick_schedule import (
+        pipelined_fwd_bwd,
+    )
+
+    loss, (g_shared, g_stage) = pipelined_fwd_bwd(
+        pre_fn, stage_fn, post_fn, shared_params, stage_params, microbatches,
+        num_chunks=1, axis_name=axis_name,
     )
     g_shared = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_shared)
     return loss, (g_shared, g_stage)

@@ -25,6 +25,12 @@ jax.config.update("jax_enable_x64", False)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test; `-m 'not slow'` gives the quick tier"
+    )
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

@@ -75,3 +75,36 @@ def test_pp_training_reduces_loss(devices8):
         params, state, loss = step(params, state, tokens, targets)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_vpp_interleaved_matches_single_device(devices8):
+    """make_pp_train_step(virtual_pipeline_size=2) == single-device step
+    (the interleaved schedule driven end-to-end through the flagship)."""
+    from apex_tpu.models.gpt import params_from_vpp_layout, params_to_vpp_layout
+
+    mesh = Mesh(np.array(devices8).reshape(2, 2, 2), ("dp", "pp", "tp"))
+    params = init_params(CFG, jax.random.PRNGKey(3))
+    opt = FusedAdam(lr=1e-2)
+
+    vparams = params_to_vpp_layout(params, pp=2, vpp=2)
+    vstate = opt.init(vparams)
+    step = make_pp_train_step(CFG, opt, mesh, num_microbatches=4, virtual_pipeline_size=2)
+
+    rng = np.random.RandomState(3)
+    tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(8, 16)))
+    targets = jnp.roll(tokens, -1, axis=1)
+    new_vparams, _, loss = step(vparams, vstate, tokens, targets)
+    new_params = params_from_vpp_layout(new_vparams, pp=2, vpp=2)
+
+    ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(params, tokens, targets, CFG)
+    ref_params, _ = opt.update(ref_grads, opt.init(params), params)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    for (ka, a), (kb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(new_params),
+        jax.tree_util.tree_leaves_with_path(ref_params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-5,
+            err_msg=jax.tree_util.keystr(ka),
+        )

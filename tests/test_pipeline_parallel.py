@@ -239,3 +239,80 @@ class TestNoPipelining:
 
         assert get_forward_backward_func(None, 1) is nop
         assert get_forward_backward_func(None, 4) is pip
+
+
+class TestMemoryBound:
+    """The 1F1B property: live activation state is O(P), not O(M).
+
+    The round-1 schedule differentiated through the forward tick-scan,
+    keeping every microbatch's residuals live (GPipe memory, linear in
+    M).  The explicit schedule bounds the activation buffer at
+    min(2P-1, M) stage inputs, so the compiled program's largest buffer
+    must not grow with M (reference
+    fwd_bwd_pipelining_without_interleaving.py:241's reason to exist).
+    """
+
+    @pytest.mark.slow
+    def test_peak_buffer_flat_in_microbatches(self, devices8):
+        import re
+
+        H2, L2, MB2, PP2 = 128, 8, 8, 4
+
+        def pre2(shared, mb):
+            return jnp.tanh(mb["x"] @ shared["w_in"])
+
+        def stage2(sp, h):
+            out, _ = jax.lax.scan(
+                lambda c, lp: (jnp.tanh(c @ lp["w"] + lp["b"]), None), h, sp
+            )
+            return out
+
+        def post2(shared, h, mb):
+            return jnp.mean((h @ shared["w_out"] - mb["y"]) ** 2)
+
+        def largest_buffer_bytes(M):
+            rng = np.random.RandomState(0)
+            shared = {
+                "w_in": jnp.asarray(rng.randn(H2, H2).astype(np.float32)),
+                "w_out": jnp.asarray(rng.randn(H2).astype(np.float32)),
+            }
+            stages = {
+                "w": jnp.asarray(rng.randn(L2, H2, H2).astype(np.float32) * 0.3),
+                "b": jnp.zeros((L2, H2), np.float32),
+            }
+            batch = {
+                "x": jnp.asarray(rng.randn(M, MB2, H2).astype(np.float32)),
+                "y": jnp.asarray(rng.randn(M, MB2).astype(np.float32)),
+            }
+            mesh = Mesh(np.array(jax.devices()[:PP2]), ("pp",))
+            sspec = {"w_in": P(), "w_out": P()}
+            stspec = {"w": P("pp", None, None), "b": P("pp", None)}
+            bspec = {"x": P(), "y": P()}
+            f = jax.jit(
+                jax.shard_map(
+                    lambda sh, st, b: forward_backward_pipelining_without_interleaving(
+                        pre2, stage2, post2, sh, st, b, axis_name="pp"
+                    ),
+                    mesh=mesh,
+                    in_specs=(sspec, stspec, bspec),
+                    out_specs=(P(), (sspec, stspec)),
+                    check_vma=False,
+                )
+            )
+            txt = f.lower(shared, stages, batch).compile().as_text()
+            # the only tensors allowed to scale with M are the microbatch
+            # inputs themselves; any other f32 buffer whose leading dim
+            # falls in the per-microbatch window [M, M+P) is a
+            # GPipe-style residual leak (T = M+P-1 tick-stacked
+            # residuals being the round-1 failure mode). M is chosen so
+            # the window can't collide with model dims (L2=8, H2=128).
+            inputs = {(M, MB2, H2), (M, MB2)}
+            offending = set()
+            for mo in re.finditer(r"f32\[([0-9,]+)\]", txt):
+                dims = tuple(int(d) for d in mo.group(1).split(","))
+                if M <= dims[0] < M + PP2 and dims not in inputs:
+                    offending.add(dims)
+            return offending
+
+        for M in (24, 48):
+            assert not largest_buffer_bytes(M), (M, largest_buffer_bytes(M))
