@@ -4,45 +4,35 @@ Reference: ``apex/contrib/fmha/fmha.py:33-60`` (``fmhalib``): flash-style
 fused attention for seqlen ≤ 512 with varlen/padding support via
 cu_seqlens.
 
-TPU form: :func:`apex_tpu.ops.attention.flash_attention` with a padding
-mask folded in (no 512 limit).  Interface takes a dense padded batch +
-boolean key-padding mask instead of packed cu_seqlens (packed layouts
-are hostile to static shapes; padded+masked is the XLA idiom).
+TPU form: :func:`apex_tpu.ops.attention.flash_attention` with the
+key-padding mask folded into the flash kernel's online softmax (no 512
+limit, no dense S×S score matrix for padded batches).  Interface takes a
+dense padded batch + boolean key-padding mask instead of packed
+cu_seqlens: packed ragged layouts are hostile to XLA's static shapes,
+while a dense mask rides the same blockwise kernel at full speed.
 """
 
 from typing import Optional
 
 import jax.numpy as jnp
 
-from apex_tpu.ops.attention import NEG_INF, flash_attention, mha_reference
+from apex_tpu.ops.attention import flash_attention
 
 
 def fmha(qkv, key_padding_mask: Optional[jnp.ndarray] = None, causal: bool = False, softmax_scale=None):
     """qkv: (B, S, 3, H, D) packed as in the reference; returns (B, S, H, D).
 
-    ``key_padding_mask``: (B, S) bool, True = valid token.
+    ``key_padding_mask``: (B, S) bool, True = valid token.  Padded keys
+    are excluded from every row's softmax inside the flash kernel, and
+    padded query rows are zeroed on the way out (matching the packed
+    varlen semantics of the reference, where padding positions simply
+    don't exist in the output).
     """
     q = qkv[:, :, 0].transpose(0, 2, 1, 3)  # (B,H,S,D)
     k = qkv[:, :, 1].transpose(0, 2, 1, 3)
     v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+    out = flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale,
+                          kv_mask=key_padding_mask)
     if key_padding_mask is not None:
-        # fold padding into k by pushing masked keys to -inf via a large
-        # negative bias on their scores: implemented by zeroing v and
-        # biasing k is fragile — instead mask scores through an additive
-        # trick: set masked k rows to a huge negative value in the first
-        # dim won't work either.  Use the dense path when padding masks
-        # are present (seqlens here are ≤512-class workloads).
-        s_mask = ~key_padding_mask[:, None, None, :]  # (B,1,1,S) True=masked
-        import jax
-
-        scale = softmax_scale if softmax_scale is not None else 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
-        if causal:
-            S = s.shape[-1]
-            s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, NEG_INF)
-        s = jnp.where(s_mask, NEG_INF, s)
-        p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(qkv.dtype)
-    else:
-        out = flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        out = out * key_padding_mask[:, None, :, None].astype(out.dtype)
     return out.transpose(0, 2, 1, 3)  # (B,S,H,D)

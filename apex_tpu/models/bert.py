@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.normalization import fused_layer_norm_affine
-from apex_tpu.transformer.functional import scaled_masked_softmax
+from apex_tpu.ops.attention import flash_attention
 from apex_tpu.transformer.tensor_parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.transformer.tensor_parallel.layers import (
     column_parallel_linear,
@@ -128,11 +128,9 @@ def _attention(x, p, pad_mask, config, axis_name, n_local_heads):
         return t.reshape(S, B, n_local_heads, hd).transpose(1, 2, 0, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(hd)
-    # pad_mask (B, S) True=valid → attention mask True=masked
-    mask = None if pad_mask is None else (~pad_mask)[:, None, None, :]
-    probs = scaled_masked_softmax(scores, mask, 1.0)
-    ctx = jnp.einsum("bnst,bnth->bnsh", probs.astype(v.dtype), v)
+    # bidirectional flash attention; pad_mask (B, S) True=valid rides the
+    # kernel's key-validity mask — no dense S×S score matrix.
+    ctx = flash_attention(q, k, v, causal=False, kv_mask=pad_mask)
     ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, n_local_heads * hd)
     if axis_name is None:
         return jnp.matmul(ctx, p["wo"].T.astype(ctx.dtype)) + p["bo"].astype(ctx.dtype)

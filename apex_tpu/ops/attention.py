@@ -33,8 +33,16 @@ def _block_sizes(sk, block_k):
     return bk
 
 
-def _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k):
+def padding_bias(kv_mask):
+    """(B, Sk) bool key-validity mask (True = valid) → f32 additive score
+    bias (B, Sk): 0 for valid keys, NEG_INF for padded ones."""
+    return jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
+
+
+def _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k,
+                     kv_bias=None):
     """Online-softmax forward.  q: (B,H,Sq,D), k/v: (B,H,Sk,D).
+    ``kv_bias``: optional (B, Sk) f32 additive key bias (padding masks).
     Returns (out, lse) with lse = log Σ exp(s·scale) per row."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -45,51 +53,62 @@ def _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k):
     vb = v.reshape(B, H, nblocks, bk, D).transpose(2, 0, 1, 3, 4)
 
     q_pos = q_offset + jnp.arange(Sq)
+    remask = causal or kv_bias is not None
 
     def body(carry, inp):
         m, l, acc = carry
-        kblk, vblk, blk_idx = inp
+        if kv_bias is None:
+            kblk, vblk, blk_idx = inp
+            bblk = None
+        else:
+            kblk, vblk, blk_idx, bblk = inp
         k_pos = k_offset + blk_idx * bk + jnp.arange(bk)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk) * scale
+        if bblk is not None:
+            s = s + bblk[:, None, None, :]
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # exp(NEG_INF - NEG_INF) = 1 would give fully-masked rows (ring
-        # warmup blocks) a spurious uniform distribution; re-mask.
+        # warmup blocks, fully-padded batch entries) a spurious uniform
+        # distribution; re-mask.
         p = jnp.exp(s - m_new[..., None])
-        if causal:
+        if remask:
             p = jnp.where(s > NEG_INF / 2, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vblk)
         return (m_new, l_new, acc_new), None
 
+    xs = (kb.astype(jnp.float32), vb.astype(jnp.float32), jnp.arange(nblocks))
+    if kv_bias is not None:
+        xs = xs + (kv_bias.reshape(B, nblocks, bk).transpose(1, 0, 2),)
     m0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Sq), jnp.float32)
     acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
-    (m, l, acc), _ = jax.lax.scan(
-        body, (m0, l0, acc0), (kb.astype(jnp.float32), vb.astype(jnp.float32), jnp.arange(nblocks))
-    )
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), xs)
     l = jnp.maximum(l, 1e-30)  # fully-masked rows (causal ring blocks)
     out = acc / l[..., None]
     lse = m + jnp.log(l)
     return out, lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, q_offset, k_offset, block_k):
-    out, _ = _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k)
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kv_bias, scale, causal, q_offset, k_offset, block_k):
+    out, _ = _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset,
+                              block_k, kv_bias=kv_bias)
     return out.astype(q.dtype)
 
 
-def _flash_fwd(q, k, v, scale, causal, q_offset, k_offset, block_k):
-    out, lse = _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k)
-    return out.astype(q.dtype), (q, k, v, out, lse)
+def _flash_fwd(q, k, v, kv_bias, scale, causal, q_offset, k_offset, block_k):
+    out, lse = _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset,
+                                block_k, kv_bias=kv_bias)
+    return out.astype(q.dtype), (q, k, v, kv_bias, out, lse)
 
 
 def flash_bwd_from_lse(q, k, v, g, lse, delta, scale, causal, q_offset=0,
-                       k_offset=0, block_k=256):
+                       k_offset=0, block_k=256, kv_bias=None):
     """Blockwise flash backward from (lse, delta): dV = PᵀdO;
     dS = P∘(dOVᵀ − Δ); dQ = dS·K·scale; dK = dSᵀ·Q·scale with
     Δ = rowsum(dO∘O) over the FULL row — pass it in when this call sees
@@ -103,19 +122,26 @@ def flash_bwd_from_lse(q, k, v, g, lse, delta, scale, causal, q_offset=0,
     qf = q.astype(jnp.float32)
     gf = g.astype(jnp.float32)
     q_pos = q_offset + jnp.arange(Sq)
+    remask = causal or kv_bias is not None
 
     kb = k.reshape(B, H, nblocks, bk, Dd).transpose(2, 0, 1, 3, 4).astype(jnp.float32)
     vb = v.reshape(B, H, nblocks, bk, Dd).transpose(2, 0, 1, 3, 4).astype(jnp.float32)
 
     def body(dq, inp):
-        kblk, vblk, blk_idx = inp
+        if kv_bias is None:
+            kblk, vblk, blk_idx = inp
+            bblk = None
+        else:
+            kblk, vblk, blk_idx, bblk = inp
         k_pos = k_offset + blk_idx * bk + jnp.arange(bk)
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk) * scale
+        if bblk is not None:
+            s = s + bblk[:, None, None, :]
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[..., None])  # (B,H,Sq,bk)
-        if causal:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
+        if remask:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
             p = jnp.where(s > NEG_INF / 2, p, 0.0)
         dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
         dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vblk)
@@ -124,20 +150,26 @@ def flash_bwd_from_lse(q, k, v, g, lse, delta, scale, causal, q_offset=0,
         dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
         return dq, (dk, dv)
 
+    xs = (kb, vb, jnp.arange(nblocks))
+    if kv_bias is not None:
+        xs = xs + (kv_bias.reshape(B, nblocks, bk).transpose(1, 0, 2),)
     dq0 = jnp.zeros_like(qf)
-    dq, (dks, dvs) = jax.lax.scan(body, dq0, (kb, vb, jnp.arange(nblocks)))
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, xs)
     dk = dks.transpose(1, 2, 0, 3, 4).reshape(B, H, Sk, Dd)
     dv = dvs.transpose(1, 2, 0, 3, 4).reshape(B, H, Sk, Dd)
     return dq, dk, dv
 
 
 def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
-    q, k, v, out, lse = res
+    q, k, v, kv_bias, out, lse = res
     delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1)  # (B,H,Sq)
     dq, dk, dv = flash_bwd_from_lse(
-        q, k, v, g, lse, delta, scale, causal, q_offset, k_offset, block_k
+        q, k, v, g, lse, delta, scale, causal, q_offset, k_offset, block_k,
+        kv_bias=kv_bias,
     )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    # the mask bias is data, not a trainable input: zero cotangent
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None if kv_bias is None else jnp.zeros_like(kv_bias))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -154,11 +186,18 @@ def flash_attention(
     k_offset: int = 0,
     impl: str = "auto",
     block_q: Optional[int] = None,
+    kv_mask: Optional[jnp.ndarray] = None,
 ):
     """Memory-efficient attention, (B, H, S, D) layout.
 
     ``q_offset``/``k_offset`` give the global sequence positions of the
     local blocks (used by ring attention for cross-device causal masks).
+
+    ``kv_mask``: optional (B, Sk) bool key-validity mask, True = valid —
+    padded keys are excluded from every row's softmax (the varlen/
+    padding support of ``apex/contrib/fmha/fmha.py:33-60``, expressed as
+    a dense mask instead of cu_seqlens because packed ragged layouts are
+    hostile to XLA's static shapes).
 
     ``impl``: "pallas" (TPU kernel), "scan" (lax.scan composite), or
     "auto" — the Pallas kernel on TPU with kernel-friendly shapes, the
@@ -178,9 +217,10 @@ def flash_attention(
             return flash_attention_pallas(
                 q, k, v, causal=causal, softmax_scale=scale,
                 q_offset=q_offset, k_offset=k_offset,
-                block_q=block_q, block_k=block_k,
+                block_q=block_q, block_k=block_k, kv_mask=kv_mask,
             )
-    return _flash(q, k, v, scale, causal, q_offset, k_offset, block_k or 256)
+    bias = None if kv_mask is None else padding_bias(kv_mask)
+    return _flash(q, k, v, bias, scale, causal, q_offset, k_offset, block_k or 256)
 
 
 def flash_attention_with_lse(
@@ -193,7 +233,7 @@ def flash_attention_with_lse(
     return out, lse
 
 
-def mha_reference(q, k, v, causal=True, softmax_scale=None):
+def mha_reference(q, k, v, causal=True, softmax_scale=None, kv_mask=None):
     """Naive O(S²)-memory oracle for tests."""
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
@@ -201,5 +241,7 @@ def mha_reference(q, k, v, causal=True, softmax_scale=None):
         Sq, Sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
         s = jnp.where(mask, s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -38,12 +38,19 @@ NEG_INF = -1e30
 _LANES = 128
 
 
-def _pick_block(seq, target):
-    """Largest divisor of ``seq`` ≤ target, preferring lane multiples."""
-    b = min(target, seq)
-    while seq % b:
-        b -= 1
-    return b
+def _pick_block(seq, target, align=_LANES):
+    """Largest divisor of ``seq`` ≤ target, preferring ``align``-aligned
+    divisors (128 for the lane dim, 8 for sublanes) — but only when the
+    aligned candidate is at least half the largest divisor: a misaligned
+    tile wastes ≤ (align−1) padded lanes, while a much smaller tile
+    multiplies grid steps and k/v refetches (e.g. seq=640, target=512:
+    320 misaligned beats 128 aligned)."""
+    divisors = [b for b in range(1, min(target, seq) + 1) if seq % b == 0]
+    best = divisors[-1]
+    aligned = [b for b in divisors if b % align == 0]
+    if aligned and 2 * aligned[-1] >= best:
+        return aligned[-1]
+    return best
 
 
 def _causal_mask(bq, bk, qi, kj, block_q, block_k, q_offset, k_offset):
@@ -53,8 +60,13 @@ def _causal_mask(bq, bk, qi, kj, block_q, block_k, q_offset, k_offset):
 
 
 # ------------------------------------------------------------------ forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale, causal, q_offset, k_offset, block_q, block_k, nk):
+def _fwd_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
+                block_q, block_k, nk):
+    if has_bias:
+        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+        b_ref = None
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -77,6 +89,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if b_ref is not None:
+            s = s + b_ref[0]  # (1, bk) key bias broadcast over rows
         if causal:
             mask = _causal_mask(q.shape[0], k.shape[0], i, j, block_q, block_k,
                                 q_offset, k_offset)
@@ -87,7 +101,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         # exp(NEG_INF - NEG_INF) = 1 would give fully-masked rows a
         # spurious uniform distribution; re-mask after the exp.
         p = jnp.exp(s - m_new)
-        if causal:
+        if causal or has_bias:
             p = jnp.where(s > NEG_INF / 2, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
@@ -108,30 +122,44 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
 
 def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
                      block_q=1024, block_k=1024, interpret=False,
-                     out_dtype=None):
+                     out_dtype=None, kv_bias=None, heads=1):
     """q: (BH, Sq, D); k/v: (BH, Sk, D).  Returns (out, lse (BH, Sq, 1)).
+
+    ``kv_bias``: optional (B, 1, Sk) f32 additive key bias (0 valid /
+    NEG_INF padded; the middle singleton keeps the block sublane-legal);
+    ``heads`` maps the flattened batch·head grid index back to the batch
+    row (b // heads).
 
     ``out_dtype`` defaults to q.dtype; ring attention requests f32 so
     cross-chunk accumulation never rounds through bf16."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     out_dtype = out_dtype or q.dtype
-    bq = _pick_block(Sq, block_q)
+    bq = _pick_block(Sq, block_q, align=8)
     bk = _pick_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
     grid = (BH, nq, nk)
+    has_bias = kv_bias is not None
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+    ]
+    inputs = (q, k, v)
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // heads, 0, j), memory_space=pltpu.VMEM)
+        )
+        inputs = inputs + (kv_bias,)
 
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, q_offset=q_offset,
-            k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
+            _fwd_kernel, scale=scale, causal=causal, has_bias=has_bias,
+            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
@@ -146,13 +174,18 @@ def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
 # ----------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-               *, scale, causal, q_offset, k_offset, block_q, block_k, nk):
+def _dq_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
+               block_q, block_k, nk):
+    if has_bias:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b_ref, dq_ref, acc_ref = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
+        b_ref = None
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -172,12 +205,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if b_ref is not None:
+            s = s + b_ref[0]
         if causal:
             mask = _causal_mask(q.shape[0], k.shape[0], i, j, block_q, block_k,
                                 q_offset, k_offset)
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])
-        if causal:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
+        if causal or has_bias:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
             p = jnp.where(s > NEG_INF / 2, p, 0.0)
         do = do_ref[0]
         dp = jax.lax.dot_general(
@@ -194,9 +229,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc, dv_acc, *, scale, causal, q_offset, k_offset,
+def _dkv_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
                 block_q, block_k, nq):
+    if has_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        b_ref = None
     j, i = pl.program_id(1), pl.program_id(2)  # k-block outer, q-block inner
 
     @pl.when(i == 0)
@@ -217,12 +258,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if b_ref is not None:
+            s = s + b_ref[0]
         if causal:
             mask = _causal_mask(q.shape[0], k.shape[0], i, j, block_q, block_k,
                                 q_offset, k_offset)
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])
-        if causal:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
+        if causal or has_bias:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
             p = jnp.where(s > NEG_INF / 2, p, 0.0)
         do = do_ref[0]
         dv_acc[:] += jax.lax.dot_general(
@@ -246,7 +289,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
                      block_q=512, block_k=512, interpret=False, delta=None,
-                     out_dtype=None):
+                     out_dtype=None, kv_bias=None, heads=1):
     # 512 (not the forward's 1024): the bwd kernels keep ~4 (bq, bk) f32
     # score-sized temporaries live, so smaller tiles stay inside VMEM.
     """All (BH, S, D); lse (BH, Sq, 1).  Returns (dq, dk, dv).
@@ -255,15 +298,17 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
     ``out`` covers more keys than this call sees — ring attention's
     backward, where each chunk-pair call sees only the local k/v chunk.
     ``out_dtype`` defaults to the input dtypes; ring passes f32.
+    ``kv_bias``/``heads`` as in :func:`flash_fwd_pallas`.
     """
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     dq_dtype = out_dtype or q.dtype
     dk_dtype = out_dtype or k.dtype
     dv_dtype = out_dtype or v.dtype
-    bq = _pick_block(Sq, block_q)
+    bq = _pick_block(Sq, block_q, align=8)
     bk = _pick_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
+    has_bias = kv_bias is not None
 
     if delta is None:
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -273,31 +318,45 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
     k_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM)
     r_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
 
+    in_specs = [q_spec, k_spec, k_spec, q_spec, r_spec, r_spec]
+    inputs = (q, k, v, do, lse, delta)
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // heads, 0, j), memory_space=pltpu.VMEM)
+        )
+        inputs = inputs + (kv_bias,)
+
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, causal=causal, q_offset=q_offset,
-            k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
+            _dq_kernel, scale=scale, causal=causal, has_bias=has_bias,
+            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
         ),
         grid=(BH, nq, nk),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), dq_dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*inputs)
 
     # k-outer grid: index maps see (b, j, i).
     qT_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM)
     kT_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM)
     rT_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM)
 
+    in_specsT = [qT_spec, kT_spec, kT_spec, qT_spec, rT_spec, rT_spec]
+    if has_bias:
+        in_specsT.append(
+            pl.BlockSpec((1, 1, bk), lambda b, j, i: (b // heads, 0, j), memory_space=pltpu.VMEM)
+        )
+
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, q_offset=q_offset,
-            k_offset=k_offset, block_q=bq, block_k=bk, nq=nq,
+            _dkv_kernel, scale=scale, causal=causal, has_bias=has_bias,
+            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk, nq=nq,
         ),
         grid=(BH, nk, nq),
-        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rT_spec, rT_spec],
+        in_specs=in_specsT,
         out_specs=[kT_spec, kT_spec],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sk, D), dk_dtype),
@@ -308,36 +367,39 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*inputs)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------- dispatch
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash_pallas(q, k, v, scale, causal, q_offset, k_offset, block_q, block_k,
-                  interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _flash_pallas(q, k, v, kv_bias, scale, causal, q_offset, k_offset,
+                  block_q, block_k, interpret, heads):
     out, _ = flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
                               block_q=block_q, block_k=block_k,
-                              interpret=interpret)
+                              interpret=interpret, kv_bias=kv_bias, heads=heads)
     return out
 
 
-def _flash_pallas_fwd(q, k, v, scale, causal, q_offset, k_offset, block_q,
-                      block_k, interpret):
+def _flash_pallas_fwd(q, k, v, kv_bias, scale, causal, q_offset, k_offset,
+                      block_q, block_k, interpret, heads):
     out, lse = flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
                                 block_q=block_q, block_k=block_k,
-                                interpret=interpret)
-    return out, (q, k, v, out, lse)
+                                interpret=interpret, kv_bias=kv_bias, heads=heads)
+    return out, (q, k, v, kv_bias, out, lse)
 
 
 def _flash_pallas_bwd(scale, causal, q_offset, k_offset, block_q, block_k,
-                      interpret, res, g):
-    q, k, v, out, lse = res
+                      interpret, heads, res, g):
+    q, k, v, kv_bias, out, lse = res
     # bwd keeps more score-sized f32 temporaries live; cap tiles at 512
-    return flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
-                            q_offset, k_offset,
-                            block_q=min(block_q, 512), block_k=min(block_k, 512),
-                            interpret=interpret)
+    dq, dk, dv = flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
+                                  q_offset, k_offset,
+                                  block_q=min(block_q, 512), block_k=min(block_k, 512),
+                                  interpret=interpret, kv_bias=kv_bias,
+                                  heads=heads)
+    # the mask bias is data, not a trainable input: zero cotangent
+    return (dq, dk, dv, None if kv_bias is None else jnp.zeros_like(kv_bias))
 
 
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
@@ -345,15 +407,25 @@ _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 
 def flash_attention_pallas(q, k, v, causal=True, softmax_scale=None,
                            q_offset=0, k_offset=0, block_q=None, block_k=None,
-                           interpret=False):
-    """(B, H, S, D) flash attention via the Pallas kernels."""
+                           interpret=False, kv_mask=None):
+    """(B, H, S, D) flash attention via the Pallas kernels.
+
+    ``kv_mask``: optional (B, Sk) bool key-validity mask (True = valid) —
+    the fmha varlen/padding semantics (``apex/contrib/fmha/fmha.py:33-60``)
+    expressed as a dense mask folded into the kernel."""
     B, H, Sq, D = q.shape
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(D)
     qf = q.reshape(B * H, Sq, D)
     kf = k.reshape(B * H, k.shape[2], D)
     vf = v.reshape(B * H, v.shape[2], D)
-    out = _flash_pallas(qf, kf, vf, scale, causal, q_offset, k_offset,
-                        block_q or 1024, block_k or 1024, interpret)
+    if kv_mask is None:
+        bias = None
+    else:
+        from apex_tpu.ops.attention import padding_bias
+
+        bias = padding_bias(kv_mask)[:, None, :]
+    out = _flash_pallas(qf, kf, vf, bias, scale, causal, q_offset, k_offset,
+                        block_q or 1024, block_k or 1024, interpret, H)
     return out.reshape(B, H, Sq, D)
 
 

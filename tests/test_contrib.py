@@ -135,6 +135,33 @@ class TestFMHA:
         out = fmha(qkv, causal=True)
         assert out.shape == (1, 16, 2, 4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_padded_parity_vs_dense_oracle(self, causal):
+        from apex_tpu.ops.attention import mha_reference
+
+        rng = np.random.RandomState(8)
+        B, S, H, D = 2, 32, 2, 8
+        qkv = jnp.asarray(rng.randn(B, S, 3, H, D).astype(np.float32))
+        mask = jnp.asarray(np.array([[True] * 32, [True] * 19 + [False] * 13]))
+        out = fmha(qkv, key_padding_mask=mask, causal=causal)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        ref = mha_reference(q, k, v, causal=causal, kv_mask=mask).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out[1, :19]), np.asarray(ref[1, :19]),
+                                   rtol=1e-4, atol=1e-5)
+        # padded query rows are zeroed (packed-varlen semantics)
+        np.testing.assert_allclose(np.asarray(out[1, 19:]), 0.0, atol=1e-6)
+
+    def test_padded_grads_flow(self):
+        rng = np.random.RandomState(9)
+        qkv = jnp.asarray(rng.randn(1, 16, 3, 2, 4).astype(np.float32))
+        mask = jnp.asarray(np.array([[True] * 10 + [False] * 6]))
+        g = jax.grad(lambda x: jnp.sum(fmha(x, key_padding_mask=mask) ** 2))(qkv)
+        assert bool(jnp.all(jnp.isfinite(g)))
+        # padded positions get zero gradient through q (their rows are zeroed)
+        np.testing.assert_allclose(np.asarray(g[0, 10:, 0]), 0.0, atol=1e-6)
+
 
 class TestMultiheadAttn:
     def test_self_attn_shapes_and_norm_add(self):
