@@ -215,8 +215,13 @@ def _dq_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
         if causal or has_bias:  # fully-masked rows have lse == NEG_INF: exp(0) = 1
             p = jnp.where(s > NEG_INF / 2, p, 0.0)
         do = do_ref[0]
+        # ring passes an f32 cotangent with bf16 k/v: widen the narrower
+        # operand instead of rounding do through bf16
+        v = v_ref[0]
+        if v.dtype != do.dtype:
+            v = v.astype(do.dtype)
         dp = jax.lax.dot_general(
-            do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta_ref[0])
         acc_ref[:] += scale * jax.lax.dot_general(
@@ -272,8 +277,12 @@ def _dkv_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        # widen v rather than rounding an f32 cotangent down (ring path)
+        v = v_ref[0]
+        if v.dtype != do.dtype:
+            v = v.astype(do.dtype)
         dp = jax.lax.dot_general(
-            do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta_ref[0])
         dk_acc[:] += scale * jax.lax.dot_general(

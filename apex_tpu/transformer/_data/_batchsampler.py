@@ -30,17 +30,18 @@ class MegatronPretrainingSampler:
         self.micro_batch_times_data_parallel_size = micro_batch_size * data_parallel_size
         self.drop_last = drop_last
 
-        assert self.total_samples > 0, "no sample to consume: {}".format(self.total_samples)
-        assert self.consumed_samples < self.total_samples, "no samples left to consume: {}, {}".format(
-            self.consumed_samples, self.total_samples
-        )
+        if self.total_samples <= 0:
+            raise ValueError(f"dataset is empty (total_samples={self.total_samples})")
+        if self.consumed_samples >= self.total_samples:
+            raise ValueError(
+                f"every sample already consumed ({self.consumed_samples} of {self.total_samples})"
+            )
         assert self.micro_batch_size > 0
         assert data_parallel_size > 0
-        assert self.data_parallel_rank < data_parallel_size, (
-            "data_parallel_rank should be smaller than data size: {}, {}".format(
-                self.data_parallel_rank, data_parallel_size
+        if self.data_parallel_rank >= data_parallel_size:
+            raise ValueError(
+                f"dp rank {self.data_parallel_rank} out of range for dp world {data_parallel_size}"
             )
-        )
 
     def __len__(self):
         return self.total_samples

@@ -74,7 +74,10 @@ def _chunk_bwd(q, k, v, do, lse, delta, scale, causal, impl, interpret):
         dq, dk, dv = flash_bwd_pallas(
             q.reshape(B * H, S, D), k.reshape(B * H, k.shape[2], D),
             v.reshape(B * H, v.shape[2], D), None,
-            lse.reshape(B * H, S, 1), do.reshape(B * H, S, D).astype(q.dtype),
+            lse.reshape(B * H, S, 1),
+            # keep the cross-chunk cotangent f32: the kernel widens v to
+            # match rather than rounding do through bf16
+            do.reshape(B * H, S, D).astype(jnp.float32),
             scale, causal, 0, 0, interpret=interpret,
             delta=delta.reshape(B * H, S, 1), out_dtype=jnp.float32,
         )

@@ -168,6 +168,31 @@ class AxisGroup(str):
     def size(self) -> int:
         return self._size
 
+    def masked_psum(self, x):
+        """Sum ``x`` over the group's *members* only.
+
+        Groups with partial membership (``members`` set, e.g. the
+        embedding group = first+last pipeline stages) still name a full
+        mesh axis, so a bare ``jax.lax.psum(x, group)`` would sum over
+        every index on the axis — including non-members.  This helper
+        zeroes non-member contributions first.  Members receive the
+        member-sum; non-members receive it too (harmless — they hold no
+        tied embedding), matching the reference's group-scoped
+        all_reduce semantics for ranks in the group.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        axis_extent = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get(str(self))
+        if self.members is None or len(self.members) == axis_extent:
+            return jax.lax.psum(x, str(self))
+        idx = jax.lax.axis_index(str(self))
+        is_member = jnp.zeros((), bool)
+        for m in self.members:
+            is_member = is_member | (idx == m)
+        zeros = jax.tree.map(lambda t: jnp.where(is_member, t, jnp.zeros_like(t)), x)
+        return jax.lax.psum(zeros, str(self))
+
 
 def get_tensor_model_parallel_group() -> AxisGroup:
     """Reference: parallel_state.py:444 — here, the ``tp`` mesh axis."""
@@ -227,6 +252,10 @@ def get_embedding_group() -> AxisGroup:
     Reference: parallel_state.py:471.  On TPU the tied-embedding gradient
     exchange is a masked ``psum`` over the ``pp`` axis done inside the
     pipeline schedule; ``members`` records which stage indices take part.
+
+    .. warning:: this group has *partial* membership — a bare
+       ``jax.lax.psum(x, group)`` sums over every pipeline stage.  Use
+       :meth:`AxisGroup.masked_psum` to reduce over members only.
     """
     s = _state()
     members = tuple(sorted({0, s.pipeline_model_parallel_size - 1}))

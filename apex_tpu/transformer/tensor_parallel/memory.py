@@ -14,9 +14,9 @@ cursor (``lax.dynamic_update_slice``) and slices it back out.  This is a
 state, so ``add`` must be called outside ``jit`` (it raises on tracers).
 Inside jit the idiomatic equivalents are ``jax.checkpoint`` policies
 (:mod:`apex_tpu.transformer.tensor_parallel.random`) — XLA already
-arena-allocates.  Usage tracking mirrors the reference (accumulated at
-``reset``, memory.py:79-88) so code ported from Megatron can budget
-identically.
+arena-allocates.  Usage tracking mirrors the reference (sampled at
+``get_data``, memory.py:115-120) so code ported from Megatron can
+budget identically.
 """
 
 from typing import Dict
@@ -72,11 +72,7 @@ class MemoryBuffer:
         self.total_value = 0.0
 
     def reset(self):
-        """Rewind the cursor; arena contents become dead (memory.py:79).
-        Usage is sampled here, once per fill cycle, as in the reference."""
-        if self.track_usage:
-            self.in_use_value += float(self._start)
-            self.total_value += float(self.numel)
+        """Rewind the cursor; arena contents become dead (memory.py:79)."""
         self._start = 0
 
     def is_in_use(self) -> bool:
@@ -112,7 +108,11 @@ class MemoryBuffer:
         return view
 
     def get_data(self):
-        """The live prefix of the arena (reference memory.py:115)."""
+        """The live prefix of the arena; usage is sampled here, per
+        consumer read, exactly as the reference does (memory.py:115-120)."""
+        if self.track_usage:
+            self.in_use_value += float(self._start)
+            self.total_value += float(self.numel)
         return self.data[: self._start]
 
     def print_average_usage(self):

@@ -346,6 +346,33 @@ class TestGroupGetters:
             )(x)
             np.testing.assert_array_equal(np.asarray(out), [6.0, 6.0, 6.0, 6.0])
 
+    def test_masked_psum_sums_members_only(self):
+        from jax.experimental.shard_map import shard_map
+
+        with parallel_state_ctx(pp=4):
+            mesh = parallel_state.get_mesh()
+            g = parallel_state.get_embedding_group()  # members (0, 3)
+
+            def f(x):
+                return g.masked_psum(x)
+
+            x = jnp.arange(4, dtype=jnp.float32) + 1.0  # stage s holds s+1
+            out = shard_map(
+                f, mesh=mesh,
+                in_specs=P(parallel_state.PIPELINE_AXIS),
+                out_specs=P(parallel_state.PIPELINE_AXIS),
+            )(x)
+            # only stages 0 and 3 contribute: 1 + 4 = 5
+            np.testing.assert_array_equal(np.asarray(out), [5.0] * 4)
+            # full-membership group degrades to a plain psum
+            tp_like = parallel_state.get_pipeline_model_parallel_group()
+            out2 = shard_map(
+                lambda x: tp_like.masked_psum(x), mesh=mesh,
+                in_specs=P(parallel_state.PIPELINE_AXIS),
+                out_specs=P(parallel_state.PIPELINE_AXIS),
+            )(x)
+            np.testing.assert_array_equal(np.asarray(out2), [10.0] * 4)
+
     def test_model_parallel_group_is_axis_tuple(self):
         from jax.experimental.shard_map import shard_map
 
@@ -370,12 +397,16 @@ class TestGroupGetters:
         with parallel_state_ctx(tp=2):
             assert parallel_state.get_embedding_group().members == (0,)
 
-    def test_usage_tracked_per_reset_cycle(self):
+    def test_usage_tracked_at_get_data(self):
+        # sampling happens at get_data, as in the reference (memory.py:115)
         buf = MemoryBuffer("cyc", 100, jnp.float32, track_usage=True)
         for _ in range(10):
             buf.add(jnp.ones((10,), jnp.float32))
-        buf.reset()
+        assert buf.in_use_value == 0.0  # not sampled yet
+        buf.get_data()
         assert buf.in_use_value == 100.0 and buf.total_value == 100.0
+        buf.reset()
+        assert buf.in_use_value == 100.0  # reset does not sample
 
     def test_add_rejects_tracers(self):
         buf = MemoryBuffer("tr", 16, jnp.float32)
