@@ -1,0 +1,83 @@
+"""Fused scaled/masked softmax family — parity vs torch softmax
+(mirrors apex tests/L0/run_transformer/test_fused_softmax.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import (
+    FusedScaleMaskSoftmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+
+def torch_ref(x, mask=None, scale=1.0, causal=False):
+    t = torch.tensor(np.asarray(x), dtype=torch.float32) * scale
+    sq, sk = t.shape[-2], t.shape[-1]
+    if causal:
+        tri = torch.tril(torch.ones(sq, sk, dtype=torch.bool))
+        t = t.masked_fill(~tri, -10000.0)
+    if mask is not None:
+        t = t.masked_fill(torch.tensor(np.asarray(mask)), -10000.0)
+    return torch.softmax(t, dim=-1).numpy()
+
+
+class TestScaledSoftmax:
+    def test_unmasked(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 8, 16).astype(np.float32))
+        out = scaled_softmax(x, 0.5)
+        np.testing.assert_allclose(np.asarray(out), torch_ref(x, scale=0.5), rtol=1e-5, atol=1e-6)
+
+    def test_causal(self):
+        x = jnp.asarray(np.random.RandomState(1).randn(2, 8, 8).astype(np.float32))
+        out = scaled_upper_triang_masked_softmax(x, 2.0)
+        np.testing.assert_allclose(np.asarray(out), torch_ref(x, scale=2.0, causal=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_masked(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(2, 3, 4, 16).astype(np.float32))
+        mask = jnp.asarray(rng.rand(2, 1, 4, 16) > 0.7)
+        out = scaled_masked_softmax(x, mask, 1.5)
+        np.testing.assert_allclose(np.asarray(out), torch_ref(x, mask=mask, scale=1.5),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grads_are_finite_and_masked(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(1, 1, 4, 8).astype(np.float32))
+        mask = jnp.zeros((1, 1, 4, 8), bool).at[0, 0, :, 6:].set(True)
+        g = jax.grad(lambda x: jnp.sum(scaled_masked_softmax(x, mask) ** 2))(x)
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+    def test_bf16_in_fp32_softmax(self):
+        x = jnp.asarray(np.random.RandomState(4).randn(2, 4, 8).astype(np.float32), jnp.bfloat16)
+        out = scaled_softmax(x, 1.0)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   torch_ref(np.asarray(x, np.float32)), atol=1e-2)
+
+
+class TestFusedScaleMaskSoftmaxModule:
+    def test_causal_mode(self):
+        m = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal, scale=0.7)
+        x = jnp.asarray(np.random.RandomState(5).randn(2, 2, 8, 8).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(m(x)), torch_ref(x, scale=0.7, causal=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_padding_mode_and_kernel_available(self):
+        m = FusedScaleMaskSoftmax()
+        assert m.is_kernel_available(None, 1, 1, 8, 8)
+        assert FusedScaleMaskSoftmax.get_batch_per_block(8, 8, 1, 1) == 1
+        x = jnp.asarray(np.random.RandomState(6).randn(1, 2, 4, 8).astype(np.float32))
+        mask = jnp.zeros((1, 1, 4, 8), bool).at[0, 0, :, 5:].set(True)
+        np.testing.assert_allclose(np.asarray(m(x, mask)), torch_ref(x, mask=mask),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_rejects_fp16_and_bf16_both(self):
+        with pytest.raises(RuntimeError):
+            FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
