@@ -96,32 +96,73 @@ class DistributedFusedAdam:
         total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
         return total
 
-    def init(self, params, world_size: Optional[int] = None) -> DistributedFusedAdamState:
+    def init(self, params, world_size: Optional[int] = None, param_specs=None,
+             axis_sizes=None) -> DistributedFusedAdamState:
         """Build the GLOBAL flat state: arrays of shape (padded_total,),
-        to be sharded over ``dp`` — pass
-        ``DistributedFusedAdamState(P(), P("dp"), P("dp"), P("dp"))`` as
-        the shard_map spec so each rank holds its 1/dp shard (the ZeRO
-        memory saving comes from the sharding, stated explicitly rather
-        than via per-device local arrays).  The fp32 master is lazily
-        sliced from params on the first update (step==0)."""
+        to be sharded over ``dp`` via :meth:`state_partition_spec` so
+        each rank holds its 1/dp shard (the ZeRO memory saving comes
+        from the sharding, stated explicitly rather than via per-device
+        local arrays).  The fp32 master is lazily sliced from params on
+        the first update (step==0).
+
+        **Composition with tensor parallelism**: when ``params`` are
+        themselves sharded over model-parallel mesh axes, pass
+        ``param_specs`` (the PartitionSpec tree used for the params) and
+        ``axis_sizes`` (mapping axis name → mesh size).  The state is
+        then sized for the *local* param shard and additionally sharded
+        over those model axes — each (tp, dp) device holds the dp-shard
+        of the optimizer state for its tp-slice of the params.
+        """
         if world_size is None:
             raise ValueError("pass world_size= (the dp axis size)")
-        total = self._total_and_pad(params)
+        self._model_axes: Tuple[str, ...] = ()
+        model_mult = 1
+        if param_specs is not None:
+            if axis_sizes is None:
+                raise ValueError("param_specs requires axis_sizes")
+            total = 0
+            used_axes = []
+            leaves, treedef = jax.tree.flatten(params)
+            spec_leaves = treedef.flatten_up_to(param_specs)
+            for leaf, spec in zip(leaves, spec_leaves):
+                n = int(np.prod(leaf.shape))
+                for entry in tuple(spec):
+                    for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                        if ax is None:
+                            continue
+                        if ax == self.axis_name:
+                            raise ValueError(
+                                f"params must not be sharded over the ZeRO axis {ax!r}"
+                            )
+                        n //= axis_sizes[ax]
+                        if ax not in used_axes:
+                            used_axes.append(ax)
+                total += n
+            self._model_axes = tuple(sorted(used_axes))
+            for ax in self._model_axes:
+                model_mult *= axis_sizes[ax]
+        else:
+            total = self._total_and_pad(params)
         padded = ((total + world_size - 1) // world_size) * world_size
+        self._total = total
         self._padded = padded
         self._world = world_size
-        zeros = jnp.zeros((padded,), jnp.float32)
+        zeros = jnp.zeros((model_mult * padded,), jnp.float32)
         return DistributedFusedAdamState(
             step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=zeros, master_shard=zeros
         )
 
     def state_partition_spec(self):
-        """The shard_map / pjit PartitionSpec tree for the state."""
+        """The shard_map / pjit PartitionSpec tree for the state.  With
+        model-parallel composition (``init(param_specs=...)``) the flat
+        axis is sharded jointly over (model axes..., dp) — model-major,
+        matching the layout :meth:`init` builds."""
         from jax.sharding import PartitionSpec as P
 
+        axes = getattr(self, "_model_axes", ())
+        flat = P((*axes, self.axis_name)) if axes else P(self.axis_name)
         return DistributedFusedAdamState(
-            step=P(), exp_avg=P(self.axis_name), exp_avg_sq=P(self.axis_name),
-            master_shard=P(self.axis_name),
+            step=P(), exp_avg=flat, exp_avg_sq=flat, master_shard=flat,
         )
 
     def update(self, grads, state: DistributedFusedAdamState, params, grads_finite=None, lr=None):
@@ -187,9 +228,12 @@ class DistributedFusedAdam:
         )
 
     # ----------------------------------------------------- state dict parity
+    SHARD_FORMAT = "apex_tpu_zero2_v1"
+
     def state_dict(self, state: DistributedFusedAdamState):
-        """Sharded state dict (reference :2527 — each rank saves its own
-        shard)."""
+        """Whole-state dict (the reference's ``gather_on_root=True`` mode,
+        distributed_fused_adam.py:2527).  For the per-rank protocol use
+        :meth:`sharded_state_dict`."""
         return {
             "step": int(state.step),
             "exp_avg": np.asarray(state.exp_avg),
@@ -203,4 +247,83 @@ class DistributedFusedAdam:
             exp_avg=jnp.asarray(d["exp_avg"]),
             exp_avg_sq=jnp.asarray(d["exp_avg_sq"]),
             master_shard=jnp.asarray(d["master_shard"]),
+        )
+
+    def sharded_state_dict(self, state: DistributedFusedAdamState, rank: int,
+                           world_size: int, total_numel: Optional[int] = None):
+        """Per-rank shard of the state + the layout metadata needed to
+        reshard on load (reference ``state_dict(gather_on_root=False)``
+        saves each rank's fragments, distributed_fused_adam.py:2527;
+        ``load_state_dict`` redistributes them :2959).
+
+        ``total_numel`` is the UNPADDED parameter count; defaults to the
+        value recorded by :meth:`init`.  It is what lets a checkpoint
+        saved at dp=4 be re-padded for dp=2.
+        """
+        if total_numel is None:
+            total_numel = getattr(self, "_total", None)
+        if total_numel is None:
+            raise ValueError(
+                "pass total_numel= (or call init() first): resharding needs "
+                "the unpadded parameter count"
+            )
+        padded = int(state.exp_avg.shape[0])
+        if padded % world_size:
+            raise ValueError(f"state length {padded} not divisible by world {world_size}")
+        shard = padded // world_size
+        sl = slice(rank * shard, (rank + 1) * shard)
+        return {
+            "format": self.SHARD_FORMAT,
+            "rank": int(rank),
+            "world_size": int(world_size),
+            "padded_total": padded,
+            "shard_numel": shard,
+            "total_numel": int(total_numel),
+            "step": int(state.step),
+            "exp_avg": np.asarray(state.exp_avg[sl]),
+            "exp_avg_sq": np.asarray(state.exp_avg_sq[sl]),
+            "master_shard": np.asarray(state.master_shard[sl]),
+        }
+
+    @classmethod
+    def load_sharded_state_dicts(cls, shards, world_size: int) -> DistributedFusedAdamState:
+        """Reassemble a full state from per-rank shard dicts and reshard
+        it for ``world_size`` ranks (which may differ from the saved
+        world size — save at dp=4, load at dp=2).
+
+        ``shards``: the complete set of shard dicts from one checkpoint,
+        any order.  Returns the global flat state padded for the NEW
+        world size; shard it with :meth:`state_partition_spec` as usual.
+        """
+        shards = sorted(shards, key=lambda d: d["rank"])
+        if not shards:
+            raise ValueError("no shards given")
+        meta = shards[0]
+        if meta.get("format") != cls.SHARD_FORMAT:
+            raise ValueError(f"unrecognized shard format {meta.get('format')!r}")
+        saved_world = meta["world_size"]
+        if [d["rank"] for d in shards] != list(range(saved_world)):
+            raise ValueError(
+                f"incomplete shard set: got ranks {[d['rank'] for d in shards]}, "
+                f"saved world size is {saved_world}"
+            )
+        for d in shards:
+            for key in ("padded_total", "total_numel", "step", "world_size"):
+                if d[key] != meta[key]:
+                    raise ValueError(f"shard {d['rank']} disagrees on {key}")
+
+        total = meta["total_numel"]
+        new_padded = ((total + world_size - 1) // world_size) * world_size
+
+        def reassemble(key):
+            full = np.concatenate([d[key] for d in shards])[:total]
+            return jnp.asarray(
+                np.pad(full, (0, new_padded - total)).astype(np.float32)
+            )
+
+        return DistributedFusedAdamState(
+            step=jnp.int32(meta["step"]),
+            exp_avg=reassemble("exp_avg"),
+            exp_avg_sq=reassemble("exp_avg_sq"),
+            master_shard=reassemble("master_shard"),
         )
