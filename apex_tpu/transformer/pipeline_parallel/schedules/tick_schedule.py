@@ -52,11 +52,15 @@ control flow (SPMD stages share one program; a stage with no unit at a
 tick computes masked work, and the segment split removes the ticks
 where *no* stage has work of that kind).
 
-Lockstep costs the schedule one honest overhead the reference doesn't
-have: ``pre_fn``/``post_fn`` run (masked) on every stage each tick
-rather than only on the first/last rank.  ``pre_fn`` is an embedding
-gather (cheap); ``post_fn``'s vocab matmul is sharded over tp, and the
-waste is the same order as the round-1 design's vmapped post.
+The pre/post units are ``lax.cond``-gated on their stage predicate
+(stage 0 / stage P-1), so the loss head's vocab matmul pair and the
+(vocab, H) embedding-grad scatter run only where the reference runs
+them (first/last rank, reference ``:305-309``) — not masked-but-
+executed on every stage.  The predicates depend only on (stage, tick),
+i.e. they are uniform along tp, which keeps tp collectives inside
+``pre_fn``/``post_fn`` in lockstep within each branch; pre/post must
+not contain pp-axis collectives (they don't: they are per-stage
+compute).
 """
 
 from functools import partial
@@ -171,14 +175,33 @@ def pipelined_fwd_bwd(
             xbuf = jnp.where(ok, written, xbuf)
             y = stage_fn(chunk_of(jnp.clip(v, 0, vpp - 1)), x)
             if do_post:
+                # Only stage P-1's last chunk runs the loss head.  The
+                # predicate depends on (stage, tick) alone — uniform
+                # across tp — so tp collectives inside post_fn stay in
+                # lockstep within every cond branch.  Gating the vjp
+                # (instead of masking its outputs) keeps the head matmul
+                # pair and the (vocab, H)-sized grad accumulation off
+                # the other P-1 stages' ticks: at vocab 32k those were
+                # the dominant per-tick cost.
                 last_vs = ok & (stage == Pp - 1) & (v == vpp - 1)
-                loss_m, post_vjp = jax.vjp(
-                    lambda sh, h: post_fn(sh, h, mb), shared_params, y
+
+                def _post(operand):
+                    loss_sum, g_sh = operand
+                    loss_m, post_vjp = jax.vjp(
+                        lambda sh, h: post_fn(sh, h, mb), shared_params, y
+                    )
+                    d_sh_post, dy_seed = post_vjp(jnp.asarray(inv_m, loss_m.dtype))
+                    g_sh = jax.tree.map(jnp.add, g_sh, d_sh_post)
+                    return (loss_sum + loss_m * inv_m, g_sh,
+                            dy_seed.astype(zero_act.dtype))
+
+                def _skip(operand):
+                    loss_sum, g_sh = operand
+                    return (loss_sum, g_sh, zero_act)
+
+                loss_sum, g_sh, seed_dx = jax.lax.cond(
+                    last_vs, _post, _skip, (loss_sum, g_sh)
                 )
-                d_sh_post, dy_seed = post_vjp(jnp.asarray(inv_m, loss_m.dtype))
-                loss_sum = loss_sum + jnp.where(last_vs, loss_m * inv_m, 0.0)
-                g_sh = _mask_add(g_sh, d_sh_post, last_vs)
-                seed_dx = jnp.where(last_vs, dy_seed.astype(zero_act.dtype), zero_act)
             act_msg = jax.lax.ppermute(y, axis_name, perm_fwd)
 
         if do_bwd:
@@ -199,11 +222,19 @@ def pipelined_fwd_bwd(
                     lambda G, n: jax.lax.dynamic_update_index_in_dim(G, n, vb_c, 0),
                     g_st, new,
                 )
-            # stage 0, chunk 0: route dx into the embedding/pre params
+            # stage 0, chunk 0: route dx into the embedding/pre params.
+            # cond-gated like the post head: the pre vjp scatters into a
+            # (vocab, H) embedding-grad buffer, which the other stages
+            # must not pay for every tick (predicate is tp-uniform).
             mb = _index_tree(microbatches, jnp.clip(mb_i, 0, M - 1))
-            _, pre_vjp = jax.vjp(lambda sh: pre_fn(sh, mb), shared_params)
-            (d_sh_pre,) = pre_vjp(dx.astype(x_shape.dtype))
-            g_sh = _mask_add(g_sh, d_sh_pre, ok_b & (stage == 0) & (vb == 0))
+            pre_vs = ok_b & (stage == 0) & (vb == 0)
+
+            def _pre(g_sh):
+                _, pre_vjp = jax.vjp(lambda sh: pre_fn(sh, mb), shared_params)
+                (d_sh_pre,) = pre_vjp(dx.astype(x_shape.dtype))
+                return jax.tree.map(jnp.add, g_sh, d_sh_pre)
+
+            g_sh = jax.lax.cond(pre_vs, _pre, lambda g: g, g_sh)
             cot_msg = jax.lax.ppermute(dx, axis_name, perm_bwd)
 
         return (act_msg, cot_msg, xbuf, loss_sum, g_sh, g_st), None

@@ -1,0 +1,139 @@
+"""Tick-schedule loss-head overhead at realistic vocab (CPU mesh).
+
+The lockstep schedule historically ran the (masked) loss head on every
+stage every steady tick; at vocab 32k the head is a (S·MB, H)×(H, V)
+matmul pair, so that waste dominates.  Round 3 cond-gates the head to
+stage P-1 (tick_schedule.py).  This bench measures, on the 8-device CPU
+mesh at P=4, M=8, H=512, vocab 32768:
+
+- t_full:    ms/step of the schedule with the real vocab head
+- t_nohead:  same schedule with a scalar head (head cost ~0)
+- t_head:    M x one head fwd+bwd on a single device (the unavoidable
+             per-microbatch head work the reference's last rank pays)
+
+post_overhead = (t_full - t_nohead - t_head) / t_full — the fraction of
+the step spent on head work beyond the reference's.  Target < 10%.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python benchmarks/pp_vocab_bench.py
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer.pipeline_parallel.schedules.tick_schedule import (
+    pipelined_fwd_bwd,
+)
+
+PP, M, MB, S, H, V, L = 4, 8, 2, 128, 512, 32768, 8
+
+
+def build(vocab_head):
+    # tied head (logits = h @ embed.T), as in GPT-2 / the reference's
+    # standalone_gpt — so every shared leaf the vjp touches is real work
+    rng = np.random.RandomState(0)
+    shared = {
+        "embed": jnp.asarray(rng.randn(V, H).astype(np.float32) * 0.02),
+    }
+    if not vocab_head:
+        shared["w_small"] = jnp.asarray(rng.randn(H, 1).astype(np.float32) * 0.02)
+    stages = {
+        "w": jnp.asarray(rng.randn(L, H, H).astype(np.float32) * 0.02),
+        "b": jnp.zeros((L, H), np.float32),
+    }
+    batch = {
+        "tok": jnp.asarray(rng.randint(0, V, size=(M, MB, S))),
+        "tgt": jnp.asarray(rng.randint(0, V if vocab_head else 1, size=(M, MB, S))),
+    }
+
+    def pre(sh, mb):
+        return jnp.take(sh["embed"], mb["tok"], axis=0)  # (MB, S, H)
+
+    def stage(sp, h):
+        out, _ = jax.lax.scan(
+            lambda c, lp: (c + jnp.tanh(c @ lp["w"] + lp["b"]), None), h, sp
+        )
+        return out
+
+    def post(sh, h, mb):
+        w = sh["embed"].T if vocab_head else sh["w_small"]
+        logits = h @ w  # (MB, S, V) tied, or (MB, S, 1) for the no-head probe
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, mb["tgt"][..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - tgt)
+
+    return pre, stage, post, shared, stages, batch
+
+
+def time_schedule(vocab_head, iters=8):
+    pre, stage, post, shared, stages, batch = build(vocab_head)
+    mesh = Mesh(np.array(jax.devices()[:PP]), ("pp",))
+    sspec = {k: P() for k in shared}
+    stspec = {"w": P("pp", None, None), "b": P("pp", None)}
+    bspec = {"tok": P(), "tgt": P()}
+
+    def run(sh, st, b):
+        loss, (g_sh, g_st) = pipelined_fwd_bwd(pre, stage, post, sh, st, b,
+                                               num_chunks=1, axis_name="pp")
+        g_sh = jax.tree.map(lambda g: jax.lax.psum(g, "pp"), g_sh)
+        return loss, (g_sh, g_st)
+
+    f = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(sspec, stspec, bspec),
+        out_specs=(P(), (sspec, stspec)), check_vma=False,
+    ))
+    out = f(shared, stages, batch)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(shared, stages, batch)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def time_head_alone(iters=8):
+    """M x (one head fwd+bwd + grad accumulation) — the per-microbatch
+    head work the reference's last rank pays: the loss fwd/bwd plus the
+    wgrad accumulate into the persistent main_grad buffer
+    (fused_weight_gradient_dense.cpp:19)."""
+    pre, stage, post, shared, stages, batch = build(True)
+    h = jnp.ones((MB, S, H), jnp.float32)
+    mb0 = jax.tree.map(lambda a: a[0], batch)
+
+    def one(e, g):
+        loss, vjp = jax.vjp(lambda e: post({"embed": e}, h, mb0), e)
+        return loss, g + vjp(jnp.float32(1.0))[0]
+
+    f = jax.jit(one, donate_argnums=(1,))
+    g = jnp.zeros_like(shared["embed"])
+    loss, g = f(shared["embed"], g)
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, g = f(shared["embed"], g)
+    jax.block_until_ready(g)
+    return (time.perf_counter() - t0) / iters * 1e3 * M
+
+
+def main():
+    t_head = time_head_alone()
+    t_nohead = time_schedule(False)
+    t_full = time_schedule(True)
+    overhead = (t_full - t_nohead - t_head) / t_full
+    print(f"P={PP} M={M} MB={MB} S={S} H={H} V={V} (CPU mesh)")
+    print(f"t_full    {t_full:8.1f} ms/step")
+    print(f"t_nohead  {t_nohead:8.1f} ms/step")
+    print(f"t_head    {t_head:8.1f} ms/step (M x single head fwd+bwd)")
+    print(f"post_overhead = (t_full - t_nohead - t_head)/t_full = {overhead:+.1%}")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,9 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     get_forward_backward_func,
     pipelined_apply,
 )
+from apex_tpu.transformer.pipeline_parallel.schedules.tick_schedule import (
+    pipelined_fwd_bwd,
+)
 
 PP = 4
 L = 8  # total layers, 2 per stage
@@ -253,10 +256,15 @@ class TestMemoryBound:
     """
 
     @pytest.mark.slow
-    def test_peak_buffer_flat_in_microbatches(self, devices8):
+    @pytest.mark.parametrize("vpp", [1, 2])
+    def test_peak_buffer_flat_in_microbatches(self, devices8, vpp):
         import re
 
-        H2, L2, MB2, PP2 = 128, 8, 8, 4
+        # Hin != Hact: a leaked activation buffer is f32[M, MB2, Hact],
+        # which can NOT alias the batch input f32[M, MB2, Hin] — the
+        # round-2 version used one width and was blind to an xbuf that
+        # regressed to n_slots == M slots.
+        Hin, Hact, L2, MB2, PP2 = 96, 128, 8, 8, 4
 
         def pre2(shared, mb):
             return jnp.tanh(mb["x"] @ shared["w_in"])
@@ -270,30 +278,40 @@ class TestMemoryBound:
         def post2(shared, h, mb):
             return jnp.mean((h @ shared["w_out"] - mb["y"]) ** 2)
 
-        def largest_buffer_bytes(M):
+        def offending_buffers(M, vpp=1):
             rng = np.random.RandomState(0)
             shared = {
-                "w_in": jnp.asarray(rng.randn(H2, H2).astype(np.float32)),
-                "w_out": jnp.asarray(rng.randn(H2).astype(np.float32)),
+                "w_in": jnp.asarray(rng.randn(Hin, Hact).astype(np.float32)),
+                "w_out": jnp.asarray(rng.randn(Hact).astype(np.float32)),
             }
             stages = {
-                "w": jnp.asarray(rng.randn(L2, H2, H2).astype(np.float32) * 0.3),
-                "b": jnp.zeros((L2, H2), np.float32),
+                "w": jnp.asarray(rng.randn(L2, Hact, Hact).astype(np.float32) * 0.3),
+                "b": jnp.zeros((L2, Hact), np.float32),
             }
             batch = {
-                "x": jnp.asarray(rng.randn(M, MB2, H2).astype(np.float32)),
+                "x": jnp.asarray(rng.randn(M, MB2, Hin).astype(np.float32)),
                 "y": jnp.asarray(rng.randn(M, MB2).astype(np.float32)),
             }
             mesh = Mesh(np.array(jax.devices()[:PP2]), ("pp",))
             sspec = {"w_in": P(), "w_out": P()}
             stspec = {"w": P("pp", None, None), "b": P("pp", None)}
             bspec = {"x": P(), "y": P()}
+            if vpp == 1:
+                def run(sh, st, b):
+                    return forward_backward_pipelining_without_interleaving(
+                        pre2, stage2, post2, sh, st, b, axis_name="pp"
+                    )
+            else:
+                def run(sh, st, b):
+                    loss, (g_sh, g_st) = pipelined_fwd_bwd(
+                        pre2, stage2, post2, sh, st, b,
+                        num_chunks=vpp, axis_name="pp",
+                    )
+                    g_sh = jax.tree.map(lambda g: jax.lax.psum(g, "pp"), g_sh)
+                    return loss, (g_sh, g_st)
             f = jax.jit(
                 jax.shard_map(
-                    lambda sh, st, b: forward_backward_pipelining_without_interleaving(
-                        pre2, stage2, post2, sh, st, b, axis_name="pp"
-                    ),
-                    mesh=mesh,
+                    run, mesh=mesh,
                     in_specs=(sspec, stspec, bspec),
                     out_specs=(P(), (sspec, stspec)),
                     check_vma=False,
@@ -302,17 +320,17 @@ class TestMemoryBound:
             txt = f.lower(shared, stages, batch).compile().as_text()
             # the only tensors allowed to scale with M are the microbatch
             # inputs themselves; any other f32 buffer whose leading dim
-            # falls in the per-microbatch window [M, M+P) is a
+            # falls in the per-microbatch window [M, M+vpp·P) is a
             # GPipe-style residual leak (T = M+P-1 tick-stacked
             # residuals being the round-1 failure mode). M is chosen so
-            # the window can't collide with model dims (L2=8, H2=128).
-            inputs = {(M, MB2, H2), (M, MB2)}
+            # the window can't collide with model dims (L2=8, H=96/128).
+            inputs = {(M, MB2, Hin), (M, MB2)}
             offending = set()
             for mo in re.finditer(r"f32\[([0-9,]+)\]", txt):
                 dims = tuple(int(d) for d in mo.group(1).split(","))
-                if M <= dims[0] < M + PP2 and dims not in inputs:
+                if M <= dims[0] < M + vpp * PP2 and dims not in inputs:
                     offending.add(dims)
             return offending
 
         for M in (24, 48):
-            assert not largest_buffer_bytes(M), (M, largest_buffer_bytes(M))
+            assert not offending_buffers(M, vpp=vpp), (M, vpp, offending_buffers(M, vpp=vpp))
