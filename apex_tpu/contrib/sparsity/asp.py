@@ -49,13 +49,30 @@ def _eligible(path: str, w) -> bool:
     return w.shape[-1] % 4 == 0
 
 
-def compute_sparse_masks(params, eligible: Callable = _eligible):
-    """Boolean mask pytree (True = keep); ineligible leaves get None."""
+def compute_sparse_masks(params, eligible: Callable = _eligible,
+                         permutation_search: bool = False):
+    """Boolean mask pytree (True = keep); ineligible leaves get None.
+
+    ``permutation_search=True`` runs the greedy channel-permutation
+    search per eligible weight (reference ``permutation_lib.py``) and
+    returns masks that retain at least as much magnitude as the naive
+    2:4 masks — the accuracy-preserving half of ASP."""
     flat = jax.tree_util.tree_flatten_with_path(params)
     masks = []
     for kp, w in flat[0]:
         path = jax.tree_util.keystr(kp)
-        masks.append(m4n2_mask(w) if eligible(path, w) else None)
+        if not eligible(path, w):
+            masks.append(None)
+        elif permutation_search:
+            from apex_tpu.contrib.sparsity.permutation_lib import (
+                permuted_m4n2_mask,
+                search_channel_permutation,
+            )
+
+            perm, _, _ = search_channel_permutation(w)
+            masks.append(permuted_m4n2_mask(w, perm))
+        else:
+            masks.append(m4n2_mask(w))
     return jax.tree_util.tree_unflatten(flat[1], masks)
 
 

@@ -1,0 +1,127 @@
+"""Channel-permutation search for 2:4 structured sparsity.
+
+Reference: ``apex/contrib/sparsity/permutation_lib.py`` +
+``permutation_search_kernels/channel_swap.py`` — the accuracy-preserving
+half of ASP: permuting a weight's input channels before applying the
+2-of-4 magnitude mask regroups correlated channels so the mask retains
+more magnitude.  The reference searches with CUDA kernels over a torch
+fx graph and physically permutes the model (compensating in neighbor
+layers).
+
+TPU redesign: the MXU executes dense, so the 2:4 pattern never needs to
+be *physically* contiguous — what transfers is mask quality.  The search
+therefore stays functional: find a permutation ``perm`` maximizing the
+magnitude retained by a 2:4 mask on ``w[:, perm]``, then map the mask
+back to the original column order (``mask = mask_perm[:, argsort(perm)]``).
+Weights never move, neighbors never compensate, and the masked model is
+numerically identical to the physically-permuted one the reference
+builds.
+
+Search = the reference's greedy channel-swap strategy
+(``channel_swap.py``: build the improvement map for all column pairs,
+apply the best positive swap, repeat until convergence), with the
+improvement map computed as one vectorized JAX evaluation over all
+(column, column) pairs instead of a CUDA kernel per stripe pair.
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sum_after_2_to_4(m: jnp.ndarray) -> jnp.ndarray:
+    """Magnitude retained by a 2-of-4 mask along the last dim
+    (reference permutation_utilities.py ``sum_after_2_to_4``)."""
+    g = jnp.abs(m.reshape(*m.shape[:-1], m.shape[-1] // 4, 4))
+    return jnp.sum(jnp.sort(g, axis=-1)[..., 2:])
+
+
+def _stripe_mags(m: jnp.ndarray) -> jnp.ndarray:
+    """Per-stripe retained magnitude: (C/4,) for m (R, C)."""
+    R, C = m.shape
+    g = jnp.abs(m.reshape(R, C // 4, 4))
+    return jnp.sum(jnp.sort(g, axis=-1)[..., 2:], axis=(0, 2))
+
+
+@partial(jax.jit, static_argnames=())
+def _swap_improvements(m: jnp.ndarray) -> jnp.ndarray:
+    """(C, C) matrix of retained-magnitude improvement for swapping
+    columns a and b (0 where a, b share a stripe — a no-op for the mask).
+
+    Vectorized form of the reference's swap map
+    (channel_swap.py ``compute_swap_map``): for the pair (a, b), only
+    stripes a//4 and b//4 change; evaluate both 4-wide stripes with the
+    swapped column patched in.
+    """
+    R, C = m.shape
+    S = C // 4
+    base = _stripe_mags(m)  # (S,)
+
+    stripes = m.reshape(R, S, 4)
+
+    def one_pair(a, b):
+        sa, ia = a // 4, a % 4
+        sb, ib = b // 4, b % 4
+        col_a = m[:, a]
+        col_b = m[:, b]
+        new_sa = jax.lax.dynamic_update_index_in_dim(
+            stripes[:, sa, :], col_b, ia, axis=1
+        )
+        new_sb = jax.lax.dynamic_update_index_in_dim(
+            stripes[:, sb, :], col_a, ib, axis=1
+        )
+        mag = lambda s: jnp.sum(jnp.sort(jnp.abs(s), axis=-1)[..., 2:])
+        improvement = mag(new_sa) + mag(new_sb) - base[sa] - base[sb]
+        return jnp.where(sa == sb, 0.0, improvement)
+
+    cols = jnp.arange(C)
+    return jax.vmap(lambda a: jax.vmap(lambda b: one_pair(a, b))(cols))(cols)
+
+
+def search_channel_permutation(
+    w, max_swaps: int = 0, tol: float = 1e-6
+) -> Tuple[np.ndarray, float, float]:
+    """Greedy channel-swap search (reference channel_swap.py).
+
+    ``w``: (..., C) weight, pruned along the last dim; leading dims are
+    flattened into rows.  Returns ``(perm, base_mag, best_mag)`` with
+    ``sum_after_2_to_4(w[..., perm]) == best_mag >= base_mag``.
+
+    ``max_swaps`` bounds the greedy iterations (0 = until convergence,
+    capped at 4·C — each swap must improve, so convergence is
+    guaranteed; the cap is a safety net against fp ties).
+    """
+    m = np.asarray(w, np.float32).reshape(-1, w.shape[-1])
+    C = m.shape[1]
+    if C % 4:
+        raise ValueError(f"channel count {C} must be divisible by 4")
+    perm = np.arange(C)
+    base = float(sum_after_2_to_4(jnp.asarray(m)))
+    limit = max_swaps if max_swaps > 0 else 4 * C
+
+    cur = m.copy()
+    for _ in range(limit):
+        imp = np.asarray(_swap_improvements(jnp.asarray(cur)))
+        a, b = np.unravel_index(np.argmax(imp), imp.shape)
+        if imp[a, b] <= tol:
+            break
+        cur[:, [a, b]] = cur[:, [b, a]]
+        perm[[a, b]] = perm[[b, a]]
+    best = float(sum_after_2_to_4(jnp.asarray(cur)))
+    return perm, base, best
+
+
+def permuted_m4n2_mask(w: jnp.ndarray, perm) -> jnp.ndarray:
+    """2-of-4 mask computed under ``perm`` and mapped back to the
+    original column order.  The mask is 2:4-structured in the permuted
+    domain (what sparse hardware would need) and strictly retains at
+    least as much magnitude as the naive mask in the original domain."""
+    from apex_tpu.contrib.sparsity.asp import m4n2_mask
+
+    perm = jnp.asarray(perm)
+    inv = jnp.argsort(perm)
+    mask_perm = m4n2_mask(w[..., perm])
+    return mask_perm[..., inv]
