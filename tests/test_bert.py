@@ -54,6 +54,7 @@ def test_padding_mask_blocks_attention(batch):
     )
 
 
+@pytest.mark.slow
 def test_tp_matches_single_device(batch, devices8):
     tokens, pad = batch
     params = init_params(CFG, jax.random.PRNGKey(0))
@@ -72,6 +73,7 @@ def test_tp_matches_single_device(batch, devices8):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_mlm_training_with_lamb_reduces_loss(batch):
     tokens, pad = batch
     params = init_params(CFG, jax.random.PRNGKey(0))

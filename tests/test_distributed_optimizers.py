@@ -55,6 +55,7 @@ def run_sharded(opt_cls, ref_opt, devices8, nsteps=4, seed=0, **kw):
 
 
 class TestDistributedFusedAdam:
+    @pytest.mark.slow
     def test_matches_fused_adam(self, devices8):
         ref = FusedAdam(lr=1e-2, weight_decay=0.01, adam_w_mode=True)
         params, ref_params = run_sharded(DistributedFusedAdam, ref, devices8)
@@ -109,6 +110,7 @@ class TestShardedStateDict:
             lambda x: jnp.asarray(rng.randn(*x.shape).astype(np.float32)), params
         )
 
+    @pytest.mark.slow
     def test_save_dp4_load_dp2_resumes_identically(self, devices8):
         params0 = make_tree(3)
         rng = np.random.RandomState(7)
@@ -160,6 +162,7 @@ class TestShardedStateDict:
                 [{**shards[0], "format": "bogus"}], world_size=2
             )
 
+    @pytest.mark.slow
     def test_zero_composed_with_tp_matches_fused_adam(self, devices8):
         """dp=4 x tp=2: params sharded over tp, ZeRO state over (tp, dp)."""
         rng = np.random.RandomState(11)
@@ -207,6 +210,7 @@ class DistributedFusedAdamStateStub:
 
 
 class TestDistributedFusedLAMB:
+    @pytest.mark.slow
     def test_matches_fused_lamb(self, devices8):
         ref = FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
         params, ref_params = run_sharded(

@@ -35,6 +35,7 @@ def test_forward_shapes(batch):
     assert logits.shape == (16, 2, CFG.vocab_size)
 
 
+@pytest.mark.slow
 def test_tp_matches_single_device(batch, devices8):
     params = init_params(CFG, jax.random.PRNGKey(0))
     ref = gpt_forward(params, batch, CFG)
@@ -53,6 +54,7 @@ def test_tp_matches_single_device(batch, devices8):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_tp_sp_matches_single_device(batch, devices8):
     cfg = GPTConfig(**{**CFG.__dict__, "sequence_parallel": True})
     params = init_params(cfg, jax.random.PRNGKey(0))
@@ -71,6 +73,7 @@ def test_tp_sp_matches_single_device(batch, devices8):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_tp_loss_and_grads_match(batch, devices8):
     params = init_params(CFG, jax.random.PRNGKey(0))
     targets = jnp.roll(batch, -1, axis=1)
@@ -98,6 +101,7 @@ def test_tp_loss_and_grads_match(batch, devices8):
         )
 
 
+@pytest.mark.slow
 def test_tp_sp_grads_match_after_sync(batch, devices8):
     """SP-mode grads (with the sequence-parallel psum) must equal the
     single-device grads — the SP analog of the reference's

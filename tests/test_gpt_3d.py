@@ -17,6 +17,9 @@ from apex_tpu.models.gpt import (
 )
 from apex_tpu.optimizers import FusedAdam
 
+# whole-file e2e/parity workloads: >20 s compiled (quick tier skips)
+pytestmark = pytest.mark.slow
+
 CFG = GPTConfig(
     vocab_size=64,
     hidden_size=32,

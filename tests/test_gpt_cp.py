@@ -56,6 +56,7 @@ def test_cp_forward_matches_single_device(devices8):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_cp_train_step_matches_single_device(devices8):
     """cp=2 × dp=2 × tp=2 full train step == single-device step."""
     cfg = GPTConfig(**BASE)

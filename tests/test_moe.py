@@ -17,6 +17,9 @@ from apex_tpu.transformer.expert_parallel import (
     moe_init,
 )
 
+# whole-file e2e/parity workloads: >20 s compiled (quick tier skips)
+pytestmark = pytest.mark.slow
+
 EP = 4
 
 

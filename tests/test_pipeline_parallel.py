@@ -119,6 +119,7 @@ class TestPipelineParity:
         )(shared, stages, batch)
         np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_oracle(self, devices8):
         shared, stages, batch = make_problem(1)
         ref_loss, (ref_gs, ref_gst) = jax.value_and_grad(oracle_loss, argnums=(0, 1))(
@@ -155,6 +156,7 @@ class TestInterleaved:
     loss/grads as the flat model (reference
     fwd_bwd_pipelining_with_interleaving.py semantics)."""
 
+    @pytest.mark.slow
     def test_interleaved_matches_oracle(self, devices8):
         from apex_tpu.transformer.pipeline_parallel.schedules import (
             forward_backward_pipelining_with_interleaving,

@@ -9,8 +9,12 @@ from pathlib import Path
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu.models.resnet import ResNet18ish, ResNet50
+
+# whole-file e2e/parity workloads: >20 s compiled (quick tier skips)
+pytestmark = pytest.mark.slow
 
 REPO = Path(__file__).resolve().parents[1]
 
