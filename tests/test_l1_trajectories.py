@@ -1,0 +1,189 @@
+"""L1-style trajectory cross-product harness.
+
+Mirrors ``tests/L1/common/run_test.sh:28-50`` + ``compare.py``: train the
+same model under the cross product of opt-level × loss-scale ×
+half-dtype, record the per-iteration loss trajectory, and assert the
+trajectory is identical between two execution modes of the same
+numerics.  The reference's two modes are two launch styles of the same
+DDP run; the TPU analog is single-device vs dp=4 ``shard_map`` over the
+same global batch (sync-BN statistics, pmean'd grads) — numerically the
+same training run, so trajectories must agree to reduction-order noise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedSGD
+
+pytestmark = pytest.mark.slow
+
+STEPS = 6
+BATCH = 16
+IMG = 8
+
+
+def init_params(rng):
+    return {
+        "conv": jnp.asarray(rng.randn(3, 3, 3, 8).astype(np.float32) * 0.2),
+        "bn_scale": jnp.ones((8,), jnp.float32),
+        "bn_bias": jnp.zeros((8,), jnp.float32),
+        "dense": jnp.asarray(rng.randn(8, 10).astype(np.float32) * 0.2),
+        "dense_b": jnp.zeros((10,), jnp.float32),
+    }
+
+
+def forward(params, x, axis_name=None):
+    """Conv → (sync)BN → relu → mean-pool → dense, computed in the dtype
+    amp cast the params to."""
+    dt = params["conv"].dtype
+    h = jax.lax.conv_general_dilated(
+        x.astype(dt), params["conv"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    hf = h.astype(jnp.float32)
+    mean = jnp.mean(hf, axis=(0, 1, 2))
+    sq = jnp.mean(hf * hf, axis=(0, 1, 2))
+    if axis_name is not None:  # sync-BN statistics over dp
+        mean = jax.lax.pmean(mean, axis_name)
+        sq = jax.lax.pmean(sq, axis_name)
+    var = sq - mean * mean
+    hn = (hf - mean) / jnp.sqrt(var + 1e-5)
+    hn = hn * params["bn_scale"].astype(jnp.float32) + params["bn_bias"].astype(jnp.float32)
+    h = jax.nn.relu(hn).astype(dt)
+    pooled = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+    logits = pooled @ params["dense"].astype(jnp.float32) + params["dense_b"].astype(jnp.float32)
+    return logits
+
+
+def make_batches(seed=0):
+    """One fixed labeled batch reused every step (so the loss trajectory
+    is monotone-ish and the 'it actually trains' assertion is meaningful)."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(BATCH, IMG, IMG, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=(BATCH,))
+    xs = np.broadcast_to(x, (STEPS, *x.shape)).copy()
+    ys = np.broadcast_to(y, (STEPS, *y.shape)).copy()
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+def run_trajectory(opt_level, loss_scale, half_dtype, dp, devices8=None):
+    rng = np.random.RandomState(1)
+    params0 = init_params(rng)
+    params, amp_obj = amp.initialize(
+        params0, opt_level=opt_level, half_dtype=half_dtype, loss_scale=loss_scale
+    )
+    opt = FusedSGD(lr=0.05, momentum=0.9, master_weights=True)
+    opt_state = opt.init(params)
+    scaler_state = amp_obj.init_state()
+    xs, ys = make_batches()
+
+    def loss_fn(params, x, y, axis_name=None):
+        logits = forward(params, x, axis_name)
+        onehot = jax.nn.one_hot(y, 10)
+        loss = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        if axis_name is not None:
+            loss = jax.lax.pmean(loss, axis_name)
+        return loss
+
+    losses = []
+    if dp == 1:
+        amp_vg = amp.value_and_grad(amp_obj, loss_fn)
+
+        @jax.jit
+        def step(params, opt_state, scaler_state, x, y):
+            loss, grads, scaler_state, finite = amp_vg(params, scaler_state, x, y)
+            params, opt_state = opt.update(grads, opt_state, params, grads_finite=finite)
+            return params, opt_state, scaler_state, loss
+
+        for i in range(STEPS):
+            params, opt_state, scaler_state, loss = step(params, opt_state, scaler_state, xs[i], ys[i])
+            losses.append(float(loss))
+    else:
+        mesh = Mesh(np.array(devices8[:dp]), ("dp",))
+        amp_vg = amp.value_and_grad(
+            amp_obj, lambda p, x, y: loss_fn(p, x, y, axis_name="dp")
+        )
+
+        def local(params, opt_state, scaler_state, x, y):
+            loss, grads, scaler_state, finite = amp_vg(params, scaler_state, x, y)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+            finite = jnp.logical_and(jax.lax.pmin(finite.astype(jnp.int32), "dp"), 1).astype(bool) if finite is not None else None
+            params, opt_state = opt.update(grads, opt_state, params, grads_finite=finite)
+            return params, opt_state, scaler_state, loss
+
+        step = jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        ))
+        for i in range(STEPS):
+            params, opt_state, scaler_state, loss = step(params, opt_state, scaler_state, xs[i], ys[i])
+            losses.append(float(loss))
+    return np.asarray(losses), params
+
+
+CONFIGS = [
+    # (opt_level, loss_scale, half_dtype, rtol)
+    ("O0", None, None, 1e-5),
+    ("O1", None, jnp.bfloat16, 2e-3),
+    ("O1", "dynamic", jnp.float16, 2e-3),
+    ("O2", None, jnp.bfloat16, 2e-3),
+    ("O2", 128.0, jnp.float16, 2e-3),
+    ("O2", "dynamic", jnp.float16, 2e-3),
+    ("O3", None, jnp.bfloat16, 4e-3),
+    ("O3", 128.0, jnp.float16, 4e-3),
+]
+
+
+class TestL1TrajectoryCrossProduct:
+    @pytest.mark.parametrize("opt_level,loss_scale,half_dtype,rtol", CONFIGS)
+    def test_single_vs_dp_trajectory(self, opt_level, loss_scale, half_dtype, rtol, devices8):
+        """compare.py's assertion: same config, two execution modes,
+        same per-iteration loss trajectory."""
+        single, _ = run_trajectory(opt_level, loss_scale, half_dtype, dp=1)
+        sharded, _ = run_trajectory(opt_level, loss_scale, half_dtype, dp=4, devices8=devices8)
+        np.testing.assert_allclose(single, sharded, rtol=rtol, atol=rtol)
+        # the run must actually train
+        assert single[-1] < single[0], single
+
+    def test_keep_batchnorm_fp32_by_level(self):
+        """O2 keeps norm params fp32; O3 casts everything (the
+        keep-batchnorm axis of the reference cross product)."""
+        params0 = init_params(np.random.RandomState(0))
+        p2, _ = amp.initialize(params0, opt_level="O2", half_dtype=jnp.bfloat16)
+        p3, _ = amp.initialize(params0, opt_level="O3", half_dtype=jnp.bfloat16)
+        assert p2["bn_scale"].dtype == jnp.float32
+        assert p2["conv"].dtype == jnp.bfloat16
+        assert p3["bn_scale"].dtype == jnp.bfloat16
+
+    def test_o0_matches_plain_fp32_training(self, devices8):
+        """O0 is a no-op policy: identical to un-amp'd training."""
+        o0, _ = run_trajectory("O0", None, None, dp=1)
+
+        rng = np.random.RandomState(1)
+        params = init_params(rng)
+        opt = FusedSGD(lr=0.05, momentum=0.9, master_weights=True)
+        opt_state = opt.init(params)
+        xs, ys = make_batches()
+
+        @jax.jit
+        def step(params, opt_state, x, y):
+            def lf(p):
+                logits = forward(p, x)
+                onehot = jax.nn.one_hot(y, 10)
+                return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+            loss, grads = jax.value_and_grad(lf)(params)
+            params, opt_state = opt.update(grads, opt_state, params)
+            return params, opt_state, loss
+
+        plain = []
+        for i in range(STEPS):
+            params, opt_state, loss = step(params, opt_state, xs[i], ys[i])
+            plain.append(float(loss))
+        np.testing.assert_allclose(o0, np.asarray(plain), rtol=1e-6)
