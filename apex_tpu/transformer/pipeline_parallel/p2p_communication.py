@@ -54,13 +54,25 @@ def send_backward(g, axis_name: str = PIPELINE_AXIS):
     return send_backward_recv_backward(g, axis_name)
 
 
-def send_forward_recv_backward(x, axis_name: str = PIPELINE_AXIS):
-    """In the reference (:490) this is one fused NCCL op used in the 1F1B
-    steady state; under XLA the two shifts are independent collectives the
-    scheduler may overlap, so this returns the forward shift (backward
-    values travel in the autodiff graph)."""
-    return send_forward_recv_forward(x, axis_name)
+def send_forward_recv_backward(x, grad, axis_name: str = PIPELINE_AXIS):
+    """The 1F1B steady-state exchange (reference :490): send this
+    stage's activation forward while sending the cotangent backward, as
+    one fused step.  Both ``ppermute``s are issued in the same program
+    point so XLA schedules them as a bidirectional neighbor exchange
+    over ICI (the pattern the reference builds with one batched
+    ``batch_isend_irecv``).
+
+    Returns ``(x_from_prev, grad_from_next)``.
+    """
+    return (
+        jax.lax.ppermute(x, axis_name, _ring(axis_name, +1)),
+        jax.lax.ppermute(grad, axis_name, _ring(axis_name, -1)),
+    )
 
 
-def send_backward_recv_forward(g, axis_name: str = PIPELINE_AXIS):
-    return send_backward_recv_backward(g, axis_name)
+def send_backward_recv_forward(grad, x, axis_name: str = PIPELINE_AXIS):
+    """Mirror of :func:`send_forward_recv_backward` (reference :521):
+    cotangent travels backward, activation forward.  Returns
+    ``(grad_from_next, x_from_prev)``."""
+    x_prev, g_next = send_forward_recv_backward(x, grad, axis_name)
+    return g_next, x_prev

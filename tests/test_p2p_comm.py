@@ -1,0 +1,96 @@
+"""Pipeline p2p primitives (mirrors apex tests/L0/run_transformer/
+test_p2p_comm.py): ring shifts route stage data correctly, the fused
+bidirectional exchange equals its two halves, and autodiff transposes a
+shift into the inverse shift."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+
+PP = 4
+
+
+def pp_mesh(devices8):
+    return Mesh(np.array(devices8[:PP]), ("pp",))
+
+
+def stage_data():
+    # stage s holds the row [s, s, s]
+    return jnp.repeat(jnp.arange(float(PP))[:, None], 3, axis=1)
+
+
+class TestShifts:
+    def test_send_forward_routes_to_next_stage(self, devices8):
+        out = jax.shard_map(
+            lambda x: p2p.send_forward(x, "pp"),
+            mesh=pp_mesh(devices8), in_specs=P("pp"), out_specs=P("pp"),
+            check_vma=False,
+        )(stage_data())
+        # stage s now holds what stage s-1 had (ring wraparound at 0)
+        np.testing.assert_array_equal(np.asarray(out)[:, 0], [PP - 1, 0, 1, 2])
+
+    def test_send_backward_routes_to_prev_stage(self, devices8):
+        out = jax.shard_map(
+            lambda g: p2p.send_backward(g, "pp"),
+            mesh=pp_mesh(devices8), in_specs=P("pp"), out_specs=P("pp"),
+            check_vma=False,
+        )(stage_data())
+        np.testing.assert_array_equal(np.asarray(out)[:, 0], [1, 2, 3, 0])
+
+    def test_fused_exchange_matches_two_shifts(self, devices8):
+        x = stage_data()
+        g = stage_data() * 10.0
+
+        def fused(x, g):
+            return p2p.send_forward_recv_backward(x, g, "pp")
+
+        xf, gb = jax.shard_map(
+            fused, mesh=pp_mesh(devices8),
+            in_specs=(P("pp"), P("pp")), out_specs=(P("pp"), P("pp")),
+            check_vma=False,
+        )(x, g)
+        xf_ref = jax.shard_map(
+            lambda x: p2p.send_forward(x, "pp"), mesh=pp_mesh(devices8),
+            in_specs=P("pp"), out_specs=P("pp"), check_vma=False,
+        )(x)
+        gb_ref = jax.shard_map(
+            lambda g: p2p.send_backward(g, "pp"), mesh=pp_mesh(devices8),
+            in_specs=P("pp"), out_specs=P("pp"), check_vma=False,
+        )(g)
+        np.testing.assert_array_equal(np.asarray(xf), np.asarray(xf_ref))
+        np.testing.assert_array_equal(np.asarray(gb), np.asarray(gb_ref))
+
+    def test_mirror_exchange_argument_order(self, devices8):
+        x = stage_data()
+        g = stage_data() * 10.0
+        gb, xf = jax.shard_map(
+            lambda g, x: p2p.send_backward_recv_forward(g, x, "pp"),
+            mesh=pp_mesh(devices8),
+            in_specs=(P("pp"), P("pp")), out_specs=(P("pp"), P("pp")),
+            check_vma=False,
+        )(g, x)
+        np.testing.assert_array_equal(np.asarray(xf)[:, 0], [PP - 1, 0, 1, 2])
+        np.testing.assert_array_equal(np.asarray(gb)[:, 0], [10, 20, 30, 0])
+
+    def test_forward_shift_transposes_to_backward_shift(self, devices8):
+        """ppermute's vjp is the inverse permutation — the correct
+        backward-communication pairing for pipeline autodiff."""
+        x = stage_data()
+
+        def loss(x):
+            y = p2p.send_forward(x, "pp")
+            # weight stage s's received value by (s+1); per-device loss —
+            # the cotangent rides the inverse ppermute back to the sender
+            s = jax.lax.axis_index("pp").astype(jnp.float32)
+            return jnp.sum(y * (s + 1.0))
+
+        g = jax.shard_map(
+            jax.grad(loss), mesh=pp_mesh(devices8),
+            in_specs=P("pp"), out_specs=P("pp"), check_vma=False,
+        )(x)
+        # d loss/d x[s] = weight of the stage that received x[s] = s+2 (mod ring)
+        np.testing.assert_array_equal(np.asarray(g)[:, 0], [2, 3, 4, 1])
