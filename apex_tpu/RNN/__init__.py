@@ -1,46 +1,54 @@
 """RNN (deprecated in the reference: ``apex/RNN`` — fp16-able
-RNN/LSTM/GRU reimplementations from the pre-amp era).
+RNN/LSTM/GRU/mLSTM reimplementations from the pre-amp era).
 
-On TPU use ``flax.linen`` recurrent cells under ``nn.scan``; thin
-factories with the reference's names are provided for discovery.
+Real scan-based implementations live in :mod:`apex_tpu.RNN.backend`;
+the factories here mirror ``apex/RNN/models.py:21-49`` signatures.
+They emit the same deprecation warning the reference does.
 """
 
 import warnings
 
-import flax.linen as nn
+from apex_tpu.RNN.backend import RNNBackend
 
 
 def _deprecated(name):
     warnings.warn(
-        f"apex_tpu.RNN.{name} mirrors the deprecated apex.RNN API; prefer "
-        "flax.linen recurrent cells directly",
+        f"apex_tpu.RNN.{name} mirrors the deprecated apex.RNN API "
+        "(apex removed it in 2023); kept for parity",
         DeprecationWarning,
         stacklevel=3,
     )
 
 
+def _make(kind, input_size, hidden_size, num_layers=1, bias=True,
+          batch_first=False, dropout=0, bidirectional=False, output_size=None):
+    if batch_first:
+        raise NotImplementedError("seq-first (T, B, F) only, like the reference")
+    return RNNBackend(kind, input_size, hidden_size, num_layers=num_layers,
+                      bias=bias, bidirectional=bidirectional, dropout=dropout,
+                      output_size=output_size)
+
+
 def LSTM(input_size, hidden_size, num_layers=1, **kw):
     _deprecated("LSTM")
-    return nn.RNN(nn.LSTMCell(features=hidden_size))
+    return _make("lstm", input_size, hidden_size, num_layers, **kw)
 
 
 def GRU(input_size, hidden_size, num_layers=1, **kw):
     _deprecated("GRU")
-    return nn.RNN(nn.GRUCell(features=hidden_size))
+    return _make("gru", input_size, hidden_size, num_layers, **kw)
 
 
 def ReLU(input_size, hidden_size, num_layers=1, **kw):
     _deprecated("ReLU")
-    return nn.RNN(nn.SimpleCell(features=hidden_size, activation_fn=nn.relu))
+    return _make("relu", input_size, hidden_size, num_layers, **kw)
 
 
 def Tanh(input_size, hidden_size, num_layers=1, **kw):
     _deprecated("Tanh")
-    return nn.RNN(nn.SimpleCell(features=hidden_size))
+    return _make("tanh", input_size, hidden_size, num_layers, **kw)
 
 
 def mLSTM(input_size, hidden_size, num_layers=1, **kw):
-    raise NotImplementedError(
-        "mLSTM (multiplicative LSTM) was deprecated in the reference; "
-        "no TPU port is provided"
-    )
+    _deprecated("mLSTM")
+    return _make("mlstm", input_size, hidden_size, num_layers, **kw)

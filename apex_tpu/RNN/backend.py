@@ -1,0 +1,208 @@
+"""Functional RNN backend (LSTM/GRU/ReLU/Tanh/mLSTM, stacked + bidirectional).
+
+Reference: ``apex/RNN/RNNBackend.py`` (``stackedRNN`` :90,
+``bidirectionalRNN`` :25, ``RNNCell`` :232) and ``apex/RNN/cells.py``
+(``mLSTMRNNCell``/``mLSTMCell``) — fp16-able pure-PyTorch RNNs from the
+pre-amp era, kept for API parity.
+
+TPU form: pure functions.  The time loop is one ``lax.scan`` per layer
+(static shapes, fused pointwise gate math — the role of the reference's
+``rnnFusedPointwise`` kernels falls out of XLA fusion), layers stack in
+a Python loop, and the bidirectional variant runs the reverse stack on
+``x[::-1]``.  Gate orders and formulas match ``torch.nn`` exactly so
+parity tests can load identical weights.
+
+Layout is seq-first ``(T, B, F)`` like the reference.
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sigmoid = jax.nn.sigmoid
+
+
+# ------------------------------------------------------------------ cells
+def lstm_cell(p, x, hidden):
+    """torch.nn.LSTMCell: gates i,f,g,o."""
+    h, c = hidden
+    gates = x @ p["w_ih"].T + h @ p["w_hh"].T
+    if "b_ih" in p:
+        gates = gates + p["b_ih"] + p["b_hh"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    cy = sigmoid(f) * c + sigmoid(i) * jnp.tanh(g)
+    hy = sigmoid(o) * jnp.tanh(cy)
+    return (hy, cy)
+
+
+def gru_cell(p, x, hidden):
+    """torch.nn.GRUCell: gates r,z,n with the r-gated hidden branch."""
+    (h,) = hidden
+    gi = x @ p["w_ih"].T
+    gh = h @ p["w_hh"].T
+    if "b_ih" in p:
+        gi = gi + p["b_ih"]
+        gh = gh + p["b_hh"]
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = sigmoid(i_r + h_r)
+    z = sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return ((1.0 - z) * n + z * h,)
+
+
+def _simple_cell(act):
+    def cell(p, x, hidden):
+        (h,) = hidden
+        g = x @ p["w_ih"].T + h @ p["w_hh"].T
+        if "b_ih" in p:
+            g = g + p["b_ih"] + p["b_hh"]
+        return (act(g),)
+
+    return cell
+
+
+relu_cell = _simple_cell(jax.nn.relu)
+tanh_cell = _simple_cell(jnp.tanh)
+
+
+def mlstm_cell(p, x, hidden):
+    """Multiplicative LSTM (reference cells.py ``mLSTMCell``):
+    m = (x·Wmihᵀ) ∘ (h·Wmhhᵀ); LSTM gates over (x, m)."""
+    h, c = hidden
+    m = (x @ p["w_mih"].T) * (h @ p["w_mhh"].T)
+    gates = x @ p["w_ih"].T + m @ p["w_hh"].T
+    if "b_ih" in p:
+        gates = gates + p["b_ih"] + p["b_hh"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    cy = sigmoid(f) * c + sigmoid(i) * jnp.tanh(g)
+    hy = sigmoid(o) * jnp.tanh(cy)
+    return (hy, cy)
+
+
+_CELLS = {
+    "lstm": (lstm_cell, 4, 2),
+    "gru": (gru_cell, 3, 1),
+    "relu": (relu_cell, 1, 1),
+    "tanh": (tanh_cell, 1, 1),
+    "mlstm": (mlstm_cell, 4, 2),
+}
+
+
+# ------------------------------------------------------------- the backend
+class RNNBackend:
+    """Stacked (optionally bidirectional) RNN over one of the cells.
+
+    Functional flax-style usage::
+
+        rnn = LSTM(input_size, hidden_size, num_layers, bidirectional=True)
+        params = rnn.init(jax.random.PRNGKey(0))
+        out, hiddens = rnn.apply(params, x)        # x: (T, B, input_size)
+
+    ``out`` is ``(T, B, D·out_size)`` (D = 2 if bidirectional); ``hiddens``
+    is a tuple of per-state arrays ``(num_layers, B, D·hidden)`` — h (and c
+    for LSTM kinds), matching the reference's collect order.
+    ``collect_hidden=True`` returns every timestep's states
+    ``(T, num_layers, B, D·hidden)`` (reference ``collect_hidden``).
+    """
+
+    def __init__(self, kind: str, input_size: int, hidden_size: int,
+                 num_layers: int = 1, bias: bool = True,
+                 bidirectional: bool = False, dropout: float = 0.0,
+                 output_size: Optional[int] = None):
+        if dropout:
+            raise NotImplementedError(
+                "inter-layer dropout needs an rng; pass dropout=0 and apply "
+                "dropout outside (the reference defaults to 0 as well)"
+            )
+        self.kind = kind
+        self.cell, self.gate_mult, self.n_states = _CELLS[kind]
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bias = bias
+        self.bidirectional = bidirectional
+        self.output_size = output_size if output_size is not None else hidden_size
+
+    # -------------------------------------------------------------- params
+    def _init_layer(self, key, in_size) -> Dict[str, Any]:
+        H, G = self.hidden_size, self.gate_mult
+        k = 1.0 / math.sqrt(H)
+        keys = jax.random.split(key, 6)
+        u = lambda kk, *s: jax.random.uniform(kk, s, jnp.float32, -k, k)
+        p = {"w_ih": u(keys[0], G * H, in_size), "w_hh": u(keys[1], G * H, self.output_size)}
+        if self.bias:
+            p["b_ih"] = u(keys[2], G * H)
+            p["b_hh"] = u(keys[3], G * H)
+        if self.kind == "mlstm":
+            p["w_mih"] = u(keys[4], self.output_size, in_size)
+            p["w_mhh"] = u(keys[5], self.output_size, self.output_size)
+        if self.output_size != self.hidden_size:
+            p["w_ho"] = u(keys[4 if self.kind != "mlstm" else 5], self.output_size, H)
+        return p
+
+    def init(self, key) -> List:
+        """Layer list (doubled pairwise for bidirectional: [fwd, bwd] per
+        stack, reference bidirectionalRNN builds two stackedRNNs)."""
+        dirs = 2 if self.bidirectional else 1
+        keys = jax.random.split(key, self.num_layers * dirs)
+        params = []
+        for d in range(dirs):
+            stack = []
+            for layer in range(self.num_layers):
+                in_size = self.input_size if layer == 0 else self.output_size * dirs
+                stack.append(self._init_layer(keys[d * self.num_layers + layer], in_size))
+            params.append(stack)
+        return params if self.bidirectional else params[0]
+
+    # ------------------------------------------------------------- forward
+    def _run_stack(self, stack, x, reverse, collect_hidden):
+        T, B = x.shape[0], x.shape[1]
+        H = self.hidden_size
+        outs = x[::-1] if reverse else x
+        all_states = []
+        for p in stack:
+            h0 = tuple(jnp.zeros((B, self.output_size if i == 0 else H), x.dtype)
+                       for i in range(self.n_states))
+
+            def step(hidden, xt, p=p):
+                new = self.cell(p, xt, hidden)
+                if "w_ho" in p:
+                    new = (new[0] @ p["w_ho"].T,) + new[1:]
+                return new, (new if collect_hidden else new[0])
+
+            hidden, scanned = jax.lax.scan(step, h0, outs)
+            outs = scanned[0] if collect_hidden else scanned
+            all_states.append(scanned if collect_hidden else hidden)
+        if reverse:
+            outs = outs[::-1]
+        return outs, all_states
+
+    def apply(self, params, x, collect_hidden: bool = False):
+        if not self.bidirectional:
+            outs, states = self._run_stack(params, x, False, collect_hidden)
+            return outs, self._stack_states(states, collect_hidden)
+        f_out, f_states = self._run_stack(params[0], x, False, collect_hidden)
+        b_out, b_states = self._run_stack(params[1], x, True, collect_hidden)
+        out = jnp.concatenate([f_out, b_out], axis=-1)
+        fs = self._stack_states(f_states, collect_hidden)
+        bs = self._stack_states(b_states, collect_hidden)
+        return out, tuple(jnp.concatenate([a, b], axis=-1) for a, b in zip(fs, bs))
+
+    __call__ = apply
+
+    def _stack_states(self, states, collect_hidden):
+        # states: per-layer tuples → tuple over state kinds, stacked on layers
+        if collect_hidden:
+            # each element: tuple of (T, B, H) per state
+            return tuple(
+                jnp.stack([layer[i] for layer in states], axis=1)
+                for i in range(self.n_states)
+            )
+        return tuple(
+            jnp.stack([layer[i] for layer in states], axis=0)
+            for i in range(self.n_states)
+        )

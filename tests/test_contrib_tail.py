@@ -217,7 +217,87 @@ class TestRNN:
 
         with pytest.warns(DeprecationWarning):
             m = RNN.LSTM(8, 16)
-        x = jnp.ones((2, 5, 8))
-        params = m.init(jax.random.PRNGKey(0), x)
-        out = m.apply(params, x)
-        assert out.shape == (2, 5, 16)
+        x = jnp.ones((5, 2, 8))  # (T, B, F), seq-first like the reference
+        params = m.init(jax.random.PRNGKey(0))
+        out, (h, c) = m.apply(params, x)
+        assert out.shape == (5, 2, 16)
+        assert h.shape == (1, 2, 16) and c.shape == (1, 2, 16)
+
+    def _load_torch_lstm_weights(self, params, t_rnn, layers, dirs=1):
+        for layer in range(layers):
+            for d in range(dirs):
+                stack = params[d][layer] if dirs == 2 else params[layer]
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                for ours, theirs in (("w_ih", "weight_ih"), ("w_hh", "weight_hh"),
+                                     ("b_ih", "bias_ih"), ("b_hh", "bias_hh")):
+                    getattr(t_rnn, theirs + sfx).data = torch.tensor(np.asarray(stack[ours]))
+
+    @pytest.mark.parametrize("kind,tcls", [("lstm", torch.nn.LSTM), ("gru", torch.nn.GRU)])
+    def test_matches_torch(self, kind, tcls):
+        import apex_tpu.RNN as RNN
+
+        T, B, I, H, L = 5, 3, 4, 6, 2
+        with pytest.warns(DeprecationWarning):
+            m = getattr(RNN, kind.upper())(I, H, num_layers=L)
+        params = m.init(jax.random.PRNGKey(1))
+        x = np.random.RandomState(0).randn(T, B, I).astype(np.float32)
+        out, hiddens = m.apply(params, jnp.asarray(x))
+
+        t_rnn = tcls(I, H, num_layers=L)
+        self._load_torch_lstm_weights(params, t_rnn, L)
+        t_out, t_hid = t_rnn(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), t_out.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        t_h = t_hid[0] if isinstance(t_hid, tuple) else t_hid
+        np.testing.assert_allclose(np.asarray(hiddens[0]), t_h.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bidirectional_matches_torch(self):
+        import apex_tpu.RNN as RNN
+
+        T, B, I, H = 4, 2, 3, 5
+        with pytest.warns(DeprecationWarning):
+            m = RNN.LSTM(I, H, num_layers=1, bidirectional=True)
+        params = m.init(jax.random.PRNGKey(2))
+        x = np.random.RandomState(1).randn(T, B, I).astype(np.float32)
+        out, _ = m.apply(params, jnp.asarray(x))
+
+        t_rnn = torch.nn.LSTM(I, H, num_layers=1, bidirectional=True)
+        self._load_torch_lstm_weights(params, t_rnn, 1, dirs=2)
+        t_out, _ = t_rnn(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), t_out.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mlstm_formula(self):
+        """mLSTM vs the reference cells.py formula, one step by hand."""
+        import apex_tpu.RNN as RNN
+
+        I, H, B = 3, 4, 2
+        with pytest.warns(DeprecationWarning):
+            m = RNN.mLSTM(I, H)
+        params = m.init(jax.random.PRNGKey(3))
+        p = params[0]
+        x = np.random.RandomState(2).randn(1, B, I).astype(np.float32)
+        out, (h, c) = m.apply(params, jnp.asarray(x))
+
+        def sig(a):
+            return 1.0 / (1.0 + np.exp(-a))
+
+        mm = (x[0] @ np.asarray(p["w_mih"]).T) * (np.zeros((B, H)) @ np.asarray(p["w_mhh"]).T)
+        gates = (x[0] @ np.asarray(p["w_ih"]).T + np.asarray(p["b_ih"])
+                 + mm @ np.asarray(p["w_hh"]).T + np.asarray(p["b_hh"]))
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        cy = sig(f) * 0 + sig(i) * np.tanh(g)
+        hy = sig(o) * np.tanh(cy)
+        np.testing.assert_allclose(np.asarray(out[0]), hy, rtol=1e-5, atol=1e-6)
+
+    def test_grads_flow(self):
+        import apex_tpu.RNN as RNN
+
+        with pytest.warns(DeprecationWarning):
+            m = RNN.GRU(4, 8, num_layers=2)
+        params = m.init(jax.random.PRNGKey(4))
+        x = jnp.ones((6, 2, 4))
+        g = jax.grad(lambda p: jnp.sum(m.apply(p, x)[0] ** 2))(params)
+        assert all(bool(jnp.all(jnp.isfinite(l))) for l in jax.tree.leaves(g))
+        assert any(float(jnp.abs(l).max()) > 0 for l in jax.tree.leaves(g))
