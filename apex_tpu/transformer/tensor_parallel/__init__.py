@@ -5,6 +5,10 @@ Reference: ``apex/transformer/tensor_parallel`` (SURVEY.md §2.1).
 
 from apex_tpu.transformer.tensor_parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.transformer.tensor_parallel.data import broadcast_data, broadcast_from_rank0
+from apex_tpu.transformer.tensor_parallel.grad_accum import (
+    accumulate_gradients,
+    make_grad_accumulator,
+)
 from apex_tpu.transformer.tensor_parallel.layers import (
     ColumnParallelLinear,
     RowParallelLinear,

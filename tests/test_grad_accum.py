@@ -1,0 +1,104 @@
+"""Gradient-accumulation fusion (main_grad contract) — parity vs one
+large-batch backward, fp32 accumulation under bf16 params, and the HLO
+memory bound (one persistent grad buffer, nothing scaling with M)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer.tensor_parallel import accumulate_gradients, make_grad_accumulator
+
+
+def loss_fn(params, mb):
+    h = jnp.tanh(mb["x"] @ params["w1"])
+    pred = h @ params["w2"]
+    return jnp.mean((pred.astype(jnp.float32) - mb["y"]) ** 2)
+
+
+def make_problem(M=6, MB=4, D=8, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    params = {
+        "w1": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3, dtype),
+        "w2": jnp.asarray(rng.randn(D, 1).astype(np.float32) * 0.3, dtype),
+    }
+    mbs = {
+        "x": jnp.asarray(rng.randn(M, MB, D).astype(np.float32), dtype),
+        "y": jnp.asarray(rng.randn(M, MB, 1).astype(np.float32)),
+    }
+    return params, mbs
+
+
+class TestGradAccumulation:
+    def test_matches_large_batch_backward(self):
+        params, mbs = make_problem()
+        loss, grads = accumulate_gradients(loss_fn, params, mbs)
+
+        def big(params):
+            flat = {k: v.reshape(-1, *v.shape[2:]) for k, v in mbs.items()}
+            return loss_fn(params, flat)
+
+        ref_loss, ref_grads = jax.value_and_grad(big)(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        for a, r in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-5, atol=1e-6)
+
+    def test_fp32_accumulation_under_bf16_params(self):
+        """The main_grad property: half model, fp32 grad buffer."""
+        params, mbs = make_problem(dtype=jnp.bfloat16)
+        _, grads = accumulate_gradients(loss_fn, params, mbs)
+        assert all(g.dtype == jnp.float32 for g in jax.tree.leaves(grads))
+
+    def test_single_resident_buffer_in_hlo(self):
+        """No gradient-sized buffer scales with the microbatch count —
+        the property wgrad_gemm_accum_fp32 exists for."""
+        D = 16
+        for M in (8, 32):
+            params, mbs = make_problem(M=M, D=D)
+            f = jax.jit(lambda p, m: accumulate_gradients(loss_fn, p, m))
+            txt = f.lower(params, mbs).compile().as_text()
+            # gradient-shaped buffers: f32[D,D]; count stacked variants
+            # f32[M,D,D] (a per-microbatch grad materialization leak)
+            leaked = re.findall(rf"f32\[{M},{D},{D}\]", txt)
+            assert not leaked, (M, leaked)
+
+    def test_under_shard_map_with_tp(self, devices8):
+        """Collectives inside loss_fn run per microbatch (reference
+        backward ordering); accumulated grads equal the dense run."""
+        from apex_tpu.transformer.tensor_parallel.layers import column_parallel_linear
+
+        D, M, MB = 8, 4, 2
+        rng = np.random.RandomState(3)
+        w = jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3)
+        mbs = {
+            "x": jnp.asarray(rng.randn(M, MB, D).astype(np.float32)),
+            "y": jnp.asarray(rng.randn(M, MB, D).astype(np.float32)),
+        }
+
+        def tp_loss(params, mb):
+            y = column_parallel_linear(mb["x"], params["w"], gather_output=True,
+                                       axis_name="tp")
+            return jnp.mean((y - mb["y"]) ** 2)
+
+        mesh = Mesh(np.array(devices8[:4]), ("tp",))
+        accum = make_grad_accumulator(tp_loss)
+        loss, grads = jax.shard_map(
+            accum, mesh=mesh,
+            in_specs=({"w": P("tp", None)}, P()),
+            out_specs=(P(), {"w": P("tp", None)}),
+            check_vma=False,
+        )({"w": w}, mbs)
+
+        def dense_loss(params):
+            losses = jax.vmap(lambda x, y: jnp.mean((x @ params["w"].T - y) ** 2))(
+                mbs["x"], mbs["y"]
+            )
+            return jnp.mean(losses)
+
+        ref_loss, ref_g = jax.value_and_grad(dense_loss)({"w": w})
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads["w"]), np.asarray(ref_g["w"]),
+                                   rtol=1e-5, atol=1e-6)
