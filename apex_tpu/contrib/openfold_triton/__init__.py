@@ -10,7 +10,22 @@ weight averaging — is implemented here.
 """
 
 from apex_tpu.contrib.openfold_triton.fused_adam_swa import AdamSWAState, FusedAdamSWA
+from apex_tpu.contrib.openfold_triton.mha import (
+    CanSchTriMHA,
+    attention_core,
+    disable,
+    enable,
+    is_enabled,
+)
 from apex_tpu.normalization import FusedLayerNorm as LayerNormSmallShapeOptImpl
-from apex_tpu.ops.attention import flash_attention as _attention_core
 
-__all__ = ["FusedAdamSWA", "AdamSWAState", "LayerNormSmallShapeOptImpl"]
+__all__ = [
+    "FusedAdamSWA",
+    "AdamSWAState",
+    "LayerNormSmallShapeOptImpl",
+    "attention_core",
+    "CanSchTriMHA",
+    "enable",
+    "disable",
+    "is_enabled",
+]

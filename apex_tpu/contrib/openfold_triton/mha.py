@@ -1,0 +1,85 @@
+"""OpenFold fused MHA: attention with mask + trained pair bias.
+
+Reference: ``apex/contrib/openfold_triton/mha.py`` —
+``FusedAttenionCoreFunc.forward(q, k, v, mask=None, bias=None, inf=…)``
+(:133) with Triton kernels ``_attention_bias``/``_attention_no_bias``
+(:400,:438), plus the ``CanSchTriMHA`` shape gate (:36) and
+enable/disable switches (:20-33).
+
+TPU form: the blockwise-scan flash path with the additive bias folded
+into the online softmax (``attn_bias`` in
+:func:`apex_tpu.ops.attention.flash_attention`).  The pair bias is
+differentiable — its cotangent is dS reduced over broadcast dims —
+because OpenFold trains it (it comes from the pair representation).
+The shape gate collapses to "always" (no Triton block constraints).
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention
+
+_enabled = True
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def CanSchTriMHA(in_shape, has_bias=True, inf=1e9, training=True) -> bool:
+    """Reference :36 gates on Triton tile shapes; the scan path handles
+    any shape, so the gate only reflects the enable switch."""
+    return _enabled
+
+
+def attention_core(
+    q,
+    k,
+    v,
+    mask: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
+    inf: float = 1e9,
+):
+    """(…, H, S, D) attention with optional mask and pair bias
+    (reference ``FusedAttenionCoreFunc`` :133).
+
+    ``mask``: broadcastable to the (…, H, Sq, Sk) scores; nonzero/True =
+    keep, 0/False = masked with ``-inf`` (OpenFold convention).
+    ``bias``: additive score bias broadcastable the same way (trained).
+    Leading dims beyond 4 are flattened into the batch.
+    """
+    lead = q.shape[:-3]
+    B = 1
+    for d in lead:
+        B *= d
+    H, Sq, D = q.shape[-3:]
+    Sk = k.shape[-2]
+    q4 = q.reshape(B, H, Sq, D)
+    k4 = k.reshape(B, H, Sk, D)
+    v4 = v.reshape(B, H, Sk, D)
+
+    def to4(t):
+        return jnp.broadcast_to(t, (*lead, H, Sq, Sk)).reshape(B, H, Sq, Sk)
+
+    attn_bias = None
+    if bias is not None:
+        attn_bias = to4(bias.astype(jnp.float32))
+    if mask is not None:
+        mask_bias = to4(jnp.where(mask.astype(bool), 0.0, -float(inf)).astype(jnp.float32))
+        attn_bias = mask_bias if attn_bias is None else attn_bias + mask_bias
+
+    out = flash_attention(
+        q4, k4, v4, causal=False, attn_bias=attn_bias, impl="scan"
+    )
+    return out.reshape(*lead, H, Sq, D)

@@ -39,6 +39,19 @@ def padding_bias(kv_mask):
     return jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
 
 
+def _bias_blocks(kv_bias, B, nblocks, bk):
+    """Split an additive score bias into k-blocks for the scan.
+
+    Accepts (B, Sk) key-only bias or a broadcastable 4D bias
+    (B or 1, H or 1, Sq or 1, Sk); returns a scan input whose element is
+    broadcastable against the (B, H, Sq, bk) score block."""
+    if kv_bias.ndim == 2:
+        kv_bias = kv_bias[:, None, None, :]
+    b0, h0, q0, Sk = kv_bias.shape
+    blocks = kv_bias.reshape(b0, h0, q0, nblocks, bk)
+    return jnp.moveaxis(blocks, 3, 0)  # (nblocks, b0, h0, q0, bk)
+
+
 def _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k,
                      kv_bias=None):
     """Online-softmax forward.  q: (B,H,Sq,D), k/v: (B,H,Sk,D).
@@ -65,7 +78,7 @@ def _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k,
         k_pos = k_offset + blk_idx * bk + jnp.arange(bk)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk) * scale
         if bblk is not None:
-            s = s + bblk[:, None, None, :]
+            s = s + bblk
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask, s, NEG_INF)
@@ -83,7 +96,7 @@ def _attend_fwd_scan(q, k, v, scale, causal, q_offset, k_offset, block_k,
 
     xs = (kb.astype(jnp.float32), vb.astype(jnp.float32), jnp.arange(nblocks))
     if kv_bias is not None:
-        xs = xs + (kv_bias.reshape(B, nblocks, bk).transpose(1, 0, 2),)
+        xs = xs + (_bias_blocks(kv_bias, B, nblocks, bk),)
     m0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Sq), jnp.float32)
     acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
@@ -127,6 +140,11 @@ def flash_bwd_from_lse(q, k, v, g, lse, delta, scale, causal, q_offset=0,
     kb = k.reshape(B, H, nblocks, bk, Dd).transpose(2, 0, 1, 3, 4).astype(jnp.float32)
     vb = v.reshape(B, H, nblocks, bk, Dd).transpose(2, 0, 1, 3, 4).astype(jnp.float32)
 
+    if kv_bias is not None:
+        bias4 = kv_bias if kv_bias.ndim == 4 else kv_bias[:, None, None, :]
+        # d_bias = dS reduced over the dims the bias broadcast along
+        bias_reduce = tuple(i for i in range(3) if bias4.shape[i] == 1)
+
     def body(dq, inp):
         if kv_bias is None:
             kblk, vblk, blk_idx = inp
@@ -136,7 +154,7 @@ def flash_bwd_from_lse(q, k, v, g, lse, delta, scale, causal, q_offset=0,
         k_pos = k_offset + blk_idx * bk + jnp.arange(bk)
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk) * scale
         if bblk is not None:
-            s = s + bblk[:, None, None, :]
+            s = s + bblk
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask, s, NEG_INF)
@@ -148,28 +166,45 @@ def flash_bwd_from_lse(q, k, v, g, lse, delta, scale, causal, q_offset=0,
         ds = p * (dp - delta[..., None])
         dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk) * scale
         dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
-        return dq, (dk, dv)
+        if bblk is None:
+            return dq, (dk, dv)
+        dbias = jnp.sum(ds, axis=bias_reduce, keepdims=True) if bias_reduce else ds
+        return dq, (dk, dv, dbias)
 
     xs = (kb, vb, jnp.arange(nblocks))
     if kv_bias is not None:
-        xs = xs + (kv_bias.reshape(B, nblocks, bk).transpose(1, 0, 2),)
+        xs = xs + (_bias_blocks(kv_bias, B, nblocks, bk),)
     dq0 = jnp.zeros_like(qf)
-    dq, (dks, dvs) = jax.lax.scan(body, dq0, xs)
+    if kv_bias is None:
+        dq, (dks, dvs) = jax.lax.scan(body, dq0, xs)
+    else:
+        dq, (dks, dvs, dbs) = jax.lax.scan(body, dq0, xs)
     dk = dks.transpose(1, 2, 0, 3, 4).reshape(B, H, Sk, Dd)
     dv = dvs.transpose(1, 2, 0, 3, 4).reshape(B, H, Sk, Dd)
-    return dq, dk, dv
+    if kv_bias is None:
+        return dq, dk, dv
+    # assemble d_bias: (nblocks, b0, h0, q0, bk) -> (b0, h0, q0, Sk) -> bias shape
+    db = jnp.moveaxis(dbs, 0, 3).reshape(*bias4.shape[:3], Sk)
+    if kv_bias.ndim == 2:
+        db = db[:, 0, 0, :]
+    return dq, dk, dv, db
 
 
 def _flash_bwd(scale, causal, q_offset, k_offset, block_k, res, g):
     q, k, v, kv_bias, out, lse = res
     delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1)  # (B,H,Sq)
-    dq, dk, dv = flash_bwd_from_lse(
+    outs = flash_bwd_from_lse(
         q, k, v, g, lse, delta, scale, causal, q_offset, k_offset, block_k,
         kv_bias=kv_bias,
     )
-    # the mask bias is data, not a trainable input: zero cotangent
+    if kv_bias is None:
+        dq, dk, dv = outs
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
+    dq, dk, dv, db = outs
+    # a trained bias (OpenFold pair bias) gets its real cotangent; a
+    # padding-mask bias's consumer (jnp.where over a bool mask) drops it
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            None if kv_bias is None else jnp.zeros_like(kv_bias))
+            db.astype(kv_bias.dtype))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -187,6 +222,7 @@ def flash_attention(
     impl: str = "auto",
     block_q: Optional[int] = None,
     kv_mask: Optional[jnp.ndarray] = None,
+    attn_bias: Optional[jnp.ndarray] = None,
 ):
     """Memory-efficient attention, (B, H, S, D) layout.
 
@@ -199,6 +235,13 @@ def flash_attention(
     a dense mask instead of cu_seqlens because packed ragged layouts are
     hostile to XLA's static shapes).
 
+    ``attn_bias``: optional additive score bias broadcastable as
+    (B|1, H|1, Sq|1, Sk) — OpenFold-style pair bias
+    (``apex/contrib/openfold_triton/mha.py``); differentiable (its
+    cotangent is dS reduced over the broadcast dims).  Runs on the scan
+    path (the bias tensor already exists at (…, Sk) granularity, so the
+    kernel's HBM saving does not apply to it).
+
     ``impl``: "pallas" (TPU kernel), "scan" (lax.scan composite), or
     "auto" — the Pallas kernel on TPU with kernel-friendly shapes, the
     scan path everywhere else.  ``block_q``/``block_k`` default to each
@@ -207,7 +250,7 @@ def flash_attention(
     if impl not in ("auto", "pallas", "scan"):
         raise ValueError(f"impl must be 'auto', 'pallas', or 'scan'; got {impl!r}")
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    if impl != "scan":
+    if impl != "scan" and attn_bias is None:
         from apex_tpu.ops.flash_attention_pallas import (
             flash_attention_pallas,
             pallas_flash_available,
@@ -219,7 +262,14 @@ def flash_attention(
                 q_offset=q_offset, k_offset=k_offset,
                 block_q=block_q, block_k=block_k, kv_mask=kv_mask,
             )
-    bias = None if kv_mask is None else padding_bias(kv_mask)
+    bias = None
+    if attn_bias is not None:
+        while attn_bias.ndim < 4:
+            attn_bias = attn_bias[None]
+        bias = attn_bias.astype(jnp.float32)
+    if kv_mask is not None:
+        pad = padding_bias(kv_mask)
+        bias = pad if bias is None else bias + pad[:, None, None, :]
     return _flash(q, k, v, bias, scale, causal, q_offset, k_offset, block_k or 256)
 
 
