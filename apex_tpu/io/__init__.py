@@ -2,7 +2,19 @@
 input prefetch."""
 
 from apex_tpu.io import native
-from apex_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+from apex_tpu.io.checkpoint import (
+    load_checkpoint,
+    load_sharded_checkpoint,
+    save_checkpoint,
+    save_sharded_checkpoint,
+)
 from apex_tpu.io.prefetch import PrefetchIterator
 
-__all__ = ["native", "save_checkpoint", "load_checkpoint", "PrefetchIterator"]
+__all__ = [
+    "native",
+    "save_checkpoint",
+    "load_checkpoint",
+    "save_sharded_checkpoint",
+    "load_sharded_checkpoint",
+    "PrefetchIterator",
+]

@@ -71,3 +71,50 @@ def load_checkpoint(path) -> Any:
     dtypes = [np.dtype(m["dtype"]) for m in header["leaves"]]
     leaves = native.unflatten(blob, shapes, dtypes)
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ------------------------------------------------------- sharded checkpoints
+def _shard_name(rank: int, world: int) -> str:
+    return f"shard_{rank:05d}-of-{world:05d}.ckpt"
+
+
+def save_sharded_checkpoint(dir_path, tree: Any, rank: int, world_size: int) -> str:
+    """Save this rank's piece of a distributed checkpoint (the per-rank
+    protocol of reference ``DistributedFusedAdam.state_dict``, :2527).
+
+    ``tree`` is whatever this rank owns — e.g. the dict from
+    :meth:`DistributedFusedAdam.sharded_state_dict`, a tp-local param
+    shard, or any pytree.  One file per rank, plus an index file written
+    by rank 0 recording the world size.  Reassembly/resharding semantics
+    belong to the consumer (``load_sharded_state_dicts`` for ZeRO).
+    """
+    d = Path(dir_path)
+    d.mkdir(parents=True, exist_ok=True)
+    if rank == 0:
+        (d / "index.json").write_text(
+            json.dumps({"format": "apex_tpu_sharded_v1", "world_size": world_size})
+        )
+    path = d / _shard_name(rank, world_size)
+    save_checkpoint(path, tree)
+    return str(path)
+
+
+def load_sharded_checkpoint(dir_path, rank=None) -> Any:
+    """Load one rank's shard (``rank=``) or the full list of shard trees
+    (``rank=None``) from a directory written by
+    :func:`save_sharded_checkpoint`.  Validates completeness against the
+    index."""
+    d = Path(dir_path)
+    index = json.loads((d / "index.json").read_text())
+    if index.get("format") != "apex_tpu_sharded_v1":
+        raise ValueError(f"{dir_path} is not a sharded apex_tpu checkpoint")
+    world = index["world_size"]
+    if rank is not None:
+        return load_checkpoint(d / _shard_name(rank, world))
+    trees = []
+    for r in range(world):
+        p = d / _shard_name(r, world)
+        if not p.exists():
+            raise FileNotFoundError(f"missing shard {r} of {world}: {p}")
+        trees.append(load_checkpoint(p))
+    return trees

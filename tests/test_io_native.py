@@ -82,3 +82,59 @@ class TestPrefetch:
         with pytest.raises(RuntimeError):
             for _ in it:
                 pass
+
+
+class TestShardedCheckpoint:
+    def test_round_trip(self, tmp_path):
+        from apex_tpu.io import load_sharded_checkpoint, save_sharded_checkpoint
+
+        d = tmp_path / "ck"
+        trees = [{"rank": np.full((3,), float(r)), "x": np.arange(r + 1)} for r in range(4)]
+        for r, t in enumerate(trees):
+            save_sharded_checkpoint(d, t, r, 4)
+        back = load_sharded_checkpoint(d)
+        assert len(back) == 4
+        for r in range(4):
+            np.testing.assert_array_equal(back[r]["rank"], trees[r]["rank"])
+        one = load_sharded_checkpoint(d, rank=2)
+        np.testing.assert_array_equal(one["x"], trees[2]["x"])
+
+    def test_missing_shard_rejected(self, tmp_path):
+        from apex_tpu.io import load_sharded_checkpoint, save_sharded_checkpoint
+
+        d = tmp_path / "ck"
+        save_sharded_checkpoint(d, {"a": np.ones(2)}, 0, 3)
+        with pytest.raises(FileNotFoundError, match="missing shard"):
+            load_sharded_checkpoint(d)
+
+    def test_zero2_resharding_through_files(self, tmp_path, devices8):
+        """End-to-end: ZeRO shard dicts through the sharded-file
+        protocol, reloaded at a different dp world."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        from apex_tpu.io import load_sharded_checkpoint, save_sharded_checkpoint
+
+        params = {"w": jnp.asarray(np.random.RandomState(0).randn(10, 3).astype(np.float32))}
+        mesh = Mesh(np.array(devices8[:4]), ("dp",))
+        opt = DistributedFusedAdam(lr=1e-2, axis_name="dp")
+        state = opt.init(params, world_size=4)
+        sspec = opt.state_partition_spec()
+        g = jax.tree.map(jnp.ones_like, params)
+        params2, state = jax.shard_map(
+            lambda p, s, gg: opt.update(gg, s, p),
+            mesh=mesh, in_specs=(P(), sspec, P()), out_specs=(P(), sspec),
+            check_vma=False,
+        )(params, state, g)
+
+        d = tmp_path / "zero"
+        for r in range(4):
+            save_sharded_checkpoint(d, opt.sharded_state_dict(state, r, 4), r, 4)
+        shards = load_sharded_checkpoint(d)
+        state2 = DistributedFusedAdam.load_sharded_state_dicts(shards, world_size=2)
+        assert int(state2.step) == 1
+        np.testing.assert_allclose(
+            np.asarray(state2.exp_avg[:30]), np.asarray(state.exp_avg[:30]), rtol=1e-7
+        )
