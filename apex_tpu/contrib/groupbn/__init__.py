@@ -1,14 +1,7 @@
 """Group BatchNorm (reference: ``apex/contrib/groupbn`` and
 ``apex/contrib/cudnn_gbn`` — NHWC BN with stats synced across a GPU
-subgroup).  On TPU this is :class:`apex_tpu.parallel.SyncBatchNorm` with
-``channel_last=True`` and the axis restricted to the subgroup mesh axis;
-re-exported under the contrib names."""
+subgroup, fused residual-add + ReLU)."""
 
-from functools import partial
-
-from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
-
-BatchNorm2d_NHWC = partial(SyncBatchNorm, channel_last=True)
-GroupBatchNorm2d = partial(SyncBatchNorm, channel_last=True)
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC, GroupBatchNorm2d
 
 __all__ = ["BatchNorm2d_NHWC", "GroupBatchNorm2d"]

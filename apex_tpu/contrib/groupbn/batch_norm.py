@@ -1,0 +1,86 @@
+"""NHWC group BatchNorm with fused residual-add + ReLU.
+
+Reference: ``apex/contrib/groupbn/batch_norm.py`` —
+``BatchNorm2d_NHWC(num_features, fuse_relu, bn_group, ...)`` (:101) over
+the ``bnp`` CUDA kernels (``bn_NHWC_impl`` :7, ``bn_addrelu_NHWC_impl``
+:53): NHWC batchnorm whose statistics sync across a ``bn_group``-sized
+subgroup of GPUs, with the residual add and ReLU fused into the BN
+kernel (``forward(x, z)``).  Also the surface of
+``apex/contrib/cudnn_gbn/batch_norm.py`` (``GroupBatchNorm2d``).
+
+TPU form: one flax module.  NHWC is already the TPU-native conv layout;
+the Welford/merge kernels collapse to f32 moment math + ``pmean`` with
+``axis_index_groups`` partitioning the dp axis into ``bn_group``-sized
+blocks (the ``create_syncbn_process_group`` pattern); add+ReLU fuse into
+the same XLA fusion as the normalization, and the ReLU backward masking
+falls out of autodiff.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+
+def _group_partition(world: int, bn_group: int):
+    """[[0..g-1], [g..2g-1], ...] — the subgroup layout of
+    ``create_syncbn_process_group`` (apex/parallel/__init__.py:60)."""
+    if world % bn_group:
+        raise ValueError(f"bn_group {bn_group} must divide world size {world}")
+    return [list(range(i, i + bn_group)) for i in range(0, world, bn_group)]
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """NHWC BN; ``__call__(x, z=None)`` fuses ``relu(bn(x) + z)`` when
+    ``fuse_relu`` (reference :196 ``forward(x, z)``)."""
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[str] = DATA_AXIS
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average: bool = False):
+        C = self.num_features
+        scale = self.param("scale", nn.initializers.ones, (C,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (C,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "running_mean",
+                                lambda: jnp.zeros((C,), jnp.float32))
+        ra_var = self.variable("batch_stats", "running_var",
+                               lambda: jnp.ones((C,), jnp.float32))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            sq = jnp.mean(xf * xf, axis=(0, 1, 2))
+            if (self.axis_name is not None and self.bn_group > 1
+                    and not self.is_initializing()):
+                world = jax.lax.axis_size(self.axis_name)
+                groups = _group_partition(world, self.bn_group)
+                mean = jax.lax.pmean(mean, self.axis_name, axis_index_groups=groups)
+                sq = jax.lax.pmean(sq, self.axis_name, axis_index_groups=groups)
+            var = sq - mean * mean
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * var
+
+        inv = jax.lax.rsqrt(var + self.eps)
+        y = (x.astype(jnp.float32) - mean) * inv * scale + bias
+        if z is not None:
+            y = y + z.astype(jnp.float32)
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y.astype(x.dtype)
+
+
+class GroupBatchNorm2d(BatchNorm2d_NHWC):
+    """cudnn_gbn surface (apex/contrib/cudnn_gbn/batch_norm.py:44) —
+    identical semantics, ``group_size`` vocabulary."""
