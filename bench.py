@@ -196,12 +196,25 @@ def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
     }
 
 
+def _progress(msg):
+    import sys
+    import time as _t
+
+    print(f"[bench {_t.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
 def main():
+    _progress("matmul roofline...")
     roofline = bench_matmul_roofline()
+    _progress(f"roofline {roofline:.1f} TFLOP/s; fused adam...")
     adam = bench_fused_adam()
+    _progress(f"adam {adam}; gpt124 s1024...")
     gpt124_1k = bench_gpt(12, 768, 12, 1024, 8, roofline)
+    _progress(f"{gpt124_1k}; gpt124 s4096...")
     gpt124_4k = bench_gpt(12, 768, 12, 4096, 2, roofline)
+    _progress(f"{gpt124_4k}; gpt345 s1024...")
     gpt345_1k = bench_gpt(24, 1024, 16, 1024, 8, roofline, iters=10)
+    _progress(f"{gpt345_1k}; done")
 
     out = {
         "metric": "fused_adam_step_speedup_vs_eager",
