@@ -9,10 +9,10 @@ single-device oracle.  Multi-chip hardware isn't needed —
 Tiers (the reference's L0/L1 split):
 
 - quick: ``pytest -m "not slow" tests/`` — unit + small parity tests,
-  ~3 min (measured 2:53 on this image, 260 tests).  Run on every change.
+  ~3.5 min (measured on this image).  Run on every change.
 - full:  ``pytest tests/`` — adds the compiled e2e/model-level parity
   workloads (GPT 3D/MoE/ResNet trainers, ZeRO resharding, HLO memory
-  regressions), ~10 min (measured 10:09, 295 tests).  CI / pre-commit.
+  regressions), ~10-11 min.  CI / pre-commit.
 
 Anything >~15 s compiled carries ``@pytest.mark.slow`` (file-level
 ``pytestmark`` for whole-file e2e suites).

@@ -405,6 +405,8 @@ class TestPallasFlashAttention:
 class TestRingAttentionPallas:
     """Ring with per-chunk-pair Pallas kernels (interpret mode)."""
 
+    pytestmark = pytest.mark.slow  # interpret-mode ring grads: ~10 s
+
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_full_attention_with_grads(self, causal, devices8):
         B, H, S, D = 1, 2, 512, 8  # S_local = 128: kernel-eligible
