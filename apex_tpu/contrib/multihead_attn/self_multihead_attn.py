@@ -36,6 +36,9 @@ class SelfMultiheadAttn(nn.Module):
 
     @nn.compact
     def __call__(self, query, key_padding_mask=None, *, causal: bool = False, train: bool = True):
+        """``key_padding_mask``: (B, S) with True/1 = PAD (torch
+        convention, reference self_multihead_attn.py:144); padded keys
+        are excluded from the softmax via the flash kernel's mask."""
         S, B, H = query.shape
         nh = self.num_heads
         hd = H // nh
@@ -71,7 +74,9 @@ class SelfMultiheadAttn(nn.Module):
         def heads(t):  # (S,B,H) → (B,nh,S,hd)
             return t.reshape(S, B, nh, hd).transpose(1, 2, 0, 3)
 
-        ctx = flash_attention(heads(q), heads(k), heads(v), causal=causal)
+        kv_mask = None if key_padding_mask is None else ~key_padding_mask.astype(bool)
+        ctx = flash_attention(heads(q), heads(k), heads(v), causal=causal,
+                              kv_mask=kv_mask)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, H)
 
         if train and self.dropout > 0:
@@ -96,7 +101,10 @@ class EncdecMultiheadAttn(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, query, key, *, train: bool = True):
+    def __call__(self, query, key, key_padding_mask=None, *, train: bool = True):
+        """``key_padding_mask``: (B, Sk), True/1 = PAD (torch
+        convention) — masks encoder keys (reference
+        encdec_multihead_attn.py)."""
         S, B, H = query.shape
         Sk = key.shape[0]
         nh = self.num_heads
@@ -113,7 +121,9 @@ class EncdecMultiheadAttn(nn.Module):
         def heads(t, s):
             return t.reshape(s, B, nh, hd).transpose(1, 2, 0, 3)
 
-        ctx = flash_attention(heads(q, S), heads(k, Sk), heads(v, Sk), causal=False)
+        kv_mask = None if key_padding_mask is None else ~key_padding_mask.astype(bool)
+        ctx = flash_attention(heads(q, S), heads(k, Sk), heads(v, Sk), causal=False,
+                              kv_mask=kv_mask)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, H)
         if train and self.dropout > 0:
             ctx = nn.Dropout(rate=self.dropout, deterministic=False)(ctx)

@@ -200,3 +200,46 @@ class TestMultiheadAttn:
 
         out = m.apply(p, jnp.asarray(x), train=False)
         np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_self_attn_key_padding_mask_matches_torch(self):
+        """key_padding_mask (True = PAD, torch polarity) actually masks."""
+        H, nh, S, B = 8, 2, 6, 2
+        rng = np.random.RandomState(9)
+        x = rng.randn(S, B, H).astype(np.float32)
+        pad = np.zeros((B, S), bool)
+        pad[1, 4:] = True  # last two positions of batch 1 are padding
+
+        m = SelfMultiheadAttn(hidden_size=H, num_heads=nh, dropout=0.0)
+        p = m.init(jax.random.PRNGKey(0), jnp.asarray(x), train=False)
+
+        tm = torch.nn.MultiheadAttention(H, nh, bias=True)
+        sd = tm.state_dict()
+        sd["in_proj_weight"] = torch.tensor(np.asarray(p["params"]["input_weights"]))
+        sd["in_proj_bias"] = torch.tensor(np.asarray(p["params"]["input_biases"]))
+        sd["out_proj.weight"] = torch.tensor(np.asarray(p["params"]["output_weights"]))
+        sd["out_proj.bias"] = torch.tensor(np.asarray(p["params"]["output_biases"]))
+        tm.load_state_dict(sd)
+        ref, _ = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                    key_padding_mask=torch.tensor(pad))
+
+        out = m.apply(p, jnp.asarray(x), jnp.asarray(pad), train=False)
+        # valid rows only (torch zeroes nothing; padded query rows attend
+        # to valid keys in both implementations)
+        np.testing.assert_allclose(np.asarray(out[:4, 1]), ref.detach().numpy()[:4, 1],
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out[:, 0]), ref.detach().numpy()[:, 0],
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_encdec_key_padding_mask_blocks_keys(self):
+        m = EncdecMultiheadAttn(hidden_size=16, num_heads=4, dropout=0.0)
+        rng = np.random.RandomState(10)
+        q = jnp.asarray(rng.randn(6, 2, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(10, 2, 16).astype(np.float32))
+        pad = np.zeros((2, 10), bool)
+        pad[0, 7:] = True
+        p = m.init(jax.random.PRNGKey(0), q, k, train=False)
+        out = m.apply(p, q, k, jnp.asarray(pad), train=False)
+        # perturbing padded encoder keys must not change the output
+        k2 = k.at[7:, 0].set(55.0)
+        out2 = m.apply(p, q, k2, jnp.asarray(pad), train=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
