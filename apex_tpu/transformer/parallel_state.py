@@ -33,6 +33,11 @@ DATA_AXIS = "dp"
 PIPELINE_AXIS = "pp"
 CONTEXT_AXIS = "cp"
 TENSOR_AXIS = "tp"
+# Multi-slice deployments add an outermost DCN axis: the analog of the
+# reference's hybrid IB/socket group split (parallel_state.py:108-153,
+# NUM_GPUS_PER_IB_BLOCK) — data parallelism hierarchically decomposed
+# into fast-domain (ICI, "dp") and slow-domain (DCN, "dcn") legs.
+DCN_AXIS = "dcn"
 AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
 
@@ -45,6 +50,7 @@ class _State:
     data_parallel_size: int
     virtual_pipeline_model_parallel_size: Optional[int]
     pipeline_model_parallel_split_rank: Optional[int]
+    num_distributed_slices: int = 1
     # mutable trace-time bookkeeping (mirrors the reference's globals)
     virtual_pipeline_model_parallel_rank: Optional[int] = None
 
@@ -59,6 +65,7 @@ def initialize_model_parallel(
     pipeline_model_parallel_split_rank_: Optional[int] = None,
     context_parallel_size_: int = 1,
     devices: Optional[Sequence] = None,
+    num_distributed_slices_: int = 1,
 ) -> Mesh:
     """Build and register the global device mesh.
 
@@ -66,6 +73,16 @@ def initialize_model_parallel(
     (parallel_state.py:155) — argument names kept (trailing underscore and
     all).  ``context_parallel_size_`` is new (ring-attention axis).
     Returns the mesh (also retrievable via :func:`get_mesh`).
+
+    ``num_distributed_slices_`` > 1 adds an outermost ``dcn`` mesh axis
+    splitting data parallelism into a cross-slice leg and a within-slice
+    leg — the multi-slice topology (model axes stay inside one slice on
+    ICI; only the infrequent data-parallel gradient reduction crosses
+    DCN).  Collectives over ``("dcn", "dp")`` lower to a hierarchical
+    reduce (ICI first, then one transfer per slice over DCN) — the TPU
+    form of the reference's IB-block-aware hybrid groups
+    (parallel_state.py:108-153).  On real multi-slice hardware pass the
+    devices ordered slice-major (``jax.devices()`` already is).
     """
     global _STATE
     devs = list(devices) if devices is not None else jax.devices()
@@ -85,8 +102,20 @@ def initialize_model_parallel(
             "pipeline-model-parallel size should be greater than 2 with interleaved schedule"
         )
 
-    arr = np.array(devs).reshape(dp, pp, cp, tp)
-    mesh = Mesh(arr, AXIS_ORDER)
+    slices = int(num_distributed_slices_)
+    if slices > 1:
+        if dp % slices:
+            raise RuntimeError(
+                f"data-parallel size ({dp}) not divisible by slices ({slices}): "
+                "model axes must fit inside one slice"
+            )
+        dp_in = dp // slices
+        arr = np.array(devs).reshape(slices, dp_in, pp, cp, tp)
+        mesh = Mesh(arr, (DCN_AXIS,) + AXIS_ORDER)
+        dp = dp_in
+    else:
+        arr = np.array(devs).reshape(dp, pp, cp, tp)
+        mesh = Mesh(arr, AXIS_ORDER)
     _STATE = _State(
         mesh=mesh,
         tensor_model_parallel_size=tp,
@@ -95,6 +124,7 @@ def initialize_model_parallel(
         data_parallel_size=dp,
         virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size_,
         pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank_,
+        num_distributed_slices=slices,
     )
     return mesh
 
@@ -211,10 +241,25 @@ def get_context_parallel_group() -> AxisGroup:
     return AxisGroup(CONTEXT_AXIS, s.context_parallel_size, s.mesh)
 
 
-def get_data_parallel_group() -> AxisGroup:
-    """Reference: parallel_state.py:462 — here, the ``dp`` mesh axis."""
+def get_data_parallel_group():
+    """Reference: parallel_state.py:462 — here, the ``dp`` mesh axis.
+
+    On a multi-slice mesh this is the combined ``(dcn, dp)`` axes: a
+    ``psum`` over it is the hierarchical (ICI-then-DCN) gradient
+    reduction."""
     s = _state()
+    if s.num_distributed_slices > 1:
+        return MultiAxisGroup(
+            (DCN_AXIS, DATA_AXIS), s.num_distributed_slices * s.data_parallel_size,
+            s.mesh,
+        )
     return AxisGroup(DATA_AXIS, s.data_parallel_size, s.mesh)
+
+
+def get_num_distributed_slices() -> int:
+    """Multi-slice count (1 = single slice; no reference analog — the
+    IB/socket hybrid logic is the closest, parallel_state.py:108)."""
+    return _state().num_distributed_slices
 
 
 class MultiAxisGroup(tuple):

@@ -40,9 +40,11 @@ class DistributedTestContext:
     tears down parallel_state around a test (the reference's
     setUp/tearDown, distributed_test_base.py:40-77)."""
 
-    def __init__(self, tp: int = 1, pp: int = 1, cp: int = 1, devices=None):
+    def __init__(self, tp: int = 1, pp: int = 1, cp: int = 1, devices=None,
+                 slices: int = 1):
         self.tp, self.pp, self.cp = tp, pp, cp
         self.devices = devices
+        self.slices = slices
         self.mesh = None
 
     def __enter__(self):
@@ -51,6 +53,7 @@ class DistributedTestContext:
             pipeline_model_parallel_size_=self.pp,
             context_parallel_size_=self.cp,
             devices=self.devices,
+            num_distributed_slices_=self.slices,
         )
         return self
 

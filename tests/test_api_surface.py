@@ -346,6 +346,37 @@ class TestGroupGetters:
             )(x)
             np.testing.assert_array_equal(np.asarray(out), [6.0, 6.0, 6.0, 6.0])
 
+    def test_multislice_mesh_and_hierarchical_dp_group(self):
+        """num_distributed_slices splits dp into (dcn, dp); the dp group
+        spans both axes so one psum is the hierarchical reduction."""
+        from jax.experimental.shard_map import shard_map
+
+        with parallel_state_ctx(tp=2, slices=2):
+            mesh = parallel_state.get_mesh()
+            assert mesh.axis_names == ("dcn", "dp", "pp", "cp", "tp")
+            assert mesh.devices.shape == (2, 2, 1, 1, 2)
+            assert parallel_state.get_num_distributed_slices() == 2
+            assert parallel_state.get_data_parallel_world_size() == 2  # per slice
+            g = parallel_state.get_data_parallel_group()
+            assert tuple(g) == ("dcn", "dp") and g.size() == 4
+
+            x = jnp.arange(8, dtype=jnp.float32)
+            out = shard_map(
+                lambda x: jax.lax.psum(x, g), mesh=mesh,
+                in_specs=P(("dcn", "dp", "pp", "cp", "tp")),
+                out_specs=P(("dcn", "dp", "pp", "cp", "tp")),
+            )(x)
+            # per tp-coordinate: tp=0 holds {0,2,4,6} → 12, tp=1 {1,3,5,7} → 16
+            np.testing.assert_array_equal(np.asarray(out), [12, 16] * 4)
+
+    def test_multislice_requires_divisible_dp(self):
+        with pytest.raises(RuntimeError, match="slices"):
+            parallel_state.initialize_model_parallel(
+                tensor_model_parallel_size_=2, num_distributed_slices_=3,
+                devices=jax.devices()[:8],
+            )
+        parallel_state.destroy_model_parallel()
+
     def test_masked_psum_sums_members_only(self):
         from jax.experimental.shard_map import shard_map
 
