@@ -30,3 +30,12 @@ def ConvBiasReLU(x, weight, bias, stride: int = 1, padding="SAME"):
 
 def ConvBiasMaskReLU(x, weight, bias, mask, stride: int = 1, padding="SAME"):
     return jax.nn.relu(ConvBias(x, weight, bias, stride, padding) * mask)
+
+
+def ConvFrozenScaleBiasReLU(x, weight, scale, bias, stride: int = 1, padding="SAME"):
+    """relu(conv(x, w) * scale + bias) with scale/bias treated as frozen
+    (no gradients — reference backward returns None for them,
+    conv_bias_relu.py:96): the folded-BatchNorm inference fusion."""
+    scale = jax.lax.stop_gradient(scale)
+    bias = jax.lax.stop_gradient(bias)
+    return jax.nn.relu(_conv(x, weight, stride, padding) * scale + bias)

@@ -391,3 +391,35 @@ class TestGroupBN:
             x, use_running_average=True,
         )
         assert np.isfinite(np.asarray(y_eval)).all()
+
+
+class TestConvFrozenScaleBiasReLU:
+    def test_forward_and_frozen_grads(self):
+        from apex_tpu.contrib.conv_bias_relu import ConvFrozenScaleBiasReLU
+
+        rng = np.random.RandomState(20)
+        x = jnp.asarray(rng.randn(1, 6, 6, 3).astype(np.float32))
+        w = jnp.asarray(rng.randn(3, 3, 3, 4).astype(np.float32))
+        scale = jnp.asarray(rng.rand(4).astype(np.float32) + 0.5)
+        bias = jnp.asarray(rng.randn(4).astype(np.float32))
+
+        out = ConvFrozenScaleBiasReLU(x, w, scale, bias)
+        ref = torch.nn.functional.relu(
+            torch.nn.functional.conv2d(
+                torch.tensor(np.asarray(x)).permute(0, 3, 1, 2),
+                torch.tensor(np.asarray(w)).permute(3, 2, 0, 1),
+                padding=1,
+            ) * torch.tensor(np.asarray(scale))[None, :, None, None]
+            + torch.tensor(np.asarray(bias))[None, :, None, None]
+        ).permute(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+        # frozen: scale/bias receive zero grads (reference returns None)
+        g = jax.grad(
+            lambda s, b: jnp.sum(ConvFrozenScaleBiasReLU(x, w, s, b) ** 2), argnums=(0, 1)
+        )(scale, bias)
+        np.testing.assert_allclose(np.asarray(g[0]), 0.0, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(g[1]), 0.0, atol=1e-7)
+        # x and weight DO get grads
+        gx = jax.grad(lambda x: jnp.sum(ConvFrozenScaleBiasReLU(x, w, scale, bias) ** 2))(x)
+        assert float(jnp.abs(gx).max()) > 0
