@@ -20,6 +20,8 @@ Model FLOPs use the standard 6·N·tokens + 12·L·S·H attention term
 """
 
 import json
+import os
+import threading
 import time
 
 import jax
@@ -205,16 +207,45 @@ def _progress(msg):
     print(f"[bench {_t.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
-def _try(name, fn, *args, **kw):
-    """One failed sub-bench must not zero the whole audited output."""
+_DEADLINE = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_SEC", "1500"))
+_DEVICE_WEDGED = False
+
+
+def _try(name, fn, *args, section_budget=600.0, **kw):
+    """One failed sub-bench must not zero the whole audited output.
+
+    Sections run under a watchdog: a wedged TPU tunnel hangs compiles
+    forever, and an audited bench that never prints its JSON line is
+    worse than one that reports the timeout.  A timed-out section marks
+    the device wedged and the remaining device sections are skipped
+    (the hung thread still holds the chip)."""
+    global _DEVICE_WEDGED
+    if _DEVICE_WEDGED:
+        return {"error": "skipped: device wedged by an earlier timeout"}
+    remaining = _DEADLINE - time.monotonic()
+    if remaining <= 10:
+        return {"error": "skipped: bench deadline reached"}
     _progress(f"{name}...")
-    try:
-        r = fn(*args, **kw)
-        _progress(f"{name}: {r}")
-        return r
-    except Exception as e:  # noqa: BLE001 — record and continue
-        _progress(f"{name} FAILED: {e!r}")
-        return {"error": f"{type(e).__name__}: {e}"}
+    box = {}
+
+    def run():
+        try:
+            box["r"] = fn(*args, **kw)
+        except Exception as e:  # noqa: BLE001 — record and continue
+            box["e"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=min(section_budget, remaining))
+    if t.is_alive():
+        _DEVICE_WEDGED = True
+        _progress(f"{name} TIMED OUT")
+        return {"error": f"timeout after {min(section_budget, remaining):.0f}s"}
+    if "e" in box:
+        _progress(f"{name} FAILED: {box['e']}")
+        return {"error": box["e"]}
+    _progress(f"{name}: {box['r']}")
+    return box["r"]
 
 
 def main():
@@ -236,9 +267,19 @@ def main():
         "gpt124_s1024": gpt124_1k,
         "gpt124_s4096": gpt124_4k,
         "gpt345_s1024": gpt345_1k,
-        "device": str(jax.devices()[0]),
     }
-    print(json.dumps(out))
+    if not _DEVICE_WEDGED:
+        try:
+            out["device"] = str(jax.devices()[0])
+        except Exception as e:  # noqa: BLE001
+            out["device"] = f"unavailable: {e}"
+    else:
+        out["device"] = "wedged (section timeout)"
+    print(json.dumps(out), flush=True)
+    if _DEVICE_WEDGED:
+        # a hung compile thread blocks the jax client's atexit teardown;
+        # the JSON line is out, so leave without waiting for it
+        os._exit(0)
 
 
 if __name__ == "__main__":
