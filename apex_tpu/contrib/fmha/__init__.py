@@ -1,3 +1,3 @@
-from apex_tpu.contrib.fmha.fmha import fmha
+from apex_tpu.contrib.fmha.fmha import fmha, fmha_varlen
 
-__all__ = ["fmha"]
+__all__ = ["fmha", "fmha_varlen"]

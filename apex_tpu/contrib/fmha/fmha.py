@@ -19,6 +19,41 @@ import jax.numpy as jnp
 from apex_tpu.ops.attention import flash_attention
 
 
+def fmha_varlen(qkv_packed, cu_seqlens, max_s: int, causal: bool = False,
+                softmax_scale=None):
+    """Packed-varlen interface matching the reference call shape
+    (``FMHAFun(qkv, cu_seqlens, p_dropout, max_s, ...)``,
+    ``apex/contrib/fmha/fmha.py:33-60``).
+
+    ``qkv_packed``: (total_tokens, 3, H, D) — all sequences concatenated;
+    ``cu_seqlens``: (B+1,) int32 cumulative sequence starts;
+    ``max_s``: static max sequence length (the dense padding width).
+
+    The packed layout is unpacked to a dense (B, max_s) batch + validity
+    mask (static shapes for XLA), run through the masked flash kernel,
+    and repacked — same numerics as the reference's ragged kernel, and
+    the pack/unpack gathers fuse into the surrounding program.
+    """
+    B = cu_seqlens.shape[0] - 1
+    total = qkv_packed.shape[0]
+    seqlens = cu_seqlens[1:] - cu_seqlens[:-1]  # (B,)
+
+    pos = jnp.arange(max_s)
+    idx = cu_seqlens[:-1, None] + pos[None, :]           # (B, max_s)
+    valid = pos[None, :] < seqlens[:, None]              # (B, max_s)
+    dense = jnp.take(qkv_packed, jnp.clip(idx, 0, total - 1), axis=0)
+    dense = jnp.where(valid[..., None, None, None], dense, 0)
+
+    out_dense = fmha(dense, key_padding_mask=valid, causal=causal,
+                     softmax_scale=softmax_scale)       # (B, max_s, H, D)
+
+    # repack: token t belongs to sequence b(t), offset t - cu_seqlens[b]
+    t = jnp.arange(total)
+    b_of_t = jnp.searchsorted(cu_seqlens, t, side="right") - 1
+    i_of_t = t - jnp.take(cu_seqlens, b_of_t)
+    return out_dense[b_of_t, i_of_t]
+
+
 def fmha(qkv, key_padding_mask: Optional[jnp.ndarray] = None, causal: bool = False, softmax_scale=None):
     """qkv: (B, S, 3, H, D) packed as in the reference; returns (B, S, H, D).
 
