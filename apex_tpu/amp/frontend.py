@@ -24,8 +24,17 @@ class Amp(NamedTuple):
     policy: Policy
     scaler: Any  # DynamicLossScaler | StaticLossScaler | None
 
-    def init_state(self) -> Optional[ScalerState]:
-        return self.scaler.init() if self.scaler is not None else None
+    def init_state(self, num_losses: int = 1):
+        """One scaler state, or a list of ``num_losses`` independent
+        states (reference ``amp.initialize(num_losses=)``,
+        frontend.py:197 — per-loss ``LossScaler`` instances; here the
+        scaler is stateless so per-loss *states* suffice, used with
+        ``loss_id`` on the loss ops)."""
+        if self.scaler is None:
+            return None if num_losses == 1 else [None] * num_losses
+        if num_losses == 1:
+            return self.scaler.init()
+        return [self.scaler.init() for _ in range(num_losses)]
 
     # -------------------------------------------------------------- loss ops
     def scale_loss(self, scaler_state, loss):
@@ -47,14 +56,25 @@ class Amp(NamedTuple):
 
     # ----------------------------------------------------- state dict parity
     def state_dict(self, scaler_state):
-        """Reference: apex/amp/frontend.py:365-376."""
+        """Reference: apex/amp/frontend.py:365-376 (one ``loss_scalerN``
+        entry per loss)."""
         if self.scaler is None:
             return {}
+        if isinstance(scaler_state, list):
+            return {
+                f"loss_scaler{i}": self.scaler.state_dict(s)
+                for i, s in enumerate(scaler_state)
+            }
         return {"loss_scaler0": self.scaler.state_dict(scaler_state)}
 
     def load_state_dict(self, d):
         if self.scaler is None or not d:
             return None
+        if len(d) > 1:
+            return [
+                self.scaler.load_state_dict(d[f"loss_scaler{i}"])
+                for i in range(len(d))
+            ]
         return self.scaler.load_state_dict(d["loss_scaler0"])
 
 

@@ -143,3 +143,24 @@ class TestValueAndGrad:
         loss, grads, st, finite = vg(cast_params, None, jnp.array([3.0]))
         assert bool(finite)
         assert grads["w"].dtype == jnp.float32
+
+
+class TestMultiLoss:
+    """num_losses parity (reference amp.initialize(num_losses=N)):
+    independent scaler states per loss."""
+
+    def test_per_loss_states_round_trip(self):
+        from apex_tpu import amp as amp_mod
+
+        params = {"w": jnp.ones((4,))}
+        _, a = amp_mod.initialize(params, opt_level="O2", half_dtype=jnp.float16)
+        states = a.init_state(num_losses=3)
+        assert len(states) == 3
+        # scale one loss's state down (simulate overflow on loss 1)
+        states[1] = a.update_scaler(states[1], jnp.bool_(False))
+        assert float(states[1].loss_scale) < float(states[0].loss_scale)
+        d = a.state_dict(states)
+        assert set(d) == {"loss_scaler0", "loss_scaler1", "loss_scaler2"}
+        back = a.load_state_dict(d)
+        assert float(back[1].loss_scale) == float(states[1].loss_scale)
+        assert float(back[0].loss_scale) == float(states[0].loss_scale)
