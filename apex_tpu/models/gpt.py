@@ -607,13 +607,24 @@ def make_pp_train_step(
             grads = sp_grad_sync(grads, tp_axis)
         if dp_axis is not None:
             loss = jax.lax.pmean(loss, dp_axis)
-            grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+            if not zero_opt:
+                grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+        # ZeRO: grads stay LOCAL — the optimizer's psum_scatter over dp
+        # IS the gradient sync (reduce-scatter fused with the update)
         new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
 
     from apex_tpu.optimizers.fused_adam import AdamState
 
-    sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
+    # A ZeRO optimizer (DistributedFusedAdam/LAMB) brings its own flat
+    # state sharding; call its init with param_specs=specs and
+    # axis_sizes={tp:..., pp:...} so the state is sized for the local
+    # (pp, tp) param shard and sharded over (model axes, dp).
+    zero_opt = hasattr(optimizer, "state_partition_spec")
+    if zero_opt:
+        sspec = optimizer.state_partition_spec()
+    else:
+        sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
     data_spec = P(dp_axis, None) if dp_axis is not None else P()
 
     sharded = jax.shard_map(
