@@ -402,6 +402,14 @@ def make_train_step(
         rest["layers"]["moe"] = moe
         return rest
 
+    # A ZeRO optimizer (state_partition_spec present) owns the dp grad
+    # sync via its reduce-scatter; grads then stay local over dp.
+    zero_opt = hasattr(optimizer, "state_partition_spec")
+    if zero_opt and config.moe:
+        raise NotImplementedError(
+            "ZeRO + MoE expert sharding both claim the dp axis; not wired"
+        )
+
     def local_step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(gpt_loss)(
             params, tokens, targets, config, tp_axis, cp_axis, ep_axis
@@ -414,6 +422,8 @@ def make_train_step(
         for ax in (cp_axis, dp_axis):
             if ax is not None:
                 loss = jax.lax.pmean(loss, ax)
+                if ax == dp_axis and zero_opt:
+                    continue
                 grads = pmean_grads(grads, ax, skip_experts=(ax == dp_axis))
         new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
@@ -424,7 +434,7 @@ def make_train_step(
 
         return AdamState(step=P(), exp_avg=params_spec, exp_avg_sq=params_spec, master=None)
 
-    sspec = state_spec_of(specs)
+    sspec = optimizer.state_partition_spec() if zero_opt else state_spec_of(specs)
     data_spec = P(dp_axis, cp_axis)  # batch over dp, sequence over cp
 
     sharded = jax.shard_map(
