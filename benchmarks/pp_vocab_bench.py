@@ -73,8 +73,19 @@ def build(vocab_head):
     return pre, stage, post, shared, stages, batch
 
 
-def time_schedule(vocab_head, iters=8):
+def _to_stage_major(v, vpp):
+    """Execution order is chunk-major (v, s, i); shard layout is
+    stage-major [s][v][i] so P("pp") slices per stage."""
+    lpc = L // (vpp * PP)
+    return np.asarray(v).reshape(vpp, PP, lpc, *v.shape[1:]).transpose(
+        1, 0, *range(2, v.ndim + 2)
+    ).reshape(v.shape)
+
+
+def time_schedule(vocab_head, iters=8, vpp=1):
     pre, stage, post, shared, stages, batch = build(vocab_head)
+    if vpp > 1:
+        stages = {k: jnp.asarray(_to_stage_major(v, vpp)) for k, v in stages.items()}
     mesh = Mesh(np.array(jax.devices()[:PP]), ("pp",))
     sspec = {k: P() for k in shared}
     stspec = {"w": P("pp", None, None), "b": P("pp", None)}
@@ -82,7 +93,7 @@ def time_schedule(vocab_head, iters=8):
 
     def run(sh, st, b):
         loss, (g_sh, g_st) = pipelined_fwd_bwd(pre, stage, post, sh, st, b,
-                                               num_chunks=1, axis_name="pp")
+                                               num_chunks=vpp, axis_name="pp")
         g_sh = jax.tree.map(lambda g: jax.lax.psum(g, "pp"), g_sh)
         return loss, (g_sh, g_st)
 
@@ -127,9 +138,11 @@ def main():
     t_head = time_head_alone()
     t_nohead = time_schedule(False)
     t_full = time_schedule(True)
+    t_vpp2 = time_schedule(True, vpp=2)
     overhead = (t_full - t_nohead - t_head) / t_full
     print(f"P={PP} M={M} MB={MB} S={S} H={H} V={V} (CPU mesh)")
-    print(f"t_full    {t_full:8.1f} ms/step")
+    print(f"t_full    {t_full:8.1f} ms/step (1F1B)")
+    print(f"t_vpp2    {t_vpp2:8.1f} ms/step (interleaved vpp=2, {t_full / t_vpp2:.2f}x vs 1F1B)")
     print(f"t_nohead  {t_nohead:8.1f} ms/step")
     print(f"t_head    {t_head:8.1f} ms/step (M x single head fwd+bwd)")
     print(f"post_overhead = (t_full - t_nohead - t_head)/t_full = {overhead:+.1%}")
