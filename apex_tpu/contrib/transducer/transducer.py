@@ -21,16 +21,41 @@ NEG_INF = -1e30
 
 class TransducerJoint:
     """f (B, T, H) ⊕ g (B, U, H) → (B, T, U, H) broadcast-add joint
-    (reference transducer.py:5; pack/relu/dropout options are composable
-    jnp ops on the result)."""
+    (reference transducer.py:5).
 
-    def __init__(self, pack_output: bool = False, relu: bool = False, dropout: float = 0.0):
+    ``relu``/``dropout`` fuse into the same XLA kernel as the add
+    (reference opt=1 fused epilogues).  ``pack_output`` in the reference
+    removes don't-care (t ≥ f_len or u ≥ g_len) entries into a ragged
+    buffer; ragged layouts are hostile to XLA, so the equivalent here is
+    zero-masking those entries in place when ``f_len``/``g_len`` are
+    given — downstream loss math ignores them either way.  Dropout needs
+    an explicit ``key`` (functional RNG).
+    """
+
+    def __init__(self, pack_output: bool = False, relu: bool = False,
+                 dropout: bool = False, dropout_prob: float = 0.0, **_opt_knobs):
+        self.pack_output = pack_output
         self.relu = relu
+        self.dropout = dropout
+        self.dropout_prob = dropout_prob
 
-    def __call__(self, f, g, f_len=None, g_len=None):
+    def __call__(self, f, g, f_len=None, g_len=None, key=None):
         out = f[:, :, None, :] + g[:, None, :, :]
         if self.relu:
             out = jax.nn.relu(out)
+        if self.dropout and self.dropout_prob > 0.0:
+            if key is None:
+                raise ValueError("dropout=True needs key= (functional RNG)")
+            keep = jax.random.bernoulli(key, 1.0 - self.dropout_prob, out.shape)
+            out = jnp.where(keep, out / (1.0 - self.dropout_prob), 0.0)
+        if self.pack_output and (f_len is not None or g_len is not None):
+            B, T, U, _ = out.shape
+            valid = jnp.ones((B, T, U), bool)
+            if f_len is not None:
+                valid &= jnp.arange(T)[None, :, None] < f_len[:, None, None]
+            if g_len is not None:
+                valid &= jnp.arange(U)[None, None, :] < g_len[:, None, None]
+            out = jnp.where(valid[..., None], out, 0.0)
         return out
 
 

@@ -423,3 +423,29 @@ class TestConvFrozenScaleBiasReLU:
         # x and weight DO get grads
         gx = jax.grad(lambda x: jnp.sum(ConvFrozenScaleBiasReLU(x, w, scale, bias) ** 2))(x)
         assert float(jnp.abs(gx).max()) > 0
+
+
+class TestTransducerJointOptions:
+    def test_relu_dropout_mask(self):
+        f = jnp.asarray(np.random.RandomState(21).randn(2, 3, 4).astype(np.float32))
+        g = jnp.asarray(np.random.RandomState(22).randn(2, 5, 4).astype(np.float32))
+        j = TransducerJoint(relu=True)
+        out = j(f, g)
+        assert (np.asarray(out) >= 0).all()
+
+        jd = TransducerJoint(dropout=True, dropout_prob=0.5)
+        with pytest.raises(ValueError, match="key"):
+            jd(f, g)
+        out_d = jd(f, g, key=jax.random.PRNGKey(0))
+        zeros = float((np.asarray(out_d) == 0).mean())
+        assert 0.3 < zeros < 0.7  # ~half dropped
+
+    def test_pack_output_zeroes_dont_care(self):
+        f = jnp.ones((2, 4, 3))
+        g = jnp.ones((2, 3, 3))
+        j = TransducerJoint(pack_output=True)
+        out = j(f, g, f_len=jnp.asarray([4, 2]), g_len=jnp.asarray([3, 1]))
+        np.testing.assert_allclose(np.asarray(out[0]), 2.0)  # fully valid
+        assert np.asarray(out[1, 2:]).sum() == 0  # t >= f_len zeroed
+        assert np.asarray(out[1, :, 1:]).sum() == 0  # u >= g_len zeroed
+        np.testing.assert_allclose(np.asarray(out[1, :2, :1]), 2.0)
