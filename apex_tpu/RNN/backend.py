@@ -16,11 +16,10 @@ Layout is seq-first ``(T, B, F)`` like the reference.
 """
 
 import math
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 sigmoid = jax.nn.sigmoid
 

@@ -17,10 +17,7 @@ inverse permutation, which is the correct backward-communication
 pairing.
 """
 
-from typing import Optional
-
 import jax
-import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
 

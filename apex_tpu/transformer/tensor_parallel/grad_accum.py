@@ -21,7 +21,7 @@ Inside the pipeline schedules the identical pattern is built in
 user-visible form for non-pipelined microbatched training.
 """
 
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
