@@ -359,8 +359,14 @@ def make_train_step(
     tp_axis: str = "tp",
     dp_axis: Optional[str] = "dp",
     cp_axis: Optional[str] = None,
+    opt_state_spec=None,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
+
+    ``opt_state_spec``: PartitionSpec tree for the optimizer state; by
+    default the FusedAdam state shape is assumed (m/v mirror the param
+    sharding, scalars replicated) and ZeRO optimizers supply their own —
+    pass this for other state shapes (e.g. ``SGDState``).
 
     The TPU shape of reference §3.2's iteration: value_and_grad inside
     ``shard_map`` (TP collectives via the mappings), gradient ``pmean``
@@ -434,7 +440,12 @@ def make_train_step(
 
         return AdamState(step=P(), exp_avg=params_spec, exp_avg_sq=params_spec, master=None)
 
-    sspec = optimizer.state_partition_spec() if zero_opt else state_spec_of(specs)
+    if opt_state_spec is not None:
+        sspec = opt_state_spec
+    elif zero_opt:
+        sspec = optimizer.state_partition_spec()
+    else:
+        sspec = state_spec_of(specs)
     data_spec = P(dp_axis, cp_axis)  # batch over dp, sequence over cp
 
     sharded = jax.shard_map(
@@ -498,8 +509,12 @@ def make_pp_train_step(
     pp_axis: str = "pp",
     dp_axis: Optional[str] = "dp",
     virtual_pipeline_size: int = 1,
+    opt_state_spec=None,
 ):
     """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
+
+    ``opt_state_spec`` overrides the optimizer-state PartitionSpec tree
+    (default: FusedAdam state shape; ZeRO optimizers supply their own).
 
     Layer-stacked params shard over ``pp`` on their leading axis and over
     ``tp`` on their weight axes (the layout of reference §3.4: each
@@ -519,11 +534,12 @@ def make_pp_train_step(
         forward_backward_pipelining_without_interleaving,
     )
 
-    if config.moe:
-        raise NotImplementedError(
-            "MoE with pipeline parallelism is not wired yet; use the tp×dp "
-            "train step (make_train_step), where EP rides the dp axis"
-        )
+    # MoE composes: experts shard over dp (EP rides DP) inside each
+    # pipeline stage; every (dp, pp, tp) rank executes the tick program
+    # in lockstep, so the per-layer all_to_all stays collective-safe.
+    ep_axis = dp_axis if config.moe else None
+    if config.moe and dp_axis is None:
+        raise ValueError("MoE in the pipeline step needs a dp axis (EP rides DP)")
     H = config.hidden_size
     tp = mesh.shape[tp_axis]
     n_local_heads = config.num_attention_heads // tp
@@ -545,14 +561,16 @@ def make_pp_train_step(
                 f"pp ({mesh.shape[pp_axis]}) when virtual_pipeline_size > 1"
             )
 
-    base = param_specs(config)
+    base = param_specs(config, ep_axis=ep_axis)
 
     def pp_spec(spec):
         # prepend pp sharding on the stacked-layer axis
         return P(pp_axis, *spec[1:])
 
     specs = dict(base)
-    specs["layers"] = {k: pp_spec(s) for k, s in base["layers"].items()}
+    specs["layers"] = jax.tree.map(
+        pp_spec, base["layers"], is_leaf=lambda s: isinstance(s, P)
+    )
 
     def pre_fn(shared, mb):
         tokens = mb["tokens"]
@@ -569,10 +587,15 @@ def make_pp_train_step(
         return x
 
     def stage_fn(stage_params, x):
-        layer = partial(_layer, config=config, axis_name=tp_axis, n_local_heads=n_local_heads)
+        layer = partial(_layer, config=config, axis_name=tp_axis,
+                        n_local_heads=n_local_heads, ep_axis=ep_axis)
         if config.checkpoint_layers:
             layer = jax.checkpoint(layer)
-        out, _ = jax.lax.scan(lambda c, lp: (layer(c, lp)[0], None), x, stage_params)
+        out, aux = jax.lax.scan(lambda c, lp: layer(c, lp), x, stage_params)
+        if config.moe:
+            # pre-weight the load-balancing aux; the schedule adds it to
+            # the loss per (stage, microbatch) unit and seeds its vjp
+            return out, config.moe_aux_coef * jnp.sum(aux)
         return out
 
     def post_fn(shared, x, mb):
@@ -607,10 +630,12 @@ def make_pp_train_step(
             loss, (g_shared, g_stage) = forward_backward_pipelining_with_interleaving(
                 pre_fn, stage_fn, post_fn, shared, stages, mb,
                 virtual_pipeline_model_parallel_size=vpp, axis_name=pp_axis,
+                stage_has_aux=config.moe,
             )
         else:
             loss, (g_shared, g_stage) = forward_backward_pipelining_without_interleaving(
-                pre_fn, stage_fn, post_fn, shared, stages, mb, axis_name=pp_axis
+                pre_fn, stage_fn, post_fn, shared, stages, mb, axis_name=pp_axis,
+                stage_has_aux=config.moe,
             )
         grads = {**g_shared, "layers": g_stage}
         if sp:
@@ -618,7 +643,23 @@ def make_pp_train_step(
         if dp_axis is not None:
             loss = jax.lax.pmean(loss, dp_axis)
             if not zero_opt:
-                grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+                if config.moe:
+                    # expert grads are dp-SHARDED (the all_to_all already
+                    # delivered the dp-summed cotangents): divide, never
+                    # pmean (which would mix different experts' grads)
+                    from apex_tpu.transformer.expert_parallel import EXPERT_PARAM_KEYS
+
+                    inv = 1.0 / jax.lax.axis_size(dp_axis)
+                    moe_g = {
+                        k: (v * inv if k in EXPERT_PARAM_KEYS
+                            else jax.lax.pmean(v, dp_axis))
+                        for k, v in grads["layers"]["moe"].items()
+                    }
+                    rest = {**grads, "layers": {k: v for k, v in grads["layers"].items() if k != "moe"}}
+                    grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), rest)
+                    grads["layers"]["moe"] = moe_g
+                else:
+                    grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
         # ZeRO: grads stay LOCAL — the optimizer's psum_scatter over dp
         # IS the gradient sync (reduce-scatter fused with the update)
         new_params, new_state = optimizer.update(grads, opt_state, params)
@@ -631,7 +672,13 @@ def make_pp_train_step(
     # axis_sizes={tp:..., pp:...} so the state is sized for the local
     # (pp, tp) param shard and sharded over (model axes, dp).
     zero_opt = hasattr(optimizer, "state_partition_spec")
-    if zero_opt:
+    if zero_opt and config.moe:
+        raise NotImplementedError(
+            "ZeRO + MoE expert sharding both claim the dp axis; not wired"
+        )
+    if opt_state_spec is not None:
+        sspec = opt_state_spec
+    elif zero_opt:
         sspec = optimizer.state_partition_spec()
     else:
         sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)

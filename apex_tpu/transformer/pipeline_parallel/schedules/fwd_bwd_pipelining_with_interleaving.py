@@ -70,6 +70,7 @@ def forward_backward_pipelining_with_interleaving(
     virtual_pipeline_model_parallel_size: int = 2,
     forward_only: bool = False,
     axis_name: str = PIPELINE_AXIS,
+    stage_has_aux: bool = False,
 ):
     """Interleaved analog of the non-interleaved fwd_bwd; stage params
     hold ``vpp`` chunks stacked on the layer axis (see
@@ -91,7 +92,7 @@ def forward_backward_pipelining_with_interleaving(
 
     loss, (g_shared, g_stage) = pipelined_fwd_bwd(
         pre_fn, stage_fn, post_fn, shared_params, stage_params, microbatches,
-        num_chunks=vpp, axis_name=axis_name,
+        num_chunks=vpp, axis_name=axis_name, stage_has_aux=stage_has_aux,
     )
     g_shared = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_shared)
     return loss, (g_shared, g_stage)

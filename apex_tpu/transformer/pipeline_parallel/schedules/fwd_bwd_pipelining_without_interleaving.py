@@ -74,6 +74,7 @@ def forward_backward_pipelining_without_interleaving(
     *,
     forward_only: bool = False,
     axis_name: str = PIPELINE_AXIS,
+    stage_has_aux: bool = False,
 ):
     """Run the pipelined schedule; returns ``(loss, (shared_grads, stage_grads))``.
 
@@ -90,7 +91,7 @@ def forward_backward_pipelining_without_interleaving(
 
     loss, (g_shared, g_stage) = pipelined_fwd_bwd(
         pre_fn, stage_fn, post_fn, shared_params, stage_params, microbatches,
-        num_chunks=1, axis_name=axis_name,
+        num_chunks=1, axis_name=axis_name, stage_has_aux=stage_has_aux,
     )
     g_shared = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_shared)
     return loss, (g_shared, g_stage)

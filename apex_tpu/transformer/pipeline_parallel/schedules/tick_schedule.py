@@ -94,6 +94,7 @@ def pipelined_fwd_bwd(
     *,
     num_chunks: int = 1,
     axis_name: str = PIPELINE_AXIS,
+    stage_has_aux: bool = False,
 ):
     """One-forward-one-backward pipeline with O(vpp·P) live activations.
 
@@ -103,6 +104,12 @@ def pipelined_fwd_bwd(
     ``(loss, (shared_grads, stage_grads))``; shared grads are LOCAL
     contributions (pre on stage 0, post on stage P-1) — psum over the
     pipeline axis to combine, as the wrapper schedules do.
+
+    ``stage_has_aux``: ``stage_fn`` returns ``(y, aux)`` with a scalar
+    auxiliary loss (MoE load balancing, pre-weighted by the caller);
+    aux is added to the loss per (stage, microbatch) unit and its
+    cotangent (1/M) is seeded into each backward unit's vjp, so expert
+    routers train identically to the non-pipelined path.
     """
     Pp = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
@@ -173,7 +180,13 @@ def pipelined_fwd_bwd(
             slot = jnp.clip(u, 0, n_slots - 1) % S_buf
             written = jax.lax.dynamic_update_index_in_dim(xbuf, x, slot, 0)
             xbuf = jnp.where(ok, written, xbuf)
-            y = stage_fn(chunk_of(jnp.clip(v, 0, vpp - 1)), x)
+            if stage_has_aux:
+                y, aux_v = stage_fn(chunk_of(jnp.clip(v, 0, vpp - 1)), x)
+                loss_sum = loss_sum + jnp.where(
+                    ok, aux_v.astype(jnp.float32) * inv_m, 0.0
+                )
+            else:
+                y = stage_fn(chunk_of(jnp.clip(v, 0, vpp - 1)), x)
             if do_post:
                 # Only stage P-1's last chunk runs the loss head.  The
                 # predicate depends on (stage, tick) alone — uniform
@@ -212,7 +225,11 @@ def pipelined_fwd_bwd(
             dy = jnp.where((stage == Pp - 1) & (vb == vpp - 1), seed_dx, cot_msg)
             vb_c = jnp.clip(vb, 0, vpp - 1)
             _, stage_vjp = jax.vjp(stage_fn, chunk_of(vb_c), x_saved)
-            d_chunk, dx = stage_vjp(dy)
+            if stage_has_aux:
+                aux_seed = jnp.where(ok_b, jnp.float32(inv_m), 0.0)
+                d_chunk, dx = stage_vjp((dy, aux_seed))
+            else:
+                d_chunk, dx = stage_vjp(dy)
             if vpp == 1:
                 g_st = _mask_add(g_st, d_chunk, ok_b)
             else:
