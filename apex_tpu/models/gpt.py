@@ -510,11 +510,17 @@ def make_pp_train_step(
     dp_axis: Optional[str] = "dp",
     virtual_pipeline_size: int = 1,
     opt_state_spec=None,
+    cp_axis: Optional[str] = None,
 ):
     """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
 
     ``opt_state_spec`` overrides the optimizer-state PartitionSpec tree
     (default: FusedAdam state shape; ZeRO optimizers supply their own).
+
+    ``cp_axis``: context parallelism inside every stage — the sequence
+    shards over the axis and each layer's attention is ring attention
+    (4D tp × pp × dp × cp).  All stages run the ring's ppermutes in
+    lockstep per tick, so the collectives stay consistent.
 
     Layer-stacked params shard over ``pp`` on their leading axis and over
     ``tp`` on their weight axes (the layout of reference §3.4: each
@@ -540,6 +546,9 @@ def make_pp_train_step(
     ep_axis = dp_axis if config.moe else None
     if config.moe and dp_axis is None:
         raise ValueError("MoE in the pipeline step needs a dp axis (EP rides DP)")
+    if cp_axis is not None and config.sequence_parallel:
+        raise ValueError("sequence_parallel (tp) and context parallelism both "
+                         "shard the sequence; enable one")
     H = config.hidden_size
     tp = mesh.shape[tp_axis]
     n_local_heads = config.num_attention_heads // tp
@@ -576,7 +585,12 @@ def make_pp_train_step(
         tokens = mb["tokens"]
         B, S = tokens.shape
         emb = vocab_parallel_embedding(tokens, shared["embed"], axis_name=tp_axis)
-        x = emb.transpose(1, 0, 2) + shared["pos_embed"][:S][:, None, :]
+        if cp_axis is not None:
+            start = jax.lax.axis_index(cp_axis) * S
+            pos = jax.lax.dynamic_slice_in_dim(shared["pos_embed"], start, S, axis=0)
+        else:
+            pos = shared["pos_embed"][:S]
+        x = emb.transpose(1, 0, 2) + pos[:, None, :]
         x = x.astype(config.compute_dtype)
         if sp:
             from apex_tpu.transformer.tensor_parallel.mappings import (
@@ -588,7 +602,8 @@ def make_pp_train_step(
 
     def stage_fn(stage_params, x):
         layer = partial(_layer, config=config, axis_name=tp_axis,
-                        n_local_heads=n_local_heads, ep_axis=ep_axis)
+                        n_local_heads=n_local_heads, ep_axis=ep_axis,
+                        cp_axis=cp_axis)
         if config.checkpoint_layers:
             layer = jax.checkpoint(layer)
         out, aux = jax.lax.scan(lambda c, lp: layer(c, lp), x, stage_params)
@@ -640,6 +655,10 @@ def make_pp_train_step(
         grads = {**g_shared, "layers": g_stage}
         if sp:
             grads = sp_grad_sync(grads, tp_axis)
+        if cp_axis is not None:
+            # each cp rank's loss/grads cover its local sequence chunk
+            loss = jax.lax.pmean(loss, cp_axis)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, cp_axis), grads)
         if dp_axis is not None:
             loss = jax.lax.pmean(loss, dp_axis)
             if not zero_opt:
@@ -682,7 +701,7 @@ def make_pp_train_step(
         sspec = optimizer.state_partition_spec()
     else:
         sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
-    data_spec = P(dp_axis, None) if dp_axis is not None else P()
+    data_spec = P(dp_axis, cp_axis) if dp_axis is not None else P(None, cp_axis)
 
     sharded = jax.shard_map(
         local_step,
