@@ -9,7 +9,7 @@ single-device oracle.  Multi-chip hardware isn't needed —
 Tiers (the reference's L0/L1 split):
 
 - quick: ``pytest -m "not slow" tests/`` — unit + small parity tests,
-  ~3.5 min (measured on this image).  Run on every change.
+  ~3-4.5 min depending on machine load.  Run on every change.
 - full:  ``pytest tests/`` — adds the compiled e2e/model-level parity
   workloads (GPT 3D/MoE/ResNet trainers, ZeRO resharding, HLO memory
   regressions), ~10-11 min.  CI / pre-commit.
