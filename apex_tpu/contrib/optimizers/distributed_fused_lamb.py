@@ -58,7 +58,15 @@ class DistributedFusedLAMB:
 
     def init(self, params, world_size: Optional[int] = None) -> DistributedFusedLAMBState:
         """GLOBAL flat state (padded_total,) — shard over dp with
-        :meth:`state_partition_spec` (see DistributedFusedAdam.init)."""
+        :meth:`state_partition_spec` (see DistributedFusedAdam.init).
+
+        dp-only by design: LAMB's stage-2 trust ratios need GLOBAL
+        per-tensor norms, so composing with tensor-parallel param shards
+        would silently turn them into per-shard norms.  Use
+        :class:`DistributedFusedAdam` when params are model-sharded
+        (its ``param_specs=`` init), or keep LAMB params replicated —
+        the reference's DistributedFusedLAMB is likewise a pure-dp
+        (BERT) optimizer."""
         if world_size is None:
             raise ValueError("pass world_size= (the dp axis size)")
         total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
