@@ -92,12 +92,14 @@ class SelfMultiheadAttn(nn.Module):
 
 class EncdecMultiheadAttn(nn.Module):
     """Cross attention: q from decoder, k/v from encoder (reference
-    encdec_multihead_attn.py)."""
+    encdec_multihead_attn.py — incl. ``bias`` and ``include_norm_add``
+    pre-LN + residual-add fusion, encdec_multihead_attn.py:27-63)."""
 
     hidden_size: int
     num_heads: int
     dropout: float = 0.0
-    use_bias: bool = True
+    use_bias: bool = False
+    include_norm_add: bool = False
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -110,12 +112,23 @@ class EncdecMultiheadAttn(nn.Module):
         nh = self.num_heads
         hd = H // nh
 
+        residual = query
+        if self.include_norm_add:
+            ln_w = self.param("lyr_nrm_gamma_weights", nn.initializers.ones, (H,), jnp.float32)
+            ln_b = self.param("lyr_nrm_beta_weights", nn.initializers.zeros, (H,), jnp.float32)
+            query = fused_layer_norm_affine(query, ln_w, ln_b, (H,), 1e-5)
+
         w_q = self.param("q_weights", nn.initializers.lecun_normal(), (H, H), self.param_dtype)
         w_kv = self.param("kv_weights", nn.initializers.lecun_normal(), (2 * H, H), self.param_dtype)
         w_out = self.param("output_weights", nn.initializers.lecun_normal(), (H, H), self.param_dtype)
 
         q = jnp.matmul(query, w_q.T.astype(query.dtype))
         kv = jnp.matmul(key, w_kv.T.astype(key.dtype))
+        if self.use_bias:
+            b_q = self.param("q_biases", nn.initializers.zeros, (H,), self.param_dtype)
+            b_kv = self.param("kv_biases", nn.initializers.zeros, (2 * H,), self.param_dtype)
+            q = q + b_q.astype(q.dtype)
+            kv = kv + b_kv.astype(kv.dtype)
         k, v = jnp.split(kv, 2, axis=-1)
 
         def heads(t, s):
@@ -127,4 +140,10 @@ class EncdecMultiheadAttn(nn.Module):
         ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, H)
         if train and self.dropout > 0:
             ctx = nn.Dropout(rate=self.dropout, deterministic=False)(ctx)
-        return jnp.matmul(ctx, w_out.T.astype(ctx.dtype))
+        out = jnp.matmul(ctx, w_out.T.astype(ctx.dtype))
+        if self.use_bias:
+            b_out = self.param("output_biases", nn.initializers.zeros, (H,), self.param_dtype)
+            out = out + b_out.astype(out.dtype)
+        if self.include_norm_add:
+            out = out + residual.astype(out.dtype)
+        return out

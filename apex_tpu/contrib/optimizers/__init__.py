@@ -1,3 +1,11 @@
+"""ZeRO distributed optimizers + the reference's deprecated re-exports.
+
+Reference ``apex/contrib/optimizers/__init__.py`` also exports legacy
+``FP16_Optimizer`` / ``FusedAdam`` / ``FusedLAMB`` shims (deprecated
+there in favor of ``apex.optimizers`` / ``apex.amp``); here they alias
+the maintained implementations and warn once.
+"""
+
 from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     DistributedFusedAdam,
     DistributedFusedAdamState,
@@ -9,3 +17,27 @@ __all__ = [
     "DistributedFusedAdamState",
     "DistributedFusedLAMB",
 ]
+
+
+def __getattr__(name):
+    _legacy = {
+        "FusedAdam": ("apex_tpu.optimizers", "FusedAdam"),
+        "FusedLAMB": ("apex_tpu.optimizers", "FusedLAMB"),
+        "FP16_Optimizer": ("apex_tpu.fp16_utils", "FP16_Optimizer"),
+    }
+    if name in _legacy:
+        import importlib
+
+        from apex_tpu import deprecated_warning
+
+        deprecated_warning(
+            f"apex_tpu.contrib.optimizers.{name} is deprecated (as in the "
+            f"reference); use {_legacy[name][0]}.{name}."
+        )
+        mod, attr = _legacy[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# NOTE: the legacy names are intentionally NOT in __all__ — the reference
+# shims warn on *use*, and a star-import must not trigger the warnings.

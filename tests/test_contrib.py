@@ -230,6 +230,76 @@ class TestMultiheadAttn:
         np.testing.assert_allclose(np.asarray(out[:, 0]), ref.detach().numpy()[:, 0],
                                    rtol=1e-3, atol=1e-4)
 
+    def test_encdec_norm_add_and_bias(self):
+        """include_norm_add (pre-LN + residual) and bias parity with the
+        reference encdec module options (encdec_multihead_attn.py:27-63)."""
+        m = EncdecMultiheadAttn(hidden_size=16, num_heads=4, dropout=0.0,
+                                use_bias=True, include_norm_add=True)
+        rng = np.random.RandomState(12)
+        q = jnp.asarray(rng.randn(6, 2, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(10, 2, 16).astype(np.float32))
+        p = m.init(jax.random.PRNGKey(0), q, k, train=False)
+        names = set(p["params"].keys())
+        assert {"lyr_nrm_gamma_weights", "lyr_nrm_beta_weights",
+                "q_biases", "kv_biases", "output_biases"} <= names
+        out = m.apply(p, q, k, train=False)
+        assert out.shape == q.shape
+        # with zero-init biases and unit LN the residual shows up: output
+        # minus residual equals the plain (norm-applied) attention output
+        m0 = EncdecMultiheadAttn(hidden_size=16, num_heads=4, dropout=0.0)
+        p0 = {"params": {n: p["params"][n] for n in
+                         ("q_weights", "kv_weights", "output_weights")}}
+        from apex_tpu.normalization import fused_layer_norm_affine
+        qn = fused_layer_norm_affine(
+            q, p["params"]["lyr_nrm_gamma_weights"],
+            p["params"]["lyr_nrm_beta_weights"], (16,), 1e-5)
+        base = m0.apply(p0, qn, k, train=False)
+        np.testing.assert_allclose(np.asarray(out - q), np.asarray(base),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mask_softmax_dropout_func(self):
+        """fast_mask_softmax_dropout_func parity vs plain softmax oracle,
+        byte-mask and additive-mask modes (reference
+        mask_softmax_dropout_func.py)."""
+        from apex_tpu.contrib.multihead_attn import fast_mask_softmax_dropout_func
+
+        B, nh, Sq, Sk = 2, 3, 4, 5
+        rng = np.random.RandomState(13)
+        scores = jnp.asarray(rng.randn(B * nh, Sq, Sk).astype(np.float32))
+        pad = np.zeros((B, Sk), np.uint8)
+        pad[1, 3:] = 1
+
+        out = fast_mask_softmax_dropout_func(False, nh, scores, jnp.asarray(pad), False, 0.3)
+        ref = np.asarray(scores, np.float64).copy().reshape(B, nh, Sq, Sk)
+        ref[1, :, :, 3:] = -1e9
+        ref = torch.softmax(torch.tensor(ref), dim=-1).numpy().reshape(B * nh, Sq, Sk)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-6)
+
+        add = np.where(pad, -30000.0, 0.0).astype(np.float32)
+        out2 = fast_mask_softmax_dropout_func(False, nh, scores, jnp.asarray(add), True, 0.0)
+        np.testing.assert_allclose(np.asarray(out2), ref, rtol=1e-4, atol=1e-6)
+
+        # training dropout: rows still sum to ~1/keep in expectation, and
+        # an explicit key is required
+        out3 = fast_mask_softmax_dropout_func(
+            True, nh, scores, None, False, 0.5, key=jax.random.PRNGKey(0))
+        assert out3.shape == scores.shape
+        with pytest.raises(ValueError):
+            fast_mask_softmax_dropout_func(True, nh, scores, None, False, 0.5)
+
+    def test_legacy_contrib_optimizer_exports(self):
+        """Reference contrib/optimizers re-exports deprecated
+        FP16_Optimizer/FusedAdam/FusedLAMB; ours alias the maintained
+        implementations."""
+        import apex_tpu.contrib.optimizers as co
+        from apex_tpu.fp16_utils import FP16_Optimizer as RealFP16
+        from apex_tpu.optimizers import FusedAdam as RealAdam
+        from apex_tpu.optimizers import FusedLAMB as RealLamb
+
+        assert co.FusedAdam is RealAdam
+        assert co.FusedLAMB is RealLamb
+        assert co.FP16_Optimizer is RealFP16
+
     def test_encdec_key_padding_mask_blocks_keys(self):
         m = EncdecMultiheadAttn(hidden_size=16, num_heads=4, dropout=0.0)
         rng = np.random.RandomState(10)
