@@ -11,6 +11,9 @@ weight averaging — is implemented here.
 
 from apex_tpu.contrib.openfold_triton.fused_adam_swa import AdamSWAState, FusedAdamSWA
 from apex_tpu.contrib.openfold_triton.mha import (
+    AttnBiasJIT,
+    AttnNoBiasJIT,
+    AttnTri,
     CanSchTriMHA,
     attention_core,
     disable,
@@ -19,8 +22,19 @@ from apex_tpu.contrib.openfold_triton.mha import (
 )
 from apex_tpu.normalization import FusedLayerNorm as LayerNormSmallShapeOptImpl
 
+def sync_triton_auto_tune_cache_across_gpus() -> None:
+    """Reference __init__.py:97 broadcasts the Triton autotune cache from
+    rank 0 so every GPU skips re-tuning.  XLA/Mosaic kernels compile
+    deterministically per shape (the compilation cache is content-
+    addressed), so there is nothing to synchronize; kept for API parity."""
+
+
 __all__ = [
     "FusedAdamSWA",
+    "AttnTri",
+    "AttnBiasJIT",
+    "AttnNoBiasJIT",
+    "sync_triton_auto_tune_cache_across_gpus",
     "AdamSWAState",
     "LayerNormSmallShapeOptImpl",
     "attention_core",

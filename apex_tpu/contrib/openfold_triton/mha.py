@@ -14,8 +14,10 @@ because OpenFold trains it (it comes from the pair representation).
 The shape gate collapses to "always" (no Triton block constraints).
 """
 
+from functools import partial
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops.attention import flash_attention
@@ -83,3 +85,29 @@ def attention_core(
         q4, k4, v4, causal=False, attn_bias=attn_bias, impl="scan"
     )
     return out.reshape(*lead, H, Sq, D)
+
+
+def AttnTri(q, k, v, mask=None, bias=None, inf=1e9):
+    """Reference ``AttnTri = FusedAttenionCoreFunc.apply`` (mha.py:397) —
+    positional alias of :func:`attention_core` (the fused/flash path)."""
+    return attention_core(q, k, v, mask=mask, bias=bias, inf=inf)
+
+
+@partial(jax.jit, static_argnames=("inf",))
+def AttnBiasJIT(query, key, value, mask, bias, inf):
+    """Reference ``torch.compile(_attention_bias)`` (mha.py:472): the
+    jitted composite with a trained pair bias — XLA fuses the
+    scale/mask/bias/softmax chain; (mask - 1)·inf reproduces the
+    OpenFold logit-mask convention exactly."""
+    scaling = 1.0 / (query.shape[-1] ** 0.5)
+    a = jnp.matmul(query * scaling, jnp.swapaxes(key, -2, -1))
+    a = a + (mask.astype(a.dtype) - 1.0) * inf
+    if bias is not None:
+        a = a + bias.astype(a.dtype)
+    a = jax.nn.softmax(a.astype(jnp.float32), axis=-1).astype(query.dtype)
+    return jnp.matmul(a, value)
+
+
+def AttnNoBiasJIT(query, key, value, mask, inf):
+    """Reference ``torch.compile(_attention_no_bias)`` (mha.py:473)."""
+    return AttnBiasJIT(query, key, value, mask, None, inf)

@@ -1,12 +1,25 @@
 """Megatron-style model parallelism for TPU (reference: ``apex/transformer``)."""
 
 from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
 
-__all__ = ["parallel_state"]
+__all__ = [
+    "amp",
+    "functional",
+    "parallel_state",
+    "pipeline_parallel",
+    "tensor_parallel",
+    "utils",
+    # enums.py
+    "LayerType",
+    "AttnType",
+    "AttnMaskType",
+    "ModelType",
+]
 
 
 def __getattr__(name):
-    if name in ("tensor_parallel", "pipeline_parallel", "functional", "layers", "amp", "_data", "testing", "enums", "microbatches", "context_parallel", "expert_parallel"):
+    if name in ("tensor_parallel", "pipeline_parallel", "functional", "layers", "amp", "_data", "testing", "enums", "microbatches", "context_parallel", "expert_parallel", "utils"):
         import importlib
 
         mod = importlib.import_module(f"apex_tpu.transformer.{name}")

@@ -110,6 +110,33 @@ def report_memory(name: str) -> None:
         print_rank_0(f"[{name}] memory stats unavailable on this backend")
 
 
+def get_autoresume():
+    """Reference: utils.py:142 — optional hook to an ADLR AutoResume
+    session.  No such service exists here; always None (the reference
+    also returns its module global, which is never set in apex)."""
+    return None
+
+
+def print_params_min_max_norm(params, iteration: int) -> None:
+    """Reference: utils.py:265 — per-tensor min/max/L2-norm debug dump.
+
+    Functional form: takes the param pytree (the reference walks
+    ``optimizer.param_groups``).  One jitted pass computes all stats
+    device-side; the host loop only formats."""
+    stats = jax.jit(
+        lambda t: [(jnp.min(x), jnp.max(x), jnp.linalg.norm(jnp.ravel(x).astype(jnp.float32)))
+                   for x in jax.tree.leaves(t)]
+    )(params)
+    lines = ["iteration, rank, index, min, max, norm"]
+    rank = jax.process_index()
+    for index, (mn, mx, nm) in enumerate(stats, 1):
+        lines.append(
+            f"{iteration:7d}, {rank:4d}, {index:4d}, "
+            f"{float(mn):.6E}, {float(mx):.6E}, {float(nm):.6E}"
+        )
+    print("\n".join(lines), flush=True)
+
+
 def get_ltor_masks_and_position_ids(
     data,
     eod_token: int,

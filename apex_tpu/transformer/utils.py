@@ -1,0 +1,43 @@
+"""Utilities shared by ``pipeline_parallel`` and ``tensor_parallel``.
+
+Reference: ``apex/transformer/utils.py`` (``ensure_divisibility`` /
+``divide`` / ``split_tensor_into_1d_equal_chunks`` /
+``gather_split_1d_tensor``).
+
+TPU note: the reference's split/gather pair exists to stash sequence-
+parallel activations as flat per-rank chunks (NCCL ``all_gather`` into a
+preallocated buffer).  Here the same contract is expressed with
+``jax.shard_map`` collectives over the ``tp`` mesh axis — the split is a
+static slice by rank index, the gather is ``jax.lax.all_gather(...,
+tiled=True)`` — so both work inside jit on any mesh the caller built via
+:mod:`apex_tpu.transformer.parallel_state`.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.misc import divide, ensure_divisibility  # noqa: F401 — re-export
+from apex_tpu.transformer import parallel_state
+
+
+def split_tensor_into_1d_equal_chunks(tensor, *, rank=None, world_size=None):
+    """This rank's equal 1-D chunk of ``tensor`` (flattened).
+
+    Inside ``shard_map`` pass nothing: rank/world come from the ``tp``
+    axis (``jax.lax.axis_index``).  Outside, pass explicit ints.
+    """
+    if world_size is None:
+        world_size = parallel_state.get_tensor_model_parallel_world_size()
+    if rank is None:
+        rank = parallel_state.get_tensor_model_parallel_rank()
+    data = jnp.ravel(tensor)
+    ensure_divisibility(data.size, world_size)
+    partition = data.size // world_size
+    return jax.lax.dynamic_slice(data, (rank * partition,), (partition,))
+
+
+def gather_split_1d_tensor(tensor, *, axis_name="tp"):
+    """Opposite of :func:`split_tensor_into_1d_equal_chunks`: all-gather
+    the per-rank 1-D chunks over the tensor-parallel axis.  Must run
+    inside ``shard_map`` with ``axis_name`` bound."""
+    return jax.lax.all_gather(jnp.ravel(tensor), axis_name, tiled=True)

@@ -9,10 +9,11 @@ single-device oracle.  Multi-chip hardware isn't needed —
 Tiers (the reference's L0/L1 split):
 
 - quick: ``pytest -m "not slow" tests/`` — unit + small parity tests,
-  ~3-4.5 min depending on machine load.  Run on every change.
+  ~2:45 on this (1-core) box.  Run on every change.
 - full:  ``pytest tests/`` — adds the compiled e2e/model-level parity
   workloads (GPT 3D/MoE/ResNet trainers, ZeRO resharding, HLO memory
-  regressions), ~10-11 min.  CI / pre-commit.
+  regressions) and every per-test ``slow`` mark, ~10-11 min.  CI /
+  pre-commit.
 
 Anything >~15 s compiled carries ``@pytest.mark.slow`` (file-level
 ``pytestmark`` for whole-file e2e suites).
@@ -32,6 +33,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+# Persistent compilation cache: the suite's wall time is dominated by
+# XLA:CPU compiles (this box has one core), and the same programs
+# recompile on every run.  First run pays; re-runs hit the cache.
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import pytest  # noqa: E402
 

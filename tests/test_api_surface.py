@@ -209,6 +209,7 @@ class TestRngTrackerAlias:
 
 
 class TestBottleneck:
+    @pytest.mark.slow
     def test_forward_shapes(self):
         m = Bottleneck(in_channels=8, bottleneck_channels=4, out_channels=16, stride=2)
         x = jnp.ones((2, 8, 8, 8), jnp.bfloat16)
@@ -216,6 +217,7 @@ class TestBottleneck:
         y = m.apply(params, x)
         assert y.shape == (2, 4, 4, 16)
 
+    @pytest.mark.slow
     def test_spatial_matches_single_device(self, devices8):
         # H split over 4 devices + halo exchange == unsharded block.
         mesh = Mesh(np.array(devices8[:4]), ("spatial",))

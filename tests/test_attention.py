@@ -28,6 +28,7 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
 
     @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.slow
     def test_backward_matches_reference(self, causal):
         q, k, v = qkv(1)
 
@@ -84,6 +85,7 @@ class TestPaddedFlashAttention:
                                    rtol=1e-4, atol=1e-5)
 
     @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.slow
     def test_backward_matches_reference(self, causal):
         q, k, v = qkv(9)
         mask = padded_mask(2, 32, [32, 21])
@@ -219,6 +221,7 @@ class TestPaddedPallasFlashAttention:
                                    np.asarray(ref[1, :, :130]), atol=2e-5, rtol=2e-5)
 
     @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.slow
     def test_backward_matches_reference(self, causal):
         from apex_tpu.ops.flash_attention_pallas import flash_attention_pallas
 
@@ -275,6 +278,7 @@ class TestRingAttention:
         out = f(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_full_attention(self, devices8):
         B, H, S, D = 1, 2, 16, 4
         q, k, v = qkv(5, B=B, H=H, S=S, D=D)
@@ -325,6 +329,7 @@ class TestPallasFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
     @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.slow
     def test_backward_matches_reference(self, causal):
         from apex_tpu.ops.flash_attention_pallas import flash_attention_pallas
 
@@ -372,6 +377,7 @@ class TestPallasFlashAttention:
             np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
         )
 
+    @pytest.mark.slow
     def test_partially_masked_block_rows_zero(self):
         """Rows fully masked but sharing a q-block with visible rows must
         still be zero (and carry zero grads), independent of block size."""

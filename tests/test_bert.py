@@ -35,6 +35,7 @@ def batch():
     return tokens, pad
 
 
+@pytest.mark.slow
 def test_forward_shapes(batch):
     tokens, pad = batch
     params = init_params(CFG, jax.random.PRNGKey(0))

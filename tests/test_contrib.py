@@ -164,6 +164,7 @@ class TestFMHA:
 
 
 class TestMultiheadAttn:
+    @pytest.mark.slow
     def test_self_attn_shapes_and_norm_add(self):
         m = SelfMultiheadAttn(hidden_size=16, num_heads=4, include_norm_add=True, dropout=0.0)
         x = jnp.ones((8, 2, 16))
@@ -179,6 +180,7 @@ class TestMultiheadAttn:
         out = m.apply(p, q, k, train=False)
         assert out.shape == q.shape
 
+    @pytest.mark.slow
     def test_self_attn_matches_torch_mha(self):
         """Parity vs torch.nn.MultiheadAttention (the reference's own test
         pattern in contrib/test/multihead_attn)."""
@@ -318,6 +320,7 @@ class TestMultiheadAttn:
 class TestFMHAVarlen:
     """Packed cu_seqlens interface (reference FMHAFun call shape)."""
 
+    @pytest.mark.slow
     def test_matches_per_sequence_oracle(self):
         from apex_tpu.contrib.fmha import fmha_varlen
         from apex_tpu.ops.attention import mha_reference
@@ -341,6 +344,7 @@ class TestFMHAVarlen:
                                        rtol=1e-4, atol=1e-5)
             off += L
 
+    @pytest.mark.slow
     def test_causal_and_grads(self):
         from apex_tpu.contrib.fmha import fmha_varlen
 

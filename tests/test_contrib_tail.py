@@ -140,6 +140,7 @@ class TestTransducer:
             ref = rnnt_oracle_full(logp[b], targets[b], T, U)
             np.testing.assert_allclose(float(loss[b]), ref, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_loss_is_differentiable(self):
         rng = np.random.RandomState(4)
         logits = jnp.asarray(rng.randn(1, 3, 2, 4).astype(np.float32))
@@ -291,6 +292,7 @@ class TestRNN:
         hy = sig(o) * np.tanh(cy)
         np.testing.assert_allclose(np.asarray(out[0]), hy, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_grads_flow(self):
         import apex_tpu.RNN as RNN
 
@@ -426,6 +428,7 @@ class TestConvFrozenScaleBiasReLU:
 
 
 class TestTransducerJointOptions:
+    @pytest.mark.slow
     def test_relu_dropout_mask(self):
         f = jnp.asarray(np.random.RandomState(21).randn(2, 3, 4).astype(np.float32))
         g = jnp.asarray(np.random.RandomState(22).randn(2, 5, 4).astype(np.float32))

@@ -73,6 +73,7 @@ class TestDistributedFusedAdam:
         spec = dist.state_partition_spec()
         assert spec.exp_avg == P("dp")
 
+    @pytest.mark.slow
     def test_overflow_skip(self, devices8):
         params = make_tree()
         mesh = Mesh(np.array(devices8), ("dp",))

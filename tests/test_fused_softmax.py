@@ -47,6 +47,7 @@ class TestScaledSoftmax:
         np.testing.assert_allclose(np.asarray(out), torch_ref(x, mask=mask, scale=1.5),
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_grads_are_finite_and_masked(self):
         rng = np.random.RandomState(3)
         x = jnp.asarray(rng.randn(1, 1, 4, 8).astype(np.float32))

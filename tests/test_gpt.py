@@ -135,6 +135,7 @@ def test_tp_sp_grads_match_after_sync(batch, devices8):
         )
 
 
+@pytest.mark.slow
 def test_training_reduces_loss(batch):
     params = init_params(CFG, jax.random.PRNGKey(0))
     targets = jnp.roll(batch, -1, axis=1)

@@ -28,6 +28,7 @@ BASE = dict(
 )
 
 
+@pytest.mark.slow
 def test_flash_core_matches_einsum_core():
     cfg_e = GPTConfig(**BASE)
     cfg_f = GPTConfig(**BASE, use_flash_attention=True)
@@ -38,6 +39,7 @@ def test_flash_core_matches_einsum_core():
     np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_f), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_cp_forward_matches_single_device(devices8):
     cfg = GPTConfig(**BASE)
     params = init_params(cfg, jax.random.PRNGKey(0))

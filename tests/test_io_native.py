@@ -107,6 +107,7 @@ class TestShardedCheckpoint:
         with pytest.raises(FileNotFoundError, match="missing shard"):
             load_sharded_checkpoint(d)
 
+    @pytest.mark.slow
     def test_zero2_resharding_through_files(self, tmp_path, devices8):
         """End-to-end: ZeRO shard dicts through the sharded-file
         protocol, reloaded at a different dp world."""

@@ -38,6 +38,7 @@ def test_fwd_interpret_matches_reference(rms):
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bwd_interpret_matches_autodiff():
     R, H = 32, 128
     rng = np.random.RandomState(1)

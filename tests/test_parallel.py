@@ -141,6 +141,7 @@ class TestSyncBatchNorm:
             np.asarray(updated["batch_stats"]["running_var"]), ref_var, rtol=1e-4, atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_sharded_matches_full_batch(self, devices8):
         """The reference's core distributed test: stats synced over dp ==
         single-process full-batch BN (two_gpu_unit_test.py)."""

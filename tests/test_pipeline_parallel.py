@@ -98,6 +98,7 @@ class TestPipelinedApply:
 class TestPipelineParity:
     """The reference's exact-parity pattern (test_pipeline_parallel_fwd_bwd.py)."""
 
+    @pytest.mark.slow
     def test_loss_matches_oracle(self, devices8):
         shared, stages, batch = make_problem()
         ref = oracle_loss(shared, stages, batch)
@@ -219,6 +220,7 @@ class TestInterleaved:
 
 
 class TestNoPipelining:
+    @pytest.mark.slow
     def test_matches_oracle(self):
         shared, stages, batch = make_problem(2)
 

@@ -121,6 +121,7 @@ class TestParallelLinears:
         ref = self.x @ self.w.T + self.b
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_column_parallel_grads_match_dense(self, tp_mesh):
         x, w, b = map(jnp.asarray, (self.x, self.w, self.b))
 
@@ -157,6 +158,7 @@ class TestParallelLinears:
         ref = x @ w.T + b
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_column_row_pair_sequence_parallel(self, tp_mesh):
         # the Megatron block pattern: SP in → column (gather) → row (reduce-scatter) → SP out
         rng = np.random.RandomState(2)
@@ -217,6 +219,7 @@ class TestVocabParallel:
         ref = lse - jnp.take_along_axis(lj, tj[:, None], axis=1)[:, 0]
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_cross_entropy_grad_matches_dense(self, tp_mesh):
         rng = np.random.RandomState(5)
         batch, vocab = 6, 16
