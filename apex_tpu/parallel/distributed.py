@@ -25,6 +25,8 @@ reference configure the overlap engine and have no TPU meaning; the
 
 from typing import Any, Optional
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
@@ -106,15 +108,22 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
+        self.prof = prof
 
     def sync(self, grads):
-        return allreduce_gradients(
-            grads,
-            axis_name=self.axis_name,
-            gradient_average=self.gradient_average,
-            gradient_predivide_factor=self.gradient_predivide_factor,
-            allreduce_always_fp32=self.allreduce_always_fp32,
-        )
+        ctx = contextlib.nullcontext()
+        if self.prof:  # reference distributed.py:363 nvtx range
+            from apex_tpu.utils.profiler import nvtx_range
+
+            ctx = nvtx_range("allreduce_gradients")
+        with ctx:
+            return allreduce_gradients(
+                grads,
+                axis_name=self.axis_name,
+                gradient_average=self.gradient_average,
+                gradient_predivide_factor=self.gradient_predivide_factor,
+                allreduce_always_fp32=self.allreduce_always_fp32,
+            )
 
     def __call__(self, *args, **kwargs):
         if self.module is None:

@@ -117,16 +117,19 @@ def get_autoresume():
     return None
 
 
+@jax.jit
+def _param_stats(t):
+    return [(jnp.min(x), jnp.max(x), jnp.linalg.norm(jnp.ravel(x).astype(jnp.float32)))
+            for x in jax.tree.leaves(t)]
+
+
 def print_params_min_max_norm(params, iteration: int) -> None:
     """Reference: utils.py:265 — per-tensor min/max/L2-norm debug dump.
 
     Functional form: takes the param pytree (the reference walks
     ``optimizer.param_groups``).  One jitted pass computes all stats
     device-side; the host loop only formats."""
-    stats = jax.jit(
-        lambda t: [(jnp.min(x), jnp.max(x), jnp.linalg.norm(jnp.ravel(x).astype(jnp.float32)))
-                   for x in jax.tree.leaves(t)]
-    )(params)
+    stats = _param_stats(params)
     lines = ["iteration, rank, index, min, max, norm"]
     rank = jax.process_index()
     for index, (mn, mx, nm) in enumerate(stats, 1):

@@ -1,7 +1,15 @@
-"""Shared utilities: logging, math helpers, pytree helpers."""
+"""Shared utilities: logging, math helpers, profiling ranges."""
 
 from apex_tpu.utils.logging import RankInfoFormatter, get_logger, set_logging_level
 from apex_tpu.utils.misc import divide, ensure_divisibility
+from apex_tpu.utils.profiler import (
+    nvtx_range,
+    nvtx_range_pop,
+    nvtx_range_push,
+    profile,
+    start_profile,
+    stop_profile,
+)
 
 __all__ = [
     "RankInfoFormatter",
@@ -9,4 +17,10 @@ __all__ = [
     "set_logging_level",
     "divide",
     "ensure_divisibility",
+    "nvtx_range",
+    "nvtx_range_push",
+    "nvtx_range_pop",
+    "profile",
+    "start_profile",
+    "stop_profile",
 ]

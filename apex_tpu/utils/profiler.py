@@ -1,0 +1,85 @@
+"""Profiling ranges and trace capture.
+
+Reference: NVTX ranges gated by the ``prof`` flag in apex DDP
+(``apex/parallel/distributed.py:363-364,406-407,520-521``) plus the
+CUDA-synchronized ``_Timers``
+(``apex/transformer/pipeline_parallel/_timers.py``; our port lives in
+:mod:`apex_tpu.transformer.pipeline_parallel.utils`).
+
+TPU mapping: ``torch.cuda.nvtx.range_push/pop`` becomes a pair of
+annotations — ``jax.named_scope`` names the ops in the traced HLO (so
+ranges survive compilation and show up in the XLA trace viewer), and
+``jax.profiler.TraceAnnotation`` marks the host timeline.  Trace
+capture (`nsys` analog) is ``jax.profiler.start_trace`` writing a
+TensorBoard-loadable protobuf.
+"""
+
+import contextlib
+from typing import List, Optional
+
+import jax
+
+__all__ = [
+    "nvtx_range",
+    "nvtx_range_push",
+    "nvtx_range_pop",
+    "start_profile",
+    "stop_profile",
+    "profile",
+]
+
+_range_stack: List[object] = []
+
+
+@contextlib.contextmanager
+def nvtx_range(name: str):
+    """Named range visible in both the HLO (op metadata) and the host
+    trace.  Usable inside traced code (the named_scope part) and out."""
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def nvtx_range_push(name: str) -> None:
+    """``torch.cuda.nvtx.range_push`` parity (stack-based form)."""
+    cm = nvtx_range(name)
+    cm.__enter__()
+    _range_stack.append(cm)
+
+
+def nvtx_range_pop() -> None:
+    """``torch.cuda.nvtx.range_pop`` parity."""
+    if not _range_stack:
+        raise RuntimeError("nvtx_range_pop without a matching push")
+    _range_stack.pop().__exit__(None, None, None)
+
+
+_trace_dir: Optional[str] = None
+
+
+def start_profile(logdir: str) -> None:
+    """Begin a device+host trace (TensorBoard / xprof format)."""
+    global _trace_dir
+    if _trace_dir is not None:
+        raise RuntimeError(f"profile already running (logdir={_trace_dir})")
+    jax.profiler.start_trace(logdir)
+    _trace_dir = logdir
+
+
+def stop_profile() -> Optional[str]:
+    """End the trace; returns the logdir it was written to."""
+    global _trace_dir
+    if _trace_dir is None:
+        raise RuntimeError("no profile running")
+    jax.profiler.stop_trace()
+    out, _trace_dir = _trace_dir, None
+    return out
+
+
+@contextlib.contextmanager
+def profile(logdir: str):
+    """``with profile('/tmp/trace'):`` — capture a trace of the body."""
+    start_profile(logdir)
+    try:
+        yield
+    finally:
+        stop_profile()

@@ -187,3 +187,55 @@ class TestL1TrajectoryCrossProduct:
             params, opt_state, loss = step(params, opt_state, xs[i], ys[i])
             plain.append(float(loss))
         np.testing.assert_allclose(o0, np.asarray(plain), rtol=1e-6)
+
+
+class TestAmpMasterParams:
+    """tests/distributed/amp_master_params analog: after DDP+amp
+    training, the half model params must equal the fp32 master params
+    cast to half, on every rank."""
+
+    @pytest.mark.parametrize("half_dtype", [jnp.float16, jnp.bfloat16])
+    def test_model_equals_master_cast(self, half_dtype, devices8):
+        params0 = init_params(np.random.RandomState(1))
+        params, amp_obj = amp.initialize(
+            params0, opt_level="O2", half_dtype=half_dtype,
+            loss_scale="dynamic" if half_dtype == jnp.float16 else None,
+        )
+        opt = FusedSGD(lr=0.05, momentum=0.9, master_weights=True)
+        opt_state = opt.init(params)
+        scaler_state = amp_obj.init_state()
+        xs, ys = make_batches()
+        mesh = Mesh(np.array(devices8[:4]), ("dp",))
+        amp_vg = amp.value_and_grad(
+            amp_obj, lambda p, x, y: (lambda logits: -jnp.mean(jnp.sum(
+                jax.nn.one_hot(y, 10) * jax.nn.log_softmax(logits), axis=-1
+            )))(forward(p, x, "dp")))
+
+        def local(params, opt_state, scaler_state, x, y):
+            loss, grads, scaler_state, finite = amp_vg(params, scaler_state, x, y)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+            if finite is not None:
+                finite = jnp.logical_and(
+                    jax.lax.pmin(finite.astype(jnp.int32), "dp"), 1).astype(bool)
+            params, opt_state = opt.update(grads, opt_state, params, grads_finite=finite)
+            return params, opt_state, scaler_state, loss
+
+        step = jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        ))
+        for i in range(STEPS):
+            params, opt_state, scaler_state, _ = step(
+                params, opt_state, scaler_state, xs[i], ys[i])
+
+        # the reference compare.py contract, leaf by leaf
+        for name, p in params.items():
+            m = opt_state.master[name]
+            if p.dtype == half_dtype:
+                assert m.dtype == jnp.float32
+                np.testing.assert_array_equal(
+                    np.asarray(p, np.float32),
+                    np.asarray(m.astype(half_dtype), np.float32),
+                    err_msg=f"model/master divergence in {name}")

@@ -1,0 +1,154 @@
+"""Multi-tensor primitive parity tests.
+
+Mirrors the reference's per-kernel L0 suite
+(``tests/L0/run_amp/test_multi_tensor_scale.py`` / ``..._axpby`` /
+``..._l2norm`` / ``..._unscale_l2norm``): each op checked against a
+NumPy oracle over fp32/fp16/bf16 in/out combinations, overflow
+(inf/nan) detection included.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_norm_blend,
+    multi_tensor_scale,
+    tree_not_finite,
+    tree_where,
+)
+
+
+def _tree(dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "a": jnp.asarray(rng.randn(33, 9).astype(np.float32)).astype(dtype),
+        "b": [jnp.asarray(rng.randn(5).astype(np.float32)).astype(dtype)],
+    }
+
+
+IN_OUT = [
+    (jnp.float32, jnp.float32),
+    (jnp.float16, jnp.float16),
+    (jnp.bfloat16, jnp.bfloat16),
+    (jnp.float16, jnp.float32),
+    (jnp.float32, jnp.float16),
+]
+
+
+class TestScale:
+    @pytest.mark.parametrize("in_dtype,out_dtype", IN_OUT)
+    def test_matches_numpy(self, in_dtype, out_dtype):
+        src = _tree(in_dtype)
+        out, found_inf = multi_tensor_scale(src, 0.25, out_dtype=out_dtype)
+        assert not bool(found_inf)
+        for k in ("a",):
+            ref = np.asarray(src[k], np.float32) * 0.25
+            assert out[k].dtype == out_dtype
+            np.testing.assert_allclose(
+                np.asarray(out[k], np.float32), ref.astype(np.dtype(out_dtype)).astype(np.float32),
+                rtol=1e-2 if out_dtype == jnp.bfloat16 else 1e-6)
+
+    def test_overflow_sets_flag(self):
+        src = _tree(jnp.float32)
+        src["a"] = src["a"].at[3, 3].set(jnp.inf)
+        _, found_inf = multi_tensor_scale(src, 2.0)
+        assert bool(found_inf)
+        # fp16 range overflow during the scale also trips it (the
+        # reference's unscale-detects-inf contract)
+        big = {"x": jnp.full((8,), 60000.0, jnp.float16)}
+        _, found_inf = multi_tensor_scale(big, 4.0, out_dtype=jnp.float16)
+        assert bool(found_inf)
+
+
+class TestAxpby:
+    @pytest.mark.parametrize("in_dtype,out_dtype", IN_OUT)
+    def test_matches_numpy(self, in_dtype, out_dtype):
+        x, y = _tree(in_dtype, 1), _tree(in_dtype, 2)
+        out, found_inf = multi_tensor_axpby(2.0, x, -0.5, y, out_dtype=out_dtype)
+        assert not bool(found_inf)
+        ref = 2.0 * np.asarray(x["a"], np.float32) - 0.5 * np.asarray(y["a"], np.float32)
+        assert out["a"].dtype == out_dtype
+        np.testing.assert_allclose(
+            np.asarray(out["a"], np.float32), ref.astype(np.dtype(out_dtype)).astype(np.float32),
+            rtol=1e-2 if out_dtype in (jnp.bfloat16, jnp.float16) else 1e-6, atol=1e-3)
+
+    def test_nan_propagates_to_flag(self):
+        x, y = _tree(jnp.float32, 1), _tree(jnp.float32, 2)
+        y["b"][0] = y["b"][0].at[0].set(jnp.nan)
+        _, found_inf = multi_tensor_axpby(1.0, x, 1.0, y)
+        assert bool(found_inf)
+
+
+class TestL2Norm:
+    def test_global_matches_numpy(self):
+        t = _tree(jnp.float32, 3)
+        flat = np.concatenate([np.asarray(t["a"]).ravel(), np.asarray(t["b"][0]).ravel()])
+        np.testing.assert_allclose(float(multi_tensor_l2norm(t)), np.linalg.norm(flat), rtol=1e-6)
+
+    def test_per_tensor(self):
+        t = _tree(jnp.float32, 4)
+        total, per = multi_tensor_l2norm(t, per_tensor=True)
+        np.testing.assert_allclose(float(per[0]), np.linalg.norm(np.asarray(t["a"])), rtol=1e-6)
+        np.testing.assert_allclose(float(per[1]), np.linalg.norm(np.asarray(t["b"][0])), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(total), np.sqrt(sum(float(p) ** 2 for p in per)), rtol=1e-6)
+
+    def test_half_inputs_fp32_math(self):
+        # fp16 inputs whose squared sum overflows fp16 still produce a
+        # finite fp32 norm (the reference computes in MATH_T=fp32)
+        t = {"x": jnp.full((4096,), 16.0, jnp.float16)}
+        n = multi_tensor_l2norm(t)
+        np.testing.assert_allclose(float(n), 16.0 * 64.0, rtol=1e-3)
+
+    def test_empty_tree(self):
+        assert float(multi_tensor_l2norm({})) == 0.0
+
+
+class TestNormBlend:
+    def test_l2_blend(self):
+        t = {"x": jnp.asarray([3.0, 4.0])}
+        old = [jnp.float32(10.0)]
+        (out,) = multi_tensor_norm_blend(old, t, 0.5, 2.0, norm_type=2)
+        np.testing.assert_allclose(float(out), np.sqrt(0.5 * 100 + 2.0 * 25), rtol=1e-6)
+
+    def test_linf_blend(self):
+        t = {"x": jnp.asarray([-7.0, 4.0])}
+        (out,) = multi_tensor_norm_blend([jnp.float32(2.0)], t, 0.5, 3.0, norm_type=0)
+        np.testing.assert_allclose(float(out), 0.5 * 2.0 + 3.0 * 7.0, rtol=1e-6)
+
+    def test_bad_norm_type(self):
+        with pytest.raises(ValueError):
+            multi_tensor_norm_blend([jnp.float32(1.0)], {"x": jnp.ones(2)}, 1, 1, norm_type=1)
+
+
+class TestPredication:
+    def test_tree_where_and_not_finite(self):
+        a = {"x": jnp.ones(3)}
+        b = {"x": jnp.zeros(3)}
+        np.testing.assert_array_equal(
+            np.asarray(tree_where(jnp.bool_(True), a, b)["x"]), np.ones(3))
+        np.testing.assert_array_equal(
+            np.asarray(tree_where(jnp.bool_(False), a, b)["x"]), np.zeros(3))
+        assert not bool(tree_not_finite(a))
+        assert bool(tree_not_finite({"x": jnp.asarray([1.0, jnp.inf])}))
+        assert not bool(tree_not_finite({}))
+
+    def test_noop_semantics_under_jit(self):
+        """The reference kernel early-exits when noop_flag is set; the XLA
+        form predicates the whole update.  Check it composes under jit."""
+
+        @jax.jit
+        def step(p, g):
+            scaled, found = multi_tensor_scale(g, 0.5)
+            new_p, _ = multi_tensor_axpby(1.0, p, -1.0, scaled)
+            return tree_where(~found, new_p, p)
+
+        p = {"w": jnp.ones(4)}
+        ok = step(p, {"w": jnp.full(4, 0.5)})
+        np.testing.assert_allclose(np.asarray(ok["w"]), 0.75)
+        bad = step(p, {"w": jnp.asarray([jnp.nan, 0, 0, 0])})
+        np.testing.assert_array_equal(np.asarray(bad["w"]), np.ones(4))

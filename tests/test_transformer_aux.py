@@ -200,3 +200,57 @@ class TestTransformerUtils:
         # parity with the reference: uninitialized parallel state raises
         with pytest.raises(RuntimeError):
             split_tensor_into_1d_equal_chunks(jnp.arange(6.0))
+
+
+class TestProfiler:
+    """NVTX-range and trace-capture analogs (reference DDP prof flag +
+    torch.cuda.nvtx)."""
+
+    def test_range_push_pop(self):
+        from apex_tpu.utils import nvtx_range, nvtx_range_pop, nvtx_range_push
+
+        nvtx_range_push("outer")
+        with nvtx_range("inner"):
+            pass
+        nvtx_range_pop()
+        with pytest.raises(RuntimeError):
+            nvtx_range_pop()
+
+    def test_named_scope_inside_jit(self):
+        from apex_tpu.utils import nvtx_range
+
+        @jax.jit
+        def f(x):
+            with nvtx_range("scaled_add"):
+                return x * 2 + 1
+
+        assert float(f(jnp.float32(3.0))) == 7.0
+        # the scope name survives into the HLO metadata
+        hlo = jax.jit(f).lower(jnp.float32(3.0)).as_text(debug_info=True)
+        assert "scaled_add" in hlo
+
+    def test_profile_capture(self, tmp_path):
+        from apex_tpu.utils import profile, start_profile, stop_profile
+
+        d = str(tmp_path / "trace")
+        with profile(d):
+            float(jnp.sum(jnp.ones((8, 8))))
+        import os
+
+        assert any("plugins" in r and f for r, _, f in os.walk(d))
+        with pytest.raises(RuntimeError):
+            stop_profile()
+
+    def test_ddp_prof_flag(self, devices8):
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel import DistributedDataParallel
+
+        ddp = DistributedDataParallel(prof=True, axis_name="dp")
+        mesh = Mesh(np.array(devices8), ("dp",))
+        out = jax.shard_map(
+            lambda g: ddp.sync(g), mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False,
+        )(jnp.arange(8.0))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))
