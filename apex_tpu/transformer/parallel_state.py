@@ -53,6 +53,10 @@ class _State:
     num_distributed_slices: int = 1
     # mutable trace-time bookkeeping (mirrors the reference's globals)
     virtual_pipeline_model_parallel_rank: Optional[int] = None
+    # static rank overrides installed by set_*_rank (test support);
+    # None → getters return the traced axis_index
+    tensor_model_parallel_rank_override: Optional[int] = None
+    pipeline_model_parallel_rank_override: Optional[int] = None
 
 
 _STATE: Optional[_State] = None
@@ -303,14 +307,42 @@ def get_embedding_group() -> AxisGroup:
        :meth:`AxisGroup.masked_psum` to reduce over members only.
     """
     s = _state()
-    members = tuple(sorted({0, s.pipeline_model_parallel_size - 1}))
+    members = _embedding_group_members()
     return AxisGroup(PIPELINE_AXIS, len(members), s.mesh, members=members)
 
 
-def get_position_embedding_group() -> AxisGroup:
-    """Reference: parallel_state.py:480 — stage 0 only (position embeddings)."""
+def _embedding_group_members() -> tuple:
+    """{first, last} stages, plus the first decoder stage when an
+    encoder/decoder split is configured (reference :352,:361-366)."""
     s = _state()
-    return AxisGroup(PIPELINE_AXIS, 1, s.mesh, members=(0,))
+    members = {0, s.pipeline_model_parallel_size - 1}
+    if (
+        s.pipeline_model_parallel_size > 1
+        and s.pipeline_model_parallel_split_rank is not None
+    ):
+        members.add(s.pipeline_model_parallel_split_rank)
+    return tuple(sorted(members))
+
+
+def _position_embedding_group_members() -> tuple:
+    """Stage 0, plus the first decoder stage under a split
+    (reference :353,:367-372)."""
+    s = _state()
+    members = {0}
+    if (
+        s.pipeline_model_parallel_size > 1
+        and s.pipeline_model_parallel_split_rank is not None
+    ):
+        members.add(s.pipeline_model_parallel_split_rank)
+    return tuple(sorted(members))
+
+
+def get_position_embedding_group() -> AxisGroup:
+    """Reference: parallel_state.py:480 — stage 0 (plus the split stage
+    for encoder/decoder models)."""
+    s = _state()
+    members = _position_embedding_group_members()
+    return AxisGroup(PIPELINE_AXIS, len(members), s.mesh, members=members)
 
 
 def get_amax_reduction_group() -> AxisGroup:
@@ -323,11 +355,13 @@ def get_amax_reduction_group() -> AxisGroup:
 # Inside shard_map these return traced per-device indices; the reference's
 # host-side rank bookkeeping has no other TPU analog.
 def get_tensor_model_parallel_rank():
-    return jax.lax.axis_index(TENSOR_AXIS)
+    ov = _STATE.tensor_model_parallel_rank_override if _STATE else None
+    return jax.lax.axis_index(TENSOR_AXIS) if ov is None else ov
 
 
 def get_pipeline_model_parallel_rank():
-    return jax.lax.axis_index(PIPELINE_AXIS)
+    ov = _STATE.pipeline_model_parallel_rank_override if _STATE else None
+    return jax.lax.axis_index(PIPELINE_AXIS) if ov is None else ov
 
 
 def get_context_parallel_rank():
@@ -395,4 +429,230 @@ def get_rank_info() -> str:
     return (
         f"tp={s.tensor_model_parallel_size} pp={s.pipeline_model_parallel_size} "
         f"cp={s.context_parallel_size} dp={s.data_parallel_size}"
+    )
+
+
+def is_unitialized() -> bool:
+    """Reference parallel_state.py:79 (typo preserved): True when model
+    parallel state has not been initialized."""
+    return _STATE is None
+
+
+# ----------------------------------------------- encoder/decoder split
+# (T5-style models: stages [0, split) run the encoder, [split, pp) the
+#  decoder; reference parallel_state.py:538-575.)
+def is_pipeline_stage_before_split(rank: Optional[int] = None, *, stage: Optional[int] = None):
+    """True if the given pipeline stage executes encoder block for a
+    model with both encoder and decoder (reference :538).  Pass the
+    static stage index as either positional ``rank`` (reference name) or
+    ``stage=``."""
+    s = _state()
+    stage = rank if stage is None else stage
+    if s.pipeline_model_parallel_size == 1:
+        return True
+    if stage is None:
+        raise ValueError("pass the static pipeline stage index")
+    if s.pipeline_model_parallel_split_rank is None:
+        return True
+    return stage < s.pipeline_model_parallel_split_rank
+
+
+def is_pipeline_stage_after_split(rank: Optional[int] = None, *, stage: Optional[int] = None):
+    """True if the given stage executes decoder block (reference :553)."""
+    s = _state()
+    stage = rank if stage is None else stage
+    if s.pipeline_model_parallel_size == 1:
+        return True
+    if stage is None:
+        raise ValueError("pass the static pipeline stage index")
+    if s.pipeline_model_parallel_split_rank is None:
+        return True
+    return stage >= s.pipeline_model_parallel_split_rank
+
+
+def is_pipeline_stage_at_split(rank: Optional[int] = None, *, stage: Optional[int] = None):
+    """True if the given stage is the last encoder stage (the next one
+    is the first decoder stage); reference :568-575."""
+    s = _state()
+    stage = rank if stage is None else stage
+    if s.pipeline_model_parallel_size == 1 or s.pipeline_model_parallel_split_rank is None:
+        return False
+    if stage is None:
+        raise ValueError("pass the static pipeline stage index")
+    return (
+        is_pipeline_stage_before_split(stage)
+        and is_pipeline_stage_after_split(stage + 1)
+    )
+
+
+# ----------------------------------------------- first/last/src ranks
+def get_pipeline_model_parallel_first_rank() -> int:
+    """Stage index of the first pipeline stage (reference :715 returns
+    the global rank; mesh-axis position here)."""
+    _state()
+    return 0
+
+
+def get_pipeline_model_parallel_last_rank() -> int:
+    """Stage index of the last pipeline stage (reference :722)."""
+    return _state().pipeline_model_parallel_size - 1
+
+
+def get_tensor_model_parallel_src_rank() -> int:
+    """Axis position of the broadcast source inside the tp group
+    (reference :699 computes the global rank of tp-local-rank 0; on a
+    named mesh axis the source is simply index 0)."""
+    _state()
+    return 0
+
+
+def get_data_parallel_src_rank() -> int:
+    """Axis position of the broadcast source inside the dp group
+    (reference :707)."""
+    _state()
+    return 0
+
+
+# ----------------------------------------------- group membership (static)
+def is_rank_in_embedding_group(ignore_virtual: bool = False, *, stage: Optional[int] = None) -> bool:
+    """True if the given static stage takes part in the tied-embedding
+    grad sync (first/last stage; reference :504-517 incl. the virtual
+    chunk refinement)."""
+    s = _state()
+    if stage is None:
+        raise ValueError("pass stage= (static pipeline stage index)")
+    members = _embedding_group_members()
+    if stage not in members:
+        return False
+    if ignore_virtual:
+        return True
+    if stage == members[0]:
+        return is_pipeline_first_stage(stage=stage)
+    if stage == members[-1]:
+        return is_pipeline_last_stage(stage=stage)
+    return True  # the split stage (reference :515-516: plain membership)
+
+
+def is_rank_in_position_embedding_group(*, stage: Optional[int] = None) -> bool:
+    """Stage 0 (plus the split stage for encoder/decoder models) holds
+    position embeddings (reference :520, group built at :353,:367-372)."""
+    _state()
+    if stage is None:
+        raise ValueError("pass stage= (static pipeline stage index)")
+    return stage in _position_embedding_group_members()
+
+
+def _relative_position_embedding_members(encoder: bool) -> tuple:
+    s = _state()
+    P = s.pipeline_model_parallel_size
+    split = s.pipeline_model_parallel_split_rank
+    if P == 1 or split is None:
+        return (0,)  # reference: [ranks[0]] when there is no split
+    return tuple(range(0, split)) if encoder else tuple(range(split, P))
+
+
+def is_rank_in_encoder_relative_position_embedding_group(*, stage: Optional[int] = None) -> bool:
+    """Reference :526 — encoder stages share relative-position-embedding
+    grads."""
+    if stage is None:
+        raise ValueError("pass stage= (static pipeline stage index)")
+    return stage in _relative_position_embedding_members(True)
+
+
+def is_rank_in_decoder_relative_position_embedding_group(*, stage: Optional[int] = None) -> bool:
+    """Reference :532."""
+    if stage is None:
+        raise ValueError("pass stage= (static pipeline stage index)")
+    return stage in _relative_position_embedding_members(False)
+
+
+def get_encoder_relative_position_embedding_group() -> AxisGroup:
+    """Encoder stages on the ``pp`` axis (reference :~356).  Partial
+    membership — reduce with :meth:`AxisGroup.masked_psum`."""
+    s = _state()
+    members = _relative_position_embedding_members(True)
+    return AxisGroup(PIPELINE_AXIS, len(members), s.mesh, members=members)
+
+
+def get_decoder_relative_position_embedding_group() -> AxisGroup:
+    """Decoder stages on the ``pp`` axis."""
+    s = _state()
+    members = _relative_position_embedding_members(False)
+    return AxisGroup(PIPELINE_AXIS, len(members), s.mesh, members=members)
+
+
+# ----------------------------------------------- test-support setters
+# The reference mutates its rank/size globals in tests
+# (parallel_state.py:578-759).  Sizes and the split rank are real state
+# here; *rank* setters install a static override returned by the
+# corresponding getter instead of the traced ``axis_index`` (ranks are
+# mesh positions under SPMD — the override exists so host-side test
+# code can reason about one stage at a time).
+def set_tensor_model_parallel_world_size(world_size: int) -> None:
+    _state().tensor_model_parallel_size = int(world_size)
+
+
+def set_pipeline_model_parallel_world_size(world_size: int) -> None:
+    _state().pipeline_model_parallel_size = int(world_size)
+
+
+def set_virtual_pipeline_model_parallel_world_size(world_size: Optional[int]) -> None:
+    _state().virtual_pipeline_model_parallel_size = world_size
+
+
+def set_pipeline_model_parallel_split_rank(rank: Optional[int]) -> None:
+    _state().pipeline_model_parallel_split_rank = rank
+
+
+def set_tensor_model_parallel_rank(rank: Optional[int]) -> None:
+    _state().tensor_model_parallel_rank_override = rank
+
+
+def set_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    _state().pipeline_model_parallel_rank_override = rank
+
+
+# ----------------------------------------------- NCCL plumbing (no-op)
+# Reference parallel_state.py:83-153 tunes NCCL transport (IB vs socket
+# per group) and builds hybrid process groups.  Interconnect placement
+# is *declarative* on TPU: the mesh layout decides which axes ride ICI
+# and which cross DCN (``initialize_model_parallel(num_distributed_
+# slices_=...)``); there is no transport to configure per group.
+def init_nccl_net(group=None) -> None:
+    """No TPU meaning (reference :91 warms up NCCL net); kept for API
+    parity."""
+
+
+def set_nccl_socket_envs() -> None:
+    """No TPU meaning (reference :83)."""
+
+
+def set_nccl_ib_envs() -> None:
+    """No TPU meaning (reference :88)."""
+
+
+def new_nccl_socket_group(ranks=None):
+    """Not constructible under SPMD: arbitrary-rank process groups are
+    replaced by named mesh axes.  Use ``initialize_model_parallel``'s
+    mesh shape (and ``num_distributed_slices_`` for the DCN leg)."""
+    raise RuntimeError(
+        "new_nccl_socket_group: process groups are mesh axes on TPU — "
+        "declare the topology via initialize_model_parallel(...)"
+    )
+
+
+def new_nccl_ib_group(ranks=None):
+    """See :func:`new_nccl_socket_group`."""
+    raise RuntimeError(
+        "new_nccl_ib_group: process groups are mesh axes on TPU — "
+        "declare the topology via initialize_model_parallel(...)"
+    )
+
+
+def new_process_group(ranks=None, backend=None):
+    """See :func:`new_nccl_socket_group` (reference :108-153 picks
+    IB/socket per group; DCN-vs-ICI placement is the mesh's job)."""
+    raise RuntimeError(
+        "new_process_group: process groups are mesh axes on TPU — "
+        "declare the topology via initialize_model_parallel(...)"
     )

@@ -80,9 +80,28 @@ def listify_model(model):
     return [model]
 
 
-def calc_params_l2_norm(params, bf16: bool = False):
+def calc_params_l2_norm(params, bf16: bool = False, attrs=None, tp_rank: int = 0):
     """Reference: utils.py:213 — global L2 norm over params (the
-    multi_tensor_l2norm kernel)."""
+    multi_tensor_l2norm kernel).
+
+    ``attrs``: optional spec tree of
+    :class:`~apex_tpu.transformer.tensor_parallel.TensorParallelAttributes`
+    mirroring ``params``; when given, TP-replicated params are counted
+    only on tp rank 0 (the reference filters with
+    ``param_is_not_tensor_parallel_duplicate``, utils.py:217-222)."""
+    if attrs is not None:
+        from apex_tpu.transformer.tensor_parallel.attributes import (
+            param_is_not_tensor_parallel_duplicate,
+        )
+
+        # tree.map validates the two trees have the same structure, so a
+        # misplaced None in attrs fails loudly instead of misaligning
+        keep = jax.tree.map(
+            lambda p, a: p if param_is_not_tensor_parallel_duplicate(a, tp_rank) else None,
+            params, attrs,
+            is_leaf=lambda x: x is None or hasattr(x, "partition_dim"),
+        )
+        params = [p for p in jax.tree.leaves(keep) if p is not None]
     return multi_tensor_l2norm(params)
 
 

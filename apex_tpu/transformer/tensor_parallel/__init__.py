@@ -9,6 +9,14 @@ from apex_tpu.transformer.tensor_parallel.grad_accum import (
     accumulate_gradients,
     make_grad_accumulator,
 )
+from apex_tpu.transformer.tensor_parallel.attributes import (
+    TensorParallelAttributes,
+    attributes_tree,
+    copy_tensor_model_parallel_attributes,
+    param_is_not_tensor_parallel_duplicate,
+    set_defaults_if_not_set_tensor_model_parallel_attributes,
+    set_tensor_model_parallel_attributes,
+)
 from apex_tpu.transformer.tensor_parallel.layers import (
     ColumnParallelLinear,
     RowParallelLinear,
@@ -33,6 +41,8 @@ from apex_tpu.transformer.tensor_parallel.memory import (
     get_mem_buffs,
 )
 from apex_tpu.transformer.tensor_parallel.random import (
+    init_checkpointed_activations_memory_buffer,
+    reset_checkpointed_activations_memory_buffer,
     RNGStatesTracker,
     checkpoint,
     get_cuda_rng_tracker,
@@ -46,6 +56,14 @@ from apex_tpu.transformer.tensor_parallel.utils import (
 )
 
 __all__ = [
+    "TensorParallelAttributes",
+    "attributes_tree",
+    "copy_tensor_model_parallel_attributes",
+    "param_is_not_tensor_parallel_duplicate",
+    "set_defaults_if_not_set_tensor_model_parallel_attributes",
+    "set_tensor_model_parallel_attributes",
+    "init_checkpointed_activations_memory_buffer",
+    "reset_checkpointed_activations_memory_buffer",
     "vocab_parallel_cross_entropy",
     "broadcast_data",
     "broadcast_from_rank0",

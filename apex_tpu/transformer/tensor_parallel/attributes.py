@@ -1,0 +1,89 @@
+"""Tensor-parallel parameter attributes.
+
+Reference: ``apex/transformer/tensor_parallel/layers.py:70-107`` —
+``set_tensor_model_parallel_attributes`` et al. stamp three attributes
+(``tensor_model_parallel``, ``partition_dim``, ``partition_stride``)
+onto ``torch.nn.Parameter`` objects so downstream code (grad-norm
+computation, checkpointing) can tell TP-sharded params from replicated
+duplicates (``param_is_not_tensor_parallel_duplicate``,
+``layers.py:76``).
+
+JAX arrays are values, not objects — they cannot carry attributes
+through transforms.  The TPU-native form is a **spec tree**: a pytree of
+:class:`TensorParallelAttributes` mirroring the param tree, built once
+at model-construction time and passed alongside params where the
+reference would read ``param.tensor_model_parallel``
+(:func:`apex_tpu.transformer.pipeline_parallel.utils.calc_params_l2_norm`
+accepts one).  The function names and semantics match the reference;
+they operate on spec objects / spec trees instead of mutating tensors.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+
+_MODEL_PARALLEL_ATTRIBUTE_DEFAULTS = {
+    "tensor_model_parallel": False,
+    "partition_dim": -1,
+    "partition_stride": 1,
+}
+
+
+@dataclasses.dataclass
+class TensorParallelAttributes:
+    """The three reference attributes (layers.py:70-74)."""
+
+    tensor_model_parallel: bool = False
+    partition_dim: int = -1
+    partition_stride: int = 1
+
+
+def set_tensor_model_parallel_attributes(
+    is_parallel: bool, dim: int, stride: int
+) -> TensorParallelAttributes:
+    """Build the spec the reference stamps onto a sharded param
+    (layers.py:82-89)."""
+    return TensorParallelAttributes(
+        tensor_model_parallel=is_parallel, partition_dim=dim, partition_stride=stride
+    )
+
+
+def set_defaults_if_not_set_tensor_model_parallel_attributes(
+    attrs: Optional[TensorParallelAttributes],
+) -> TensorParallelAttributes:
+    """None → replicated defaults (layers.py:92-98)."""
+    return TensorParallelAttributes() if attrs is None else attrs
+
+
+def copy_tensor_model_parallel_attributes(
+    source: TensorParallelAttributes,
+) -> TensorParallelAttributes:
+    """Fresh copy of a spec (layers.py:101-107; e.g. when cloning a
+    param into a master-weight tree)."""
+    return dataclasses.replace(source)
+
+
+def param_is_not_tensor_parallel_duplicate(
+    attrs: Optional[TensorParallelAttributes], tp_rank: int
+) -> bool:
+    """True if this param should be counted on this tp rank: it is
+    TP-sharded (every rank owns a distinct slice) or we are tp rank 0
+    (replicated params counted once).  Reference layers.py:76-79."""
+    a = set_defaults_if_not_set_tensor_model_parallel_attributes(attrs)
+    return a.tensor_model_parallel or tp_rank == 0
+
+
+def attributes_tree(params: Any, is_parallel_fn) -> Any:
+    """Build a spec tree for ``params``: ``is_parallel_fn(path, leaf)``
+    returns ``None`` (replicated) or ``(dim, stride)`` for sharded
+    leaves."""
+
+    def one(path, leaf):
+        r = is_parallel_fn(path, leaf)
+        if r is None:
+            return TensorParallelAttributes()
+        dim, stride = r
+        return set_tensor_model_parallel_attributes(True, dim, stride)
+
+    return jax.tree_util.tree_map_with_path(one, params)

@@ -64,12 +64,22 @@ class MemoryBuffer:
         self.name = name
         self.numel = int(numel)
         self.dtype = dtype
-        self.data = jnp.zeros((self.numel,), dtype=dtype)
-        self._start = 0
+        self._data = None  # allocated lazily on first use: a buffer that
+        self._start = 0    # is never add()ed to must not pin device memory
         # usage tracking (reference memory.py:70-77,122)
         self.track_usage = track_usage
         self.in_use_value = 0.0
         self.total_value = 0.0
+
+    @property
+    def data(self):
+        if self._data is None:
+            self._data = jnp.zeros((self.numel,), dtype=self.dtype)
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = value
 
     def reset(self):
         """Rewind the cursor; arena contents become dead (memory.py:79)."""

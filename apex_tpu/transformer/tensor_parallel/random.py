@@ -124,3 +124,49 @@ def checkpoint(function, distribute_saved_activations: bool = False, *args):
     TPU — XLA decides placement — the flag is accepted for parity.
     """
     return jax.checkpoint(function)(*args)
+
+
+# ----------------------------------------------------------------------
+# Checkpointed-activations memory buffer (reference random.py:44-88 —
+# deprecated there, kept for API parity).  On TPU the buffer is a
+# host-side planning object: ``jax.checkpoint`` owns what actually gets
+# saved, so the value of this API is the *capacity accounting* (how many
+# activation elements a schedule would pin) rather than real storage.
+_CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER = None
+
+
+def init_checkpointed_activations_memory_buffer(
+    micro_batch_size,
+    max_position_embeddings,
+    hidden_size,
+    num_layers,
+    tensor_model_parallel_size,
+    checkpoint_num_layers,
+    fp16,
+):
+    """Reference random.py:48-81; same sizing math (seq·mbs·hidden/tp per
+    checkpointed layer)."""
+    from apex_tpu.transformer.tensor_parallel.memory import allocate_mem_buff
+
+    per_layer = (
+        micro_batch_size * max_position_embeddings * hidden_size
+        // tensor_model_parallel_size
+    )
+    if num_layers % checkpoint_num_layers != 0:
+        raise ValueError("number of layers is not divisible by checkpoint-num-layers")
+    numel = per_layer * (num_layers // checkpoint_num_layers)
+    dtype = jnp.float16 if fp16 else jnp.float32
+
+    global _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER
+    if _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER is not None:
+        raise RuntimeError("checkpointed activations memory buffer is already allocated.")
+    _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER = allocate_mem_buff(
+        "checkpointed activations", numel, dtype, track_usage=False
+    )
+    return _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER
+
+
+def reset_checkpointed_activations_memory_buffer():
+    """Reference random.py:84-88."""
+    if _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER is not None:
+        _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER.reset()

@@ -41,10 +41,11 @@ class DistributedTestContext:
     setUp/tearDown, distributed_test_base.py:40-77)."""
 
     def __init__(self, tp: int = 1, pp: int = 1, cp: int = 1, devices=None,
-                 slices: int = 1):
+                 slices: int = 1, split_rank=None):
         self.tp, self.pp, self.cp = tp, pp, cp
         self.devices = devices
         self.slices = slices
+        self.split_rank = split_rank
         self.mesh = None
 
     def __enter__(self):
@@ -52,6 +53,7 @@ class DistributedTestContext:
             tensor_model_parallel_size_=self.tp,
             pipeline_model_parallel_size_=self.pp,
             context_parallel_size_=self.cp,
+            pipeline_model_parallel_split_rank_=self.split_rank,
             devices=self.devices,
             num_distributed_slices_=self.slices,
         )

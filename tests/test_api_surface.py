@@ -535,3 +535,77 @@ class TestPublicSurfaceInventory:
         from apex_tpu.transformer._data._batchsampler import (  # noqa: F401
             MegatronPretrainingRandomSampler, MegatronPretrainingSampler,
         )
+
+
+class TestSplitRankMachinery:
+    """Encoder/decoder split predicates, membership checks, src/first/
+    last rank getters, and test-support setters (reference
+    parallel_state.py:504-759)."""
+
+    def test_split_predicates(self):
+        with parallel_state_ctx(pp=4, split_rank=2):
+            ps = parallel_state
+            assert ps.get_pipeline_model_parallel_split_rank() == 2
+            assert [ps.is_pipeline_stage_before_split(s) for s in range(4)] == [True, True, False, False]
+            assert [ps.is_pipeline_stage_after_split(s) for s in range(4)] == [False, False, True, True]
+            assert [ps.is_pipeline_stage_at_split(s) for s in range(4)] == [False, True, False, False]
+
+    def test_split_predicates_no_split(self):
+        with parallel_state_ctx(pp=4):
+            ps = parallel_state
+            assert ps.is_pipeline_stage_before_split(3)
+            assert ps.is_pipeline_stage_after_split(0)
+            assert not ps.is_pipeline_stage_at_split(1)
+
+    def test_membership_and_ranks(self):
+        with parallel_state_ctx(pp=4, split_rank=2):
+            ps = parallel_state
+            assert ps.get_pipeline_model_parallel_first_rank() == 0
+            assert ps.get_pipeline_model_parallel_last_rank() == 3
+            assert ps.get_tensor_model_parallel_src_rank() == 0
+            assert ps.get_data_parallel_src_rank() == 0
+            # with split=2 the embedding group is {0, 2, 3} (the first
+            # decoder stage owns the decoder's tied embedding) and the
+            # position group {0, 2} — reference :352-372
+            assert ps.is_rank_in_embedding_group(stage=0)
+            assert ps.is_rank_in_embedding_group(stage=2)
+            assert ps.is_rank_in_embedding_group(stage=3)
+            assert not ps.is_rank_in_embedding_group(stage=1)
+            assert ps.get_embedding_group().members == (0, 2, 3)
+            assert ps.is_rank_in_position_embedding_group(stage=0)
+            assert ps.is_rank_in_position_embedding_group(stage=2)
+            assert not ps.is_rank_in_position_embedding_group(stage=1)
+            assert ps.get_position_embedding_group().members == (0, 2)
+            # encoder stages {0,1}; decoder stages {2,3}
+            assert ps.is_rank_in_encoder_relative_position_embedding_group(stage=1)
+            assert not ps.is_rank_in_encoder_relative_position_embedding_group(stage=2)
+            assert ps.is_rank_in_decoder_relative_position_embedding_group(stage=2)
+            enc = ps.get_encoder_relative_position_embedding_group()
+            dec = ps.get_decoder_relative_position_embedding_group()
+            assert enc.members == (0, 1) and dec.members == (2, 3)
+            assert enc == parallel_state.PIPELINE_AXIS  # usable as axis_name
+
+    def test_setters_and_uninitialized(self):
+        assert parallel_state.is_unitialized()
+        with parallel_state_ctx(tp=2, pp=2):
+            ps = parallel_state
+            assert not ps.is_unitialized()
+            ps.set_pipeline_model_parallel_split_rank(1)
+            assert ps.get_pipeline_model_parallel_split_rank() == 1
+            ps.set_tensor_model_parallel_world_size(1)
+            assert ps.get_tensor_model_parallel_world_size() == 1
+            ps.set_tensor_model_parallel_rank(1)
+            assert ps.get_tensor_model_parallel_rank() == 1  # static override
+            ps.set_tensor_model_parallel_rank(None)
+            ps.set_pipeline_model_parallel_rank(0)
+            assert ps.get_pipeline_model_parallel_rank() == 0
+
+    def test_nccl_plumbing_shims(self):
+        parallel_state.init_nccl_net()
+        parallel_state.set_nccl_ib_envs()
+        parallel_state.set_nccl_socket_envs()
+        for fn in (parallel_state.new_process_group,
+                   parallel_state.new_nccl_ib_group,
+                   parallel_state.new_nccl_socket_group):
+            with pytest.raises(RuntimeError, match="mesh axes"):
+                fn([0, 1])

@@ -279,3 +279,42 @@ class TestParallelState:
         )(jnp.zeros(4))
         np.testing.assert_allclose(np.asarray(out), [0, 1, 2, 3])
         parallel_state.destroy_model_parallel()
+
+
+class TestTensorParallelAttributes:
+    """Spec-tree analog of the reference's param attribute stamping
+    (layers.py:70-107) and its consumer (calc_params_l2_norm dedup)."""
+
+    def test_defaults_and_duplicate_rule(self):
+        from apex_tpu.transformer.tensor_parallel import (
+            TensorParallelAttributes,
+            copy_tensor_model_parallel_attributes,
+            param_is_not_tensor_parallel_duplicate,
+            set_defaults_if_not_set_tensor_model_parallel_attributes,
+            set_tensor_model_parallel_attributes,
+        )
+
+        d = set_defaults_if_not_set_tensor_model_parallel_attributes(None)
+        assert d == TensorParallelAttributes(False, -1, 1)
+        s = set_tensor_model_parallel_attributes(True, 0, 1)
+        c = copy_tensor_model_parallel_attributes(s)
+        assert c == s and c is not s
+        # sharded params count on every rank; replicated only on rank 0
+        assert param_is_not_tensor_parallel_duplicate(s, tp_rank=3)
+        assert param_is_not_tensor_parallel_duplicate(None, tp_rank=0)
+        assert not param_is_not_tensor_parallel_duplicate(None, tp_rank=1)
+
+    def test_attributes_tree_and_l2norm_dedup(self):
+        from apex_tpu.transformer.pipeline_parallel.utils import calc_params_l2_norm
+        from apex_tpu.transformer.tensor_parallel import attributes_tree
+
+        params = {"wq": jnp.full((4,), 2.0), "ln": jnp.full((9,), 2.0)}
+        attrs = attributes_tree(
+            params, lambda path, leaf: (0, 1) if "wq" in str(path) else None)
+        assert attrs["wq"].tensor_model_parallel and not attrs["ln"].tensor_model_parallel
+
+        # rank 0 counts both; rank 1 counts only the sharded leaf
+        n0 = float(calc_params_l2_norm(params, attrs=attrs, tp_rank=0))
+        n1 = float(calc_params_l2_norm(params, attrs=attrs, tp_rank=1))
+        np.testing.assert_allclose(n0, np.sqrt(4 * 4 + 9 * 4), rtol=1e-6)
+        np.testing.assert_allclose(n1, np.sqrt(4 * 4), rtol=1e-6)
