@@ -7,6 +7,8 @@ optimizers already use.
 """
 
 from apex_tpu.fp16_utils.fp16util import (
+    BN_convert_float,
+    FP16Model,
     convert_network,
     master_params_to_model_params,
     model_grads_to_master_grads,
@@ -18,6 +20,8 @@ from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer
 from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
 
 __all__ = [
+    "BN_convert_float",
+    "FP16Model",
     "network_to_half",
     "convert_network",
     "tofp16",

@@ -62,3 +62,44 @@ def master_params_to_model_params(model_params, master_params):
     """Copy master values into model dtype (fp16util.py:160) — the
     post-step sync of the O2 flow."""
     return jax.tree.map(lambda p, m: m.astype(p.dtype), model_params, master_params)
+
+
+def BN_convert_float(params):
+    """Re-promote norm-layer params to fp32 in an already-half tree
+    (reference fp16util.py:22 — legacy helper behind network_to_half)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)
+
+    def promote(kp, x):
+        if jnp.issubdtype(x.dtype, jnp.floating) and _is_norm(jax.tree_util.keystr(kp)):
+            return x.astype(jnp.float32)
+        return x
+
+    return jax.tree_util.tree_unflatten(
+        flat[1], [promote(kp, x) for kp, x in flat[0]]
+    )
+
+
+class FP16Model:
+    """Reference fp16util.py:73 — wrap an apply fn + params so inputs
+    and params run in half (norm params fp32) while outputs keep the fn's
+    dtype.  Functional form: ``FP16Model(apply_fn, params)(x)``."""
+
+    def __init__(self, apply_fn, params, half_dtype=jnp.bfloat16):
+        from apex_tpu import deprecated_warning
+
+        deprecated_warning(
+            "fp16_utils is a legacy API (deprecated in the reference); "
+            "prefer apex_tpu.amp policies."
+        )
+        self.apply_fn = apply_fn
+        self.half_dtype = half_dtype
+        self.params = convert_network(params, half_dtype)
+
+    def __call__(self, *inputs):
+        cast = tuple(
+            x.astype(self.half_dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x
+            for x in inputs
+        )
+        return self.apply_fn(self.params, *cast)

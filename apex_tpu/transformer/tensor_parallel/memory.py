@@ -123,6 +123,8 @@ class MemoryBuffer:
         if self.track_usage:
             self.in_use_value += float(self._start)
             self.total_value += float(self.numel)
+        if self._data is None and self._start == 0:
+            return jnp.zeros((0,), dtype=self.dtype)  # unused arena: stay lazy
         return self.data[: self._start]
 
     def print_average_usage(self):

@@ -157,8 +157,12 @@ def init_checkpointed_activations_memory_buffer(
     numel = per_layer * (num_layers // checkpoint_num_layers)
     dtype = jnp.float16 if fp16 else jnp.float32
 
+    from apex_tpu.transformer.tensor_parallel.memory import get_mem_buffs
+
     global _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER
-    if _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER is not None:
+    # stay in sync with the _MEM_BUFFS registry: if reset_mem_buffs()
+    # cleared it, a stale module global must not block re-initialization
+    if "checkpointed activations" in get_mem_buffs():
         raise RuntimeError("checkpointed activations memory buffer is already allocated.")
     _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER = allocate_mem_buff(
         "checkpointed activations", numel, dtype, track_usage=False
