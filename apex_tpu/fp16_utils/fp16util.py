@@ -24,19 +24,24 @@ def network_to_half(params, half_dtype=jnp.bfloat16):
     return convert_network(params, half_dtype)
 
 
-def convert_network(params, dtype=jnp.bfloat16):
-    """Reference fp16util.py:60: cast all but _BatchNorm-style params."""
+def _map_norm_leaves(params, norm_fn, other_fn):
+    """Apply ``norm_fn`` to float leaves on norm-layer paths and
+    ``other_fn`` to the remaining float leaves; non-floats pass through."""
     flat = jax.tree_util.tree_flatten_with_path(params)
 
-    def cast(kp, x):
+    def one(kp, x):
         if not jnp.issubdtype(x.dtype, jnp.floating):
             return x
-        if _is_norm(jax.tree_util.keystr(kp)):
-            return x.astype(jnp.float32)
-        return x.astype(dtype)
+        return norm_fn(x) if _is_norm(jax.tree_util.keystr(kp)) else other_fn(x)
 
-    leaves = [cast(kp, x) for kp, x in flat[0]]
-    return jax.tree_util.tree_unflatten(flat[1], leaves)
+    return jax.tree_util.tree_unflatten(flat[1], [one(kp, x) for kp, x in flat[0]])
+
+
+def convert_network(params, dtype=jnp.bfloat16):
+    """Reference fp16util.py:60: cast all but _BatchNorm-style params."""
+    return _map_norm_leaves(
+        params, lambda x: x.astype(jnp.float32), lambda x: x.astype(dtype)
+    )
 
 
 def prep_param_lists(params, flat_master: bool = False) -> Tuple[Any, Any]:
@@ -67,16 +72,7 @@ def master_params_to_model_params(model_params, master_params):
 def BN_convert_float(params):
     """Re-promote norm-layer params to fp32 in an already-half tree
     (reference fp16util.py:22 — legacy helper behind network_to_half)."""
-    flat = jax.tree_util.tree_flatten_with_path(params)
-
-    def promote(kp, x):
-        if jnp.issubdtype(x.dtype, jnp.floating) and _is_norm(jax.tree_util.keystr(kp)):
-            return x.astype(jnp.float32)
-        return x
-
-    return jax.tree_util.tree_unflatten(
-        flat[1], [promote(kp, x) for kp, x in flat[0]]
-    )
+    return _map_norm_leaves(params, lambda x: x.astype(jnp.float32), lambda x: x)
 
 
 class FP16Model:

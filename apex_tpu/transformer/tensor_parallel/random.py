@@ -132,7 +132,15 @@ def checkpoint(function, distribute_saved_activations: bool = False, *args):
 # host-side planning object: ``jax.checkpoint`` owns what actually gets
 # saved, so the value of this API is the *capacity accounting* (how many
 # activation elements a schedule would pin) rather than real storage.
-_CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER = None
+_CHECKPOINTED_BUFFER_NAME = "checkpointed activations"
+
+
+def _checkpointed_buffer():
+    """Single source of truth is the _MEM_BUFFS registry (so
+    reset_mem_buffs() and this API can never disagree)."""
+    from apex_tpu.transformer.tensor_parallel.memory import get_mem_buffs
+
+    return get_mem_buffs().get(_CHECKPOINTED_BUFFER_NAME)
 
 
 def init_checkpointed_activations_memory_buffer(
@@ -157,20 +165,13 @@ def init_checkpointed_activations_memory_buffer(
     numel = per_layer * (num_layers // checkpoint_num_layers)
     dtype = jnp.float16 if fp16 else jnp.float32
 
-    from apex_tpu.transformer.tensor_parallel.memory import get_mem_buffs
-
-    global _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER
-    # stay in sync with the _MEM_BUFFS registry: if reset_mem_buffs()
-    # cleared it, a stale module global must not block re-initialization
-    if "checkpointed activations" in get_mem_buffs():
+    if _checkpointed_buffer() is not None:
         raise RuntimeError("checkpointed activations memory buffer is already allocated.")
-    _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER = allocate_mem_buff(
-        "checkpointed activations", numel, dtype, track_usage=False
-    )
-    return _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER
+    return allocate_mem_buff(_CHECKPOINTED_BUFFER_NAME, numel, dtype, track_usage=False)
 
 
 def reset_checkpointed_activations_memory_buffer():
     """Reference random.py:84-88."""
-    if _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER is not None:
-        _CHECKPOINTED_ACTIVATIONS_MEMORY_BUFFER.reset()
+    buf = _checkpointed_buffer()
+    if buf is not None:
+        buf.reset()

@@ -31,6 +31,7 @@ import json
 import os
 import threading
 import time
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -369,7 +370,43 @@ def _try(name, fn, *args, section_budget=600.0, **kw):
     return box["r"]
 
 
+def _device_preflight(timeout_s=420.0) -> Optional[str]:
+    """Probe the device in a SUBPROCESS before any in-process jax call.
+
+    A wedged axon tunnel hangs PJRT client creation inside a C call that
+    holds the GIL — the in-process watchdog threads can never fire.  A
+    subprocess can always be killed, so this is the one reliable guard;
+    returns an error string (and the caller emits JSON and exits) or
+    None when the chip answers."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); import jax.numpy as jnp; "
+             "print(float(jnp.asarray(1.0)+1))"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return f"device preflight timed out after {timeout_s:.0f}s (tunnel wedged)"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        return f"device preflight failed rc={r.returncode}: {tail[0]}"
+    return None
+
+
 def main():
+    err = _device_preflight()
+    if err is not None:
+        print(json.dumps({
+            "metric": "fused_adam_step_speedup_vs_eager",
+            "value": -1.0,
+            "unit": "x",
+            "vs_baseline": -1.0,
+            "error": err,
+        }), flush=True)
+        return
     roofline = _try("matmul_roofline", bench_matmul_roofline)
     roof = roofline if isinstance(roofline, float) else 65.0  # measured typical
     adam = _try("fused_adam", bench_fused_adam)
