@@ -8,9 +8,11 @@ from apex_tpu.io.checkpoint import (
     save_checkpoint,
     save_sharded_checkpoint,
 )
+from apex_tpu.io.async_checkpoint import AsyncCheckpointer
 from apex_tpu.io.prefetch import PrefetchIterator
 
 __all__ = [
+    "AsyncCheckpointer",
     "native",
     "save_checkpoint",
     "load_checkpoint",

@@ -1,6 +1,7 @@
 """Native runtime + checkpoint I/O tests (the apex_C flatten/unflatten
 parity of reference tests, host-side)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -139,3 +140,72 @@ class TestShardedCheckpoint:
         np.testing.assert_allclose(
             np.asarray(state2.exp_avg[:30]), np.asarray(state.exp_avg[:30]), rtol=1e-7
         )
+
+
+class TestAsyncCheckpointer:
+    """Non-blocking save: snapshot-at-call-time semantics, ordered
+    writes, atomic publish, error propagation."""
+
+    def test_snapshot_semantics_and_roundtrip(self, tmp_path):
+        from apex_tpu.io import AsyncCheckpointer, load_checkpoint
+
+        p = str(tmp_path / "a.apex")
+        tree = {"w": jnp.arange(4.0), "step": jnp.int32(7)}
+        with AsyncCheckpointer() as ckpt:
+            ckpt.save(p, tree)
+            # mutate AFTER save returns: the file must hold the old values
+            tree = {"w": tree["w"] * 100, "step": jnp.int32(8)}
+        out = load_checkpoint(p)
+        np.testing.assert_array_equal(out["w"], np.arange(4.0))
+        assert int(out["step"]) == 7
+
+    def test_many_saves_all_land_in_order(self, tmp_path):
+        from apex_tpu.io import AsyncCheckpointer, load_checkpoint
+
+        ckpt = AsyncCheckpointer()
+        for i in range(5):
+            ckpt.save(str(tmp_path / f"s{i}.apex"), {"i": jnp.int32(i)})
+        ckpt.wait_until_finished()
+        for i in range(5):
+            assert int(load_checkpoint(str(tmp_path / f"s{i}.apex"))["i"]) == i
+        # no stray .tmp files (atomic publish)
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_write_error_reraises(self, tmp_path):
+        from apex_tpu.io import AsyncCheckpointer
+
+        ckpt = AsyncCheckpointer()
+        bad = str(tmp_path / "no" / "\0bad")  # NUL in path: open() raises
+        ckpt.save(bad, {"x": jnp.zeros(1)})
+        with pytest.raises(RuntimeError, match="async checkpoint"):
+            ckpt.wait_until_finished()
+        # checkpointer stays usable after the failure
+        ok = str(tmp_path / "ok.apex")
+        ckpt.save(ok, {"x": jnp.ones(1)})
+        ckpt.wait_until_finished()
+        ckpt.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            ckpt.save(ok, {"x": jnp.ones(1)})
+
+    def test_numpy_leaves_are_copied(self, tmp_path):
+        """np.ndarray leaves must be deep-copied at save() time: the
+        caller may mutate them in place while the write is queued."""
+        from apex_tpu.io import AsyncCheckpointer, load_checkpoint
+
+        arr = np.arange(4.0)
+        p = str(tmp_path / "c.apex")
+        with AsyncCheckpointer() as ckpt:
+            ckpt.save(p, {"w": arr})
+            arr *= 100  # in-place mutation after save returned
+        np.testing.assert_array_equal(load_checkpoint(p)["w"], np.arange(4.0))
+
+    def test_close_joins_worker(self, tmp_path):
+        import threading
+
+        from apex_tpu.io import AsyncCheckpointer
+
+        before = threading.active_count()
+        ckpt = AsyncCheckpointer()
+        ckpt.save(str(tmp_path / "d.apex"), {"x": jnp.ones(2)})
+        ckpt.close()
+        assert threading.active_count() == before
