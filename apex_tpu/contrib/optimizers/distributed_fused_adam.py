@@ -38,7 +38,30 @@ class DistributedFusedAdamState(NamedTuple):
     step: jnp.ndarray
     exp_avg: jnp.ndarray  # (local_shard,) fp32
     exp_avg_sq: jnp.ndarray  # (local_shard,) fp32
-    master_shard: jnp.ndarray  # (local_shard,) fp32 — fp32 master of owned params
+    # fp32 master of owned params — or, with store_param_remainders, the
+    # low 16 bits (uint16) the bf16 param is missing
+    master_shard: jnp.ndarray
+
+
+def _master_from_remainder(p_f32, rem_u16):
+    """Exact fp32 master = (bf16 param bits << 16) | remainder.
+
+    ``p_f32`` is the f32 *extension* of the bf16 param, whose low 16
+    mantissa bits are zero by construction — OR-ing in the remainder
+    reconstructs the master bit-exactly (reference
+    distributed_fused_adam.py ``store_param_remainders``)."""
+    bits = jax.lax.bitcast_convert_type(p_f32, jnp.uint32)
+    return jax.lax.bitcast_convert_type(bits | rem_u16.astype(jnp.uint32), jnp.float32)
+
+
+def _split_master(master_f32):
+    """(bf16 param, uint16 remainder): the bf16 the model sees is the
+    master's high 16 bits (truncation, not round-to-nearest — the
+    reference's convention, which is what makes reconstruction exact)."""
+    bits = jax.lax.bitcast_convert_type(master_f32, jnp.uint32)
+    rem = (bits & jnp.uint32(0xFFFF)).astype(jnp.uint16)
+    p_bf16 = jax.lax.bitcast_convert_type((bits >> 16).astype(jnp.uint16), jnp.bfloat16)
+    return p_bf16, rem
 
 
 def _flatten(tree):
@@ -81,6 +104,7 @@ class DistributedFusedAdam:
         process_group=None,
         distributed_process_group=None,
         redundant_process_group=None,
+        store_param_remainders: bool = False,
     ):
         self.lr = lr
         self.bias_correction = bias_correction
@@ -90,6 +114,11 @@ class DistributedFusedAdam:
         self.weight_decay = weight_decay
         self.axis_name = axis_name
         self.grad_average = grad_average
+        # halve master-weight memory for bf16 params: store only the 16
+        # mantissa bits the bf16 param is missing (reference
+        # ``store_param_remainders``); param sync also all-gathers bf16
+        # instead of fp32 (half the traffic)
+        self.store_param_remainders = store_param_remainders
 
     # -------------------------------------------------------------- helpers
     def _total_and_pad(self, params):
@@ -147,9 +176,23 @@ class DistributedFusedAdam:
         self._total = total
         self._padded = padded
         self._world = world_size
+        if self.store_param_remainders:
+            bad = [
+                l.dtype for l in jax.tree.leaves(params) if l.dtype != jnp.bfloat16
+            ]
+            if bad:
+                raise ValueError(
+                    f"store_param_remainders requires bf16 params (got {bad[:3]}): "
+                    "the master's high 16 bits must BE the param"
+                )
         zeros = jnp.zeros((model_mult * padded,), jnp.float32)
+        master0 = (
+            jnp.zeros((model_mult * padded,), jnp.uint16)
+            if self.store_param_remainders
+            else zeros
+        )
         return DistributedFusedAdamState(
-            step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=zeros, master_shard=zeros
+            step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=zeros, master_shard=master0
         )
 
     def state_partition_spec(self):
@@ -186,12 +229,18 @@ class DistributedFusedAdam:
         if self.grad_average:
             g_local = g_local / world
 
-        # lazily materialize the fp32 master shard from params on step 0
         flat_p = _flatten(params)
         if padded != total:
             flat_p = jnp.pad(flat_p, (0, padded - total))
         p_owned = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard, shard)
-        master = jnp.where(state.step == 0, p_owned, state.master_shard)
+        if self.store_param_remainders:
+            # master ≡ (bf16 param bits | stored remainder); zero
+            # remainders (fresh state) reconstruct exactly the fp32
+            # extension of the params — no separate lazy-init needed
+            master = _master_from_remainder(p_owned, state.master_shard)
+        else:
+            # lazily materialize the fp32 master shard from params on step 0
+            master = jnp.where(state.step == 0, p_owned, state.master_shard)
 
         step = state.step + (
             jnp.asarray(grads_finite).astype(jnp.int32) if grads_finite is not None else 1
@@ -219,6 +268,16 @@ class DistributedFusedAdam:
             v_new = jnp.where(pred, v_new, state.exp_avg_sq)
             master_new = jnp.where(pred, master_new, master)
 
+        if self.store_param_remainders:
+            # param = master's high bits (truncation); sync bf16 — half
+            # the all-gather traffic of the fp32 path
+            p_bf16, rem_new = _split_master(master_new)
+            flat_new = jax.lax.all_gather(p_bf16, ax, axis=0, tiled=True)
+            new_params = _unflatten_into(params, flat_new[:total])
+            return new_params, DistributedFusedAdamState(
+                step=step, exp_avg=m_new, exp_avg_sq=v_new, master_shard=rem_new
+            )
+
         # ZeRO param sync: all-gather the updated shards
         flat_new = jax.lax.all_gather(master_new, ax, axis=0, tiled=True)
         new_params = _unflatten_into(params, flat_new[:total])
@@ -230,18 +289,37 @@ class DistributedFusedAdam:
     # ----------------------------------------------------- state dict parity
     SHARD_FORMAT = "apex_tpu_zero2_v1"
 
+    @property
+    def _master_kind(self) -> str:
+        return "remainder_u16" if self.store_param_remainders else "fp32"
+
+    def _check_master_kind(self, d):
+        """A store_param_remainders mismatch between save and load would
+        value-convert master bit patterns silently — refuse instead."""
+        kind = d.get("master_kind")
+        if kind is None:  # pre-remainder checkpoints were always fp32
+            kind = "fp32"
+        if kind != self._master_kind:
+            raise ValueError(
+                f"checkpoint master_kind {kind!r} does not match this "
+                f"optimizer's ({self._master_kind!r}): set "
+                f"store_param_remainders={kind == 'remainder_u16'}"
+            )
+
     def state_dict(self, state: DistributedFusedAdamState):
         """Whole-state dict (the reference's ``gather_on_root=True`` mode,
         distributed_fused_adam.py:2527).  For the per-rank protocol use
         :meth:`sharded_state_dict`."""
         return {
             "step": int(state.step),
+            "master_kind": self._master_kind,
             "exp_avg": np.asarray(state.exp_avg),
             "exp_avg_sq": np.asarray(state.exp_avg_sq),
             "master_shard": np.asarray(state.master_shard),
         }
 
     def load_state_dict(self, d) -> DistributedFusedAdamState:
+        self._check_master_kind(d)
         return DistributedFusedAdamState(
             step=jnp.int32(d["step"]),
             exp_avg=jnp.asarray(d["exp_avg"]),
@@ -274,6 +352,7 @@ class DistributedFusedAdam:
         sl = slice(rank * shard, (rank + 1) * shard)
         return {
             "format": self.SHARD_FORMAT,
+            "master_kind": self._master_kind,
             "rank": int(rank),
             "world_size": int(world_size),
             "padded_total": padded,
@@ -286,7 +365,9 @@ class DistributedFusedAdam:
         }
 
     @classmethod
-    def load_sharded_state_dicts(cls, shards, world_size: int) -> DistributedFusedAdamState:
+    def load_sharded_state_dicts(cls, shards, world_size: int,
+                                 store_param_remainders: Optional[bool] = None
+                                 ) -> DistributedFusedAdamState:
         """Reassemble a full state from per-rank shard dicts and reshard
         it for ``world_size`` ranks (which may differ from the saved
         world size — save at dp=4, load at dp=2).
@@ -311,15 +392,25 @@ class DistributedFusedAdam:
             for key in ("padded_total", "total_numel", "step", "world_size"):
                 if d[key] != meta[key]:
                     raise ValueError(f"shard {d['rank']} disagrees on {key}")
+            if d.get("master_kind", "fp32") != meta.get("master_kind", "fp32"):
+                raise ValueError(f"shard {d['rank']} disagrees on master_kind")
+        if store_param_remainders is not None:
+            want = "remainder_u16" if store_param_remainders else "fp32"
+            got = meta.get("master_kind", "fp32")
+            if got != want:
+                raise ValueError(
+                    f"checkpoint master_kind {got!r} does not match "
+                    f"store_param_remainders={store_param_remainders}"
+                )
 
         total = meta["total_numel"]
         new_padded = ((total + world_size - 1) // world_size) * world_size
 
         def reassemble(key):
             full = np.concatenate([d[key] for d in shards])[:total]
-            return jnp.asarray(
-                np.pad(full, (0, new_padded - total)).astype(np.float32)
-            )
+            # dtype preserved: fp32 masters stay fp32, uint16 remainders
+            # (store_param_remainders) stay uint16
+            return jnp.asarray(np.pad(full, (0, new_padded - total)))
 
         return DistributedFusedAdamState(
             step=jnp.int32(meta["step"]),

@@ -219,3 +219,114 @@ class TestDistributedFusedLAMB:
         )
         for a, r in zip(jax.tree.leaves(params), jax.tree.leaves(ref_params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-4, atol=1e-5)
+
+
+class TestStoreParamRemainders:
+    """fp32 master = bf16 param bits + stored 16-bit remainder
+    (reference distributed_fused_adam.py store_param_remainders)."""
+
+    def test_split_combine_bitwise_roundtrip(self):
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            _master_from_remainder,
+            _split_master,
+        )
+
+        rng = np.random.RandomState(3)
+        master = jnp.asarray((rng.randn(257) * 10 ** rng.uniform(-3, 3, 257)).astype(np.float32))
+        p_bf16, rem = _split_master(master)
+        back = _master_from_remainder(p_bf16.astype(jnp.float32), rem)
+        np.testing.assert_array_equal(
+            np.asarray(master).view(np.uint32), np.asarray(back).view(np.uint32))
+
+    def test_requires_bf16_params(self, devices8):
+        opt = DistributedFusedAdam(lr=1e-2, store_param_remainders=True)
+        with pytest.raises(ValueError, match="bf16"):
+            opt.init(make_tree(), world_size=DP)
+
+    @pytest.mark.slow
+    def test_master_trajectory_matches_fp32_mode(self, devices8):
+        """The reconstructed master must track the fp32-master mode's
+        master bitwise: precision is identical, only storage differs."""
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            _master_from_remainder,
+        )
+
+        params0 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), make_tree(7))
+        mesh = Mesh(np.array(devices8), ("dp",))
+        rng = np.random.RandomState(11)
+        grads = [
+            jax.tree.map(lambda x: jnp.asarray(
+                rng.randn(*x.shape).astype(np.float32)), params0)
+            for _ in range(4)
+        ]
+
+        def run(store_rem):
+            opt = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                       store_param_remainders=store_rem)
+            state = opt.init(params0, world_size=DP)
+            sspec = opt.state_partition_spec()
+            params = params0
+            for g in grads:
+                params, state = jax.shard_map(
+                    lambda p, s, g: opt.update(g, s, p),
+                    mesh=mesh, in_specs=(P(), sspec, P()),
+                    out_specs=(P(), sspec), check_vma=False,
+                )(params, state, g)
+            return opt, params, state
+
+        opt_r, p_r, s_r = run(True)
+        opt_f, p_f, s_f = run(False)
+
+        assert s_r.master_shard.dtype == jnp.uint16  # half the memory
+        # reconstruct the remainder-mode master from (params, remainder)
+        leaves = [np.asarray(x, np.float32).reshape(-1) for x in jax.tree.leaves(p_r)]
+        flat_p = np.concatenate(leaves)
+        padded = s_r.master_shard.shape[0]
+        flat_p = np.pad(flat_p, (0, padded - flat_p.size))
+        master_r = _master_from_remainder(
+            jnp.asarray(flat_p), s_r.master_shard)
+        np.testing.assert_array_equal(
+            np.asarray(master_r).view(np.uint32),
+            np.asarray(s_f.master_shard).view(np.uint32))
+        # params agree to bf16 rounding-mode differences (trunc vs RNE)
+        for a, b in zip(jax.tree.leaves(p_r), jax.tree.leaves(p_f)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-2, atol=1e-3)
+
+    @pytest.mark.slow
+    def test_overflow_skip_keeps_params(self, devices8):
+        params0 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), make_tree(9))
+        mesh = Mesh(np.array(devices8), ("dp",))
+        opt = DistributedFusedAdam(lr=1e-2, store_param_remainders=True)
+        state = opt.init(params0, world_size=DP)
+        sspec = opt.state_partition_spec()
+        g = jax.tree.map(lambda x: jnp.full(x.shape, jnp.nan, jnp.float32), params0)
+        params, state = jax.shard_map(
+            lambda p, s, g: opt.update(g, s, p, grads_finite=jnp.bool_(False)),
+            mesh=mesh, in_specs=(P(), sspec, P()),
+            out_specs=(P(), sspec), check_vma=False,
+        )(params0, state, g)
+        assert int(state.step) == 0
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params0)):
+            np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+
+    def test_master_kind_mismatch_refused(self):
+        opt_rem = DistributedFusedAdam(lr=1e-2, store_param_remainders=True)
+        opt_f32 = DistributedFusedAdam(lr=1e-2)
+        sd = {"step": 0, "master_kind": "remainder_u16",
+              "exp_avg": np.zeros(8, np.float32),
+              "exp_avg_sq": np.zeros(8, np.float32),
+              "master_shard": np.zeros(8, np.uint16)}
+        with pytest.raises(ValueError, match="master_kind"):
+            opt_f32.load_state_dict(sd)
+        sd["master_kind"] = "fp32"
+        sd["master_shard"] = np.zeros(8, np.float32)
+        opt_f32.load_state_dict(sd)  # ok
+        with pytest.raises(ValueError, match="master_kind"):
+            opt_rem.load_state_dict(sd)
+        # pre-remainder checkpoints (no field) load as fp32
+        del sd["master_kind"]
+        opt_f32.load_state_dict(sd)
