@@ -68,6 +68,55 @@ def f32(tree: Tree) -> Tree:
     return jax.tree.map(lambda x: x.astype(jnp.float32), tree)
 
 
+class HyperLeaf(dict):
+    """An override dict that is a pytree *leaf* (unregistered dict
+    subclass), so a tree of them can ride through ``jax.tree.map``
+    alongside array trees."""
+
+
+def leaf_hypers(params: Tree, param_group_fn, group_hypers) -> Optional[Tree]:
+    """Per-leaf hyperparameter overrides — the functional form of torch
+    ``param_groups`` (reference optimizers iterate
+    ``self.param_groups`` with per-group lr/weight_decay,
+    fused_adam.py:127+).
+
+    ``param_group_fn(path_str, leaf) -> group_name`` assigns each param
+    leaf to a named group at trace time (paths are static);
+    ``group_hypers[group_name]`` is a dict of overrides (``lr``
+    (absolute — replaces any runtime schedule for that group),
+    ``lr_scale`` (multiplies the runtime lr), ``weight_decay``,
+    optimizer-specific keys).  Returns a tree of :class:`HyperLeaf`
+    matching ``params``, or None when no grouping is configured.
+    Raises if a ``group_hypers`` key names a group no param maps to
+    (a typo'd group name must not silently disable its overrides).
+    """
+    if param_group_fn is None:
+        return None
+    group_hypers = group_hypers or {}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    seen = set()
+    out = []
+    for kp, leaf in flat:
+        g = param_group_fn(jax.tree_util.keystr(kp), leaf)
+        seen.add(g)
+        out.append(HyperLeaf(group_hypers.get(g, {})))
+    unused = set(group_hypers) - seen
+    if unused:
+        raise ValueError(
+            f"group_hypers keys {sorted(unused)} match no param group "
+            f"(param_group_fn produced {sorted(seen)})"
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def leaf_lr(h: dict, lr):
+    """Resolve a leaf's lr: absolute ``lr`` override wins, else the
+    runtime lr scaled by ``lr_scale``."""
+    if "lr" in h:
+        return h["lr"]
+    return lr * h.get("lr_scale", 1.0)
+
+
 class OptimizerBase:
     """Common constructor plumbing.  Subclasses define init/update."""
 

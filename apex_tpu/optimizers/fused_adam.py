@@ -43,7 +43,13 @@ class FusedAdam(base.OptimizerBase):
         weight_decay: float = 0.0,
         amsgrad: bool = False,
         master_weights: bool = False,
+        param_group_fn=None,
+        group_hypers=None,
     ):
+        """``param_group_fn(path, leaf) -> group_name`` +
+        ``group_hypers={name: {"lr": ..., "weight_decay": ...}}`` is the
+        functional form of the reference's ``param_groups`` (per-group
+        hyperparameters, e.g. no weight decay on norms/biases)."""
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
         super().__init__(lr, weight_decay, master_weights)
@@ -51,6 +57,8 @@ class FusedAdam(base.OptimizerBase):
         self.beta1, self.beta2 = betas
         self.eps = eps
         self.adam_w_mode = adam_w_mode
+        self.param_group_fn = param_group_fn
+        self.group_hypers = group_hypers
 
     def init(self, params) -> AdamState:
         zeros = lambda t: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t)
@@ -74,23 +82,28 @@ class FusedAdam(base.OptimizerBase):
             bc1 = bc2 = jnp.float32(1.0)
 
         p_math = base.math_params(params, state.master)
+        hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers)
 
-        def one(g, p, m, v):
+        def one(g, p, m, v, h):
+            wd_i = h.get("weight_decay", wd)
+            lr_i = base.leaf_lr(h, lr)
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             if not self.adam_w_mode:  # ADAM_MODE_0: L2 regularization
-                g = g + wd * p32
+                g = g + wd_i * p32
             m_new = b1 * m + (1.0 - b1) * g
             v_new = b2 * v + (1.0 - b2) * g * g
             denom = jnp.sqrt(v_new / bc2) + eps
             update = (m_new / bc1) / denom
             if self.adam_w_mode:  # ADAM_MODE_1: decoupled weight decay
-                update = update + wd * p32
-            return p32 - lr * update, m_new, v_new
+                update = update + wd_i * p32
+            return p32 - lr_i * update, m_new, v_new
 
-        out = jax.tree.map(one, grads, p_math, state.exp_avg, state.exp_avg_sq)
-        # unzip the 3-tuples
         treedef = jax.tree.structure(grads)
+        if hypers is None:
+            hypers = jax.tree.map(lambda _: base.HyperLeaf(), grads)
+        # tree.map validates all five trees share grads' structure
+        out = jax.tree.map(one, grads, p_math, state.exp_avg, state.exp_avg_sq, hypers)
         flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
         p_new = jax.tree.unflatten(treedef, [x[0] for x in flat])
         m_new = jax.tree.unflatten(treedef, [x[1] for x in flat])

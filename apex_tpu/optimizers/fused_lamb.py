@@ -45,7 +45,14 @@ class FusedLAMB(base.OptimizerBase):
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
         master_weights: bool = False,
+        param_group_fn=None,
+        group_hypers=None,
     ):
+        """``param_group_fn``/``group_hypers``: functional param_groups
+        (see :class:`~apex_tpu.optimizers.FusedAdam`).  LAMB additionally
+        honors the per-group key ``use_trust_ratio`` (False → plain lr
+        step, the BERT recipe's exclude_from_layer_adaptation for
+        norms/biases)."""
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
         super().__init__(lr, weight_decay, master_weights)
@@ -56,6 +63,8 @@ class FusedLAMB(base.OptimizerBase):
         self.grad_averaging = grad_averaging
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
+        self.param_group_fn = param_group_fn
+        self.group_hypers = group_hypers
 
     def init(self, params) -> LambState:
         zeros = lambda t: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t)
@@ -90,40 +99,46 @@ class FusedLAMB(base.OptimizerBase):
         )
 
         p_math = base.math_params(params, state.master)
+        hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers)
+        treedef = jax.tree.structure(grads)
+        if hypers is None:
+            hypers = jax.tree.map(lambda _: base.HyperLeaf(), grads)
 
-        def stage1(g, p, m, v):
+        def stage1(g, p, m, v, h):
+            wd_i = h.get("weight_decay", wd)
             g = g.astype(jnp.float32) / clip
             p32 = p.astype(jnp.float32)
             if not self.adam_w_mode:  # MOMENT_MODE_0: L2 on scaled grad
-                g = g + wd * p32
+                g = g + wd_i * p32
             m_new = b1 * m + b3 * g
             v_new = b2 * v + (1.0 - b2) * g * g
             u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
             if self.adam_w_mode:  # MOMENT_MODE_1: decoupled
-                u = u + wd * p32
+                u = u + wd_i * p32
             return u, m_new, v_new
 
-        out = jax.tree.map(stage1, grads, p_math, state.exp_avg, state.exp_avg_sq)
-        treedef = jax.tree.structure(grads)
+        out = jax.tree.map(stage1, grads, p_math, state.exp_avg, state.exp_avg_sq, hypers)
         flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
         updates = jax.tree.unflatten(treedef, [x[0] for x in flat])
         m_new = jax.tree.unflatten(treedef, [x[1] for x in flat])
         v_new = jax.tree.unflatten(treedef, [x[2] for x in flat])
 
         # Stage 2: per-tensor trust ratio (multi_tensor_lamb.cu:255-262).
-        def stage2(p, u):
+        def stage2(p, u, h):
+            wd_i = h.get("weight_decay", wd)
+            lr_i = base.leaf_lr(h, lr)
             p32 = p.astype(jnp.float32)
-            if self.use_nvlamb or wd != 0.0:
+            if h.get("use_trust_ratio", True) and (self.use_nvlamb or wd_i != 0.0):
                 p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
                 u_norm = jnp.sqrt(jnp.sum(jnp.square(u)))
                 ratio = jnp.where(
-                    (p_norm != 0.0) & (u_norm != 0.0), lr * (p_norm / u_norm), lr
+                    (p_norm != 0.0) & (u_norm != 0.0), lr_i * (p_norm / u_norm), lr_i
                 )
             else:
-                ratio = lr
+                ratio = lr_i
             return p32 - ratio * u
 
-        p_new = jax.tree.map(stage2, p_math, updates)
+        p_new = jax.tree.map(stage2, p_math, updates, hypers)
 
         p_new = base.select(grads_finite, p_new, p_math)
         m_new = base.select(grads_finite, m_new, state.exp_avg)

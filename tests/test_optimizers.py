@@ -208,3 +208,104 @@ class TestFusedNovoGrad:
         _, state = opt.update(g, state, params)
         expected = np.sqrt(4 * 4.0)  # ||g|| = 4
         np.testing.assert_allclose(float(jax.tree.leaves(state.exp_avg_sq)[0]), expected, rtol=1e-5)
+
+
+class TestParamGroups:
+    """Functional param_groups (reference optimizers iterate per-group
+    lr/weight_decay): path->group mapping + per-group overrides."""
+
+    def _groups(self, path, leaf):
+        return "no_decay" if ("bias" in path or "norm" in path) else "default"
+
+    def test_adam_no_decay_group(self):
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"w": jnp.ones((4, 4)), "bias": jnp.ones((4,)),
+                  "norm_scale": jnp.ones((4,))}
+        grads = jax.tree.map(jnp.zeros_like, params)  # wd effect only
+
+        grouped = FusedAdam(lr=0.1, weight_decay=0.5,
+                            param_group_fn=self._groups,
+                            group_hypers={"no_decay": {"weight_decay": 0.0}})
+        st = grouped.init(params)
+        p2, _ = grouped.update(grads, st, params)
+        # zero grad + AdamW: p -= lr*wd*p only where decay applies
+        np.testing.assert_allclose(np.asarray(p2["w"]), 0.95, rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(p2["bias"]), 1.0)
+        np.testing.assert_array_equal(np.asarray(p2["norm_scale"]), 1.0)
+
+    def test_adam_per_group_lr(self):
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"w": jnp.ones((4,)), "head_w": jnp.ones((4,))}
+        grads = {"w": jnp.full((4,), 0.1), "head_w": jnp.full((4,), 0.1)}
+        opt = FusedAdam(lr=0.1, weight_decay=0.0,
+                        param_group_fn=lambda p, l: "head" if "head" in p else "body",
+                        group_hypers={"head": {"lr": 0.0}})
+        st = opt.init(params)
+        p2, _ = opt.update(grads, st, params)
+        assert float(p2["w"][0]) != 1.0
+        np.testing.assert_array_equal(np.asarray(p2["head_w"]), 1.0)  # lr=0
+
+    def test_ungrouped_matches_hand_oracle(self):
+        """No param_group_fn → exact AdamW numerics (pins the default
+        code path against a hand-computed oracle)."""
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"w": jnp.asarray([1.0, 2.0])}
+        grads = {"w": jnp.asarray([0.1, -0.2])}
+        a = FusedAdam(lr=0.01, weight_decay=0.01)
+        pa, _ = a.update(grads, a.init(params), params)
+
+        g = np.array([0.1, -0.2]); p = np.array([1.0, 2.0])
+        m = 0.1 * g; v = 0.001 * g * g
+        u = (m / (1 - 0.9)) / (np.sqrt(v / (1 - 0.999)) + 1e-8) + 0.01 * p
+        np.testing.assert_allclose(np.asarray(pa["w"]), p - 0.01 * u, rtol=1e-6)
+
+    def test_lr_scale_composes_with_schedule(self):
+        """lr_scale multiplies the runtime lr (the schedule-friendly
+        per-group knob); absolute 'lr' replaces it."""
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"w": jnp.ones((4,)), "head": jnp.ones((4,))}
+        grads = {"w": jnp.full((4,), 0.1), "head": jnp.full((4,), 0.1)}
+        opt = FusedAdam(lr=999.0, weight_decay=0.0,
+                        param_group_fn=lambda p, l: "head" if "head" in p else "body",
+                        group_hypers={"head": {"lr_scale": 0.5}})
+        st = opt.init(params)
+        runtime_lr = 0.01
+        p2, _ = opt.update(grads, st, params, lr=runtime_lr)
+        dw = 1.0 - float(p2["w"][0])      # stepped at runtime lr
+        dh = 1.0 - float(p2["head"][0])   # stepped at 0.5 * runtime lr
+        np.testing.assert_allclose(dh, dw * 0.5, rtol=1e-5)
+
+    def test_typod_group_name_raises(self):
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"w": jnp.ones((2,))}
+        grads = {"w": jnp.ones((2,))}
+        opt = FusedAdam(lr=0.1, param_group_fn=lambda p, l: "body",
+                        group_hypers={"no-decay": {"weight_decay": 0.0}})
+        with pytest.raises(ValueError, match="no-decay"):
+            opt.update(grads, opt.init(params), params)
+
+    def test_lamb_trust_ratio_exclusion(self):
+        from apex_tpu.optimizers import FusedLAMB
+
+        params = {"w": jnp.full((8,), 2.0), "ln_g": jnp.full((8,), 2.0)}
+        grads = {"w": jnp.full((8,), 0.3), "ln_g": jnp.full((8,), 0.3)}
+        opt = FusedLAMB(
+            lr=0.1, weight_decay=0.1, max_grad_norm=1e9,
+            param_group_fn=lambda p, l: "ln" if p.startswith("['ln") else "w",
+            group_hypers={"ln": {"use_trust_ratio": False, "weight_decay": 0.0}})
+        st = opt.init(params)
+        p2, _ = opt.update(grads, st, params)
+
+        # oracle: ln_g takes a plain Adam-style step (no trust ratio, no wd)
+        bc1, bc2 = 1 - 0.9, 1 - 0.999
+        m = 0.1 * 0.3
+        v = 0.001 * 0.3 ** 2
+        u = (m / bc1) / (np.sqrt(v / bc2) + 1e-6)
+        np.testing.assert_allclose(np.asarray(p2["ln_g"]), 2.0 - 0.1 * u, rtol=1e-5)
+        # w uses the trust ratio: ||p||/||u_w|| scaling, so a different step
+        assert not np.allclose(np.asarray(p2["w"]), np.asarray(p2["ln_g"]))
