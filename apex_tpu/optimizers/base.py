@@ -89,9 +89,11 @@ def leaf_hypers(params: Tree, param_group_fn, group_hypers) -> Optional[Tree]:
     matching ``params``, or None when no grouping is configured.
     Raises if a ``group_hypers`` key names a group no param maps to
     (a typo'd group name must not silently disable its overrides).
+    When no grouping is configured, returns a tree of empty overrides
+    (so optimizers have one code path).
     """
     if param_group_fn is None:
-        return None
+        return jax.tree.map(lambda _: HyperLeaf(), params)
     group_hypers = group_hypers or {}
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     seen = set()

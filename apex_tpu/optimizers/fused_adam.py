@@ -100,8 +100,6 @@ class FusedAdam(base.OptimizerBase):
             return p32 - lr_i * update, m_new, v_new
 
         treedef = jax.tree.structure(grads)
-        if hypers is None:
-            hypers = jax.tree.map(lambda _: base.HyperLeaf(), grads)
         # tree.map validates all five trees share grads' structure
         out = jax.tree.map(one, grads, p_math, state.exp_avg, state.exp_avg_sq, hypers)
         flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))

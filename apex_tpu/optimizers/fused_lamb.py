@@ -101,8 +101,6 @@ class FusedLAMB(base.OptimizerBase):
         p_math = base.math_params(params, state.master)
         hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers)
         treedef = jax.tree.structure(grads)
-        if hypers is None:
-            hypers = jax.tree.map(lambda _: base.HyperLeaf(), grads)
 
         def stage1(g, p, m, v, h):
             wd_i = h.get("weight_decay", wd)

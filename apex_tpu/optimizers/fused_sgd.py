@@ -40,7 +40,12 @@ class FusedSGD(base.OptimizerBase):
         nesterov: bool = False,
         wd_after_momentum: bool = False,
         master_weights: bool = False,
+        param_group_fn=None,
+        group_hypers=None,
     ):
+        """``param_group_fn``/``group_hypers``: functional param_groups
+        (see :class:`~apex_tpu.optimizers.FusedAdam`); per-group keys
+        here: ``lr``/``lr_scale``, ``weight_decay``, ``momentum``."""
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires a momentum and zero dampening")
         super().__init__(lr, weight_decay, master_weights)
@@ -48,6 +53,8 @@ class FusedSGD(base.OptimizerBase):
         self.dampening = dampening
         self.nesterov = nesterov
         self.wd_after_momentum = wd_after_momentum
+        self.param_group_fn = param_group_fn
+        self.group_hypers = group_hypers
 
     def init(self, params) -> SGDState:
         return SGDState(
@@ -65,26 +72,30 @@ class FusedSGD(base.OptimizerBase):
 
         step = base.predicate_step(grads_finite, state.step)
         p_math = base.math_params(params, state.master)
+        hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers)
 
-        def one(g, p, buf):
+        def one(g, p, buf, h):
+            wd_i = h.get("weight_decay", wd)
+            lr_i = base.leaf_lr(h, lr)
+            mu_i = h.get("momentum", mu)
             g = g.astype(jnp.float32) * (1.0 / scale)
             p32 = p.astype(jnp.float32)
-            if wd != 0.0 and not self.wd_after_momentum:
-                g = g + wd * p32
-            if mu != 0.0:
-                steady = mu * buf + (1.0 - damp) * g
+            if not self.wd_after_momentum and wd_i != 0.0:
+                g = g + wd_i * p32
+            if mu_i != 0.0:
+                steady = mu_i * buf + (1.0 - damp) * g
                 buf_new = jnp.where(first_run, g, steady)
                 if self.nesterov:
-                    g = g + mu * buf_new
+                    g = g + mu_i * buf_new
                 else:
                     g = buf_new
             else:
                 buf_new = buf
-            if wd != 0.0 and self.wd_after_momentum:
-                g = g + wd * p32
-            return p32 - lr * g, buf_new
+            if self.wd_after_momentum and wd_i != 0.0:
+                g = g + wd_i * p32
+            return p32 - lr_i * g, buf_new
 
-        out = jax.tree.map(one, grads, p_math, state.momentum_buffer)
+        out = jax.tree.map(one, grads, p_math, state.momentum_buffer, hypers)
         treedef = jax.tree.structure(grads)
         flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
         p_new = jax.tree.unflatten(treedef, [x[0] for x in flat])

@@ -309,3 +309,24 @@ class TestParamGroups:
         np.testing.assert_allclose(np.asarray(p2["ln_g"]), 2.0 - 0.1 * u, rtol=1e-5)
         # w uses the trust ratio: ||p||/||u_w|| scaling, so a different step
         assert not np.allclose(np.asarray(p2["w"]), np.asarray(p2["ln_g"]))
+
+    def test_sgd_per_group_momentum_and_decay(self):
+        from apex_tpu.optimizers import FusedSGD
+
+        params = {"w": jnp.ones((4,)), "bn_scale": jnp.ones((4,))}
+        grads = {"w": jnp.full((4,), 0.1), "bn_scale": jnp.full((4,), 0.1)}
+        opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=0.5,
+                       param_group_fn=lambda p, l: "bn" if "bn" in p else "w",
+                       group_hypers={"bn": {"weight_decay": 0.0, "momentum": 0.0}})
+        st = opt.init(params)
+        p2, st = opt.update(grads, st, params)
+        # bn: plain SGD, no decay: p - lr*g
+        np.testing.assert_allclose(np.asarray(p2["bn_scale"]), 1.0 - 0.1 * 0.1, rtol=1e-6)
+        # w: wd folded in before momentum; first step buf = g
+        np.testing.assert_allclose(np.asarray(p2["w"]), 1.0 - 0.1 * (0.1 + 0.5), rtol=1e-6)
+        # second step, exact: buf=0.6 (first step), g2 = 0.1 + 0.5*0.94
+        # = 0.57, steady = 0.9*0.6 + 0.57 = 1.11, p3 = 0.94 - 0.1*1.11
+        p3, st = opt.update(grads, st, p2)
+        np.testing.assert_allclose(np.asarray(p3["w"]), 0.94 - 0.111, rtol=1e-6)
+        # bn stays momentum-free: another plain lr*g step
+        np.testing.assert_allclose(np.asarray(p3["bn_scale"]), 0.99 - 0.01, rtol=1e-6)
