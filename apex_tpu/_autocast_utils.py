@@ -8,7 +8,7 @@ JAX has no ambient autocast state; the functional analog is an explicit
 policy-scoped cast applied at a function boundary.
 """
 
-from typing import Callable, Optional, Sequence
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

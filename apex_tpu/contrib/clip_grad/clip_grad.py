@@ -7,7 +7,6 @@ Functional: returns ``(clipped_grads, total_norm)`` instead of mutating.
 Supports ``norm_type`` 2.0 and inf like the reference.
 """
 
-from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

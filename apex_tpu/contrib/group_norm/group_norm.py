@@ -5,7 +5,6 @@ one-pass/two-pass CUDA kernels (diffusion workloads).  NHWC is the TPU
 conv layout already; stats in fp32; SiLU fuses into the same pass.
 """
 
-from typing import Any, Optional
 
 import flax.linen as nn
 import jax

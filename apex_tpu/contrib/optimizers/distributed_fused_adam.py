@@ -25,7 +25,7 @@ TPU-native collapse of that machinery:
 Use inside ``shard_map`` with params replicated over ``dp``.
 """
 
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

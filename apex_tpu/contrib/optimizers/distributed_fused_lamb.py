@@ -13,13 +13,13 @@ update — the all_gather needed for param sync anyway supplies the
 update vector, so the extra cost is one pass of per-tensor reductions.
 """
 
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.contrib.optimizers.distributed_fused_adam import _flatten, _unflatten_into
+from apex_tpu.contrib.optimizers.distributed_fused_adam import _flatten
 from apex_tpu.transformer.parallel_state import DATA_AXIS
 
 

@@ -14,7 +14,7 @@ in Python; the CUDA here is only the permutation search, replaced by a
 greedy JAX implementation.
 """
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,6 @@ reference hand-codes beta; autodiff of the forward DP is mathematically
 identical).
 """
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,7 @@ TPU for long rows; the math here is the specification and fallback.
 
 import numbers
 from functools import partial
-from typing import Optional, Sequence, Tuple
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

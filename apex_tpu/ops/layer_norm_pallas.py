@@ -16,7 +16,6 @@ lane-aligned hidden sizes; the jnp path remains the universal fallback
 and the numerics specification.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -15,7 +15,7 @@ These functions are the building blocks for :mod:`apex_tpu.optimizers`
 and :mod:`apex_tpu.amp`.
 """
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

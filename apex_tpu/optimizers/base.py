@@ -16,7 +16,7 @@ back to storage dtype (reference: ``AdamCapturableMasterFunctor``,
 ``multi_tensor_adam.cu:243``; ``fp16_utils/fp16_optimizer.py``).
 """
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

@@ -20,7 +20,7 @@ Note ``bias_correction2 = sqrt(1-β2^t)`` here (unlike Adam) —
 ``multi_tensor_novograd.cu:150-152``.
 """
 
-from typing import Any, List, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

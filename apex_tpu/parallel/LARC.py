@@ -7,7 +7,6 @@ mode) or the adaptive lr outright (scale mode).  Weight decay is folded
 into the grad when active (LARC.py:98-104).
 """
 
-from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,6 @@ reference configure the overlap engine and have no TPU meaning; the
 ``DistributedDataParallel`` wrapper accepts and ignores them.
 """
 
-from typing import Any, Optional
 
 import contextlib
 

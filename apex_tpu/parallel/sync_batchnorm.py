@@ -17,7 +17,7 @@ Uneven per-rank batches (reference
 element *count* rather than multiplying by world size.
 """
 
-from typing import Any, Optional
+from typing import Optional
 
 import flax.linen as nn
 import jax
