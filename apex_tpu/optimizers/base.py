@@ -93,6 +93,11 @@ def leaf_hypers(params: Tree, param_group_fn, group_hypers) -> Optional[Tree]:
     (so optimizers have one code path).
     """
     if param_group_fn is None:
+        if group_hypers:
+            raise ValueError(
+                "group_hypers given without param_group_fn — no param can "
+                "map to any group, so the overrides would be silently ignored"
+            )
         return jax.tree.map(lambda _: HyperLeaf(), params)
     group_hypers = group_hypers or {}
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)

@@ -32,10 +32,14 @@ class FusedAdagrad(base.OptimizerBase):
         weight_decay: float = 0.0,
         adagrad_w_mode: bool = False,
         master_weights: bool = False,
+        param_group_fn=None,
+        group_hypers=None,
     ):
         super().__init__(lr, weight_decay, master_weights)
         self.eps = eps
         self.adagrad_w_mode = adagrad_w_mode
+        self.param_group_fn = param_group_fn
+        self.group_hypers = group_hypers
 
     def init(self, params) -> AdagradState:
         return AdagradState(
@@ -50,20 +54,23 @@ class FusedAdagrad(base.OptimizerBase):
 
         step = base.predicate_step(grads_finite, state.step)
         p_math = base.math_params(params, state.master)
+        hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers)
 
-        def one(g, p, h):
+        def one(g, p, h, hyp):
+            wd_i = hyp.get("weight_decay", wd)
+            lr_i = base.leaf_lr(hyp, lr)
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             if not self.adagrad_w_mode:
-                g = g + wd * p32
+                g = g + wd_i * p32
                 h_new = h + g * g
-                p_out = p32 - lr * (g / (jnp.sqrt(h_new) + eps))
+                p_out = p32 - lr_i * (g / (jnp.sqrt(h_new) + eps))
             else:
                 h_new = h + g * g
-                p_out = p32 - lr * (g / (jnp.sqrt(h_new) + eps) + wd * p32)
+                p_out = p32 - lr_i * (g / (jnp.sqrt(h_new) + eps) + wd_i * p32)
             return p_out, h_new
 
-        out = jax.tree.map(one, grads, p_math, state.sum)
+        out = jax.tree.map(one, grads, p_math, state.sum, hypers)
         treedef = jax.tree.structure(grads)
         flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
         p_new = jax.tree.unflatten(treedef, [x[0] for x in flat])

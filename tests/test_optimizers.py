@@ -330,3 +330,21 @@ class TestParamGroups:
         np.testing.assert_allclose(np.asarray(p3["w"]), 0.94 - 0.111, rtol=1e-6)
         # bn stays momentum-free: another plain lr*g step
         np.testing.assert_allclose(np.asarray(p3["bn_scale"]), 0.99 - 0.01, rtol=1e-6)
+
+    def test_adagrad_no_decay_group(self):
+        from apex_tpu.optimizers import FusedAdagrad
+
+        params = {"w": jnp.ones((4,)), "b": jnp.ones((4,))}
+        opt = FusedAdagrad(lr=0.1, weight_decay=0.5,
+                           param_group_fn=lambda p, l: "b" if p == "['b']" else "w",
+                           group_hypers={"b": {"weight_decay": 0.0}})
+        st = opt.init(params)
+        g1 = {"w": jnp.full((4,), 0.1), "b": jnp.full((4,), 0.1)}
+        p2, st = opt.update(g1, st, params)
+        # zero grad: only weight decay moves params — the no-decay group
+        # must hold still (first-step adagrad normalizes to sign(g), so
+        # the wd difference is only visible from step 2 on)
+        g0 = jax.tree.map(jnp.zeros_like, g1)
+        p3, st = opt.update(g0, st, p2)
+        np.testing.assert_array_equal(np.asarray(p3["b"]), np.asarray(p2["b"]))
+        assert not np.allclose(np.asarray(p3["w"]), np.asarray(p2["w"]))
