@@ -70,6 +70,46 @@ def _flatten(tree):
     return flat
 
 
+def local_total_and_axes(params, param_specs, axis_sizes, zero_axis):
+    """(local_total_numel, model_axes, leaf_repl): per-device param
+    count when ``params`` are sharded over model-parallel mesh axes per
+    ``param_specs``, the sorted tuple of those axes, and — per leaf —
+    the replication factor a psum over ``model_axes`` over-counts it by
+    (1 for fully sharded leaves).  Raises if any param is sharded over
+    the ZeRO axis itself."""
+    total = 0
+    used_axes = []
+    leaves, treedef = jax.tree.flatten(params)
+    spec_leaves = treedef.flatten_up_to(param_specs)
+    leaf_axes = []
+    for leaf, spec in zip(leaves, spec_leaves):
+        n = int(np.prod(leaf.shape))
+        axes_here = set()
+        for entry in tuple(spec):
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                if ax is None:
+                    continue
+                if ax == zero_axis:
+                    raise ValueError(
+                        f"params must not be sharded over the ZeRO axis {ax!r}"
+                    )
+                n //= axis_sizes[ax]
+                axes_here.add(ax)
+                if ax not in used_axes:
+                    used_axes.append(ax)
+        leaf_axes.append(axes_here)
+        total += n
+    model_axes = tuple(sorted(used_axes))
+    # replication factor per leaf: a psum over model_axes counts a leaf
+    # replicated over an axis once PER rank of that axis — norm math
+    # must divide its contribution back out
+    repl = [
+        int(np.prod([axis_sizes[ax] for ax in model_axes if ax not in s] or [1]))
+        for s in leaf_axes
+    ]
+    return total, model_axes, repl
+
+
 def _unflatten_into(tree, flat):
     leaves, treedef = jax.tree.flatten(tree)
     out = []
@@ -149,25 +189,9 @@ class DistributedFusedAdam:
         if param_specs is not None:
             if axis_sizes is None:
                 raise ValueError("param_specs requires axis_sizes")
-            total = 0
-            used_axes = []
-            leaves, treedef = jax.tree.flatten(params)
-            spec_leaves = treedef.flatten_up_to(param_specs)
-            for leaf, spec in zip(leaves, spec_leaves):
-                n = int(np.prod(leaf.shape))
-                for entry in tuple(spec):
-                    for ax in (entry if isinstance(entry, tuple) else (entry,)):
-                        if ax is None:
-                            continue
-                        if ax == self.axis_name:
-                            raise ValueError(
-                                f"params must not be sharded over the ZeRO axis {ax!r}"
-                            )
-                        n //= axis_sizes[ax]
-                        if ax not in used_axes:
-                            used_axes.append(ax)
-                total += n
-            self._model_axes = tuple(sorted(used_axes))
+            total, self._model_axes, _ = local_total_and_axes(
+                params, param_specs, axis_sizes, self.axis_name
+            )
             for ax in self._model_axes:
                 model_mult *= axis_sizes[ax]
         else:

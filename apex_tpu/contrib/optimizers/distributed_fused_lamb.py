@@ -19,7 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.contrib.optimizers.distributed_fused_adam import _flatten
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    _flatten,
+    local_total_and_axes,
+)
 from apex_tpu.transformer.parallel_state import DATA_AXIS
 
 
@@ -56,22 +59,35 @@ class DistributedFusedLAMB:
         self.use_nvlamb = use_nvlamb
         self.axis_name = axis_name
 
-    def init(self, params, world_size: Optional[int] = None) -> DistributedFusedLAMBState:
+    def init(self, params, world_size: Optional[int] = None, param_specs=None,
+             axis_sizes=None) -> DistributedFusedLAMBState:
         """GLOBAL flat state (padded_total,) — shard over dp with
         :meth:`state_partition_spec` (see DistributedFusedAdam.init).
 
-        dp-only by design: LAMB's stage-2 trust ratios need GLOBAL
-        per-tensor norms, so composing with tensor-parallel param shards
-        would silently turn them into per-shard norms.  Use
-        :class:`DistributedFusedAdam` when params are model-sharded
-        (its ``param_specs=`` init), or keep LAMB params replicated —
-        the reference's DistributedFusedLAMB is likewise a pure-dp
-        (BERT) optimizer."""
+        **Composition with tensor parallelism**: pass ``param_specs`` +
+        ``axis_sizes`` exactly as for DistributedFusedAdam.  LAMB's
+        stage-2 trust ratios need GLOBAL per-tensor norms, so with
+        model-sharded params the per-tensor ‖p‖/‖u‖ sums are psum'd over
+        the model axes before the ratio — per-shard norms would silently
+        change the numerics (the reference's DistributedFusedLAMB is
+        pure-dp and never faces this)."""
         if world_size is None:
             raise ValueError("pass world_size= (the dp axis size)")
-        total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        self._model_axes = ()
+        self._leaf_repl = None
+        if param_specs is not None:
+            if axis_sizes is None:
+                raise ValueError("param_specs requires axis_sizes")
+            total, self._model_axes, self._leaf_repl = local_total_and_axes(
+                params, param_specs, axis_sizes, self.axis_name
+            )
+        else:
+            total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        model_mult = 1
+        for ax in self._model_axes:
+            model_mult *= axis_sizes[ax]
         padded = ((total + world_size - 1) // world_size) * world_size
-        zeros = jnp.zeros((padded,), jnp.float32)
+        zeros = jnp.zeros((model_mult * padded,), jnp.float32)
         return DistributedFusedLAMBState(
             step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=zeros, master_shard=zeros
         )
@@ -79,9 +95,10 @@ class DistributedFusedLAMB:
     def state_partition_spec(self):
         from jax.sharding import PartitionSpec as P
 
+        axes = getattr(self, "_model_axes", ())
+        flat = P((*axes, self.axis_name)) if axes else P(self.axis_name)
         return DistributedFusedLAMBState(
-            step=P(), exp_avg=P(self.axis_name), exp_avg_sq=P(self.axis_name),
-            master_shard=P(self.axis_name),
+            step=P(), exp_avg=flat, exp_avg_sq=flat, master_shard=flat,
         )
 
     def update(self, grads, state, params, grads_finite=None, lr=None):
@@ -103,8 +120,31 @@ class DistributedFusedLAMB:
         if self.grad_averaging:
             g_local = g_local / world
 
-        # global grad norm on the AVERAGED grad (fused_lamb.py:121-136)
-        gn_sq = jax.lax.psum(jnp.sum(jnp.square(g_local)), ax)
+        # global grad norm on the dp-AVERAGED grad (fused_lamb.py:121-136).
+        # Per-leaf sums are recovered from the scattered shard via a
+        # static segment map (leaf boundaries in the flat layout), so
+        # the dp reduction stays a reduce-scatter; with model-sharded
+        # params the norm additionally psums over the model axes with
+        # tp-REPLICATED leaves counted once, not once per rank.
+        model_axes = getattr(self, "_model_axes", ())
+        leaves_g = jax.tree.leaves(grads)
+        L = len(leaves_g)
+        seg_ids = np.repeat(
+            np.arange(L), [int(np.prod(g.shape)) for g in leaves_g]
+        )
+        seg_ids = np.pad(seg_ids, (0, padded - total), constant_values=L)
+        seg_local = jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(seg_ids), rank * shard, shard
+        )
+        leaf_sq_local = jax.ops.segment_sum(
+            jnp.square(g_local), seg_local, num_segments=L + 1
+        )[:L]
+        leaf_sq = jax.lax.psum(leaf_sq_local, ax)  # ||avg grad leaf||², per leaf
+        if model_axes:
+            repl = jnp.asarray(self._leaf_repl, jnp.float32)
+            gn_sq = jax.lax.psum(jnp.sum(leaf_sq / repl), model_axes)
+        else:
+            gn_sq = jnp.sum(leaf_sq)
         global_norm = jnp.sqrt(gn_sq)
         clip = jnp.where(
             global_norm > self.max_grad_norm, global_norm / self.max_grad_norm, jnp.float32(1.0)
@@ -140,16 +180,34 @@ class DistributedFusedLAMB:
         flat_pm = jax.lax.all_gather(master, ax, axis=0, tiled=True)[:total]
 
         leaves, treedef = jax.tree.flatten(params)
+        if self.use_nvlamb or wd != 0.0:
+            # all per-tensor ‖p‖²/‖u‖² in ONE batched psum over the
+            # model axes (not 2·L scalar collectives)
+            sums = []
+            off = 0
+            for p in leaves:
+                n = int(np.prod(p.shape))
+                sums.append(jnp.sum(jnp.square(flat_pm[off : off + n])))
+                sums.append(jnp.sum(jnp.square(flat_u[off : off + n])))
+                off += n
+            sums = jnp.stack(sums).reshape(len(leaves), 2)
+            if model_axes:  # GLOBAL per-tensor norms across tp shards;
+                # replicated leaves counted once, not once per rank
+                repl2 = jnp.asarray(self._leaf_repl, jnp.float32)[:, None]
+                sums = jax.lax.psum(sums, model_axes) / repl2
+            p_norms = jnp.sqrt(sums[:, 0])
+            u_norms = jnp.sqrt(sums[:, 1])
         new_leaves = []
         off = 0
-        for p in leaves:
+        for i, p in enumerate(leaves):
             n = int(np.prod(p.shape))
             u_t = flat_u[off : off + n]
             p_t = flat_pm[off : off + n]
             if self.use_nvlamb or wd != 0.0:
-                p_norm = jnp.sqrt(jnp.sum(jnp.square(p_t)))
-                u_norm = jnp.sqrt(jnp.sum(jnp.square(u_t)))
-                ratio = jnp.where((p_norm != 0.0) & (u_norm != 0.0), lr * (p_norm / u_norm), lr)
+                ratio = jnp.where(
+                    (p_norms[i] != 0.0) & (u_norms[i] != 0.0),
+                    lr * (p_norms[i] / u_norms[i]), lr,
+                )
             else:
                 ratio = lr
             new_leaves.append((p_t - ratio * u_t).reshape(p.shape).astype(p.dtype))
