@@ -232,7 +232,10 @@ def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
         "tokens_per_sec": round(tokens_per_sec, 0),
         "ms_per_step": round(dt * 1e3, 2),
         "model_tflops": round(tflops, 1),
-        "mfu_vs_measured_roofline": round(tflops / roofline_tflops, 3),
+        # MFU only against a *measured* roofline — no hardcoded denominator
+        "mfu_vs_measured_roofline": (
+            round(tflops / roofline_tflops, 3) if roofline_tflops else None
+        ),
     }
 
 
@@ -329,7 +332,8 @@ def _progress(msg):
     print(f"[bench {_t.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
-_DEADLINE = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_SEC", "1500"))
+_BUDGET_SEC = float(os.environ.get("BENCH_DEADLINE_SEC", "1500"))
+_DEADLINE = time.monotonic() + _BUDGET_SEC  # re-armed in main() post-preflight
 _DEVICE_WEDGED = False
 
 
@@ -397,7 +401,16 @@ def _device_preflight(timeout_s=420.0) -> Optional[str]:
 
 
 def main():
+    global _DEADLINE
     err = _device_preflight()
+    if err is not None and "timed out" in err:
+        # one retry after a backoff: transient tunnel hiccups recover in
+        # well under a minute, and an audited bench is worth the wait.
+        # (Deterministic failures — nonzero rc — repeat identically, so
+        # only the timeout case earns the retry.)
+        _progress(f"preflight failed ({err}); retrying in 90s")
+        time.sleep(90)
+        err = _device_preflight()
     if err is not None:
         print(json.dumps({
             "metric": "fused_adam_step_speedup_vs_eager",
@@ -407,8 +420,13 @@ def main():
             "error": err,
         }), flush=True)
         return
+    # re-arm the deadline now that the chip answered: preflight (and its
+    # possible retry) must not eat the section budget
+    _DEADLINE = time.monotonic() + _BUDGET_SEC
     roofline = _try("matmul_roofline", bench_matmul_roofline)
-    roof = roofline if isinstance(roofline, float) else 65.0  # measured typical
+    # If the roofline section failed, MFU has no honest denominator:
+    # report null and skip MFU rather than inventing a constant.
+    roof = roofline if isinstance(roofline, float) else None
     adam = _try("fused_adam", bench_fused_adam)
     gpt124_1k = _try("gpt124_s1024", bench_gpt, 12, 768, 12, 1024, 8, roof)
     gpt124_4k = _try("gpt124_s4096", bench_gpt, 12, 768, 12, 4096, 2, roof)
@@ -423,7 +441,7 @@ def main():
         "unit": "x",
         "vs_baseline": round(headline / 1.5, 3) if headline is not None else -1.0,
         "adam": adam,
-        "matmul_roofline_tflops": round(roof, 1),
+        "matmul_roofline_tflops": round(roof, 1) if roof is not None else None,
         "gpt124_s1024": gpt124_1k,
         "gpt124_s4096": gpt124_4k,
         "gpt345_s1024": gpt345_1k,
