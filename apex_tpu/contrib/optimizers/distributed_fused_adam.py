@@ -56,8 +56,16 @@ def _master_from_remainder(p_f32, rem_u16):
 
 def _split_master(master_f32):
     """(bf16 param, uint16 remainder): the bf16 the model sees is the
-    master's high 16 bits (truncation, not round-to-nearest — the
-    reference's convention, which is what makes reconstruction exact)."""
+    master's high 16 bits.
+
+    Truncation is THIS repo's convention (chosen so reconstruction is a
+    plain bitwise OR).  The reference instead stores signed int16
+    remainders and rounds the bf16 to nearest
+    (multi_tensor_distopt_adam_kernel.cu:295-312), so remainder-mode
+    bf16 params here can differ by up to 1 ulp (toward zero) from both
+    the reference and this repo's fp32-master mode (which RNE-casts).
+    The fp32 master — what the optimizer actually integrates — is
+    bit-exact either way."""
     bits = jax.lax.bitcast_convert_type(master_f32, jnp.uint32)
     rem = (bits & jnp.uint32(0xFFFF)).astype(jnp.uint16)
     p_bf16 = jax.lax.bitcast_convert_type((bits >> 16).astype(jnp.uint16), jnp.bfloat16)
@@ -85,15 +93,30 @@ def local_total_and_axes(params, param_specs, axis_sizes, zero_axis):
     for leaf, spec in zip(leaves, spec_leaves):
         n = int(np.prod(leaf.shape))
         axes_here = set()
-        for entry in tuple(spec):
-            for ax in (entry if isinstance(entry, tuple) else (entry,)):
-                if ax is None:
-                    continue
+        for dim, entry in enumerate(tuple(spec)):
+            dim_axes = tuple(
+                ax for ax in (entry if isinstance(entry, tuple) else (entry,))
+                if ax is not None
+            )
+            if not dim_axes:
+                continue
+            for ax in dim_axes:
                 if ax == zero_axis:
                     raise ValueError(
                         f"params must not be sharded over the ZeRO axis {ax!r}"
                     )
-                n //= axis_sizes[ax]
+            shard = int(np.prod([axis_sizes[ax] for ax in dim_axes]))
+            # the check must be per-DIMENSION: a divisible total with an
+            # indivisible sharded dim (e.g. (13, 5) split 5-way on dim 0)
+            # still pads/misaligns the flat layout
+            if leaf.shape[dim] % shard != 0:
+                raise ValueError(
+                    f"param dim {dim} of shape {leaf.shape} is not divisible "
+                    f"by mesh axes {dim_axes!r} (total size {shard}); the "
+                    "flat ZeRO layout would silently misalign"
+                )
+            n //= shard
+            for ax in dim_axes:
                 axes_here.add(ax)
                 if ax not in used_axes:
                     used_axes.append(ax)

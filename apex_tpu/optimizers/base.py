@@ -74,7 +74,12 @@ class HyperLeaf(dict):
     alongside array trees."""
 
 
-def leaf_hypers(params: Tree, param_group_fn, group_hypers) -> Optional[Tree]:
+#: override keys every optimizer understands
+_BASE_HYPER_KEYS = frozenset({"lr", "lr_scale", "weight_decay"})
+
+
+def leaf_hypers(params: Tree, param_group_fn, group_hypers,
+                extra_keys=()) -> Optional[Tree]:
     """Per-leaf hyperparameter overrides — the functional form of torch
     ``param_groups`` (reference optimizers iterate
     ``self.param_groups`` with per-group lr/weight_decay,
@@ -88,10 +93,21 @@ def leaf_hypers(params: Tree, param_group_fn, group_hypers) -> Optional[Tree]:
     optimizer-specific keys).  Returns a tree of :class:`HyperLeaf`
     matching ``params``, or None when no grouping is configured.
     Raises if a ``group_hypers`` key names a group no param maps to
-    (a typo'd group name must not silently disable its overrides).
+    (a typo'd group name must not silently disable its overrides), and
+    if any override key inside a group is not one the calling optimizer
+    reads (``lr``/``lr_scale``/``weight_decay`` plus ``extra_keys``) —
+    a typo like ``weight_dacay`` must not be silently ignored.
     When no grouping is configured, returns a tree of empty overrides
     (so optimizers have one code path).
     """
+    allowed = _BASE_HYPER_KEYS | set(extra_keys)
+    for gname, overrides in (group_hypers or {}).items():
+        unknown = set(overrides) - allowed
+        if unknown:
+            raise ValueError(
+                f"group_hypers[{gname!r}] has unknown override keys "
+                f"{sorted(unknown)}; this optimizer supports {sorted(allowed)}"
+            )
     if param_group_fn is None:
         if group_hypers:
             raise ValueError(

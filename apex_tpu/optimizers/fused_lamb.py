@@ -32,6 +32,10 @@ class LambState(NamedTuple):
 
 
 class FusedLAMB(base.OptimizerBase):
+
+    #: group-override keys beyond the base lr/lr_scale/weight_decay set
+    _HYPER_KEYS = ("use_trust_ratio",)
+
     def __init__(
         self,
         lr: float = 1e-3,
@@ -99,7 +103,8 @@ class FusedLAMB(base.OptimizerBase):
         )
 
         p_math = base.math_params(params, state.master)
-        hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers)
+        hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers,
+                                  extra_keys=self._HYPER_KEYS)
         treedef = jax.tree.structure(grads)
 
         def stage1(g, p, m, v, h):

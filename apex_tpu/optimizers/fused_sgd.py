@@ -31,6 +31,10 @@ class SGDState(NamedTuple):
 
 
 class FusedSGD(base.OptimizerBase):
+
+    #: group-override keys beyond the base lr/lr_scale/weight_decay set
+    _HYPER_KEYS = ("momentum",)
+
     def __init__(
         self,
         lr: float,
@@ -72,7 +76,8 @@ class FusedSGD(base.OptimizerBase):
 
         step = base.predicate_step(grads_finite, state.step)
         p_math = base.math_params(params, state.master)
-        hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers)
+        hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers,
+                                  extra_keys=self._HYPER_KEYS)
 
         def one(g, p, buf, h):
             wd_i = h.get("weight_decay", wd)

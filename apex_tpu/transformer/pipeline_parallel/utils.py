@@ -80,7 +80,8 @@ def listify_model(model):
     return [model]
 
 
-def calc_params_l2_norm(params, bf16: bool = False, attrs=None, tp_rank: int = 0):
+def calc_params_l2_norm(params, bf16: bool = False, attrs=None, tp_rank: int = 0,
+                        axis_name=None):
     """Reference: utils.py:213 — global L2 norm over params (the
     multi_tensor_l2norm kernel).
 
@@ -88,7 +89,39 @@ def calc_params_l2_norm(params, bf16: bool = False, attrs=None, tp_rank: int = 0
     :class:`~apex_tpu.transformer.tensor_parallel.TensorParallelAttributes`
     mirroring ``params``; when given, TP-replicated params are counted
     only on tp rank 0 (the reference filters with
-    ``param_is_not_tensor_parallel_duplicate``, utils.py:217-222)."""
+    ``param_is_not_tensor_parallel_duplicate``, utils.py:217-222).
+
+    ``axis_name``: mesh axis (or tuple of axes) the param *views* are
+    sharded over.  The reference all-reduces norm² across the
+    model-parallel group (utils.py:234-238); here, when called inside
+    ``shard_map`` on per-rank shards, pass the axis name(s) and the
+    norm² is psum-med the same way.  Without it the result is the norm
+    of the LOCAL shard only — callers on sharded views must either pass
+    ``axis_name`` or psum the squared result themselves.
+
+    With BOTH ``attrs`` and ``axis_name``: sharded leaves contribute
+    from every rank (each owns a distinct slice); replicated leaves
+    contribute only where ``lax.axis_index == 0`` (a traced analog of
+    the reference's rank-0-only counting — a static ``tp_rank`` filter
+    would count them once PER rank and inflate the psum)."""
+    if attrs is not None and axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.attributes import (
+            set_defaults_if_not_set_tensor_model_parallel_attributes as _defaults,
+        )
+
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        on_rank0 = jnp.float32(1.0)
+        for ax in axes:
+            on_rank0 = on_rank0 * (jax.lax.axis_index(ax) == 0)
+        leaves, treedef = jax.tree.flatten(params)
+        attr_leaves = treedef.flatten_up_to(attrs)
+        sq = jnp.float32(0.0)
+        for p, a in zip(leaves, attr_leaves):
+            contrib = jnp.sum(jnp.square(p.astype(jnp.float32)))
+            if not _defaults(a).tensor_model_parallel:
+                contrib = contrib * on_rank0
+            sq = sq + contrib
+        return jnp.sqrt(jax.lax.psum(sq, axis_name))
     if attrs is not None:
         from apex_tpu.transformer.tensor_parallel.attributes import (
             param_is_not_tensor_parallel_duplicate,
@@ -102,7 +135,10 @@ def calc_params_l2_norm(params, bf16: bool = False, attrs=None, tp_rank: int = 0
             is_leaf=lambda x: x is None or hasattr(x, "partition_dim"),
         )
         params = [p for p in jax.tree.leaves(keep) if p is not None]
-    return multi_tensor_l2norm(params)
+    norm = multi_tensor_l2norm(params)
+    if axis_name is not None:
+        norm = jnp.sqrt(jax.lax.psum(jnp.square(norm), axis_name))
+    return norm
 
 
 def print_rank_0(message: str) -> None:

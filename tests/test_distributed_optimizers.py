@@ -202,6 +202,28 @@ class TestShardedStateDict:
         with pytest.raises(ValueError, match="total_numel"):
             opt.sharded_state_dict(state, 0, 2)
 
+    def test_indivisible_model_shard_rejected(self):
+        """A param whose numel isn't divisible by its mesh-axis sizes
+        must be rejected — floor division would silently misalign the
+        flat ZeRO layout."""
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            local_total_and_axes,
+        )
+
+        params = {"w": jnp.zeros((13, 5))}  # dim 0 (13) not divisible by tp=2
+        with pytest.raises(ValueError, match="not divisible"):
+            local_total_and_axes(params, {"w": P("tp", None)},
+                                 {"tp": 2}, zero_axis="dp")
+        # the check is per-dimension: total 65 IS divisible by 5, but
+        # dim 0 (13) split 5 ways still misaligns — must raise
+        with pytest.raises(ValueError, match="not divisible"):
+            local_total_and_axes(params, {"w": P("tp", None)},
+                                 {"tp": 5}, zero_axis="dp")
+        # dim 1 (5) split 5 ways is fine
+        total, axes, repl = local_total_and_axes(
+            params, {"w": P(None, "tp")}, {"tp": 5}, zero_axis="dp")
+        assert total == 13 and axes == ("tp",) and repl == [1]
+
 
 class DistributedFusedAdamStateStub:
     exp_avg = jnp.zeros((8,), jnp.float32)

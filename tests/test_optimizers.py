@@ -289,6 +289,27 @@ class TestParamGroups:
         with pytest.raises(ValueError, match="no-decay"):
             opt.update(grads, opt.init(params), params)
 
+    def test_typod_override_key_raises(self):
+        """A typo'd override key ('weight_dacay') must fail loudly, not
+        be silently ignored by the h.get() lookups."""
+        from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+        params = {"w": jnp.ones((2,))}
+        grads = {"w": jnp.ones((2,))}
+        opt = FusedAdam(lr=0.1, param_group_fn=lambda p, l: "body",
+                        group_hypers={"body": {"weight_dacay": 0.0}})
+        with pytest.raises(ValueError, match="weight_dacay"):
+            opt.update(grads, opt.init(params), params)
+        # optimizer-specific keys are allowed only where that optimizer
+        # reads them: momentum is FusedSGD's, not FusedAdam's
+        opt2 = FusedAdam(lr=0.1, param_group_fn=lambda p, l: "body",
+                         group_hypers={"body": {"momentum": 0.5}})
+        with pytest.raises(ValueError, match="momentum"):
+            opt2.update(grads, opt2.init(params), params)
+        opt3 = FusedSGD(lr=0.1, momentum=0.9, param_group_fn=lambda p, l: "body",
+                        group_hypers={"body": {"momentum": 0.5}})
+        opt3.update(grads, opt3.init(params), params)  # valid for SGD
+
     def test_lamb_trust_ratio_exclusion(self):
         from apex_tpu.optimizers import FusedLAMB
 

@@ -318,3 +318,48 @@ class TestTensorParallelAttributes:
         n1 = float(calc_params_l2_norm(params, attrs=attrs, tp_rank=1))
         np.testing.assert_allclose(n0, np.sqrt(4 * 4 + 9 * 4), rtol=1e-6)
         np.testing.assert_allclose(n1, np.sqrt(4 * 4), rtol=1e-6)
+
+    def test_l2norm_axis_name_psum(self, devices8):
+        """With axis_name, per-rank sharded views psum norm² over the
+        group (reference utils.py:234-238 all-reduces across mp)."""
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from apex_tpu.transformer.pipeline_parallel.utils import calc_params_l2_norm
+
+        mesh = Mesh(np.array(devices8[:4]), ("tp",))
+        w = jnp.arange(16.0, dtype=jnp.float32)
+
+        def f(w_shard):
+            return calc_params_l2_norm({"w": w_shard}, axis_name="tp")
+
+        norm = shard_map(f, mesh=mesh, in_specs=P("tp"),
+                         out_specs=P())(w)
+        np.testing.assert_allclose(
+            float(norm), np.linalg.norm(np.arange(16.0)), rtol=1e-6)
+
+    def test_l2norm_axis_name_with_attrs_counts_replicated_once(self, devices8):
+        """attrs × axis_name: replicated leaves count once across the
+        group (traced axis_index-0 weighting), sharded leaves from every
+        rank — matching reference utils.py:217-238 filter-then-allreduce."""
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from apex_tpu.transformer.pipeline_parallel.utils import calc_params_l2_norm
+        from apex_tpu.transformer.tensor_parallel import attributes_tree
+
+        mesh = Mesh(np.array(devices8[:4]), ("tp",))
+        sharded = jnp.arange(16.0, dtype=jnp.float32)   # split over tp
+        replicated = jnp.full((3,), 2.0)                # same on every rank
+        attrs = attributes_tree(
+            {"s": sharded, "r": replicated},
+            lambda path, leaf: (0, 1) if "'s'" in str(path) else None)
+
+        def f(s_shard, r):
+            return calc_params_l2_norm({"s": s_shard, "r": r},
+                                       attrs=attrs, axis_name="tp")
+
+        norm = shard_map(f, mesh=mesh, in_specs=(P("tp"), P()),
+                         out_specs=P())(sharded, replicated)
+        expect = np.sqrt(np.sum(np.arange(16.0) ** 2) + 3 * 4.0)
+        np.testing.assert_allclose(float(norm), expect, rtol=1e-6)
