@@ -352,6 +352,22 @@ def sp_grad_sync(grads, axis_name: str):
     return {**grads, "layers": layers}
 
 
+def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
+                         opt_state, params, sync_axes):
+    """The shared unscale → found_inf vote → predicated step → scale
+    update tail of both scaled train steps (reference §3.2 ctx-exit:
+    ``apex/amp/handle.py:119-158`` + the model-parallel found_inf
+    agreement of ``apex/transformer/amp/grad_scaler.py:49,102``)."""
+    from apex_tpu.transformer.amp.grad_scaler import sync_found_inf
+
+    grads, finite = loss_scaler.unscale(scaler_state, grads)
+    finite = sync_found_inf(finite, sync_axes)
+    new_params, new_state = optimizer.update(
+        grads, opt_state, params, grads_finite=finite
+    )
+    return new_params, new_state, loss_scaler.update(scaler_state, finite)
+
+
 def make_train_step(
     config: GPTConfig,
     optimizer,
@@ -360,6 +376,7 @@ def make_train_step(
     dp_axis: Optional[str] = "dp",
     cp_axis: Optional[str] = None,
     opt_state_spec=None,
+    loss_scaler=None,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
 
@@ -368,11 +385,24 @@ def make_train_step(
     sharding, scalars replicated) and ZeRO optimizers supply their own —
     pass this for other state shapes (e.g. ``SGDState``).
 
+    ``loss_scaler``: an :class:`apex_tpu.amp.DynamicLossScaler` /
+    ``StaticLossScaler`` — the flagship fp16 path (reference
+    ``apex/amp/handle.py:16`` scale_loss × DDP composition).  Backward
+    runs on the SCALED loss so half-precision cotangents don't
+    underflow; grads are unscaled in fp32, the finite flag is agreed
+    across every model-parallel axis (the TP-aware GradScaler semantics,
+    reference ``apex/transformer/amp/grad_scaler.py:21-126``), the
+    optimizer step is predicated on it, and the scaler state updates
+    device-side.  The step then takes/returns a scaler state:
+    ``step(params, opt_state, scaler_state, tokens, targets) ->
+    (params, opt_state, scaler_state, loss)``.
+
     The TPU shape of reference §3.2's iteration: value_and_grad inside
     ``shard_map`` (TP collectives via the mappings), gradient ``pmean``
     over ``dp`` (the DDP allreduce, ``apex/parallel/distributed.py:429``),
     then the fused optimizer update on local shards.
-    Returns ``step(params, opt_state, tokens, targets) -> (params, opt_state, loss)``.
+    Without a scaler, returns
+    ``step(params, opt_state, tokens, targets) -> (params, opt_state, loss)``.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -416,23 +446,48 @@ def make_train_step(
             "ZeRO + MoE expert sharding both claim the dp axis; not wired"
         )
 
-    def local_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(gpt_loss)(
-            params, tokens, targets, config, tp_axis, cp_axis, ep_axis
-        )
+    def sync_loss_and_grads(loss, grads):
+        """cp behaves as a data axis for grads: each rank differentiated
+        its local-chunk loss (ring-travelled k/v cotangents included),
+        so pmean over cp (and dp) recovers the global-mean-loss grads."""
         if config.sequence_parallel:
             grads = sp_grad_sync(grads, tp_axis)
-        # cp behaves as a data axis for grads: each rank differentiated
-        # its local-chunk loss (ring-travelled k/v cotangents included),
-        # so pmean over cp (and dp) recovers the global-mean-loss grads
         for ax in (cp_axis, dp_axis):
             if ax is not None:
                 loss = jax.lax.pmean(loss, ax)
                 if ax == dp_axis and zero_opt:
                     continue
                 grads = pmean_grads(grads, ax, skip_experts=(ax == dp_axis))
+        return loss, grads
+
+    def local_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(gpt_loss)(
+            params, tokens, targets, config, tp_axis, cp_axis, ep_axis
+        )
+        loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
+
+    def scaled_local_step(params, opt_state, scaler_state, tokens, targets):
+        def scaled_loss_fn(p):
+            l = gpt_loss(p, tokens, targets, config, tp_axis, cp_axis, ep_axis)
+            return loss_scaler.scale(scaler_state, l)
+
+        scaled_loss, grads = jax.value_and_grad(scaled_loss_fn)(params)
+        loss = scaled_loss / scaler_state.loss_scale
+        loss, grads = sync_loss_and_grads(loss, grads)
+        # tp-sharded grad shards can overflow on one rank only; with
+        # ZeRO (local dp grads) or MoE (dp-sharded expert grads) the dp
+        # ranks can disagree too — every such axis must join the vote
+        # (pmean'd axes already agree: a nan poisons every rank's copy)
+        sync_axes = [tp_axis]
+        if (zero_opt or config.moe) and dp_axis is not None:
+            sync_axes.append(dp_axis)
+        new_params, new_state, new_scaler_state = _apply_scaled_update(
+            loss_scaler, scaler_state, grads, optimizer, opt_state, params,
+            sync_axes,
+        )
+        return new_params, new_state, new_scaler_state, loss
 
     # optimizer state mirrors param sharding for m/v/master; scalars replicated
     def state_spec_of(params_spec):
@@ -448,6 +503,15 @@ def make_train_step(
         sspec = state_spec_of(specs)
     data_spec = P(dp_axis, cp_axis)  # batch over dp, sequence over cp
 
+    if loss_scaler is not None:
+        sharded = jax.shard_map(
+            scaled_local_step,
+            mesh=mesh,
+            in_specs=(specs, sspec, P(), data_spec, data_spec),
+            out_specs=(specs, sspec, P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
@@ -511,11 +575,20 @@ def make_pp_train_step(
     virtual_pipeline_size: int = 1,
     opt_state_spec=None,
     cp_axis: Optional[str] = None,
+    loss_scaler=None,
 ):
     """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
 
     ``opt_state_spec`` overrides the optimizer-state PartitionSpec tree
     (default: FusedAdam state shape; ZeRO optimizers supply their own).
+
+    ``loss_scaler``: fp16 dynamic loss scaling through the pipeline
+    (see :func:`make_train_step`): the schedule's backward seed is the
+    SCALED loss, found_inf is pmax-agreed over tp AND pp (every stage
+    must skip together — the reference's model-parallel GradScaler,
+    ``apex/transformer/amp/grad_scaler.py:21-126``), and the step
+    signature grows a scaler state:
+    ``step(params, opt_state, scaler_state, tokens, targets)``.
 
     ``cp_axis``: context parallelism inside every stage — the sequence
     shards over the axis and each layer's attention is ring attention
@@ -633,7 +706,7 @@ def make_pp_train_step(
         loss = vocab_parallel_cross_entropy(logits, t, 0.0, tp_axis)
         return jnp.mean(loss)
 
-    def local_step(params, opt_state, tokens, targets):
+    def run_schedule(params, tokens, targets, stage_fn_, post_fn_):
         shared = {k: v for k, v in params.items() if k != "layers"}
         stages = params["layers"]
         B = tokens.shape[0]
@@ -643,16 +716,18 @@ def make_pp_train_step(
         }
         if vpp > 1:
             loss, (g_shared, g_stage) = forward_backward_pipelining_with_interleaving(
-                pre_fn, stage_fn, post_fn, shared, stages, mb,
+                pre_fn, stage_fn_, post_fn_, shared, stages, mb,
                 virtual_pipeline_model_parallel_size=vpp, axis_name=pp_axis,
                 stage_has_aux=config.moe,
             )
         else:
             loss, (g_shared, g_stage) = forward_backward_pipelining_without_interleaving(
-                pre_fn, stage_fn, post_fn, shared, stages, mb, axis_name=pp_axis,
+                pre_fn, stage_fn_, post_fn_, shared, stages, mb, axis_name=pp_axis,
                 stage_has_aux=config.moe,
             )
-        grads = {**g_shared, "layers": g_stage}
+        return loss, {**g_shared, "layers": g_stage}
+
+    def sync_loss_and_grads(loss, grads):
         if sp:
             grads = sp_grad_sync(grads, tp_axis)
         if cp_axis is not None:
@@ -681,8 +756,47 @@ def make_pp_train_step(
                     grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
         # ZeRO: grads stay LOCAL — the optimizer's psum_scatter over dp
         # IS the gradient sync (reduce-scatter fused with the update)
+        return loss, grads
+
+    def local_step(params, opt_state, tokens, targets):
+        loss, grads = run_schedule(params, tokens, targets, stage_fn, post_fn)
+        loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
+
+    def scaled_local_step(params, opt_state, scaler_state, tokens, targets):
+        scale = scaler_state.loss_scale
+
+        def post_scaled(shared, x, mb_):
+            # the schedule seeds backward from post_fn's output, so
+            # scaling HERE scales every cotangent in the pipeline
+            return post_fn(shared, x, mb_) * scale
+
+        if config.moe:
+            def stage_scaled(stage_params, x):
+                out, aux = stage_fn(stage_params, x)
+                # the aux loss enters the total inside the schedule;
+                # scale it so expert grads ride the same scaled backward
+                return out, aux * scale
+        else:
+            stage_scaled = stage_fn
+
+        scaled_loss, grads = run_schedule(
+            params, tokens, targets, stage_scaled, post_scaled
+        )
+        loss = scaled_loss / scale
+        loss, grads = sync_loss_and_grads(loss, grads)
+        # stage-sharded (pp) and tp-sharded grads can overflow on one
+        # rank only — every such axis must agree on the skip decision;
+        # ZeRO (local dp grads) and MoE (dp-sharded expert grads) add dp
+        sync_axes = [tp_axis, pp_axis]
+        if (zero_opt or config.moe) and dp_axis is not None:
+            sync_axes.append(dp_axis)
+        new_params, new_state, new_scaler_state = _apply_scaled_update(
+            loss_scaler, scaler_state, grads, optimizer, opt_state, params,
+            sync_axes,
+        )
+        return new_params, new_state, new_scaler_state, loss
 
     from apex_tpu.optimizers.fused_adam import AdamState
 
@@ -703,6 +817,15 @@ def make_pp_train_step(
         sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
     data_spec = P(dp_axis, cp_axis) if dp_axis is not None else P(None, cp_axis)
 
+    if loss_scaler is not None:
+        sharded = jax.shard_map(
+            scaled_local_step,
+            mesh=mesh,
+            in_specs=(specs, sspec, P(), data_spec, data_spec),
+            out_specs=(specs, sspec, P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,

@@ -81,7 +81,7 @@ def listify_model(model):
 
 
 def calc_params_l2_norm(params, bf16: bool = False, attrs=None, tp_rank: int = 0,
-                        axis_name=None):
+                        axis_name=None, tp_axis_name=None):
     """Reference: utils.py:213 — global L2 norm over params (the
     multi_tensor_l2norm kernel).
 
@@ -100,19 +100,24 @@ def calc_params_l2_norm(params, bf16: bool = False, attrs=None, tp_rank: int = 0
     ``axis_name`` or psum the squared result themselves.
 
     With BOTH ``attrs`` and ``axis_name``: sharded leaves contribute
-    from every rank (each owns a distinct slice); replicated leaves
-    contribute only where ``lax.axis_index == 0`` (a traced analog of
-    the reference's rank-0-only counting — a static ``tp_rank`` filter
-    would count them once PER rank and inflate the psum)."""
+    from every rank (each owns a distinct slice); TP-replicated leaves
+    contribute only where ``lax.axis_index(tp) == 0`` (a traced analog
+    of the reference's rank-0-only counting — a static ``tp_rank``
+    filter would count them once PER rank and inflate the psum).  The
+    dedup weighting applies to the TP axis ONLY — the reference filters
+    TP duplicates and then all-reduces over the full mp group
+    (utils.py:217-238); a tp-replicated leaf on another listed axis
+    (e.g. pp-stage-sharded LN params) is still distinct per rank there
+    and must count from every rank of that axis.  ``tp_axis_name``
+    selects the dedup axis (default: the first axis of ``axis_name``)."""
     if attrs is not None and axis_name is not None:
         from apex_tpu.transformer.tensor_parallel.attributes import (
             set_defaults_if_not_set_tensor_model_parallel_attributes as _defaults,
         )
 
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
-        on_rank0 = jnp.float32(1.0)
-        for ax in axes:
-            on_rank0 = on_rank0 * (jax.lax.axis_index(ax) == 0)
+        dedup_ax = tp_axis_name if tp_axis_name is not None else axes[0]
+        on_rank0 = (jax.lax.axis_index(dedup_ax) == 0).astype(jnp.float32)
         leaves, treedef = jax.tree.flatten(params)
         attr_leaves = treedef.flatten_up_to(attrs)
         sq = jnp.float32(0.0)

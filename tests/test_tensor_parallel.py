@@ -363,3 +363,38 @@ class TestTensorParallelAttributes:
                          out_specs=P())(sharded, replicated)
         expect = np.sqrt(np.sum(np.arange(16.0) ** 2) + 3 * 4.0)
         np.testing.assert_allclose(float(norm), expect, rtol=1e-6)
+
+    def test_l2norm_tp_dedup_keeps_pp_distinct_leaves(self, devices8):
+        """tp-replicated but pp-stage-sharded params (per-layer LN
+        scales) are distinct per pp rank: the dedup weighting applies to
+        the tp axis only, so every pp rank's slice counts (the reference
+        filters TP duplicates then all-reduces over the full mp group)."""
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from apex_tpu.transformer.pipeline_parallel.utils import calc_params_l2_norm
+        from apex_tpu.transformer.tensor_parallel import attributes_tree
+
+        mesh = Mesh(np.array(devices8[:4]).reshape(2, 2), ("tp", "pp"))
+        # the flagship layout: layer-stacked params shard over pp on the
+        # leading axis; weights additionally shard over tp, LN params
+        # are tp-replicated
+        wq = jnp.arange(16.0, dtype=jnp.float32).reshape(4, 4)  # P(pp, tp)
+        ln = jnp.arange(8.0, dtype=jnp.float32)                 # P(pp)
+        attrs = attributes_tree(
+            {"wq": wq, "ln": ln},
+            lambda path, leaf: (1, 1) if "'wq'" in str(path) else None)
+
+        def f(wq_shard, ln_shard):
+            return calc_params_l2_norm(
+                {"wq": wq_shard, "ln": ln_shard}, attrs=attrs,
+                axis_name=("tp", "pp"), tp_axis_name="tp")
+
+        norm = shard_map(f, mesh=mesh, in_specs=(P("pp", "tp"), P("pp")),
+                         out_specs=P())(wq, ln)
+        # wq: every (pp, tp) rank owns a distinct slice -> sumsq once;
+        # ln: distinct per pp rank, tp-replicated -> counted on tp rank
+        # 0 of EACH pp rank -> sumsq once.  A dedup over both axes would
+        # have dropped pp rank 1's ln slice.
+        expect = np.sqrt(np.sum(np.arange(16.0) ** 2) + np.sum(np.arange(8.0) ** 2))
+        np.testing.assert_allclose(float(norm), expect, rtol=1e-6)
