@@ -4,8 +4,11 @@ input prefetch."""
 from apex_tpu.io import native
 from apex_tpu.io.checkpoint import (
     load_checkpoint,
+    load_distributed_checkpoint,
     load_sharded_checkpoint,
+    make_global_array_tree,
     save_checkpoint,
+    save_distributed_checkpoint,
     save_sharded_checkpoint,
 )
 from apex_tpu.io.async_checkpoint import AsyncCheckpointer
@@ -18,5 +21,8 @@ __all__ = [
     "load_checkpoint",
     "save_sharded_checkpoint",
     "load_sharded_checkpoint",
+    "save_distributed_checkpoint",
+    "load_distributed_checkpoint",
+    "make_global_array_tree",
     "PrefetchIterator",
 ]

@@ -1,0 +1,118 @@
+"""Real multi-process distributed tests.
+
+The reference's distributed test strategy is real multi-process spawn
+(``apex/transformer/testing/distributed_test_base.py:22-94``,
+``MultiProcessTestCase`` with file-store rendezvous; 2-proc shell tests
+under ``tests/distributed/``).  The TPU-native analog: 2 OS processes ×
+4 virtual CPU devices each, rendezvoused through
+``jax.distributed.initialize`` — one process per host is exactly the
+pod deployment shape, so this exercises mesh construction across
+processes, global-array data feeding, cross-process collectives, and
+multi-host checkpoint coordination that the single-process 8-device
+suite cannot.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def worker_run(tmp_path_factory):
+    """Launch the 2-process worker fleet once; tests assert on its
+    artifacts."""
+    out = tmp_path_factory.mktemp("mp")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_COMPILATION_CACHE_DIR"] = str(REPO / "tests" / ".jax_cache")
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
+    env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(REPO / "tests" / "_mp_worker.py"),
+             "--process-id", str(i), "--num-processes", "2",
+             "--coordinator", f"127.0.0.1:{port}", "--out", str(out)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(stdout)
+    for i, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, (
+            f"worker {i} failed rc={p.returncode}:\n{text[-4000:]}"
+        )
+    return out, outputs
+
+
+def test_two_process_dp_tp_matches_single_process_oracle(worker_run):
+    """The 2-process dp4×tp2 loss trajectory must match a single-device
+    oracle of the same batch — the reference's dominant distributed test
+    pattern (parallel run vs equivalent single-process run)."""
+    out, _ = worker_run
+    mp_losses = np.asarray(json.loads((out / "losses.json").read_text()))
+
+    from apex_tpu.models.gpt import GPTConfig, gpt_loss, init_params
+    from apex_tpu.optimizers import FusedAdam
+
+    config = GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_attention_heads=4,
+        max_seq_len=16, compute_dtype=jnp.float32, checkpoint_layers=True,
+    )
+    params = init_params(config, jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init(params)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, size=(8, 16)))
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(gpt_loss)(params, tokens, targets, config)
+        params, state = opt.update(grads, state, params)
+        return params, state, loss
+
+    oracle = []
+    for _ in range(3):
+        params, state, loss = step(params, state)
+        oracle.append(float(loss))
+    np.testing.assert_allclose(mp_losses, np.asarray(oracle), rtol=1e-4)
+
+
+def test_two_process_zero_checkpoint_resumes_bit_identical(worker_run):
+    """Each process wrote only its addressable ZeRO shards; both
+    processes verified the reassembled restart is bit-identical to the
+    uninterrupted run (markers written by the workers)."""
+    out, outputs = worker_run
+    assert (out / "zero_ok_0").exists(), outputs[0][-2000:]
+    assert (out / "zero_ok_1").exists(), outputs[1][-2000:]
