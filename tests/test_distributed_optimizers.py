@@ -112,7 +112,14 @@ class TestShardedStateDict:
         )
 
     @pytest.mark.slow
-    def test_save_dp4_load_dp2_resumes_identically(self, devices8):
+    @pytest.mark.parametrize("via_disk", [False, True], ids=["memory", "disk"])
+    def test_save_dp4_load_dp2_resumes_identically(self, devices8, tmp_path, via_disk):
+        """Per-rank save at dp=4, resume at dp=2, trajectory parity vs
+        the uninterrupted run.  ``via_disk`` composes ZeRO with io: the
+        state shards go through per-rank files (io.save_sharded_
+        checkpoint) and the params through the async checkpointer, and
+        the disk round trip must be bit-exact vs the in-memory dicts
+        (reference distributed_fused_adam.py:2527, :2959)."""
         params0 = make_tree(3)
         rng = np.random.RandomState(7)
 
@@ -128,15 +135,30 @@ class TestShardedStateDict:
         assert shards[0]["shard_numel"] * 4 == shards[0]["padded_total"]
 
         # --- resume at dp=2, continuing the same grad stream
-        mesh2 = Mesh(np.array(devices8[:2]), ("dp",))
-        opt2 = DistributedFusedAdam(lr=1e-2, weight_decay=0.01, axis_name="dp")
-        state2 = DistributedFusedAdam.load_sharded_state_dicts(shards, world_size=2)
+        if via_disk:
+            from apex_tpu import io
+
+            zdir = tmp_path / "zero"
+            for r, sd in enumerate(shards):
+                io.save_sharded_checkpoint(zdir, sd, r, 4)
+            with io.AsyncCheckpointer() as ck:
+                ck.save(tmp_path / "params.ckpt", params)
+            loaded = io.load_sharded_checkpoint(zdir)
+            state2 = DistributedFusedAdam.load_sharded_state_dicts(loaded, world_size=2)
+            state2_mem = DistributedFusedAdam.load_sharded_state_dicts(shards, world_size=2)
+            for a, b in zip(jax.tree.leaves(state2), jax.tree.leaves(state2_mem)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            params_r = jax.tree.map(jnp.asarray, io.load_checkpoint(tmp_path / "params.ckpt"))
+        else:
+            state2 = DistributedFusedAdam.load_sharded_state_dicts(shards, world_size=2)
+            # a real resume re-reads params from the checkpoint: drop the
+            # old mesh's device placement
+            params_r = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)), params)
         assert int(state2.step) == 3
         total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params0))
         assert state2.exp_avg.shape[0] == ((total + 1) // 2) * 2
-        # a real resume re-reads params from the checkpoint: drop the old
-        # mesh's device placement
-        params_r = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)), params)
+        mesh2 = Mesh(np.array(devices8[:2]), ("dp",))
+        opt2 = DistributedFusedAdam(lr=1e-2, weight_decay=0.01, axis_name="dp")
         for _ in range(2):
             params_r, state2 = _zero_step(opt2, mesh2, params_r, state2, self._grads(params_r, rng))
 
