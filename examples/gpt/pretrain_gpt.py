@@ -1,0 +1,186 @@
+"""GPT pretraining — the flagship end-to-end composition.
+
+The reference has no trainer of its own (SURVEY §1 L7: entry points are
+users' scripts); this example is the script a Megatron/apex user would
+write, re-based on apex_tpu: 3D parallelism (tp × pp × dp) over a
+device mesh, optional fp16 dynamic loss scaling (the amp × parallel
+flagship stack, reference ``apex/amp/handle.py:16`` +
+``apex/transformer/amp/grad_scaler.py``), optional ZeRO-2 optimizer
+state sharding (``DistributedFusedAdam``), Megatron batch sampling, and
+async checkpoint/resume through ``apex_tpu.io``.
+
+Runs out of the box on the virtual CPU mesh (synthetic data):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/gpt/pretrain_gpt.py --tp 2 --pp 2 --steps 4
+    ... --tp 2 --fp16                  # fp16 + dynamic loss scaling
+    ... --tp 2 --zero                  # ZeRO-2 state sharding over dp
+    ... --checkpoint /tmp/gpt_ck --steps 4   # then: --resume /tmp/gpt_ck
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--micro-batches", type=int, default=2)
+    p.add_argument("--global-batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--fp16", action="store_true",
+                   help="float16 compute + dynamic loss scaling")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-2: shard optimizer state over dp")
+    p.add_argument("--sequence-parallel", action="store_true")
+    p.add_argument("--checkpoint", default=None, help="save dir (async)")
+    p.add_argument("--save-every", type=int, default=4)
+    p.add_argument("--resume", default=None, help="checkpoint dir to resume")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+
+    from apex_tpu import io
+    from apex_tpu.amp import DynamicLossScaler
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.models.gpt import (
+        GPTConfig, init_params, make_pp_train_step, make_train_step,
+        param_specs,
+    )
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state as ps
+    from apex_tpu.transformer._data import MegatronPretrainingSampler
+
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size_=args.tp,
+        pipeline_model_parallel_size_=args.pp,
+    )
+    dp = mesh.shape["dp"]
+    print(f"mesh: dp={dp} pp={args.pp} tp={args.tp} "
+          f"({len(jax.devices())} devices)")
+
+    config = GPTConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.layers, num_attention_heads=args.heads,
+        max_seq_len=args.seq,
+        compute_dtype=jnp.float16 if args.fp16 else jnp.bfloat16,
+        checkpoint_layers=True,
+        sequence_parallel=args.sequence_parallel,
+    )
+    params = init_params(config, jax.random.PRNGKey(0))
+
+    if args.zero:
+        from jax.sharding import PartitionSpec as P
+
+        optimizer = DistributedFusedAdam(lr=args.lr, weight_decay=0.01,
+                                         axis_name="dp")
+        # the specs handed to init must include every model axis the
+        # params shard over — with pp, stacked layers shard over it
+        zspecs = dict(param_specs(config))
+        if args.pp > 1:
+            zspecs["layers"] = {
+                k: P("pp", *s[1:]) for k, s in zspecs["layers"].items()
+            }
+        axis_sizes = {"tp": args.tp}
+        if args.pp > 1:
+            axis_sizes["pp"] = args.pp
+        state = optimizer.init(params, world_size=dp, param_specs=zspecs,
+                               axis_sizes=axis_sizes)
+    else:
+        optimizer = FusedAdam(lr=args.lr, weight_decay=0.01)
+        state = optimizer.init(params)
+
+    scaler = DynamicLossScaler(init_scale=2.0 ** 12) if args.fp16 else None
+    scaler_state = scaler.init() if scaler else None
+
+    if args.pp > 1:
+        step = make_pp_train_step(config, optimizer, mesh,
+                                  num_microbatches=args.micro_batches,
+                                  loss_scaler=scaler)
+    else:
+        step = make_train_step(config, optimizer, mesh, loss_scaler=scaler)
+
+    # Megatron sampling over a synthetic corpus: each dp rank draws its
+    # slice of the global batch; consumed_samples resumes exactly.
+    corpus = np.random.RandomState(0).randint(
+        0, args.vocab, size=(4096, args.seq + 1))
+    start_step = 0
+
+    if args.resume:
+        ck = io.load_checkpoint(Path(args.resume) / "latest.ckpt")
+        params = jax.tree.map(jnp.asarray, ck["params"])
+        # load_checkpoint restores the saved pytree structure, so a
+        # checkpoint from a different optimizer fails loudly in update()
+        state = jax.tree.map(jnp.asarray, ck["state"])
+        start_step = int(ck["step"])
+        if scaler is not None:
+            scaler_state = scaler.load_state_dict(ck["scaler"])
+        print(f"resumed at step {start_step}")
+
+    ckpt = io.AsyncCheckpointer() if args.checkpoint else None
+    mb_size = args.global_batch  # sampler yields global batches here
+
+    def epoch_cycling_batches(consumed):
+        """Megatron sampling with epoch wrap: the sampler is
+        single-epoch by design (reference _batchsampler.py), so restart
+        it from zero each time the corpus is exhausted."""
+        consumed %= (len(corpus) // mb_size) * mb_size
+        while True:
+            it = MegatronPretrainingSampler(
+                total_samples=len(corpus), consumed_samples=consumed,
+                micro_batch_size=mb_size,
+                data_parallel_rank=0, data_parallel_size=1,
+            )
+            yield from it
+            consumed = 0
+
+    sampler = epoch_cycling_batches(start_step * args.global_batch)
+
+    t0 = time.time()
+    for i in range(start_step, start_step + args.steps):
+        idx = next(sampler)
+        batch = corpus[np.asarray(idx)]
+        tokens = jnp.asarray(batch[:, :-1])
+        targets = jnp.asarray(batch[:, 1:])
+        if scaler is not None:
+            params, state, scaler_state, loss = step(
+                params, state, scaler_state, tokens, targets)
+            extra = f" scale={float(scaler_state.loss_scale):.0f}"
+        else:
+            params, state, loss = step(params, state, tokens, targets)
+            extra = ""
+        print(f"step {i}: loss={float(loss):.4f}{extra}", flush=True)
+        if ckpt and (i + 1) % args.save_every == 0:
+            ckpt.save(Path(args.checkpoint) / "latest.ckpt", {
+                "params": params,
+                "state": state,
+                "step": i + 1,
+                "scaler": scaler.state_dict(scaler_state) if scaler else None,
+            })
+    if ckpt:
+        ckpt.close()
+        print(f"checkpoint: {args.checkpoint}/latest.ckpt")
+    dt = time.time() - t0
+    print(f"{args.steps} steps in {dt:.1f}s "
+          f"({args.global_batch * args.seq * args.steps / dt:.0f} tokens/s)")
+
+
+if __name__ == "__main__":
+    main()
