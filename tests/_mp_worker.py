@@ -98,6 +98,52 @@ def main():
         (out / "losses.json").write_text(json.dumps(losses))
     print(f"[worker {args.process_id}] phase1 losses: {losses}", flush=True)
 
+    # --- phase 1b: pp=2 × tp=4 (dp=1) — the pipeline ppermutes CROSS the
+    # process boundary.  The mesh is dp-outermost, so with dp=1 stage 0
+    # is devices 0-3 (all of process 0) and stage 1 is devices 4-7 (all
+    # of process 1): every cross-stage send is a cross-process transfer.
+    from apex_tpu.models.gpt import make_pp_train_step
+
+    ps.destroy_model_parallel()
+    pp_mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size_=4, pipeline_model_parallel_size_=2,
+        devices=jax.devices(),
+    )
+    assert pp_mesh.shape["dp"] == 1
+    stage0 = {d.process_index for d in pp_mesh.devices[0, 0].ravel()}
+    stage1 = {d.process_index for d in pp_mesh.devices[0, 1].ravel()}
+    assert stage0 == {0} and stage1 == {1}, (
+        f"stages must live on different processes (got {stage0} vs "
+        f"{stage1}) for this test to exercise cross-process ppermutes")
+    pp_cfg = GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=4, num_attention_heads=4,
+        max_seq_len=16, compute_dtype=jnp.float32, checkpoint_layers=True,
+    )
+    pp_base = param_specs(pp_cfg)
+    pp_specs = dict(pp_base)
+    pp_specs["layers"] = {k: P("pp", *s[1:]) for k, s in pp_base["layers"].items()}
+    pp_params_host = init_params(pp_cfg, jax.random.PRNGKey(2))
+    pp_opt = FusedAdam(lr=1e-2)
+    pp_state_host = pp_opt.init(pp_params_host)
+    pp_sspec = AdamState(step=P(), exp_avg=pp_specs, exp_avg_sq=pp_specs,
+                         master=None)
+    pp_params = io.make_global_array_tree(pp_params_host, pp_mesh, pp_specs)
+    pp_state = io.make_global_array_tree(pp_state_host, pp_mesh, pp_sspec)
+    pp_tok = io.make_global_array_tree(tokens_np, pp_mesh, P("dp", None))
+    pp_tgt = io.make_global_array_tree(targets_np, pp_mesh, P("dp", None))
+    pp_step = make_pp_train_step(pp_cfg, pp_opt, pp_mesh, num_microbatches=2)
+    pp_losses = []
+    for _ in range(2):
+        pp_params, pp_state, pp_loss = pp_step(pp_params, pp_state, pp_tok, pp_tgt)
+        pp_losses.append(float(pp_loss))
+    if args.process_id == 0:
+        (out / "pp_losses.json").write_text(json.dumps(pp_losses))
+    print(f"[worker {args.process_id}] phase1b pp losses: {pp_losses}", flush=True)
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size_=2, devices=jax.devices()
+    )
+
     # ------------------------------- phase 2: ZeRO distributed ckpt/resume
     from apex_tpu.contrib.optimizers import DistributedFusedAdam
 

@@ -9,11 +9,12 @@ single-device oracle.  Multi-chip hardware isn't needed —
 Tiers (the reference's L0/L1 split):
 
 - quick: ``pytest -m "not slow" tests/`` — unit + small parity tests,
-  ~2:45 on this (1-core) box.  Run on every change.
+  ~2:30 on this (1-core) box.  Run on every change.
 - full:  ``pytest tests/`` — adds the compiled e2e/model-level parity
   workloads (GPT 3D/MoE/ResNet trainers, ZeRO resharding + tp
-  composition, HLO memory regressions) and every per-test ``slow``
-  mark; 411 tests, ~16 min on this box.  CI / pre-commit.
+  composition, HLO memory regressions, 2-process jax.distributed
+  tests) and every per-test ``slow`` mark; 425 tests, ~19 min on this
+  box.  CI / pre-commit.
 
 Anything >~15 s compiled carries ``@pytest.mark.slow`` (file-level
 ``pytestmark`` for whole-file e2e suites).

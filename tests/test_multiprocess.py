@@ -75,21 +75,18 @@ def worker_run(tmp_path_factory):
     return out, outputs
 
 
-def test_two_process_dp_tp_matches_single_process_oracle(worker_run):
-    """The 2-process dp4×tp2 loss trajectory must match a single-device
-    oracle of the same batch — the reference's dominant distributed test
-    pattern (parallel run vs equivalent single-process run)."""
-    out, _ = worker_run
-    mp_losses = np.asarray(json.loads((out / "losses.json").read_text()))
-
+def _oracle_losses(num_layers, key, steps):
+    """Single-device GPT trajectory over the worker's batch (same
+    config family, PRNG key, and token stream as the worker phases)."""
     from apex_tpu.models.gpt import GPTConfig, gpt_loss, init_params
     from apex_tpu.optimizers import FusedAdam
 
     config = GPTConfig(
-        vocab_size=64, hidden_size=32, num_layers=2, num_attention_heads=4,
-        max_seq_len=16, compute_dtype=jnp.float32, checkpoint_layers=True,
+        vocab_size=64, hidden_size=32, num_layers=num_layers,
+        num_attention_heads=4, max_seq_len=16,
+        compute_dtype=jnp.float32, checkpoint_layers=True,
     )
-    params = init_params(config, jax.random.PRNGKey(0))
+    params = init_params(config, jax.random.PRNGKey(key))
     opt = FusedAdam(lr=1e-2)
     state = opt.init(params)
     rng = np.random.RandomState(0)
@@ -103,10 +100,31 @@ def test_two_process_dp_tp_matches_single_process_oracle(worker_run):
         return params, state, loss
 
     oracle = []
-    for _ in range(3):
+    for _ in range(steps):
         params, state, loss = step(params, state)
         oracle.append(float(loss))
-    np.testing.assert_allclose(mp_losses, np.asarray(oracle), rtol=1e-4)
+    return np.asarray(oracle)
+
+
+def test_two_process_dp_tp_matches_single_process_oracle(worker_run):
+    """The 2-process dp4×tp2 loss trajectory must match a single-device
+    oracle of the same batch — the reference's dominant distributed test
+    pattern (parallel run vs equivalent single-process run)."""
+    out, _ = worker_run
+    mp_losses = np.asarray(json.loads((out / "losses.json").read_text()))
+    np.testing.assert_allclose(
+        mp_losses, _oracle_losses(num_layers=2, key=0, steps=3), rtol=1e-4)
+
+
+def test_two_process_pipeline_crosses_processes_matches_oracle(worker_run):
+    """pp2×tp4 across 2 processes with stage 0 entirely on process 0 and
+    stage 1 on process 1 (asserted in the worker) — every pipeline
+    ppermute is a cross-process transfer — must match the single-device
+    oracle."""
+    out, _ = worker_run
+    mp_losses = np.asarray(json.loads((out / "pp_losses.json").read_text()))
+    np.testing.assert_allclose(
+        mp_losses, _oracle_losses(num_layers=4, key=2, steps=2), rtol=1e-4)
 
 
 def test_two_process_zero_checkpoint_resumes_bit_identical(worker_run):
