@@ -35,16 +35,48 @@ Guarantees:
 import os
 import queue
 import threading
+from pathlib import Path
 from typing import Any, List
 
 import jax
 import numpy as np
 
-from apex_tpu.io.checkpoint import save_checkpoint
+from apex_tpu.io.checkpoint import (
+    _distributed_payload,
+    _shard_name,
+    _write_index,
+    save_checkpoint,
+)
 
 __all__ = ["AsyncCheckpointer"]
 
 _STOP = object()
+
+
+def _atomic_write(path: str, host_tree: Any) -> None:
+    """tmp + fsync + rename + dir-fsync: a crash mid-save never leaves a
+    truncated file under the final name."""
+    tmp = path + ".tmp"
+    try:
+        save_checkpoint(tmp, host_tree)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)  # data durable before the rename publishes it
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)  # the rename itself durable
+        finally:
+            os.close(dfd)
+    except BaseException:
+        try:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class AsyncCheckpointer:
@@ -73,7 +105,32 @@ class AsyncCheckpointer:
         host_tree = jax.tree.map(
             lambda x: np.array(jax.device_get(x), copy=True), tree
         )
-        self._q.put((str(path), host_tree))
+        self._q.put(lambda: _atomic_write(str(path), host_tree))
+
+    def save_distributed(self, dir_path, tree: Any) -> None:
+        """Non-blocking multi-host save: snapshot THIS process's
+        addressable shards now (real copies), write its per-process
+        shard file on the background thread
+        (:func:`apex_tpu.io.save_distributed_checkpoint` semantics —
+        call from every process; the pod-scale version of ``save``).
+
+        Callers coordinating a restore barrier across hosts should
+        ``wait_until_finished()`` before signalling (e.g. via
+        ``multihost_utils.sync_global_devices``) that the checkpoint is
+        complete."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._reraise()
+        payload, pid, nprocs = _distributed_payload(tree, copy=True)
+        d = Path(dir_path)
+
+        def write():
+            d.mkdir(parents=True, exist_ok=True)
+            if pid == 0:
+                _write_index(d, nprocs)
+            _atomic_write(str(d / _shard_name(pid, nprocs)), payload)
+
+        self._q.put(write)
 
     def wait_until_finished(self) -> None:
         """Block until every queued save is on disk (then re-raise any
@@ -112,27 +169,9 @@ class AsyncCheckpointer:
             if item is _STOP:
                 self._q.task_done()
                 return
-            path, host_tree = item
-            tmp = path + ".tmp"
             try:
-                save_checkpoint(tmp, host_tree)
-                fd = os.open(tmp, os.O_RDONLY)
-                try:
-                    os.fsync(fd)  # data durable before the rename publishes it
-                finally:
-                    os.close(fd)
-                os.replace(tmp, path)
-                dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
-                try:
-                    os.fsync(dfd)  # the rename itself durable
-                finally:
-                    os.close(dfd)
+                item()
             except BaseException as e:  # noqa: BLE001 — collected, re-raised on the caller's thread
-                try:
-                    if os.path.exists(tmp):
-                        os.unlink(tmp)
-                except OSError:
-                    pass
                 with self._lock:
                     self._errors.append(e)
             finally:

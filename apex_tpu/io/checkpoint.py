@@ -15,6 +15,7 @@ the dependency-free fast path the reference's users had with
 """
 
 import json
+import os
 import struct
 from pathlib import Path
 from typing import Any
@@ -97,6 +98,19 @@ def _shard_name(rank: int, world: int) -> str:
     return f"shard_{rank:05d}-of-{world:05d}.ckpt"
 
 
+def _write_index(dir_path, world_size: int) -> None:
+    """Atomically publish the sharded-checkpoint index (tmp + rename —
+    a crash mid-write must not leave a truncated index.json under the
+    final name)."""
+    d = Path(dir_path)
+    d.mkdir(parents=True, exist_ok=True)
+    tmp = d / "index.json.tmp"
+    tmp.write_text(
+        json.dumps({"format": "apex_tpu_sharded_v1", "world_size": world_size})
+    )
+    os.replace(tmp, d / "index.json")
+
+
 def save_sharded_checkpoint(dir_path, tree: Any, rank: int, world_size: int) -> str:
     """Save this rank's piece of a distributed checkpoint (the per-rank
     protocol of reference ``DistributedFusedAdam.state_dict``, :2527).
@@ -110,9 +124,7 @@ def save_sharded_checkpoint(dir_path, tree: Any, rank: int, world_size: int) -> 
     d = Path(dir_path)
     d.mkdir(parents=True, exist_ok=True)
     if rank == 0:
-        (d / "index.json").write_text(
-            json.dumps({"format": "apex_tpu_sharded_v1", "world_size": world_size})
-        )
+        _write_index(d, world_size)
     path = d / _shard_name(rank, world_size)
     save_checkpoint(path, tree)
     return str(path)
@@ -130,7 +142,20 @@ def save_distributed_checkpoint(dir_path, tree: Any) -> str:
     Shards with ``replica_id != 0`` are skipped, so each distinct piece
     of data is written exactly once across the fleet.  Call from EVERY
     process; reassemble with :func:`load_distributed_checkpoint`.
+    For a non-blocking save use
+    :meth:`apex_tpu.io.AsyncCheckpointer.save_distributed`.
     """
+    payload, pid, nprocs = _distributed_payload(tree)
+    return save_sharded_checkpoint(dir_path, payload, pid, nprocs)
+
+
+def _distributed_payload(tree: Any, copy: bool = False):
+    """(payload, process_index, process_count): this process's
+    addressable, replica-deduped shards of ``tree`` as host arrays.
+    ``copy=True`` forces real copies (the async checkpointer's snapshot
+    guarantee — on the CPU backend ``np.asarray`` of a shard can be a
+    zero-copy view the caller could donate mid-write)."""
+    to_host = (lambda x: np.array(x, copy=True)) if copy else np.asarray
     pid, nprocs = jax.process_index(), jax.process_count()
     payload = {}
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
@@ -148,7 +173,7 @@ def save_distributed_checkpoint(dir_path, tree: Any) -> str:
             shards.append({
                 "start": np.asarray(starts, np.int64),
                 "stop": np.asarray(stops, np.int64),
-                "data": np.asarray(s.data),
+                "data": to_host(s.data),
             })
         if not hasattr(leaf, "addressable_shards"):
             # plain numpy / python scalar: process 0 owns it
@@ -157,10 +182,10 @@ def save_distributed_checkpoint(dir_path, tree: Any) -> str:
                 shards.append({
                     "start": np.zeros(a.ndim, np.int64),
                     "stop": np.asarray(a.shape, np.int64),
-                    "data": a,
+                    "data": np.array(a, copy=True) if copy else a,
                 })
         payload[key] = shards
-    return save_sharded_checkpoint(dir_path, payload, pid, nprocs)
+    return payload, pid, nprocs
 
 
 def _assemble_slice(pieces, leaf_shape, leaf_dtype, idx, key):

@@ -209,3 +209,54 @@ class TestAsyncCheckpointer:
         ckpt.save(str(tmp_path / "d.apex"), {"x": jnp.ones(2)})
         ckpt.close()
         assert threading.active_count() == before
+
+    def test_save_distributed_snapshot_and_roundtrip(self, tmp_path, devices8):
+        """Async multi-host save: shards snapshot at call time (donation
+        safe), the per-process file lands atomically, and the mesh-aware
+        load reassembles the saved values."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from apex_tpu.io import AsyncCheckpointer, load_distributed_checkpoint
+
+        mesh = Mesh(np.array(devices8[:4]), ("dp",))
+        sh = NamedSharding(mesh, P("dp"))
+        x = jax.device_put(jnp.arange(16.0), sh)
+        d = tmp_path / "dist"
+        with AsyncCheckpointer() as ckpt:
+            ckpt.save_distributed(d, {"x": x, "step": jnp.int32(3)})
+            # mutate after save returns: the file must hold the old values
+            x = jax.device_put(x * 100, sh)
+        out = load_distributed_checkpoint(
+            d, {"x": x, "step": jnp.int32(0)}, mesh=mesh,
+            spec_tree={"x": P("dp"), "step": P()})
+        np.testing.assert_array_equal(np.asarray(out["x"]), np.arange(16.0))
+        assert int(out["step"]) == 3
+        assert not list(d.glob("*.tmp"))
+
+    def test_distributed_payload_copy_does_not_alias_device_buffers(self, devices8):
+        """The async snapshot guarantee hinges on copy=True producing
+        REAL copies: on the CPU backend np.asarray of a shard is a
+        zero-copy view, so a donated buffer would corrupt a queued
+        write.  Pin it with shares_memory (the behavior-level 'mutate
+        after save' test can't catch a regression — JAX arrays are
+        immutable, so rebinding keeps the old buffer alive either way)."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from apex_tpu.io.checkpoint import _distributed_payload
+
+        mesh = Mesh(np.array(devices8[:4]), ("dp",))
+        x = jax.device_put(jnp.arange(16.0), NamedSharding(mesh, P("dp")))
+        raw_views = [np.asarray(s.data) for s in x.addressable_shards]
+        payload, _, _ = _distributed_payload({"x": x}, copy=True)
+        for piece in payload["['x']"]:
+            assert not any(np.shares_memory(piece["data"], rv) for rv in raw_views)
+        # sanity: the zero-copy premise holds (the view path DOES alias),
+        # so the assertion above is actually discriminating
+        view_payload, _, _ = _distributed_payload({"x": x}, copy=False)
+        aliases = [
+            np.shares_memory(piece["data"], rv)
+            for piece in view_payload["['x']"] for rv in raw_views
+        ]
+        assert any(aliases)
