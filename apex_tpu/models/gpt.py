@@ -44,6 +44,9 @@ class GPTConfig:
     num_attention_heads: int = 16
     max_seq_len: int = 1024
     ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    # grouped-query attention (Megatron's knob name): number of kv-head
+    # groups; None = one kv head per q head (standard MHA), 1 = MQA.
+    num_query_groups: Optional[int] = None
     layernorm_eps: float = 1e-5
     compute_dtype: Any = jnp.bfloat16
     checkpoint_layers: bool = True
@@ -71,6 +74,17 @@ class GPTConfig:
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads
 
+    @property
+    def kv_heads(self):
+        if self.num_query_groups is None:
+            return self.num_attention_heads
+        if self.num_query_groups < 1:
+            raise ValueError(
+                f"num_query_groups must be >= 1 (got {self.num_query_groups}); "
+                "use None for standard multi-head attention"
+            )
+        return self.num_query_groups
+
 
 def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     """Global (unsharded) fp32 params; shard via PartitionSpecs from
@@ -80,6 +94,12 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     std = 0.02
     init = lambda k, *s: jax.random.normal(k, s, jnp.float32) * std
 
+    if config.num_attention_heads % config.kv_heads != 0:
+        raise ValueError(
+            f"num_attention_heads ({config.num_attention_heads}) must be "
+            f"divisible by num_query_groups ({config.kv_heads})"
+        )
+    KV = config.kv_heads * config.head_dim  # kv projection width (GQA)
     params = {
         "embed": init(k[0], V, H),
         "pos_embed": init(k[1], config.max_seq_len, H),
@@ -87,11 +107,11 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
             "ln1_scale": jnp.ones((L, H)),
             "ln1_bias": jnp.zeros((L, H)),
             "wq": init(k[2], L, H, H),
-            "wk": init(k[3], L, H, H),
-            "wv": init(k[4], L, H, H),
+            "wk": init(k[3], L, KV, H),
+            "wv": init(k[4], L, KV, H),
             "bq": jnp.zeros((L, H)),
-            "bk": jnp.zeros((L, H)),
-            "bv": jnp.zeros((L, H)),
+            "bk": jnp.zeros((L, KV)),
+            "bv": jnp.zeros((L, KV)),
             "wo": init(k[5], L, H, H) / np.sqrt(2 * L),
             "bo": jnp.zeros((L, H)),
             "ln2_scale": jnp.ones((L, H)),
@@ -166,10 +186,20 @@ def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
     proj (reference standalone_transformer_lm.py ParallelAttention).
     The core is selectable: fused-softmax einsum (default), flash
     attention, or ring attention when the sequence is sharded over
-    ``cp_axis``."""
+    ``cp_axis``.  With grouped-query attention
+    (``config.num_query_groups``) k/v carry fewer heads; the flash
+    kernel reads group-shared kv blocks directly, the einsum/ring paths
+    repeat heads."""
     S = x.shape[0] * (1 if not (axis_name and config.sequence_parallel) else jax.lax.axis_size(axis_name))
     B = x.shape[1]
     hd = config.head_dim
+    tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    if config.kv_heads % tp != 0:
+        raise ValueError(
+            f"num_query_groups ({config.kv_heads}) must be divisible by the "
+            f"tensor-parallel size ({tp}): kv heads shard over tp"
+        )
+    n_local_kv = config.kv_heads // tp
     sp = config.sequence_parallel and axis_name is not None
 
     def col(x_, w, b):
@@ -184,19 +214,25 @@ def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
     v = col(x, p["wv"], p["bv"])
 
     # (S, B, local_heads*hd) → (B, nh, S, hd)
-    def heads(t):
-        return t.reshape(S, B, n_local_heads, hd).transpose(1, 2, 0, 3)
+    def heads(t, nh):
+        return t.reshape(S, B, nh, hd).transpose(1, 2, 0, 3)
 
-    q, k, v = heads(q), heads(k), heads(v)
+    q, k, v = heads(q, n_local_heads), heads(k, n_local_kv), heads(v, n_local_kv)
     if cp_axis is not None:
+        from apex_tpu.ops.attention import repeat_kv_heads
         from apex_tpu.transformer.context_parallel import ring_attention
 
+        # the ring walks matched head counts; GQA repeats before it
+        k, v = repeat_kv_heads(q, k, v)
         ctx = ring_attention(q, k, v, cp_axis, causal=True).astype(v.dtype)
     elif config.use_flash_attention:
         from apex_tpu.ops.attention import flash_attention
 
         ctx = flash_attention(q, k, v, causal=True)
     else:
+        from apex_tpu.ops.attention import repeat_kv_heads
+
+        k, v = repeat_kv_heads(q, k, v)
         scores = jnp.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(hd)
         probs = scaled_upper_triang_masked_softmax(scores, 1.0)
         ctx = jnp.einsum("bnst,bnth->bnsh", probs.astype(v.dtype), v)

@@ -39,6 +39,20 @@ def padding_bias(kv_mask):
     return jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
 
 
+def repeat_kv_heads(q, k, v):
+    """Grouped-query attention on paths that want full-width kv: repeat
+    each kv head across its q-head group (identity when the head counts
+    already match).  dk/dv cotangents through the repeat sum over the
+    group — the GQA backward semantics — via ``jnp.repeat``'s transpose."""
+    H, Hkv = q.shape[1], k.shape[1]
+    if Hkv == H:
+        return k, v
+    if H % Hkv != 0:
+        raise ValueError(f"q heads ({H}) not divisible by kv heads ({Hkv})")
+    g = H // Hkv
+    return jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+
+
 def _bias_blocks(kv_bias, B, nblocks, bk):
     """Split an additive score bias into k-blocks for the scan.
 
@@ -246,6 +260,11 @@ def flash_attention(
     "auto" — the Pallas kernel on TPU with kernel-friendly shapes, the
     scan path everywhere else.  ``block_q``/``block_k`` default to each
     implementation's tuned tile size (scan: 256; pallas: 1024 fwd).
+
+    Grouped-query attention: k/v may carry fewer heads than q (H_kv
+    divides H).  The Pallas kernels read the group-shared kv blocks
+    directly; the scan path repeats kv heads (its backward sums the
+    group — the same semantics).
     """
     if impl not in ("auto", "pallas", "scan"):
         raise ValueError(f"impl must be 'auto', 'pallas', or 'scan'; got {impl!r}")
@@ -262,6 +281,7 @@ def flash_attention(
                 q_offset=q_offset, k_offset=k_offset,
                 block_q=block_q, block_k=block_k, kv_mask=kv_mask,
             )
+    k, v = repeat_kv_heads(q, k, v)
     bias = None
     if attn_bias is not None:
         while attn_bias.ndim < 4:
@@ -284,7 +304,8 @@ def flash_attention_with_lse(
 
 
 def mha_reference(q, k, v, causal=True, softmax_scale=None, kv_mask=None):
-    """Naive O(S²)-memory oracle for tests."""
+    """Naive O(S²)-memory oracle for tests (GQA via head repeat)."""
+    k, v = repeat_kv_heads(q, k, v)
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if causal:

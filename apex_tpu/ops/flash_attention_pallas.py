@@ -120,20 +120,34 @@ def _fwd_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
         lse_ref[0] = m_ref[:, 0:1] + jnp.log(l)
 
 
+def _kv_row(b, heads, kv_heads):
+    """Flattened k/v batch·head row for flattened q row ``b``: grouped-
+    query attention maps each q head to its group's shared kv head
+    (identity when kv_heads == heads)."""
+    if kv_heads == heads:
+        return b
+    group = heads // kv_heads
+    return (b // heads) * kv_heads + (b % heads) // group
+
+
 def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
                      block_q=1024, block_k=1024, interpret=False,
-                     out_dtype=None, kv_bias=None, heads=1):
-    """q: (BH, Sq, D); k/v: (BH, Sk, D).  Returns (out, lse (BH, Sq, 1)).
+                     out_dtype=None, kv_bias=None, heads=1, kv_heads=None):
+    """q: (BH, Sq, D); k/v: (B·kv_heads, Sk, D).  Returns
+    (out, lse (BH, Sq, 1)).
 
     ``kv_bias``: optional (B, 1, Sk) f32 additive key bias (0 valid /
     NEG_INF padded; the middle singleton keeps the block sublane-legal);
     ``heads`` maps the flattened batch·head grid index back to the batch
-    row (b // heads).
+    row (b // heads).  ``kv_heads`` < heads = grouped-query attention:
+    the kernel reads each q head's group-shared k/v block directly (no
+    materialized head repeat in HBM).
 
     ``out_dtype`` defaults to q.dtype; ring attention requests f32 so
     cross-chunk accumulation never rounds through bf16."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    kv_heads = kv_heads or heads
     out_dtype = out_dtype or q.dtype
     bq = _pick_block(Sq, block_q, align=8)
     bk = _pick_block(Sk, block_k)
@@ -141,10 +155,15 @@ def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
     grid = (BH, nq, nk)
     has_bias = kv_bias is not None
 
+    kv_spec = pl.BlockSpec(
+        (1, bk, D),
+        lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0),
+        memory_space=pltpu.VMEM,
+    )
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        kv_spec,
+        kv_spec,
     ]
     inputs = (q, k, v)
     if has_bias:
@@ -235,7 +254,11 @@ def _dq_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
 
 
 def _dkv_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
-                block_q, block_k, nq):
+                block_q, block_k, nq, nt):
+    """k-block outer; the inner dimension ``t`` walks ALL nt = g·nq
+    q-blocks that attend to this kv head — for grouped-query attention
+    the g q-heads of the group accumulate into the same dk/dv block
+    (i = t % nq is the q-block index within the current q head)."""
     if has_bias:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -243,9 +266,10 @@ def _dkv_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
         b_ref = None
-    j, i = pl.program_id(1), pl.program_id(2)  # k-block outer, q-block inner
+    j, t = pl.program_id(1), pl.program_id(2)
+    i = t % nq
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -290,7 +314,7 @@ def _dkv_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == nt - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -298,19 +322,25 @@ def _dkv_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
 
 def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
                      block_q=512, block_k=512, interpret=False, delta=None,
-                     out_dtype=None, kv_bias=None, heads=1):
+                     out_dtype=None, kv_bias=None, heads=1, kv_heads=None):
     # 512 (not the forward's 1024): the bwd kernels keep ~4 (bq, bk) f32
     # score-sized temporaries live, so smaller tiles stay inside VMEM.
-    """All (BH, S, D); lse (BH, Sq, 1).  Returns (dq, dk, dv).
+    """q/out/do (BH, Sq, D); k/v (B·kv_heads, Sk, D); lse (BH, Sq, 1).
+    Returns (dq, dk, dv) with dk/dv shaped like k/v.
 
     ``delta`` (rowsum of do·out over the FULL row) may be passed in when
     ``out`` covers more keys than this call sees — ring attention's
     backward, where each chunk-pair call sees only the local k/v chunk.
     ``out_dtype`` defaults to the input dtypes; ring passes f32.
-    ``kv_bias``/``heads`` as in :func:`flash_fwd_pallas`.
+    ``kv_bias``/``heads``/``kv_heads`` as in :func:`flash_fwd_pallas`;
+    with grouped-query attention the dk/dv grid walks every q head of
+    the group before finalizing, so the group sum happens in VMEM.
     """
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    kv_heads = kv_heads or heads
+    group = heads // kv_heads
+    BKV = k.shape[0]
     dq_dtype = out_dtype or q.dtype
     dk_dtype = out_dtype or k.dtype
     dv_dtype = out_dtype or v.dtype
@@ -324,7 +354,11 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
                         axis=-1, keepdims=True)
 
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec(
+        (1, bk, D),
+        lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0),
+        memory_space=pltpu.VMEM,
+    )
     r_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
 
     in_specs = [q_spec, k_spec, k_spec, q_spec, r_spec, r_spec]
@@ -348,28 +382,42 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
         interpret=interpret,
     )(*inputs)
 
-    # k-outer grid: index maps see (b, j, i).
-    qT_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM)
-    kT_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM)
-    rT_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM)
+    # k-outer grid over the KV rows: index maps see (b, j, t) with
+    # t ∈ [0, group·nq) walking q-blocks of every q head in the group
+    # (qh = t // nq, qi = t % nq); the q row is the group member's.
+    def _q_row(b, t):
+        if group == 1:
+            return b
+        return (b // kv_heads) * heads + (b % kv_heads) * group + t // nq
+
+    qT_spec = pl.BlockSpec(
+        (1, bq, D), lambda b, j, t: (_q_row(b, t), t % nq, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kT_spec = pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, 0), memory_space=pltpu.VMEM)
+    rT_spec = pl.BlockSpec(
+        (1, bq, 1), lambda b, j, t: (_q_row(b, t), t % nq, 0),
+        memory_space=pltpu.VMEM,
+    )
 
     in_specsT = [qT_spec, kT_spec, kT_spec, qT_spec, rT_spec, rT_spec]
     if has_bias:
         in_specsT.append(
-            pl.BlockSpec((1, 1, bk), lambda b, j, i: (b // heads, 0, j), memory_space=pltpu.VMEM)
+            pl.BlockSpec((1, 1, bk), lambda b, j, t: (b // kv_heads, 0, j), memory_space=pltpu.VMEM)
         )
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, has_bias=has_bias,
-            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk, nq=nq,
+            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk,
+            nq=nq, nt=group * nq,
         ),
-        grid=(BH, nk, nq),
+        grid=(BKV, nk, group * nq),
         in_specs=in_specsT,
         out_specs=[kT_spec, kT_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sk, D), dk_dtype),
-            jax.ShapeDtypeStruct((BH, Sk, D), dv_dtype),
+            jax.ShapeDtypeStruct((BKV, Sk, D), dk_dtype),
+            jax.ShapeDtypeStruct((BKV, Sk, D), dv_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
@@ -381,32 +429,34 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
 
 
 # ---------------------------------------------------------------- dispatch
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _flash_pallas(q, k, v, kv_bias, scale, causal, q_offset, k_offset,
-                  block_q, block_k, interpret, heads):
+                  block_q, block_k, interpret, heads, kv_heads):
     out, _ = flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
                               block_q=block_q, block_k=block_k,
-                              interpret=interpret, kv_bias=kv_bias, heads=heads)
+                              interpret=interpret, kv_bias=kv_bias, heads=heads,
+                              kv_heads=kv_heads)
     return out
 
 
 def _flash_pallas_fwd(q, k, v, kv_bias, scale, causal, q_offset, k_offset,
-                      block_q, block_k, interpret, heads):
+                      block_q, block_k, interpret, heads, kv_heads):
     out, lse = flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
                                 block_q=block_q, block_k=block_k,
-                                interpret=interpret, kv_bias=kv_bias, heads=heads)
+                                interpret=interpret, kv_bias=kv_bias, heads=heads,
+                                kv_heads=kv_heads)
     return out, (q, k, v, kv_bias, out, lse)
 
 
 def _flash_pallas_bwd(scale, causal, q_offset, k_offset, block_q, block_k,
-                      interpret, heads, res, g):
+                      interpret, heads, kv_heads, res, g):
     q, k, v, kv_bias, out, lse = res
     # bwd keeps more score-sized f32 temporaries live; cap tiles at 512
     dq, dk, dv = flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
                                   q_offset, k_offset,
                                   block_q=min(block_q, 512), block_k=min(block_k, 512),
                                   interpret=interpret, kv_bias=kv_bias,
-                                  heads=heads)
+                                  heads=heads, kv_heads=kv_heads)
     # the mask bias is data, not a trainable input: zero cotangent
     return (dq, dk, dv, None if kv_bias is None else jnp.zeros_like(kv_bias))
 
@@ -421,12 +471,20 @@ def flash_attention_pallas(q, k, v, causal=True, softmax_scale=None,
 
     ``kv_mask``: optional (B, Sk) bool key-validity mask (True = valid) —
     the fmha varlen/padding semantics (``apex/contrib/fmha/fmha.py:33-60``)
-    expressed as a dense mask folded into the kernel."""
+    expressed as a dense mask folded into the kernel.
+
+    Grouped-query attention: k/v may carry fewer heads than q
+    ((B, H_kv, Sk, D) with H % H_kv == 0) — the kernels index each q
+    head's group-shared k/v block directly, so GQA costs no HBM head
+    repeat and dk/dv group sums happen in VMEM scratch."""
     B, H, Sq, D = q.shape
+    Hkv = k.shape[1]
+    if H % Hkv != 0:
+        raise ValueError(f"q heads ({H}) not divisible by kv heads ({Hkv})")
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(D)
     qf = q.reshape(B * H, Sq, D)
-    kf = k.reshape(B * H, k.shape[2], D)
-    vf = v.reshape(B * H, v.shape[2], D)
+    kf = k.reshape(B * Hkv, k.shape[2], D)
+    vf = v.reshape(B * Hkv, v.shape[2], D)
     if kv_mask is None:
         bias = None
     else:
@@ -434,7 +492,7 @@ def flash_attention_pallas(q, k, v, causal=True, softmax_scale=None,
 
         bias = padding_bias(kv_mask)[:, None, :]
     out = _flash_pallas(qf, kf, vf, bias, scale, causal, q_offset, k_offset,
-                        block_q or 1024, block_k or 1024, interpret, H)
+                        block_q or 1024, block_k or 1024, interpret, H, Hkv)
     return out.reshape(B, H, Sq, D)
 
 

@@ -442,3 +442,106 @@ class TestRingAttentionPallas:
         )(q, k, v)
         for a, r in zip(g, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=2e-4, atol=2e-5)
+
+
+class TestGroupedQueryAttention:
+    """GQA: k/v with fewer heads than q.  The Pallas kernels read the
+    group-shared kv blocks via index maps (no HBM repeat); the scan
+    path repeats heads.  Oracle = dense attention with repeated kv."""
+
+    def _inputs(self, B=2, H=4, Hkv=2, Sq=256, Sk=256, D=64, seed=0):
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(B, H, Sq, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, Hkv, Sk, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, Hkv, Sk, D).astype(np.float32))
+        return q, k, v
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("hkv", [1, 2])  # MQA and 2-way groups
+    def test_pallas_forward_matches_reference(self, causal, hkv):
+        from apex_tpu.ops.flash_attention_pallas import flash_attention_pallas
+
+        q, k, v = self._inputs(Hkv=hkv)
+        out = flash_attention_pallas(q, k, v, causal=causal, interpret=True)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("hkv", [1, 2])  # MQA (group=heads) and group=2
+    @pytest.mark.slow
+    def test_pallas_backward_matches_reference(self, causal, hkv):
+        """dk/dv must be the GROUP SUM over the kv head's q heads — the
+        kernel accumulates it in VMEM across the extended inner grid."""
+        from apex_tpu.ops.flash_attention_pallas import flash_attention_pallas
+
+        q, k, v = self._inputs(Hkv=hkv, Sq=128, Sk=128)
+
+        def loss_pallas(q, k, v):
+            return jnp.sum(flash_attention_pallas(q, k, v, causal=causal, interpret=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            assert a.shape == b.shape
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+    @pytest.mark.slow
+    def test_pallas_backward_with_padding_mask(self):
+        """The dkv pass's bias rows index the (B·kv_heads) grid
+        (b // kv_heads); a regression to b // heads would read the
+        wrong batch's mask.  B>1 with different per-batch masks makes
+        that misread change the numbers."""
+        from apex_tpu.ops.flash_attention_pallas import flash_attention_pallas
+
+        q, k, v = self._inputs(B=3, H=4, Hkv=2, Sq=128, Sk=128)
+        rng = np.random.RandomState(5)
+        lengths = rng.randint(32, 129, size=3)
+        kv_mask = jnp.asarray(np.arange(128)[None, :] < lengths[:, None])
+
+        def loss_pallas(q, k, v):
+            return jnp.sum(flash_attention_pallas(
+                q, k, v, causal=False, kv_mask=kv_mask, interpret=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=False, kv_mask=kv_mask) ** 2)
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+    def test_scan_path_matches_reference(self):
+        q, k, v = self._inputs(Sq=64, Sk=64, D=8)
+        out = flash_attention(q, k, v, causal=True, impl="scan", block_k=16)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+        # backward through the repeat sums the group
+        gp = jax.grad(lambda *a: jnp.sum(flash_attention(*a, causal=True, impl="scan", block_k=16) ** 2),
+                      argnums=(1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(mha_reference(*a, causal=True) ** 2),
+                      argnums=(1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            assert a.shape == b.shape
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+    def test_pallas_gqa_with_padding_mask(self):
+        from apex_tpu.ops.flash_attention_pallas import flash_attention_pallas
+
+        q, k, v = self._inputs()
+        rng = np.random.RandomState(3)
+        lengths = rng.randint(128, 257, size=q.shape[0])
+        kv_mask = jnp.asarray(np.arange(256)[None, :] < lengths[:, None])
+        out = flash_attention_pallas(q, k, v, causal=False, kv_mask=kv_mask,
+                                     interpret=True)
+        ref = mha_reference(q, k, v, causal=False, kv_mask=kv_mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_indivisible_heads_rejected(self):
+        from apex_tpu.ops.flash_attention_pallas import flash_attention_pallas
+
+        q, k, v = self._inputs(H=4, Hkv=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention_pallas(q, k, v, interpret=True)
