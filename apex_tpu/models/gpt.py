@@ -47,6 +47,21 @@ class GPTConfig:
     # grouped-query attention (Megatron's knob name): number of kv-head
     # groups; None = one kv head per q head (standard MHA), 1 = MQA.
     num_query_groups: Optional[int] = None
+    # "learned" (absolute table, the reference's standalone GPT) or
+    # "rope" (rotary: unbounded length, composes with ring attention)
+    position_embedding_type: str = "learned"
+    rope_theta: float = 10000.0
+
+    def __post_init__(self):
+        # validate HERE so every path (incl. checkpoint-restored params
+        # that never call init_params) fails loudly on a typo'd type —
+        # an unrecognized value would otherwise silently train with NO
+        # positional information
+        if self.position_embedding_type not in ("learned", "rope"):
+            raise ValueError(
+                f"position_embedding_type must be 'learned' or 'rope' "
+                f"(got {self.position_embedding_type!r})"
+            )
     layernorm_eps: float = 1e-5
     compute_dtype: Any = jnp.bfloat16
     checkpoint_layers: bool = True
@@ -102,7 +117,6 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     KV = config.kv_heads * config.head_dim  # kv projection width (GQA)
     params = {
         "embed": init(k[0], V, H),
-        "pos_embed": init(k[1], config.max_seq_len, H),
         "layers": {
             "ln1_scale": jnp.ones((L, H)),
             "ln1_bias": jnp.zeros((L, H)),
@@ -120,6 +134,8 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
         "final_ln_scale": jnp.ones((H,)),
         "final_ln_bias": jnp.zeros((H,)),
     }
+    if config.position_embedding_type == "learned":
+        params["pos_embed"] = init(k[1], config.max_seq_len, H)
     if config.moe:
         from apex_tpu.transformer.expert_parallel import moe_init
 
@@ -172,13 +188,30 @@ def param_specs(config: GPTConfig, ep_axis: Optional[str] = None):
         layers["moe"] = moe_param_specs(ep_axis, layers=True)
     else:
         layers.update({"fc1": col, "fc1_b": colb, "fc2": row, "fc2_b": rep2})
-    return {
+    specs = {
         "embed": P("tp", None),
-        "pos_embed": P(None, None),
         "layers": layers,
         "final_ln_scale": P(None),
         "final_ln_bias": P(None),
     }
+    if config.position_embedding_type == "learned":
+        specs["pos_embed"] = P(None, None)
+    return specs
+
+
+def _add_pos_embed(x, params, config: GPTConfig, cp_axis):
+    """Add the learned position table to (S, B, H) activations — the
+    LOCAL sequence chunk's rows when the sequence is cp-sharded.  No-op
+    under rope (positions enter as q/k rotations in attention)."""
+    if config.position_embedding_type != "learned":
+        return x
+    S = x.shape[0]
+    if cp_axis is not None:
+        start = jax.lax.axis_index(cp_axis) * S
+        pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], start, S, axis=0)
+    else:
+        pos = params["pos_embed"][:S]
+    return x + pos[:, None, :]
 
 
 def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
@@ -218,6 +251,15 @@ def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
         return t.reshape(S, B, nh, hd).transpose(1, 2, 0, 3)
 
     q, k, v = heads(q, n_local_heads), heads(k, n_local_kv), heads(v, n_local_kv)
+    if config.position_embedding_type == "rope":
+        from apex_tpu.ops.rope import apply_rope
+
+        # global positions of the LOCAL chunk: with context parallelism
+        # each rank rotates its own chunk before k/v ride the ring
+        start = 0 if cp_axis is None else jax.lax.axis_index(cp_axis) * S
+        positions = start + jnp.arange(S)
+        q = apply_rope(q, positions, config.rope_theta)
+        k = apply_rope(k, positions, config.rope_theta)
     if cp_axis is not None:
         from apex_tpu.ops.attention import repeat_kv_heads
         from apex_tpu.transformer.context_parallel import ring_attention
@@ -321,12 +363,7 @@ def gpt_forward(
         emb = jnp.take(params["embed"], tokens, axis=0)  # (B, S, H)
     else:
         emb = vocab_parallel_embedding(tokens, params["embed"], axis_name=axis_name)
-    if cp_axis is not None:
-        start = jax.lax.axis_index(cp_axis) * S
-        pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], start, S, axis=0)
-    else:
-        pos = params["pos_embed"][:S]
-    x = emb.transpose(1, 0, 2) + pos[:, None, :]
+    x = _add_pos_embed(emb.transpose(1, 0, 2), params, config, cp_axis)
     x = x.astype(config.compute_dtype)
 
     if config.sequence_parallel and axis_name is not None:
@@ -694,12 +731,7 @@ def make_pp_train_step(
         tokens = mb["tokens"]
         B, S = tokens.shape
         emb = vocab_parallel_embedding(tokens, shared["embed"], axis_name=tp_axis)
-        if cp_axis is not None:
-            start = jax.lax.axis_index(cp_axis) * S
-            pos = jax.lax.dynamic_slice_in_dim(shared["pos_embed"], start, S, axis=0)
-        else:
-            pos = shared["pos_embed"][:S]
-        x = emb.transpose(1, 0, 2) + pos[:, None, :]
+        x = _add_pos_embed(emb.transpose(1, 0, 2), shared, config, cp_axis)
         x = x.astype(config.compute_dtype)
         if sp:
             from apex_tpu.transformer.tensor_parallel.mappings import (

@@ -1,0 +1,42 @@
+"""Rotary position embeddings (RoPE).
+
+Beyond the reference (apex predates RoPE), in service of the
+long-context mandate: a learned position table caps sequence length at
+``max_seq_len`` rows, while RoPE encodes positions as per-head
+rotations of q/k — unbounded length, and it composes with ring
+attention (rotation is per-position preprocessing, so each context-
+parallel rank rotates its LOCAL chunk with its GLOBAL positions before
+the k/v chunks ride the ring).
+
+GPT-NeoX-style half-rotation: the head dim splits in two and each
+(x1[i], x2[i]) pair rotates by ``pos·theta^(-2i/D)``.  Pure elementwise
+math — XLA fuses it into the surrounding projections; no kernel needed.
+"""
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions, head_dim: int, theta: float = 10000.0):
+    """(S,) int positions → (S, head_dim/2) f32 rotation angles."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim (got {head_dim})")
+    d2 = head_dim // 2
+    inv_freq = theta ** (-jnp.arange(0, d2, dtype=jnp.float32) / d2)
+    return positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotate ``x`` (..., S, D) by its positions (S,).
+
+    Works for any leading batch/head dims; math in fp32, result cast
+    back to ``x.dtype`` (rotations are norm-preserving, so fp32 here
+    costs nothing downstream)."""
+    D = x.shape[-1]
+    ang = rope_angles(positions, D, theta)  # (S, d2)
+    cos = jnp.cos(ang).astype(jnp.float32)
+    sin = jnp.sin(ang).astype(jnp.float32)
+    d2 = D // 2
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :d2], xf[..., d2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)

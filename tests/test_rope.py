@@ -1,0 +1,153 @@
+"""Rotary position embeddings: op properties + GPT composition
+(tp, pipeline, and ring-attention context parallelism — each rank
+rotates its local chunk with GLOBAL positions before the ring)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.models.gpt import (
+    GPTConfig,
+    gpt_loss,
+    init_params,
+    make_pp_train_step,
+    make_train_step,
+    param_specs,
+)
+from apex_tpu.ops.rope import apply_rope
+from apex_tpu.optimizers import FusedAdam
+
+ROPE_CFG = GPTConfig(
+    vocab_size=64, hidden_size=32, num_layers=2, num_attention_heads=4,
+    max_seq_len=32, compute_dtype=jnp.float32, checkpoint_layers=False,
+    position_embedding_type="rope",
+)
+
+
+class TestRopeOp:
+    def test_preserves_norms(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 3, 16, 8).astype(np.float32))
+        pos = jnp.arange(16)
+        y = apply_rope(x, pos)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+
+    def test_scores_depend_only_on_relative_position(self):
+        """<rope(q, p1), rope(k, p2)> must be shift-invariant — the
+        property that makes RoPE length-extrapolating."""
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(8).astype(np.float32))
+        k = jnp.asarray(rng.randn(8).astype(np.float32))
+
+        def score(p1, p2):
+            qr = apply_rope(q[None], jnp.asarray([p1]))[0]
+            kr = apply_rope(k[None], jnp.asarray([p2]))[0]
+            return float(jnp.dot(qr, kr))
+
+        np.testing.assert_allclose(score(3, 7), score(103, 107), rtol=1e-4)
+        np.testing.assert_allclose(score(10, 2), score(1010, 1002), rtol=1e-4)
+        assert abs(score(3, 7) - score(3, 9)) > 1e-4  # distance matters
+
+    def test_odd_head_dim_rejected(self):
+        with pytest.raises(ValueError, match="even head_dim"):
+            apply_rope(jnp.zeros((4, 7)), jnp.arange(4))
+
+    def test_positions_beyond_any_table(self):
+        """No max_seq_len cap: positions far past the config's table
+        size are fine (the point of rope for long context)."""
+        x = jnp.ones((4, 8))
+        y = apply_rope(x, jnp.arange(4) + 10_000_000)
+        assert np.all(np.isfinite(np.asarray(y)))
+
+
+class TestGPTWithRope:
+    def test_no_pos_table_in_params(self):
+        params = init_params(ROPE_CFG, jax.random.PRNGKey(0))
+        assert "pos_embed" not in params
+        assert "pos_embed" not in param_specs(ROPE_CFG)
+
+    def test_training_reduces_loss(self):
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "tp"))
+        params = init_params(ROPE_CFG, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        step = make_train_step(ROPE_CFG, opt, mesh)
+        rng = np.random.RandomState(0)
+        tok = jnp.asarray(rng.randint(0, 64, size=(2, 32)))
+        tgt = jnp.roll(tok, -1, axis=1)
+        losses = []
+        for _ in range(5):
+            params, state, loss = step(params, state, tok, tgt)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    @pytest.mark.slow
+    def test_tp_matches_single_device(self, devices8):
+        params = init_params(ROPE_CFG, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        tok = jnp.asarray(rng.randint(0, 64, size=(2, 32)))
+        tgt = jnp.roll(tok, -1, axis=1)
+        ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(params, tok, tgt, ROPE_CFG)
+
+        mesh = Mesh(np.array(devices8[:4]), ("tp",))
+        f = jax.shard_map(
+            jax.value_and_grad(lambda p, t, y: gpt_loss(p, t, y, ROPE_CFG, axis_name="tp")),
+            mesh=mesh, in_specs=(param_specs(ROPE_CFG), P(), P()),
+            out_specs=(P(), param_specs(ROPE_CFG)), check_vma=False,
+        )
+        loss, grads = f(params, tok, tgt)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+        for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads),
+            jax.tree_util.tree_leaves_with_path(ref_grads),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4,
+                err_msg=jax.tree_util.keystr(ka))
+
+    @pytest.mark.slow
+    def test_cp_ring_matches_single_device(self, devices8):
+        """Per-rank rotation with global positions + the ring must equal
+        full attention with rope on one device."""
+        cfg = dataclasses.replace(ROPE_CFG, checkpoint_layers=True)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        mesh = Mesh(np.array(devices8).reshape(2, 2, 2), ("dp", "cp", "tp"))
+        step = make_train_step(cfg, opt, mesh, cp_axis="cp")
+        rng = np.random.RandomState(0)
+        tok = jnp.asarray(rng.randint(0, 64, size=(4, 32)))
+        tgt = jnp.roll(tok, -1, axis=1)
+        _, _, loss = step(params, state, tok, tgt)
+
+        ref_loss, _ = jax.value_and_grad(gpt_loss)(params, tok, tgt, cfg)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+
+    @pytest.mark.slow
+    def test_pp_matches_single_device(self, devices8):
+        cfg = dataclasses.replace(ROPE_CFG, num_layers=4, checkpoint_layers=True)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        mesh = Mesh(np.array(devices8).reshape(2, 2, 2), ("dp", "pp", "tp"))
+        step = make_pp_train_step(cfg, opt, mesh, num_microbatches=2)
+        rng = np.random.RandomState(0)
+        tok = jnp.asarray(rng.randint(0, 64, size=(4, 32)))
+        tgt = jnp.roll(tok, -1, axis=1)
+        _, _, loss = step(params, state, tok, tgt)
+        ref_loss, _ = jax.value_and_grad(gpt_loss)(params, tok, tgt, cfg)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+
+    def test_rope_with_gqa(self):
+        cfg = dataclasses.replace(ROPE_CFG, num_query_groups=2)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        tok = jnp.asarray(rng.randint(0, 64, size=(2, 32)))
+        loss = gpt_loss(params, tok, jnp.roll(tok, -1, 1), cfg)
+        assert np.isfinite(float(loss))
