@@ -51,17 +51,6 @@ class GPTConfig:
     # "rope" (rotary: unbounded length, composes with ring attention)
     position_embedding_type: str = "learned"
     rope_theta: float = 10000.0
-
-    def __post_init__(self):
-        # validate HERE so every path (incl. checkpoint-restored params
-        # that never call init_params) fails loudly on a typo'd type —
-        # an unrecognized value would otherwise silently train with NO
-        # positional information
-        if self.position_embedding_type not in ("learned", "rope"):
-            raise ValueError(
-                f"position_embedding_type must be 'learned' or 'rope' "
-                f"(got {self.position_embedding_type!r})"
-            )
     layernorm_eps: float = 1e-5
     compute_dtype: Any = jnp.bfloat16
     checkpoint_layers: bool = True
@@ -76,6 +65,17 @@ class GPTConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+
+    def __post_init__(self):
+        # validate at construction so every path (incl. checkpoint-
+        # restored params that never call init_params) fails loudly on
+        # a typo'd type — an unrecognized value would otherwise
+        # silently train with NO positional information
+        if self.position_embedding_type not in ("learned", "rope"):
+            raise ValueError(
+                f"position_embedding_type must be 'learned' or 'rope' "
+                f"(got {self.position_embedding_type!r})"
+            )
 
     @property
     def ffn(self):

@@ -14,15 +14,32 @@ math — XLA fuses it into the surrounding projections; no kernel needed.
 """
 
 import jax.numpy as jnp
+import numpy as np
 
 
 def rope_angles(positions, head_dim: int, theta: float = 10000.0):
-    """(S,) int positions → (S, head_dim/2) f32 rotation angles."""
+    """(S,) int positions → (S, head_dim/2) f32 rotation angles.
+
+    A naive ``positions.astype(f32) * inv_freq`` loses integer
+    resolution past 2**24 (adjacent positions round to the SAME fp32
+    value — zero positional signal between neighbors).  Positions are
+    split ``pos = hi·2**16 + lo`` with both halves exactly
+    representable, and the static per-frequency constants
+    ``(2**16·inv_freq) mod 2π`` are computed in float64 at trace time —
+    neighbor resolution holds through int32 range, with residual angle
+    error only from fp32 products (≲1e-2 rad at positions ~2**31)."""
     if head_dim % 2:
         raise ValueError(f"RoPE needs an even head_dim (got {head_dim})")
     d2 = head_dim // 2
-    inv_freq = theta ** (-jnp.arange(0, d2, dtype=jnp.float32) / d2)
-    return positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    two_pi = 2.0 * np.pi
+    inv_freq64 = theta ** (-np.arange(0, d2, dtype=np.float64) / d2)
+    inv_freq = jnp.asarray(inv_freq64, jnp.float32)
+    hi_freq = jnp.asarray(np.mod(65536.0 * inv_freq64, two_pi), jnp.float32)
+    pos = positions.astype(jnp.int32)
+    hi = (pos // 65536).astype(jnp.float32)
+    lo = (pos % 65536).astype(jnp.float32)
+    ang = hi[:, None] * hi_freq[None, :] + lo[:, None] * inv_freq[None, :]
+    return jnp.mod(ang, two_pi)
 
 
 def apply_rope(x, positions, theta: float = 10000.0):
@@ -33,8 +50,8 @@ def apply_rope(x, positions, theta: float = 10000.0):
     costs nothing downstream)."""
     D = x.shape[-1]
     ang = rope_angles(positions, D, theta)  # (S, d2)
-    cos = jnp.cos(ang).astype(jnp.float32)
-    sin = jnp.sin(ang).astype(jnp.float32)
+    cos = jnp.cos(ang)
+    sin = jnp.sin(ang)
     d2 = D // 2
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :d2], xf[..., d2:]

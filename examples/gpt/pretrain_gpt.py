@@ -45,6 +45,10 @@ def parse_args():
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--fp16", action="store_true",
                    help="float16 compute + dynamic loss scaling")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings (no learned table)")
+    p.add_argument("--num-query-groups", type=int, default=None,
+                   help="grouped-query attention: kv-head groups (1 = MQA)")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-2: shard optimizer state over dp")
     p.add_argument("--sequence-parallel", action="store_true")
@@ -83,6 +87,8 @@ def main():
         compute_dtype=jnp.float16 if args.fp16 else jnp.bfloat16,
         checkpoint_layers=True,
         sequence_parallel=args.sequence_parallel,
+        position_embedding_type="rope" if args.rope else "learned",
+        num_query_groups=args.num_query_groups,
     )
     params = init_params(config, jax.random.PRNGKey(0))
 

@@ -65,6 +65,25 @@ class TestRopeOp:
         y = apply_rope(x, jnp.arange(4) + 10_000_000)
         assert np.all(np.isfinite(np.asarray(y)))
 
+    def test_neighbor_resolution_past_fp32_integer_range(self):
+        """Adjacent positions past 2**24 must still rotate DIFFERENTLY
+        (a naive fp32 position cast rounds them to the same value); the
+        hi/lo split keeps neighbor resolution through int32 range, and
+        shift invariance must hold across the boundary too."""
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(8).astype(np.float32))
+        base = 2 ** 25
+        r0 = apply_rope(q[None], jnp.asarray([base]))[0]
+        r1 = apply_rope(q[None], jnp.asarray([base + 1]))[0]
+        assert float(jnp.max(jnp.abs(r0 - r1))) > 1e-3
+        # relative scores survive the translation to huge offsets
+        k = jnp.asarray(rng.randn(8).astype(np.float32))
+        near = float(jnp.dot(apply_rope(q[None], jnp.asarray([3]))[0],
+                             apply_rope(k[None], jnp.asarray([7]))[0]))
+        far = float(jnp.dot(apply_rope(q[None], jnp.asarray([base + 3]))[0],
+                            apply_rope(k[None], jnp.asarray([base + 7]))[0]))
+        np.testing.assert_allclose(near, far, rtol=1e-3)
+
 
 class TestGPTWithRope:
     def test_no_pos_table_in_params(self):
@@ -114,7 +133,9 @@ class TestGPTWithRope:
     @pytest.mark.slow
     def test_cp_ring_matches_single_device(self, devices8):
         """Per-rank rotation with global positions + the ring must equal
-        full attention with rope on one device."""
+        full attention with rope on one device — TWO steps, so the
+        second loss also certifies first-step grad parity through
+        Adam (rope cotangents through the ring included)."""
         cfg = dataclasses.replace(ROPE_CFG, checkpoint_layers=True)
         params = init_params(cfg, jax.random.PRNGKey(0))
         opt = FusedAdam(lr=1e-2)
@@ -124,10 +145,19 @@ class TestGPTWithRope:
         rng = np.random.RandomState(0)
         tok = jnp.asarray(rng.randint(0, 64, size=(4, 32)))
         tgt = jnp.roll(tok, -1, axis=1)
-        _, _, loss = step(params, state, tok, tgt)
+        losses = []
+        for _ in range(2):
+            params, state, loss = step(params, state, tok, tgt)
+            losses.append(float(loss))
 
-        ref_loss, _ = jax.value_and_grad(gpt_loss)(params, tok, tgt, cfg)
-        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+        o_params = init_params(cfg, jax.random.PRNGKey(0))
+        o_state = opt.init(o_params)
+        o_losses = []
+        for _ in range(2):
+            loss, grads = jax.value_and_grad(gpt_loss)(o_params, tok, tgt, cfg)
+            o_params, o_state = opt.update(grads, o_state, o_params)
+            o_losses.append(float(loss))
+        np.testing.assert_allclose(losses, o_losses, rtol=1e-4)
 
     @pytest.mark.slow
     def test_pp_matches_single_device(self, devices8):
@@ -141,7 +171,7 @@ class TestGPTWithRope:
         tok = jnp.asarray(rng.randint(0, 64, size=(4, 32)))
         tgt = jnp.roll(tok, -1, axis=1)
         _, _, loss = step(params, state, tok, tgt)
-        ref_loss, _ = jax.value_and_grad(gpt_loss)(params, tok, tgt, cfg)
+        ref_loss = gpt_loss(params, tok, tgt, cfg)
         np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
 
     def test_rope_with_gqa(self):
