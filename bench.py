@@ -201,6 +201,28 @@ def bench_fused_adam():
 
 def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
               vocab=50304):
+    """GPT train-step throughput.  On HBM exhaustion the batch halves
+    (at most twice) and the result records the batch that actually ran —
+    an audited number at a smaller batch beats an OOM error (GPT-345M
+    has never executed on this chip; whether batch 8 fits is unknown).
+    Retries are capped: each attempt is a full recompile, and an
+    unbounded loop could eat the section budget and trip _try's
+    watchdog — which would mark the device wedged and skip every
+    remaining section."""
+    for retries_left in (2, 1, 0):
+        try:
+            return _bench_gpt_at_batch(layers, hidden, heads, seq, batch,
+                                       roofline_tflops, iters, vocab)
+        except Exception as e:  # noqa: BLE001 — only OOM is retried
+            oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+            if not oom or batch <= 1 or retries_left == 0:
+                raise
+            _progress(f"OOM at batch {batch}; retrying at {batch // 2}")
+            batch //= 2
+
+
+def _bench_gpt_at_batch(layers, hidden, heads, seq, batch, roofline_tflops,
+                        iters, vocab):
     from apex_tpu.models.gpt import GPTConfig, gpt_loss, init_params
     from apex_tpu.optimizers import FusedAdam
 
@@ -239,6 +261,7 @@ def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
     tflops = flops_per_token * tokens_per_sec / 1e12
     return {
         "params_m": round(n_params / 1e6, 1),
+        "batch": batch,
         "tokens_per_sec": round(tokens_per_sec, 0),
         "ms_per_step": round(dt * 1e3, 2),
         "model_tflops": round(tflops, 1),
