@@ -13,7 +13,7 @@ Tiers (the reference's L0/L1 split):
 - full:  ``pytest tests/`` — adds the compiled e2e/model-level parity
   workloads (GPT 3D/MoE/ResNet trainers, ZeRO resharding + tp
   composition, HLO memory regressions, 2-process jax.distributed
-  tests) and every per-test ``slow`` mark; 445 tests, ~20 min on this
+  tests) and every per-test ``slow`` mark; 456 tests, ~20 min on this
   box.  CI / pre-commit.
 
 Anything >~15 s compiled carries ``@pytest.mark.slow`` (file-level
