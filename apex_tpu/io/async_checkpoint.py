@@ -32,7 +32,6 @@ Guarantees:
   a crash mid-save never leaves a truncated file under the final name.
 """
 
-import os
 import queue
 import threading
 from pathlib import Path
@@ -42,41 +41,15 @@ import jax
 import numpy as np
 
 from apex_tpu.io.checkpoint import (
+    _atomic_write,
     _distributed_payload,
     _shard_name,
     _write_index,
-    save_checkpoint,
 )
 
 __all__ = ["AsyncCheckpointer"]
 
 _STOP = object()
-
-
-def _atomic_write(path: str, host_tree: Any) -> None:
-    """tmp + fsync + rename + dir-fsync: a crash mid-save never leaves a
-    truncated file under the final name."""
-    tmp = path + ".tmp"
-    try:
-        save_checkpoint(tmp, host_tree)
-        fd = os.open(tmp, os.O_RDONLY)
-        try:
-            os.fsync(fd)  # data durable before the rename publishes it
-        finally:
-            os.close(fd)
-        os.replace(tmp, path)
-        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dfd)  # the rename itself durable
-        finally:
-            os.close(dfd)
-    except BaseException:
-        try:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-        except OSError:
-            pass
-        raise
 
 
 class AsyncCheckpointer:

@@ -75,22 +75,114 @@ def save_checkpoint(path, tree: Any) -> None:
         f.write(blob.tobytes())
 
 
-def load_checkpoint(path) -> Any:
-    """Load a pytree saved by :func:`save_checkpoint` (numpy leaves)."""
+def _read_header(f, path):
+    """Parse the checkpoint preamble from an open file: returns
+    ``(header, treedef)`` and leaves ``f`` positioned at the first blob
+    byte — the ONE definition of the byte layout both the eager and
+    lazy readers depend on."""
     import pickle
 
+    magic = f.read(8)
+    if magic != _MAGIC:
+        raise ValueError(f"{path} is not an apex_tpu checkpoint")
+    hlen, tlen = struct.unpack("<QQ", f.read(16))
+    header = json.loads(f.read(hlen))
+    treedef = pickle.loads(f.read(tlen))
+    return header, treedef
+
+
+def load_checkpoint(path) -> Any:
+    """Load a pytree saved by :func:`save_checkpoint` (numpy leaves)."""
     with open(path, "rb") as f:
-        magic = f.read(8)
-        if magic != _MAGIC:
-            raise ValueError(f"{path} is not an apex_tpu checkpoint")
-        hlen, tlen = struct.unpack("<QQ", f.read(16))
-        header = json.loads(f.read(hlen))
-        treedef = pickle.loads(f.read(tlen))
+        header, treedef = _read_header(f, path)
         blob = np.frombuffer(f.read(), np.uint8)
     shapes = [tuple(m["shape"]) for m in header["leaves"]]
     dtypes = [_resolve_dtype(m["dtype"]) for m in header["leaves"]]
     leaves = native.unflatten(blob, shapes, dtypes)
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class _LazyLeaf:
+    """Handle to one leaf's bytes inside a checkpoint file.
+
+    The blob is a plain concatenation of the leaves' bytes
+    (:func:`apex_tpu.io.native.flatten`), so each leaf lives at a fixed
+    offset computable from the header alone — materializing one leaf is
+    a seek + read of exactly its bytes, never the whole file."""
+
+    __slots__ = ("path", "offset", "shape", "dtype")
+
+    def __init__(self, path, offset, shape, dtype):
+        self.path = path
+        self.offset = offset
+        self.shape = shape
+        self.dtype = dtype
+
+    def read_from(self, f) -> np.ndarray:
+        """Read this leaf's bytes from an already-open file object."""
+        n = int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+        f.seek(self.offset)
+        buf = f.read(n)
+        if len(buf) != n:
+            raise ValueError(
+                f"checkpoint {self.path} truncated: leaf at offset "
+                f"{self.offset} wants {n} bytes, got {len(buf)}"
+            )
+        return np.frombuffer(buf, self.dtype).reshape(self.shape)
+
+    def load(self) -> np.ndarray:
+        with open(self.path, "rb") as f:
+            return self.read_from(f)
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.load()
+        return a.astype(dtype) if dtype is not None else a
+
+
+def open_checkpoint_lazy(path) -> Any:
+    """Like :func:`load_checkpoint`, but leaves are :class:`_LazyLeaf`
+    handles — only the header and treedef are read now; each leaf's
+    bytes are read on demand via ``np.asarray(leaf)``.  This is how a
+    pod-scale restore avoids materializing every rank's full shard file
+    on every host (see :func:`load_distributed_checkpoint`)."""
+    with open(path, "rb") as f:
+        header, treedef = _read_header(f, path)
+        base = f.tell()
+    leaves = []
+    off = base
+    for m in header["leaves"]:
+        shape = tuple(m["shape"])
+        dtype = _resolve_dtype(m["dtype"])
+        leaves.append(_LazyLeaf(str(path), off, shape, dtype))
+        off += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _atomic_write(path: str, tree: Any) -> None:
+    """tmp + fsync + rename + dir-fsync around :func:`save_checkpoint`:
+    a crash mid-save never leaves a truncated file under the final
+    name, and the published bytes are durable."""
+    tmp = str(path) + ".tmp"
+    try:
+        save_checkpoint(tmp, tree)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)  # data durable before the rename publishes it
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        dfd = os.open(os.path.dirname(str(path)) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)  # the rename itself durable
+        finally:
+            os.close(dfd)
+    except BaseException:
+        try:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 # ------------------------------------------------------- sharded checkpoints
@@ -99,16 +191,34 @@ def _shard_name(rank: int, world: int) -> str:
 
 
 def _write_index(dir_path, world_size: int) -> None:
-    """Atomically publish the sharded-checkpoint index (tmp + rename —
-    a crash mid-write must not leave a truncated index.json under the
-    final name)."""
+    """Durably publish the sharded-checkpoint index (tmp + fsync +
+    rename + dir-fsync — a crash or power loss mid-write must not leave
+    a truncated or missing index.json while the shard data survives)."""
     d = Path(dir_path)
     d.mkdir(parents=True, exist_ok=True)
     tmp = d / "index.json.tmp"
-    tmp.write_text(
-        json.dumps({"format": "apex_tpu_sharded_v1", "world_size": world_size})
-    )
-    os.replace(tmp, d / "index.json")
+    try:
+        with open(tmp, "w") as f:
+            f.write(
+                json.dumps(
+                    {"format": "apex_tpu_sharded_v1", "world_size": world_size}
+                )
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, d / "index.json")
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except BaseException:
+        try:
+            if tmp.exists():
+                tmp.unlink()
+        except OSError:
+            pass
+        raise
 
 
 def save_sharded_checkpoint(dir_path, tree: Any, rank: int, world_size: int) -> str:
@@ -126,7 +236,7 @@ def save_sharded_checkpoint(dir_path, tree: Any, rank: int, world_size: int) -> 
     if rank == 0:
         _write_index(d, world_size)
     path = d / _shard_name(rank, world_size)
-    save_checkpoint(path, tree)
+    _atomic_write(str(path), tree)
     return str(path)
 
 
@@ -188,6 +298,21 @@ def _distributed_payload(tree: Any, copy: bool = False):
     return payload, pid, nprocs
 
 
+def _materialize_lazy(items) -> None:
+    """Replace :class:`_LazyLeaf` values with their bytes, in place:
+    ``items`` yields ``(container, key)`` pairs.  One file open per
+    shard file, reads in offset order — per-leaf opens would cost
+    O(leaves × world) round trips on network filesystems."""
+    by_file = {}
+    for c, k in items:
+        if isinstance(c[k], _LazyLeaf):
+            by_file.setdefault(c[k].path, []).append((c, k))
+    for path, group in by_file.items():
+        with open(path, "rb") as f:
+            for c, k in sorted(group, key=lambda it: it[0][it[1]].offset):
+                c[k] = c[k].read_from(f)
+
+
 def _assemble_slice(pieces, leaf_shape, leaf_dtype, idx, key):
     """Fill the region ``idx`` (tuple of slices into a ``leaf_shape``
     array) from saved shard ``pieces``; raise unless every element of
@@ -198,17 +323,24 @@ def _assemble_slice(pieces, leaf_shape, leaf_dtype, idx, key):
         for sl, dim in zip(idx, leaf_shape)
     ]
     out_shape = tuple(b - a for a, b in bounds)
-    arr = np.zeros(out_shape, leaf_dtype)
-    covered = 0
+    hits = []
     for s in pieces:
         lo = [max(int(a), ra) for a, (ra, _) in zip(s["start"], bounds)]
         hi = [min(int(b), rb) for b, (_, rb) in zip(s["stop"], bounds)]
         if any(l >= h for l, h in zip(lo, hi)):
             continue  # no overlap with the requested region
+        hits.append((s, lo, hi))
+    # materialize lazy pieces: only overlapping ones, at most once each
+    # (cached in place, so a piece spanning several device regions is
+    # read exactly once)
+    _materialize_lazy((s, "data") for s, _, _ in hits)
+    arr = np.zeros(out_shape, leaf_dtype)
+    covered = 0
+    for s, lo, hi in hits:
         dst = tuple(
             slice(l - ra, h - ra) for l, h, (ra, _) in zip(lo, hi, bounds)
         )
-        data = s["data"].reshape(
+        data = np.asarray(s["data"]).reshape(
             tuple(int(b) - int(a) for a, b in zip(s["start"], s["stop"]))
         )
         src = tuple(
@@ -234,19 +366,32 @@ def load_distributed_checkpoint(dir_path, template: Any, mesh=None,
 
     ``template``: abstract or concrete pytree supplying
     structure/shape/dtype.  With ``mesh`` + ``spec_tree``, returns
-    GLOBAL ``jax.Array``s directly: each process assembles only the
-    slices its own devices need (via ``jax.make_array_from_callback``),
-    so no full-size array is materialized on any host beyond what the
-    shard files themselves hold.  Without them, returns host numpy
-    arrays (every process materializes the full tree — fine for states
-    that fit one host).  Raises if the shards don't exactly cover a
-    requested region (a save/template shape mismatch)."""
+    GLOBAL ``jax.Array``s directly: each process opens every shard file
+    LAZILY (header only) and reads from disk exactly the pieces that
+    overlap the slices its own devices need (via
+    ``jax.make_array_from_callback``) — a state too big for any one
+    host restores without any host ever holding more than its own
+    addressable bytes.  Without them, returns host numpy arrays (every
+    process materializes the full tree — fine for states that fit one
+    host).  Raises if the shards don't exactly cover a requested region
+    (a save/template shape mismatch)."""
     from jax.sharding import NamedSharding
 
-    payloads = load_sharded_checkpoint(dir_path)
-    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     if (mesh is None) != (spec_tree is None):
         raise ValueError("pass mesh and spec_tree together")
+    lazy = mesh is not None
+    payloads = load_sharded_checkpoint(dir_path, lazy=lazy)
+    if lazy:
+        # start/stop bounds are needed up front for overlap tests and
+        # are tiny (ndim int64 each); only "data" stays on disk
+        _materialize_lazy(
+            (s, k)
+            for p in payloads
+            for pieces in p.values()
+            for s in pieces
+            for k in ("start", "stop")
+        )
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     spec_leaves = treedef.flatten_up_to(spec_tree) if spec_tree is not None else None
     out = []
     for i, (path, leaf) in enumerate(flat):
@@ -254,6 +399,10 @@ def load_distributed_checkpoint(dir_path, template: Any, mesh=None,
         pieces = [s for p in payloads for s in p.get(key, ())]
         if not pieces:
             raise KeyError(f"checkpoint has no shards for leaf {key}")
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            # Python scalar template leaf (save-side _distributed_payload
+            # stored it via np.asarray, so mirror that here)
+            leaf = np.asarray(leaf)
         shape, dtype = tuple(leaf.shape), leaf.dtype
         if spec_leaves is None:
             full = tuple(slice(0, d) for d in shape)
@@ -285,22 +434,25 @@ def make_global_array_tree(tree: Any, mesh, spec_tree: Any) -> Any:
     return jax.tree.map(one, tree, spec_tree)
 
 
-def load_sharded_checkpoint(dir_path, rank=None) -> Any:
+def load_sharded_checkpoint(dir_path, rank=None, lazy: bool = False) -> Any:
     """Load one rank's shard (``rank=``) or the full list of shard trees
     (``rank=None``) from a directory written by
     :func:`save_sharded_checkpoint`.  Validates completeness against the
-    index."""
+    index.  ``lazy=True`` returns trees of :class:`_LazyLeaf` handles
+    (headers read now, bytes on demand) so callers that need only a
+    fraction of each shard never pull whole files into RAM."""
     d = Path(dir_path)
     index = json.loads((d / "index.json").read_text())
     if index.get("format") != "apex_tpu_sharded_v1":
         raise ValueError(f"{dir_path} is not a sharded apex_tpu checkpoint")
     world = index["world_size"]
+    reader = open_checkpoint_lazy if lazy else load_checkpoint
     if rank is not None:
-        return load_checkpoint(d / _shard_name(rank, world))
+        return reader(d / _shard_name(rank, world))
     trees = []
     for r in range(world):
         p = d / _shard_name(r, world)
         if not p.exists():
             raise FileNotFoundError(f"missing shard {r} of {world}: {p}")
-        trees.append(load_checkpoint(p))
+        trees.append(reader(p))
     return trees

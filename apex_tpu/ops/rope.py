@@ -23,22 +23,44 @@ def rope_angles(positions, head_dim: int, theta: float = 10000.0):
     A naive ``positions.astype(f32) * inv_freq`` loses integer
     resolution past 2**24 (adjacent positions round to the SAME fp32
     value — zero positional signal between neighbors).  Positions are
-    split ``pos = hi·2**16 + lo`` with both halves exactly
-    representable, and the static per-frequency constants
-    ``(2**16·inv_freq) mod 2π`` are computed in float64 at trace time —
-    neighbor resolution holds through int32 range, with residual angle
-    error only from fp32 products (≲1e-2 rad at positions ~2**31)."""
+    split into base-2**16 digits ``pos = a·2**32 + b·2**16 + c`` with
+    every digit exactly representable in f32, and the static
+    per-frequency constants ``(2**k·inv_freq) mod 2π`` are computed in
+    float64 at trace time.  int64 positions (numpy, or jnp under x64)
+    are split in int64 BEFORE any float cast, so neighbor resolution
+    holds exactly through |pos| < 2**48 (the ``a`` digit itself loses
+    integer resolution past that); int32 inputs are covered through
+    their whole range, with residual angle error only from fp32
+    products (≲1e-2 rad at positions ~2**31)."""
     if head_dim % 2:
         raise ValueError(f"RoPE needs an even head_dim (got {head_dim})")
     d2 = head_dim // 2
     two_pi = 2.0 * np.pi
     inv_freq64 = theta ** (-np.arange(0, d2, dtype=np.float64) / d2)
-    inv_freq = jnp.asarray(inv_freq64, jnp.float32)
-    hi_freq = jnp.asarray(np.mod(65536.0 * inv_freq64, two_pi), jnp.float32)
-    pos = positions.astype(jnp.int32)
-    hi = (pos // 65536).astype(jnp.float32)
-    lo = (pos % 65536).astype(jnp.float32)
-    ang = hi[:, None] * hi_freq[None, :] + lo[:, None] * inv_freq[None, :]
+    f_lo = jnp.asarray(inv_freq64, jnp.float32)
+    f_mid = jnp.asarray(np.mod(65536.0 * inv_freq64, two_pi), jnp.float32)
+    f_hi = jnp.asarray(np.mod(65536.0 * 65536.0 * inv_freq64, two_pi), jnp.float32)
+    if isinstance(positions, np.ndarray):
+        # concrete host positions: split in int64 on the host, so the
+        # unbounded-length use case works even with jax x64 disabled
+        # (jnp.asarray of an int64 array would silently truncate)
+        pos = positions.astype(np.int64)
+        a = jnp.asarray((pos >> 32).astype(np.float32))
+        b = jnp.asarray(((pos >> 16) & 0xFFFF).astype(np.float32))
+        c = jnp.asarray((pos & 0xFFFF).astype(np.float32))
+    else:
+        pos = positions if jnp.issubdtype(positions.dtype, jnp.integer) \
+            else positions.astype(jnp.int32)
+        # arithmetic shifts = floor division by 2**16: the digits
+        # reconstruct pos exactly for negatives too
+        a = ((pos >> 16) >> 16).astype(jnp.float32)
+        b = ((pos >> 16) & 0xFFFF).astype(jnp.float32)
+        c = (pos & 0xFFFF).astype(jnp.float32)
+    ang = (
+        a[:, None] * f_hi[None, :]
+        + b[:, None] * f_mid[None, :]
+        + c[:, None] * f_lo[None, :]
+    )
     return jnp.mod(ang, two_pi)
 
 

@@ -54,6 +54,9 @@ def parse_args():
     p.add_argument("--sequence-parallel", action="store_true")
     p.add_argument("--checkpoint", default=None, help="save dir (async)")
     p.add_argument("--save-every", type=int, default=4)
+    p.add_argument("--keep", type=int, default=3,
+                   help="multi-process: retain this many step_* dirs "
+                        "(min 3 — younger dirs may still be writing)")
     p.add_argument("--resume", default=None, help="checkpoint dir to resume")
     return p.parse_args()
 
@@ -92,18 +95,26 @@ def main():
     )
     params = init_params(config, jax.random.PRNGKey(0))
 
-    if args.zero:
+    def train_param_specs():
+        """PartitionSpec tree for the params as the train step shards
+        them — with pp, stacked layers shard over the pp mesh axis.
+        The ONE place this rule lives: ZeRO init and checkpoint specs
+        both consume it."""
         from jax.sharding import PartitionSpec as P
 
+        specs = dict(param_specs(config))
+        if args.pp > 1:
+            specs["layers"] = {
+                k: P("pp", *s[1:]) for k, s in specs["layers"].items()
+            }
+        return specs
+
+    if args.zero:
         optimizer = DistributedFusedAdam(lr=args.lr, weight_decay=0.01,
                                          axis_name="dp")
         # the specs handed to init must include every model axis the
-        # params shard over — with pp, stacked layers shard over it
-        zspecs = dict(param_specs(config))
-        if args.pp > 1:
-            zspecs["layers"] = {
-                k: P("pp", *s[1:]) for k, s in zspecs["layers"].items()
-            }
+        # params shard over
+        zspecs = train_param_specs()
         axis_sizes = {"tp": args.tp}
         if args.pp > 1:
             axis_sizes["pp"] = args.pp
@@ -129,12 +140,83 @@ def main():
         0, args.vocab, size=(4096, args.seq + 1))
     start_step = 0
 
+    multiproc = jax.process_count() > 1
+
+    def ckpt_tree(params, state, step, scaler_state):
+        return {
+            "params": params,
+            "state": state,
+            "step": np.int64(step),
+            "scaler": scaler.state_dict(scaler_state) if scaler else None,
+        }
+
+    def ckpt_specs():
+        """The training-time PartitionSpec tree for everything saved —
+        the same specs the train step shards with, NOT inferred from
+        array shardings (freshly-initialized params are unsharded, so
+        introspection would silently restore everything replicated)."""
+        from jax.sharding import PartitionSpec as P
+
+        pspecs = train_param_specs()
+        if args.zero:
+            sspec = optimizer.state_partition_spec()
+        else:
+            sspec = type(state)(
+                step=P(), exp_avg=pspecs, exp_avg_sq=pspecs,
+                master=pspecs if state.master is not None else None,
+            )
+        scaler_spec = (
+            jax.tree.map(lambda _: P(), scaler.state_dict(scaler_state))
+            if scaler else None
+        )
+        return {"params": pspecs, "state": sspec, "step": P(),
+                "scaler": scaler_spec}
+
     if args.resume:
-        ck = io.load_checkpoint(Path(args.resume) / "latest.ckpt")
-        params = jax.tree.map(jnp.asarray, ck["params"])
-        # load_checkpoint restores the saved pytree structure, so a
+        if multiproc:
+            # pod-scale restore: every process reads only the pieces its
+            # own devices need (lazy shard files, no host materializes
+            # the full state).  Per-step directories: an interrupted
+            # save can only leave an INCOMPLETE newest dir, never a torn
+            # mix of steps.  Process 0 picks the newest complete dir and
+            # broadcasts it so the whole pod resumes the same step even
+            # if a shared FS shows processes different file listings;
+            # load errors (template/shape mismatch) propagate loudly.
+            import json as _json
+
+            from jax.experimental import multihost_utils
+
+            def newest_complete():
+                for d in sorted(Path(args.resume).glob("step_*"),
+                                reverse=True):
+                    idx = d / "index.json"
+                    if not idx.exists():
+                        continue
+                    try:
+                        world = _json.loads(idx.read_text())["world_size"]
+                    except (ValueError, KeyError):
+                        continue
+                    if len(list(d.glob("shard_*.ckpt"))) >= world:
+                        return int(d.name.split("_")[1])
+                return -1
+
+            chosen = newest_complete() if jax.process_index() == 0 else 0
+            chosen = int(multihost_utils.broadcast_one_to_all(
+                np.int64(chosen)))
+            if chosen < 0:
+                raise FileNotFoundError(
+                    f"no complete checkpoint under {args.resume}")
+            ck = io.load_distributed_checkpoint(
+                Path(args.resume) / f"step_{chosen:08d}",
+                ckpt_tree(params, state, 0, scaler_state),
+                mesh=mesh, spec_tree=ckpt_specs())
+        else:
+            ck = io.load_checkpoint(Path(args.resume) / "latest.ckpt")
+            ck = jax.tree.map(jnp.asarray, ck)
+        params = ck["params"]
+        # the checkpoint restores the saved pytree structure, so a
         # checkpoint from a different optimizer fails loudly in update()
-        state = jax.tree.map(jnp.asarray, ck["state"])
+        state = ck["state"]
         start_step = int(ck["step"])
         if scaler is not None:
             scaler_state = scaler.load_state_dict(ck["scaler"])
@@ -174,15 +256,32 @@ def main():
             extra = ""
         print(f"step {i}: loss={float(loss):.4f}{extra}", flush=True)
         if ckpt and (i + 1) % args.save_every == 0:
-            ckpt.save(Path(args.checkpoint) / "latest.ckpt", {
-                "params": params,
-                "state": state,
-                "step": i + 1,
-                "scaler": scaler.state_dict(scaler_state) if scaler else None,
-            })
+            tree = ckpt_tree(params, state, i + 1, scaler_state)
+            if multiproc:
+                # each process snapshots + writes only its addressable
+                # shards (non-addressable global arrays never hit host);
+                # one directory per step keeps every published
+                # checkpoint internally consistent
+                ckpt.save_distributed(
+                    Path(args.checkpoint) / f"step_{i + 1:08d}", tree)
+                if jax.process_index() == 0:
+                    # bounded disk: drop dirs older than the newest
+                    # --keep.  The async queue holds ≤2 pending saves
+                    # per process, so anything older than the 3 newest
+                    # is fully published on every process — with the
+                    # default keep=3 a prune can never race a write.
+                    import shutil
+
+                    old = sorted(Path(args.checkpoint).glob("step_*"))
+                    for d in old[:-max(args.keep, 3)]:
+                        shutil.rmtree(d, ignore_errors=True)
+            else:
+                ckpt.save(Path(args.checkpoint) / "latest.ckpt", tree)
     if ckpt:
         ckpt.close()
-        print(f"checkpoint: {args.checkpoint}/latest.ckpt")
+        where = args.checkpoint if multiproc \
+            else f"{args.checkpoint}/latest.ckpt"
+        print(f"checkpoint: {where}")
     dt = time.time() - t0
     print(f"{args.steps} steps in {dt:.1f}s "
           f"({args.global_batch * args.seq * args.steps / dt:.0f} tokens/s)")

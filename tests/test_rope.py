@@ -84,6 +84,25 @@ class TestRopeOp:
                             apply_rope(k[None], jnp.asarray([base + 7]))[0]))
         np.testing.assert_allclose(near, far, rtol=1e-3)
 
+    def test_int64_positions_past_int32_range(self):
+        """Numpy int64 positions ≥ 2**31 must not wrap (the old path
+        cast to int32, turning huge positions into NEGATIVE ones):
+        neighbors still rotate differently and shift invariance holds
+        against small positions — exact digit split through 2**48."""
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(8).astype(np.float32))
+        k = jnp.asarray(rng.randn(8).astype(np.float32))
+        base = np.int64(2) ** 35
+        r0 = apply_rope(q[None], np.asarray([base]))[0]
+        r1 = apply_rope(q[None], np.asarray([base + 1]))[0]
+        assert np.all(np.isfinite(np.asarray(r0)))
+        assert float(jnp.max(jnp.abs(r0 - r1))) > 1e-3
+        near = float(jnp.dot(apply_rope(q[None], np.asarray([np.int64(3)]))[0],
+                             apply_rope(k[None], np.asarray([np.int64(7)]))[0]))
+        far = float(jnp.dot(apply_rope(q[None], np.asarray([base + 3]))[0],
+                            apply_rope(k[None], np.asarray([base + 7]))[0]))
+        np.testing.assert_allclose(near, far, rtol=1e-3)
+
 
 class TestGPTWithRope:
     def test_no_pos_table_in_params(self):
