@@ -3,11 +3,11 @@ and ``examples/imagenet``)."""
 
 from apex_tpu.models import gpt
 
-__all__ = ["gpt"]
+__all__ = ["gpt", "t5"]
 
 
 def __getattr__(name):
-    if name in ("resnet", "bert"):
+    if name in ("resnet", "bert", "t5"):
         import importlib
 
         mod = importlib.import_module(f"apex_tpu.models.{name}")

@@ -1,0 +1,468 @@
+"""T5-style encoder-decoder transformer — the model family behind the
+``ModelType.encoder_and_decoder`` path of the reference's pipeline
+schedules (``apex/transformer/pipeline_parallel/schedules/common.py:
+85-100``, ``fwd_bwd_pipelining_without_interleaving.py:50-84``; the
+reference ships the *machinery* for such models, the model itself lives
+in Megatron — this module supplies both the machinery driver and a
+concrete model so the path is testable end-to-end).
+
+Architecture (kept close to T5 where it doesn't fight the pipeline):
+
+- pre-norm residual blocks with scale-only RMSNorm (T5's norm),
+  bias-free projections (T5 has no biases), tied source/target
+  embedding reused as the LM head;
+- learned absolute position tables per side instead of T5's relative
+  position bias: a per-layer bias table would have to ride every
+  pipeline hop as a second stream for no scheduling insight;
+- each decoder layer RMS-norms the encoder memory with its OWN scale
+  (``lnm_scale``) instead of one shared final encoder norm: the
+  pipeline forwards the encoder's RAW final hidden state stage to
+  stage, so a shared scale would belong to no stage's chunk — a
+  per-layer scale is strictly more expressive and keeps every
+  parameter either per-chunk or in ``shared_params``.
+
+Tensor parallelism follows the reference recipe (column-parallel
+q/k/v + fc1, row-parallel o + fc2, vocab-parallel embedding and cross
+entropy — reference ``tensor_parallel/layers.py:174,460,645``);
+pipeline parallelism drives the dual-stream tick schedule
+(:mod:`...schedules.tick_schedule_encdec`) with the split rank from
+``parallel_state`` (reference ``parallel_state.py:538-575``).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.normalization.fused_layer_norm import fused_rms_norm_affine
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+
+__all__ = [
+    "T5Config", "init_params", "param_specs", "t5_forward", "t5_loss",
+    "make_train_step", "make_pp_train_step", "params_to_pp_layout",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    hidden_size: int = 512
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    num_attention_heads: int = 8
+    max_src_len: int = 512
+    max_tgt_len: int = 512
+    ffn_hidden_size: Optional[int] = None  # default 4H
+    layernorm_eps: float = 1e-6
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    checkpoint_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+
+def init_params(config: T5Config, key):
+    """Global (unsharded) fp32 params.  Encoder/decoder layers are
+    stacked on a leading layer axis (scan/pipeline layout)."""
+    H, F, V = config.hidden_size, config.ffn, config.vocab_size
+    ks = jax.random.split(key, 8)
+
+    def w(k, shape, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(shape[-1])
+        return (jax.random.normal(k, shape, jnp.float32) * scale)
+
+    def enc_layer(k, L):
+        kk = jax.random.split(k, 6)
+        return {
+            "ln1_scale": jnp.ones((L, H)),
+            "wq": w(kk[0], (L, H, H)),
+            "wk": w(kk[1], (L, H, H)),
+            "wv": w(kk[2], (L, H, H)),
+            "wo": w(kk[3], (L, H, H)),
+            "ln2_scale": jnp.ones((L, H)),
+            "fc1": w(kk[4], (L, F, H)),
+            "fc2": w(kk[5], (L, H, F)),
+        }
+
+    def dec_layer(k, L):
+        kk = jax.random.split(k, 10)
+        return {
+            "ln1_scale": jnp.ones((L, H)),
+            "wq": w(kk[0], (L, H, H)),
+            "wk": w(kk[1], (L, H, H)),
+            "wv": w(kk[2], (L, H, H)),
+            "wo": w(kk[3], (L, H, H)),
+            "lnx_scale": jnp.ones((L, H)),   # cross-attn input norm
+            "lnm_scale": jnp.ones((L, H)),   # encoder-memory norm
+            "cq": w(kk[4], (L, H, H)),
+            "ck": w(kk[5], (L, H, H)),
+            "cv": w(kk[6], (L, H, H)),
+            "co": w(kk[7], (L, H, H)),
+            "ln3_scale": jnp.ones((L, H)),
+            "fc1": w(kk[8], (L, F, H)),
+            "fc2": w(kk[9], (L, H, F)),
+        }
+
+    return {
+        "embed": w(ks[0], (V, H), scale=0.02),
+        "pos_enc": w(ks[1], (config.max_src_len, H), scale=0.02),
+        "pos_dec": w(ks[2], (config.max_tgt_len, H), scale=0.02),
+        "enc_layers": enc_layer(ks[3], config.num_encoder_layers),
+        "dec_layers": dec_layer(ks[4], config.num_decoder_layers),
+        "lnf_scale": jnp.ones((H,)),  # final decoder norm (shared: head)
+    }
+
+
+def param_specs(config: T5Config):
+    """PartitionSpecs (tp axis 'tp'): column-parallel shard the output
+    dim, row-parallel the input dim, embedding the vocab."""
+    from jax.sharding import PartitionSpec as P
+
+    col = P(None, "tp", None)
+    row = P(None, None, "tp")
+    rep = P(None, None)
+    enc = {
+        "ln1_scale": rep, "wq": col, "wk": col, "wv": col, "wo": row,
+        "ln2_scale": rep, "fc1": col, "fc2": row,
+    }
+    dec = {
+        "ln1_scale": rep, "wq": col, "wk": col, "wv": col, "wo": row,
+        "lnx_scale": rep, "lnm_scale": rep,
+        "cq": col, "ck": col, "cv": col, "co": row,
+        "ln3_scale": rep, "fc1": col, "fc2": row,
+    }
+    return {
+        "embed": P("tp", None),
+        "pos_enc": P(), "pos_dec": P(),
+        "enc_layers": enc,
+        "dec_layers": dec,
+        "lnf_scale": P(),
+    }
+
+
+# ---------------------------------------------------------------- layers
+def _rms(x, scale, config):
+    return fused_rms_norm_affine(
+        x, scale, (config.hidden_size,), config.layernorm_eps)
+
+
+def _heads(t, B, S, nh, hd):
+    return t.reshape(S, B, nh, hd).transpose(1, 2, 0, 3)  # (B,nh,S,hd)
+
+
+def _attn_core(q, k, v, causal, hd):
+    scores = jnp.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(hd)
+    if causal:
+        # the repo's fused causal-softmax path (square S==T self-attn)
+        from apex_tpu.transformer.functional.fused_softmax import (
+            scaled_upper_triang_masked_softmax,
+        )
+
+        probs = scaled_upper_triang_masked_softmax(scores, 1.0)
+    else:
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bnst,bnth->bnsh", probs.astype(v.dtype), v)
+
+
+def _mha(x_q, x_kv, p, prefix, config, axis_name, causal):
+    """Multi-head attention (x: (S, B, H)); q from ``x_q``, k/v from
+    ``x_kv`` (``None`` = self-attention).  Column-parallel projections,
+    row-parallel output."""
+    if x_kv is None:
+        x_kv = x_q
+    Sq, B, _ = x_q.shape
+    Skv = x_kv.shape[0]
+    hd = config.head_dim
+    tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    nl = config.num_attention_heads // tp
+    names = {"q": prefix[0], "k": prefix[1], "v": prefix[2], "o": prefix[3]}
+
+    def col(x_, w):
+        if axis_name is None:
+            return jnp.matmul(x_, w.T.astype(x_.dtype))
+        return column_parallel_linear(x_, w, None, gather_output=False,
+                                      axis_name=axis_name)
+
+    q = _heads(col(x_q, p[names["q"]]), B, Sq, nl, hd)
+    k = _heads(col(x_kv, p[names["k"]]), B, Skv, nl, hd)
+    v = _heads(col(x_kv, p[names["v"]]), B, Skv, nl, hd)
+    ctx = _attn_core(q, k, v, causal, hd)
+    ctx = ctx.transpose(2, 0, 1, 3).reshape(Sq, B, nl * hd)
+    if axis_name is None:
+        return jnp.matmul(ctx, p[names["o"]].T.astype(ctx.dtype))
+    return row_parallel_linear(ctx, p[names["o"]], None,
+                               input_is_parallel=True, axis_name=axis_name)
+
+
+def _ffn(x, p, config, axis_name):
+    if axis_name is None:
+        h = jax.nn.relu(jnp.matmul(x, p["fc1"].T.astype(x.dtype)))
+        return jnp.matmul(h, p["fc2"].T.astype(h.dtype))
+    h = column_parallel_linear(x, p["fc1"], None, gather_output=False,
+                               axis_name=axis_name)
+    h = jax.nn.relu(h)
+    return row_parallel_linear(h, p["fc2"], None, input_is_parallel=True,
+                               axis_name=axis_name)
+
+
+def encoder_layer(x, p, config: T5Config, axis_name=None):
+    cd = config.compute_dtype
+    x = x + _mha(_rms(x, p["ln1_scale"], config).astype(cd), None, p,
+                 ("wq", "wk", "wv", "wo"), config, axis_name,
+                 causal=False)
+    x = x + _ffn(_rms(x, p["ln2_scale"], config).astype(cd), p, config,
+                 axis_name)
+    return x
+
+
+def decoder_layer(x, enc_out, p, config: T5Config, axis_name=None):
+    cd = config.compute_dtype
+    x = x + _mha(_rms(x, p["ln1_scale"], config).astype(cd), None, p,
+                 ("wq", "wk", "wv", "wo"), config, axis_name, causal=True)
+    mem = _rms(enc_out, p["lnm_scale"], config).astype(cd)
+    xq = _rms(x, p["lnx_scale"], config).astype(cd)
+    x = x + _mha(xq, mem, p, ("cq", "ck", "cv", "co"), config, axis_name,
+                 causal=False)
+    x = x + _ffn(_rms(x, p["ln3_scale"], config).astype(cd), p, config,
+                 axis_name)
+    return x
+
+
+def _embed(tokens, params, pos_key, config, axis_name):
+    """(B, S) ids -> (S, B, H) compute-dtype embeddings + positions."""
+    if axis_name is None:
+        emb = params["embed"][tokens]
+    else:
+        emb = vocab_parallel_embedding(tokens, params["embed"],
+                                       axis_name=axis_name)
+    S = tokens.shape[1]
+    x = emb.transpose(1, 0, 2) + params[pos_key][:S][:, None, :]
+    return x.astype(config.compute_dtype)
+
+
+def _lm_head(x, params, config, axis_name):
+    """Tied head: (S_tgt, B, H) -> vocab(-parallel) logits fp32."""
+    x = fused_rms_norm_affine(x, params["lnf_scale"],
+                              (config.hidden_size,), config.layernorm_eps)
+    if axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            copy_to_tensor_model_parallel_region,
+        )
+
+        x = copy_to_tensor_model_parallel_region(x, axis_name)
+    return jnp.matmul(x.astype(jnp.float32),
+                      params["embed"].T.astype(jnp.float32))
+
+
+def _ce(logits, targets, axis_name):
+    """targets (B, S) -> mean loss; vocab-parallel CE on a mesh."""
+    t = targets.transpose(1, 0)
+    if axis_name is None:
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - tgt)
+    return jnp.mean(vocab_parallel_cross_entropy(logits, t, 0.0, axis_name))
+
+
+# ---------------------------------------------------------------- oracle
+def t5_forward(params, src_tokens, dec_tokens, config: T5Config,
+               axis_name: Optional[str] = None):
+    """Full forward: (B, S_src), (B, S_tgt) token ids -> (S_tgt, B, V)
+    fp32 logits.  The single-device (or tp-only) oracle the pipeline
+    schedules are parity-tested against."""
+    x = _embed(src_tokens, params, "pos_enc", config, axis_name)
+    enc = partial(encoder_layer, config=config, axis_name=axis_name)
+    if config.checkpoint_layers:
+        enc = jax.checkpoint(enc)
+    x = jax.lax.scan(lambda c, lp: (enc(c, lp), None),
+                     x, params["enc_layers"])[0]
+    y = _embed(dec_tokens, params, "pos_dec", config, axis_name)
+    dec = partial(decoder_layer, config=config, axis_name=axis_name)
+    if config.checkpoint_layers:
+        dec = jax.checkpoint(dec)
+    y = jax.lax.scan(lambda c, lp: (dec(c, x, lp), None),
+                     y, params["dec_layers"])[0]
+    return _lm_head(y, params, config, axis_name)
+
+
+def t5_loss(params, src_tokens, dec_tokens, targets, config: T5Config,
+            axis_name: Optional[str] = None):
+    logits = t5_forward(params, src_tokens, dec_tokens, config, axis_name)
+    return _ce(logits, targets, axis_name)
+
+
+def make_train_step(config: T5Config, optimizer, mesh=None,
+                    tp_axis: str = "tp", dp_axis: Optional[str] = None):
+    """(tp × dp) train step without pipeline parallelism."""
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        def step(params, opt_state, src, dec_in, targets):
+            loss, grads = jax.value_and_grad(t5_loss)(
+                params, src, dec_in, targets, config)
+            params, opt_state = optimizer.update(grads, opt_state, params)
+            return params, opt_state, loss
+
+        return jax.jit(step)
+
+    specs = param_specs(config)
+
+    def local_step(params, opt_state, src, dec_in, targets):
+        loss, grads = jax.value_and_grad(t5_loss)(
+            params, src, dec_in, targets, config, tp_axis)
+        if dp_axis is not None:
+            loss = jax.lax.pmean(loss, dp_axis)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+        params, opt_state = optimizer.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    from apex_tpu.optimizers.fused_adam import AdamState
+
+    sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
+    data = P(dp_axis) if dp_axis else P()
+    return jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, sspec, data, data, data),
+        out_specs=(specs, sspec, P()),
+        check_vma=False,
+    ))
+
+
+# -------------------------------------------------------------- pipeline
+def params_to_pp_layout(params, pp: int, split: int):
+    """Re-stack enc/dec layers into the padded per-stage SPMD layout
+    (:func:`...tick_schedule_encdec.pad_stage_layout_encdec`): encoder
+    chunks real on stages < split, decoder chunks real on stages >=
+    split, zeros elsewhere.  Shard the results over pp on dim 0."""
+    from apex_tpu.transformer.pipeline_parallel.schedules.tick_schedule_encdec import (
+        pad_stage_layout_encdec,
+    )
+
+    enc_p, dec_p = pad_stage_layout_encdec(
+        params["enc_layers"], params["dec_layers"], pp, split)
+    return {**params, "enc_layers": enc_p, "dec_layers": dec_p}
+
+
+def make_pp_train_step(
+    config: T5Config,
+    optimizer,
+    mesh,
+    num_microbatches: int,
+    split: Optional[int] = None,
+    tp_axis: str = "tp",
+    pp_axis: str = "pp",
+    dp_axis: Optional[str] = None,
+):
+    """Encoder-decoder pipeline train step (tp × pp × dp) over the
+    dual-stream 1F1B schedule.  ``split`` defaults to
+    ``parallel_state.get_pipeline_model_parallel_split_rank()``
+    (reference parallel_state.py:538: the rank where encoder hands to
+    decoder).  Params (and optimizer state) must be in the
+    :func:`params_to_pp_layout` layout.
+
+    Returns ``step(params, opt_state, src, dec_in, targets) ->
+    (params, opt_state, loss)`` (jitted); token arrays are (B, S) and
+    split into ``num_microbatches`` along B.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.pipeline_parallel.schedules.tick_schedule_encdec import (
+        forward_backward_pipelining_encdec,
+    )
+
+    pp = mesh.shape[pp_axis]
+    if split is None:
+        try:
+            split = parallel_state.get_pipeline_model_parallel_split_rank()
+        except RuntimeError:
+            split = None  # parallel_state not initialized: require split=
+    if split is None:
+        raise ValueError(
+            "pass split= or initialize_model_parallel(..., "
+            "pipeline_model_parallel_split_rank_=...) — an encoder-decoder "
+            "pipeline needs the split rank (reference common.py:90)")
+    if not (0 < split < pp):
+        raise ValueError(f"split must be in (0, {pp}); got {split}")
+
+    base = param_specs(config)
+
+    def pp_spec(spec):
+        return P(pp_axis, *spec[1:])
+
+    specs = dict(base)
+    for side in ("enc_layers", "dec_layers"):
+        specs[side] = jax.tree.map(
+            pp_spec, base[side], is_leaf=lambda s: isinstance(s, P))
+
+    def pre_enc_fn(shared, mb):
+        return _embed(mb["src"], shared, "pos_enc", config, tp_axis)
+
+    def pre_dec_fn(shared, mb):
+        return _embed(mb["dec_in"], shared, "pos_dec", config, tp_axis)
+
+    def enc_stage_fn(chunk, x):
+        layer = partial(encoder_layer, config=config, axis_name=tp_axis)
+        if config.checkpoint_layers:
+            layer = jax.checkpoint(layer)
+        return jax.lax.scan(lambda c, lp: (layer(c, lp), None), x, chunk)[0]
+
+    def dec_stage_fn(chunk, x, enc_out):
+        layer = partial(decoder_layer, config=config, axis_name=tp_axis)
+        if config.checkpoint_layers:
+            layer = jax.checkpoint(layer)
+        return jax.lax.scan(
+            lambda c, lp: (layer(c, enc_out, lp), None), x, chunk)[0]
+
+    def post_fn(shared, y, mb):
+        logits = _lm_head(y, shared, config, tp_axis)
+        return _ce(logits, mb["targets"], tp_axis)
+
+    def local_step(params, opt_state, src, dec_in, targets):
+        shared = {k: v for k, v in params.items()
+                  if k not in ("enc_layers", "dec_layers")}
+        B = src.shape[0]
+        mb = {
+            "src": src.reshape(num_microbatches, B // num_microbatches, -1),
+            "dec_in": dec_in.reshape(num_microbatches,
+                                     B // num_microbatches, -1),
+            "targets": targets.reshape(num_microbatches,
+                                       B // num_microbatches, -1),
+        }
+        loss, (g_sh, g_enc, g_dec) = forward_backward_pipelining_encdec(
+            pre_enc_fn, pre_dec_fn, enc_stage_fn, dec_stage_fn, post_fn,
+            shared, params["enc_layers"], params["dec_layers"], mb,
+            split=split, axis_name=pp_axis,
+        )
+        grads = {**g_sh, "enc_layers": g_enc, "dec_layers": g_dec}
+        if dp_axis is not None:
+            loss = jax.lax.pmean(loss, dp_axis)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+        params, opt_state = optimizer.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    from apex_tpu.optimizers.fused_adam import AdamState
+
+    sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
+    data = P(dp_axis) if dp_axis else P()
+    return jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, sspec, data, data, data),
+        out_specs=(specs, sspec, P()),
+        check_vma=False,
+    ))

@@ -16,6 +16,11 @@ from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_with_in
     forward_backward_pipelining_with_interleaving,
     interleaved_pipelined_apply,
 )
+from apex_tpu.transformer.pipeline_parallel.schedules.tick_schedule_encdec import (
+    forward_backward_pipelining_encdec,
+    pad_stage_layout_encdec,
+    unpad_stage_layout_encdec,
+)
 
 
 def get_forward_backward_func(virtual_pipeline_model_parallel_size, pipeline_model_parallel_size):
@@ -32,9 +37,12 @@ __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
+    "forward_backward_pipelining_encdec",
     "interleaved_pipelined_apply",
     "make_pipeline_loss_fn",
+    "pad_stage_layout_encdec",
     "pipelined_apply",
     "broadcast_from_last_stage",
     "build_model",
+    "unpad_stage_layout_encdec",
 ]

@@ -174,9 +174,15 @@ def pipelined_fwd_bwd(
             v, m, ok = decode_fwd(u)
             m_c = jnp.clip(m, 0, M - 1)
             mb = _index_tree(microbatches, m_c)
-            x_pre = pre_fn(shared_params, mb)
+            # cond-gated like the post head: the embedding gather (+ its
+            # tp collective) runs only where the seed is consumed; the
+            # predicate is tp-uniform so the collective stays in
+            # lockstep within the taken branch
             first_vs = (stage == 0) & (v == 0)
-            x = jnp.where(first_vs, x_pre.astype(act_msg.dtype), act_msg)
+            x = jax.lax.cond(
+                first_vs,
+                lambda: pre_fn(shared_params, mb).astype(act_msg.dtype),
+                lambda: act_msg)
             slot = jnp.clip(u, 0, n_slots - 1) % S_buf
             written = jax.lax.dynamic_update_index_in_dim(xbuf, x, slot, 0)
             xbuf = jnp.where(ok, written, xbuf)

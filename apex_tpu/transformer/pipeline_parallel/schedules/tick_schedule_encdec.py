@@ -1,0 +1,334 @@
+"""Encoder-decoder (T5-style) 1F1B tick schedule: dual activation
+streams across the pipeline split rank.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_without_interleaving.py:50-84`` (``ModelType.
+encoder_and_decoder``: ranks before the split carry ONE tensor — the
+encoder stream at the encoder sequence length; ranks at/after the split
+carry TWO — the decoder stream plus the encoder's final output
+forwarded stage-to-stage for cross-attention) and ``schedules/
+common.py:85-100`` (``add_encoder``/``add_decoder`` role assignment by
+``pipeline_model_parallel_split_rank``).
+
+TPU-native redesign.  The reference routes per-rank control flow: each
+rank materializes a different send/recv shape list and runs only its
+own role's module.  Under SPMD (one program on every pp rank via
+``shard_map``) the same semantics come from three moves:
+
+- **Uniform dual-stream message.**  Every hop ``ppermute``s the PAIR
+  ``(a_enc, a_dec)``.  Before the split, ``a_enc`` is the live encoder
+  stream and ``a_dec`` rides as zeros; at/after the split, ``a_dec`` is
+  the live decoder stream and ``a_enc`` carries the encoder's final
+  output — the exact two-tensor protocol of the reference, expressed as
+  one static shape so XLA compiles a single program.
+- **``lax.cond``-gated roles.**  ``stage < split`` picks the encoder or
+  decoder branch per tick.  The predicate depends only on the stage
+  index — uniform along tp — so tp collectives inside either branch
+  stay in lockstep (the same argument that gates the loss head in
+  :mod:`tick_schedule`).  Only the taken branch executes: encoder
+  stages never pay for decoder FLOPs or vice versa.
+- **Boundary seeding, both directions.**  Stage ``split`` seeds the
+  decoder stream from ``pre_dec_fn`` (the decoder embedding) exactly as
+  stage 0 seeds the encoder stream from ``pre_enc_fn``; in backward,
+  stage ``split`` routes the decoder-input cotangent into the shared
+  params via the ``pre_dec_fn`` vjp (cond-gated) while the encoder-
+  output cotangent — accumulated through every decoder stage's
+  cross-attention — rides the reverse ring into the encoder stages.
+
+Interleaving (vpp > 1) is intentionally unsupported, matching the
+reference: its interleaved schedule asserts ``encoder_or_decoder``
+only.  Timing/memory are the vpp=1 case of :mod:`tick_schedule`:
+warmup P-1 forward ticks, M+?? steady 1F1B ticks, P-1 backward
+cooldown, activation buffer of min(2P-1, M) stream PAIRS.
+
+Per-stage parameter layout: SPMD needs every stage to hold the same
+pytree structure, so encoder chunks live in a ``(P·lpc_e, ...)``
+stacked array (real layers on stages < split, zeros elsewhere) and
+decoder chunks mirror that — see :func:`pad_stage_layout_encdec`.  The
+zero chunks cost HBM but no FLOPs (their branch never runs); their
+grads come back zero, so optimizers keep them at zero (zero params
+see zero weight-decay pull).
+"""
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.tick_schedule import (
+    _index_tree,
+    _mask_add,
+)
+
+
+def pad_stage_layout_encdec(enc_layers, dec_layers, pp: int, split: int):
+    """Stack per-side layer trees into the uniform SPMD layout.
+
+    ``enc_layers`` leaves are ``(L_enc, ...)``; returns leaves of shape
+    ``(pp·lpc_e, ...)`` with stages ``< split`` holding the real
+    chunks (lpc_e = L_enc // split) and later stages zeros — and the
+    mirrored layout for ``dec_layers`` (real on stages >= split).
+    Shard the results over the pp mesh axis on dim 0."""
+    if not (0 < split < pp):
+        raise ValueError(f"split must be in (0, {pp}); got {split}")
+
+    def pad(tree, n_layers, first, count, lpc):
+        if n_layers % count:
+            raise ValueError(
+                f"{n_layers} layers do not divide over {count} stages"
+            )
+
+        def one(a):
+            out = jnp.zeros((pp * lpc, *a.shape[1:]), a.dtype)
+            return jax.lax.dynamic_update_slice_in_dim(
+                out, a, first * lpc, axis=0
+            )
+
+        return jax.tree.map(one, tree)
+
+    L_e = jax.tree.leaves(enc_layers)[0].shape[0]
+    L_d = jax.tree.leaves(dec_layers)[0].shape[0]
+    lpc_e = L_e // split
+    lpc_d = L_d // (pp - split)
+    return (
+        pad(enc_layers, L_e, 0, split, lpc_e),
+        pad(dec_layers, L_d, split, pp - split, lpc_d),
+    )
+
+
+def unpad_stage_layout_encdec(enc_padded, dec_padded, pp: int, split: int):
+    """Inverse of :func:`pad_stage_layout_encdec` (e.g. for checkpoints
+    interchangeable with the non-pipelined layout)."""
+
+    def cut(tree, first, count):
+        def one(a):
+            lpc = a.shape[0] // pp
+            return jax.lax.dynamic_slice_in_dim(
+                a, first * lpc, count * lpc, axis=0
+            )
+
+        return jax.tree.map(one, tree)
+
+    return cut(enc_padded, 0, split), cut(dec_padded, split, pp - split)
+
+
+def pipelined_fwd_bwd_encdec(
+    pre_enc_fn: Callable,
+    pre_dec_fn: Callable,
+    enc_stage_fn: Callable,
+    dec_stage_fn: Callable,
+    post_fn: Callable,
+    shared_params,
+    enc_stage_params,
+    dec_stage_params,
+    microbatches,
+    *,
+    split: int,
+    axis_name: str = PIPELINE_AXIS,
+):
+    """1F1B fwd+bwd for an encoder-decoder model over the pp axis.
+
+    - ``pre_enc_fn(shared, mb) -> x_enc`` — encoder embedding, stage 0
+    - ``pre_dec_fn(shared, mb) -> x_dec`` — decoder embedding, stage
+      ``split`` (reference common.py:92: ``pre_process`` is True on
+      rank 0 AND rank split)
+    - ``enc_stage_fn(enc_chunk, x_enc) -> y_enc``
+    - ``dec_stage_fn(dec_chunk, x_dec, enc_out) -> y_dec`` —
+      ``enc_out`` is the encoder's final output (cross-attention keys)
+    - ``post_fn(shared, y_dec, mb) -> scalar loss`` — stage P-1
+
+    ``enc_stage_params`` / ``dec_stage_params`` are this stage's local
+    chunks in the :func:`pad_stage_layout_encdec` layout (zeros on the
+    other side's stages).  Returns ``(loss, (shared_grads,
+    enc_stage_grads, dec_stage_grads))``; shared grads are LOCAL
+    per-stage contributions — psum over the pipeline axis to combine.
+    """
+    Pp = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    M = jax.tree.leaves(microbatches)[0].shape[0]
+
+    n_slots = M
+    delta = Pp - 1
+    S_buf = min(2 * Pp - 1, n_slots)
+    inv_m = 1.0 / M
+    is_enc = stage < split
+
+    mb0 = _index_tree(microbatches, jnp.int32(0))
+    xe_shape = jax.eval_shape(pre_enc_fn, shared_params, mb0)
+    xd_shape = jax.eval_shape(pre_dec_fn, shared_params, mb0)
+    zero_enc = jnp.zeros(xe_shape.shape, xe_shape.dtype)
+    zero_dec = jnp.zeros(xd_shape.shape, xd_shape.dtype)
+
+    perm_fwd = [(i, (i + 1) % Pp) for i in range(Pp)]
+    perm_bwd = [(i, (i - 1) % Pp) for i in range(Pp)]
+
+    def stage_pair_fn(chunks, x_pair):
+        """The SPMD role dispatch: encoder stages transform the enc
+        stream (dec rides zeros); decoder stages pass the enc output
+        through untouched and transform the dec stream.  One branch
+        executes per stage; vjp of the cond is the cond of the vjps,
+        with zero cotangents for the untaken branch's params."""
+        enc_chunk, dec_chunk = chunks
+        xe, xd = x_pair
+        return jax.lax.cond(
+            is_enc,
+            lambda: (enc_stage_fn(enc_chunk, xe), zero_dec),
+            lambda: (xe, dec_stage_fn(dec_chunk, xd, xe)),
+        )
+
+    def tick(carry, t, do_fwd, do_bwd, do_post):
+        (msg_e, msg_d, cot_e, cot_d, xbuf_e, xbuf_d,
+         loss_sum, g_sh, g_enc, g_dec) = carry
+        seed_dx = zero_dec
+
+        if do_fwd:
+            u = t - stage
+            m = jnp.clip(u, 0, M - 1)
+            ok = (u >= 0) & (u < n_slots)
+            mb = _index_tree(microbatches, m)
+            # stream seeds: stage 0 embeds the source, stage `split`
+            # embeds the target.  cond-gated (not masked-but-executed):
+            # the embedding gather + its tp collective run only on the
+            # seeding stage — the predicates are tp-uniform, so the
+            # collectives inside the taken branch stay in lockstep
+            xe = jax.lax.cond(
+                stage == 0,
+                lambda: pre_enc_fn(shared_params, mb).astype(msg_e.dtype),
+                lambda: msg_e)
+            xd = jax.lax.cond(
+                stage == split,
+                lambda: pre_dec_fn(shared_params, mb).astype(msg_d.dtype),
+                lambda: msg_d)
+            slot = jnp.clip(u, 0, n_slots - 1) % S_buf
+            xbuf_e = jnp.where(
+                ok, jax.lax.dynamic_update_index_in_dim(xbuf_e, xe, slot, 0),
+                xbuf_e)
+            xbuf_d = jnp.where(
+                ok, jax.lax.dynamic_update_index_in_dim(xbuf_d, xd, slot, 0),
+                xbuf_d)
+            ye, yd = stage_pair_fn((enc_stage_params, dec_stage_params),
+                                   (xe, xd))
+            if do_post:
+                last = ok & (stage == Pp - 1)
+
+                def _post(operand):
+                    loss_sum, g_sh = operand
+                    loss_m, post_vjp = jax.vjp(
+                        lambda sh, h: post_fn(sh, h, mb), shared_params, yd
+                    )
+                    d_sh_post, dy_seed = post_vjp(
+                        jnp.asarray(inv_m, loss_m.dtype))
+                    g_sh = jax.tree.map(jnp.add, g_sh, d_sh_post)
+                    return (loss_sum + loss_m * inv_m, g_sh,
+                            dy_seed.astype(zero_dec.dtype))
+
+                loss_sum, g_sh, seed_dx = jax.lax.cond(
+                    last, _post,
+                    lambda op: (op[0], op[1], zero_dec), (loss_sum, g_sh)
+                )
+            msg_e = jax.lax.ppermute(ye, axis_name, perm_fwd)
+            msg_d = jax.lax.ppermute(yd, axis_name, perm_fwd)
+
+        if do_bwd:
+            ub = t - delta - (Pp - 1) + stage
+            ok_b = (ub >= 0) & (ub < n_slots)
+            m_b = jnp.clip(ub, 0, M - 1)
+            slot = jnp.clip(ub, 0, n_slots - 1) % S_buf
+            xe_s = jax.lax.dynamic_index_in_dim(xbuf_e, slot, 0,
+                                                keepdims=False)
+            xd_s = jax.lax.dynamic_index_in_dim(xbuf_d, slot, 0,
+                                                keepdims=False)
+            last = stage == Pp - 1
+            # the last stage's enc-output passthrough feeds nothing
+            # downstream (the ring wraps to stage 0's seed), so its
+            # cotangent seed is zero; the dec stream seeds from the
+            # loss head's vjp
+            dye = jnp.where(last, jnp.zeros_like(cot_e), cot_e)
+            dyd = jnp.where(last, seed_dx, cot_d)
+            _, pair_vjp = jax.vjp(
+                stage_pair_fn, (enc_stage_params, dec_stage_params),
+                (xe_s, xd_s))
+            (d_enc_c, d_dec_c), (dxe, dxd) = pair_vjp((dye, dyd))
+            g_enc = _mask_add(g_enc, d_enc_c, ok_b)
+            g_dec = _mask_add(g_dec, d_dec_c, ok_b)
+
+            mb = _index_tree(microbatches, m_b)
+            # stage 0: encoder-input cotangent -> source embedding grads
+            pre_e = ok_b & (stage == 0)
+
+            def _pre_e(g_sh):
+                _, vjp = jax.vjp(lambda sh: pre_enc_fn(sh, mb),
+                                 shared_params)
+                (d_sh,) = vjp(dxe.astype(xe_shape.dtype))
+                return jax.tree.map(jnp.add, g_sh, d_sh)
+
+            g_sh = jax.lax.cond(pre_e, _pre_e, lambda g: g, g_sh)
+            # stage split: decoder-input cotangent -> target embedding
+            # grads (it must NOT ride the ring into the encoder side;
+            # encoder stages' zero-output dec branch would ignore it,
+            # but the pre_dec vjp is where it belongs)
+            pre_d = ok_b & (stage == split)
+
+            def _pre_d(g_sh):
+                _, vjp = jax.vjp(lambda sh: pre_dec_fn(sh, mb),
+                                 shared_params)
+                (d_sh,) = vjp(dxd.astype(xd_shape.dtype))
+                return jax.tree.map(jnp.add, g_sh, d_sh)
+
+            g_sh = jax.lax.cond(pre_d, _pre_d, lambda g: g, g_sh)
+            cot_e = jax.lax.ppermute(dxe, axis_name, perm_bwd)
+            cot_d = jax.lax.ppermute(dxd, axis_name, perm_bwd)
+
+        return (msg_e, msg_d, cot_e, cot_d, xbuf_e, xbuf_d,
+                loss_sum, g_sh, g_enc, g_dec), None
+
+    xbuf_e0 = jnp.zeros((S_buf, *xe_shape.shape), xe_shape.dtype)
+    xbuf_d0 = jnp.zeros((S_buf, *xd_shape.shape), xd_shape.dtype)
+    g_sh0 = jax.tree.map(jnp.zeros_like, shared_params)
+    g_enc0 = jax.tree.map(jnp.zeros_like, enc_stage_params)
+    g_dec0 = jax.tree.map(jnp.zeros_like, dec_stage_params)
+    carry = (zero_enc, zero_dec, zero_enc, zero_dec, xbuf_e0, xbuf_d0,
+             jnp.float32(0.0), g_sh0, g_enc0, g_dec0)
+
+    def run(carry, lo, hi, **kw):
+        if hi <= lo:
+            return carry
+        body = partial(tick, **kw)
+        carry, _ = jax.lax.scan(
+            lambda c, t: body(c, t), carry,
+            jnp.arange(lo, hi, dtype=jnp.int32))
+        return carry
+
+    steady_end = n_slots + Pp - 1
+    carry = run(carry, 0, delta, do_fwd=True, do_bwd=False, do_post=False)
+    carry = run(carry, delta, steady_end, do_fwd=True, do_bwd=True,
+                do_post=True)
+    carry = run(carry, steady_end, steady_end + delta, do_fwd=False,
+                do_bwd=True, do_post=False)
+
+    loss_sum, g_sh, g_enc, g_dec = carry[6], carry[7], carry[8], carry[9]
+    loss = jax.lax.psum(loss_sum, axis_name)
+    return loss, (g_sh, g_enc, g_dec)
+
+
+def forward_backward_pipelining_encdec(
+    pre_enc_fn, pre_dec_fn, enc_stage_fn, dec_stage_fn, post_fn,
+    shared_params, enc_stage_params, dec_stage_params, microbatches,
+    *, split: int, axis_name: str = PIPELINE_AXIS,
+):
+    """Run the encoder-decoder 1F1B schedule; returns
+    ``(loss, (shared_grads, enc_stage_grads, dec_stage_grads))`` with
+    shared-param grads psum'd over the pipeline axis (each contribution
+    lives on exactly one stage: source embedding on 0, target embedding
+    on ``split``, head on P-1 — the reference's embedding-grad
+    allreduce between first/split/last ranks,
+    ``apex/transformer/parallel_state.py:316-340`` embedding groups)."""
+    loss, (g_sh, g_enc, g_dec) = pipelined_fwd_bwd_encdec(
+        pre_enc_fn, pre_dec_fn, enc_stage_fn, dec_stage_fn, post_fn,
+        shared_params, enc_stage_params, dec_stage_params, microbatches,
+        split=split, axis_name=axis_name,
+    )
+    g_sh = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_sh)
+    return loss, (g_sh, g_enc, g_dec)
