@@ -38,6 +38,36 @@ NEG_INF = -1e30
 _LANES = 128
 
 
+# ------------------------------------------------------------ block tuning
+# Measured per-shape block targets, keyed (seq_q, head_dim, dtype name)
+# -> (block_q, block_k).  Populated from benchmarks/flash_sweep.py runs
+# on real hardware (each entry's provenance is recorded in
+# benchmarks/RESULTS.md); consulted by flash_attention_pallas when the
+# caller passes no explicit blocks, before the _pick_block static
+# heuristic (VERDICT r4 task 4: sweep results feed per-shape defaults).
+_TUNED_BLOCKS: dict = {}
+
+
+def tuned_blocks(seq_q, head_dim, dtype):
+    """(block_q, block_k) measured best for this shape, or None."""
+    return _TUNED_BLOCKS.get(
+        (int(seq_q), int(head_dim), jnp.dtype(dtype).name))
+
+
+def set_tuned_blocks(table) -> None:
+    """Install sweep-measured block targets: ``{(S, D, dtype): (bq,
+    bk)}`` or an iterable of ``[[S, D, dtype], [bq, bk]]`` pairs (the
+    exact JSON flash_sweep.py prints as ``tuned_blocks_table``).  The
+    dtype key is normalized through ``jnp.dtype`` so ``jnp.bfloat16``,
+    ``'bfloat16'``, and ``np.dtype`` all land on the same entry."""
+    items = table.items() if hasattr(table, "items") else table
+    for key, val in items:
+        s, d, name = key
+        bq, bk = val
+        _TUNED_BLOCKS[(int(s), int(d), jnp.dtype(name).name)] = (
+            int(bq), int(bk))
+
+
 def _pick_block(seq, target, align=_LANES):
     """Largest divisor of ``seq`` ≤ target, preferring ``align``-aligned
     divisors (128 for the lane dim, 8 for sublanes) — but only when the
@@ -491,6 +521,14 @@ def flash_attention_pallas(q, k, v, causal=True, softmax_scale=None,
         from apex_tpu.ops.attention import padding_bias
 
         bias = padding_bias(kv_mask)[:, None, :]
+    if (block_q is None or block_k is None) and k.shape[2] == Sq:
+        # self-attention shapes only: the sweep measures Sk == Sq, and a
+        # block_k tuned for that must not leak onto cross-attention
+        # calls with a different key length
+        tuned = tuned_blocks(Sq, D, q.dtype)
+        if tuned is not None:
+            block_q = block_q or tuned[0]
+            block_k = block_k or tuned[1]
     out = _flash_pallas(qf, kf, vf, bias, scale, causal, q_offset, k_offset,
                         block_q or 1024, block_k or 1024, interpret, H, Hkv)
     return out.reshape(B, H, Sq, D)

@@ -160,6 +160,18 @@ def main():
     # call memory-bound honestly
     print(json.dumps({"summary": results}), flush=True)
 
+    # table-ready per-shape defaults: best fwd+bwd combo per shape
+    # (falling back to the fwd-only best when only fwd ran), in the
+    # list-of-pairs format set_tuned_blocks accepts directly:
+    #   set_tuned_blocks(json.loads(line)["tuned_blocks_table"])
+    table = {}
+    for r in results:
+        B, H, S, D = r["shape"]
+        if (S, D) not in table or not r["fwd_only"]:
+            table[(S, D)] = [r["bq"], r["bk"]]
+    pairs = [[[s, d, "bfloat16"], v] for (s, d), v in table.items()]
+    print(json.dumps({"tuned_blocks_table": pairs}), flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -328,6 +328,56 @@ class TestPallasFlashAttention:
         ref = mha_reference(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
+    def test_tuned_block_table_consulted(self, monkeypatch):
+        """Sweep-installed per-shape blocks must reach the kernel when
+        the caller passes none, lose to explicit args, and miss cleanly
+        for unkeyed shapes (the _pick_block fallback)."""
+        from apex_tpu.ops import flash_attention_pallas as fap
+
+        q, k, v = self._inputs()
+        monkeypatch.setattr(fap, "_TUNED_BLOCKS", {})
+        fap.set_tuned_blocks({(256, 64, "float32"): (128, 128)})
+        assert fap.tuned_blocks(256, 64, jnp.float32) == (128, 128)
+        assert fap.tuned_blocks(512, 64, jnp.float32) is None
+
+        seen = []
+        orig = fap._pick_block
+
+        def spy(seq, target, align=fap._LANES):
+            seen.append(target)
+            return orig(seq, target, align)
+
+        monkeypatch.setattr(fap, "_pick_block", spy)
+        out = fap.flash_attention_pallas(q, k, v, causal=True, interpret=True)
+        assert seen[:2] == [128, 128]  # table hit, not the 1024 default
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        seen.clear()
+        fap.flash_attention_pallas(q, k, v, causal=True, block_q=256,
+                                   block_k=256, interpret=True)
+        assert seen[:2] == [256, 256]  # explicit args beat the table
+        # cross-attention (Sk != Sq) must NOT pick up the self-attn entry
+        seen.clear()
+        q2, k2, v2 = self._inputs(Sq=256, Sk=128)
+        fap.flash_attention_pallas(q2, k2, v2, causal=False, interpret=True)
+        assert seen[:2] == [1024, 1024]
+
+    def test_tuned_blocks_json_round_trip(self, monkeypatch):
+        """The sweep's printed tuned_blocks_table JSON must install
+        directly, and dtype keys normalize (jnp.bfloat16 == 'bfloat16')."""
+        import json
+
+        from apex_tpu.ops import flash_attention_pallas as fap
+
+        monkeypatch.setattr(fap, "_TUNED_BLOCKS", {})
+        line = json.dumps(
+            {"tuned_blocks_table": [[[1024, 64, "bfloat16"], [512, 256]]]})
+        fap.set_tuned_blocks(json.loads(line)["tuned_blocks_table"])
+        assert fap.tuned_blocks(1024, 64, jnp.bfloat16) == (512, 256)
+        fap.set_tuned_blocks({(2048, 128, jnp.float32): (256, 512)})
+        assert fap.tuned_blocks(2048, 128, "float32") == (256, 512)
+
     @pytest.mark.parametrize("causal", [True, False])
     @pytest.mark.slow
     def test_backward_matches_reference(self, causal):
