@@ -57,6 +57,13 @@ def parse_args():
     p.add_argument("--keep", type=int, default=3,
                    help="multi-process: retain this many step_* dirs "
                         "(min 3 — younger dirs may still be writing)")
+    p.add_argument("--data", default=None,
+                   help="memmapped token file (flat binary of ids); "
+                        "consumed as non-overlapping seq+1 windows. "
+                        "Default: a synthetic random corpus")
+    p.add_argument("--data-dtype", default="uint16",
+                   choices=["uint16", "int32"],
+                   help="token id dtype of --data")
     p.add_argument("--resume", default=None, help="checkpoint dir to resume")
     return p.parse_args()
 
@@ -134,10 +141,21 @@ def main():
     else:
         step = make_train_step(config, optimizer, mesh, loss_scaler=scaler)
 
-    # Megatron sampling over a synthetic corpus: each dp rank draws its
-    # slice of the global batch; consumed_samples resumes exactly.
-    corpus = np.random.RandomState(0).randint(
-        0, args.vocab, size=(4096, args.seq + 1))
+    # Corpus: a memmapped token file (--data, the real-pretraining path:
+    # the OS pages in only the rows each batch touches) or a synthetic
+    # random corpus.  Either way batches assemble through the native
+    # multithreaded gather_rows on a background prefetch thread.
+    if args.data:
+        raw = np.memmap(args.data, dtype=args.data_dtype, mode="r")
+        n = len(raw) // (args.seq + 1)
+        if n < args.global_batch:
+            raise ValueError(
+                f"--data holds {n} samples of seq+1={args.seq + 1} tokens; "
+                f"need at least one global batch ({args.global_batch})")
+        corpus = raw[: n * (args.seq + 1)].reshape(n, args.seq + 1)
+    else:
+        corpus = np.random.RandomState(0).randint(
+            0, args.vocab, size=(4096, args.seq + 1))
     start_step = 0
 
     multiproc = jax.process_count() > 1
@@ -241,10 +259,29 @@ def main():
 
     sampler = epoch_cycling_batches(start_step * args.global_batch)
 
+    # batch assembly off the training thread: the native multithreaded
+    # gather_rows pulls the sampled rows (reference's DataLoader-worker
+    # role; on a memmap corpus only the touched rows page in), a
+    # depth-2 prefetch queue keeps it a step ahead of the device.
+    # Token ids validate per batch — exactly the rows about to train —
+    # so a bad id anywhere in --data fails loudly instead of wrapping
+    # through the embedding lookup (the prefetch worker's exception
+    # re-raises on the training thread).
+    def assemble(idx):
+        batch = io.native.gather_rows(corpus, np.asarray(idx))
+        if args.data:
+            lo, hi = int(batch.min()), int(batch.max())
+            if lo < 0 or hi >= args.vocab:
+                raise ValueError(
+                    f"--data batch has token id "
+                    f"{lo if lo < 0 else hi} outside [0, vocab={args.vocab})")
+        return batch.astype(np.int32)
+
+    prefetch = io.PrefetchIterator(sampler, size=2, transform=assemble)
+
     t0 = time.time()
     for i in range(start_step, start_step + args.steps):
-        idx = next(sampler)
-        batch = corpus[np.asarray(idx)]
+        batch = next(prefetch)
         tokens = jnp.asarray(batch[:, :-1])
         targets = jnp.asarray(batch[:, 1:])
         if scaler is not None:
