@@ -367,6 +367,7 @@ def make_pp_train_step(
     tp_axis: str = "tp",
     pp_axis: str = "pp",
     dp_axis: Optional[str] = None,
+    loss_scaler=None,
 ):
     """Encoder-decoder pipeline train step (tp × pp × dp) over the
     dual-stream 1F1B schedule.  ``split`` defaults to
@@ -375,9 +376,17 @@ def make_pp_train_step(
     decoder).  Params (and optimizer state) must be in the
     :func:`params_to_pp_layout` layout.
 
-    Returns ``step(params, opt_state, src, dec_in, targets) ->
-    (params, opt_state, loss)`` (jitted); token arrays are (B, S) and
-    split into ``num_microbatches`` along B.
+    ``loss_scaler``: fp16 dynamic loss scaling through the dual-stream
+    pipeline (reference ``apex/transformer/amp/grad_scaler.py``): the
+    loss head seeds the SCALED backward, found_inf is agreed over tp
+    AND pp, and the step signature grows a scaler state —
+    ``step(params, opt_state, scaler_state, src, dec_in, targets)``.
+
+    Returns (jitted) ``step(params, opt_state, src, dec_in, targets)
+    -> (params, opt_state, loss)`` without a scaler, or
+    ``step(params, opt_state, scaler_state, src, dec_in, targets) ->
+    (params, opt_state, scaler_state, loss)`` with one; token arrays
+    are (B, S) and split into ``num_microbatches`` along B.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -433,7 +442,7 @@ def make_pp_train_step(
         logits = _lm_head(y, shared, config, tp_axis)
         return _ce(logits, mb["targets"], tp_axis)
 
-    def local_step(params, opt_state, src, dec_in, targets):
+    def run_schedule(params, src, dec_in, targets, post_fn_):
         shared = {k: v for k, v in params.items()
                   if k not in ("enc_layers", "dec_layers")}
         B = src.shape[0]
@@ -445,21 +454,56 @@ def make_pp_train_step(
                                        B // num_microbatches, -1),
         }
         loss, (g_sh, g_enc, g_dec) = forward_backward_pipelining_encdec(
-            pre_enc_fn, pre_dec_fn, enc_stage_fn, dec_stage_fn, post_fn,
+            pre_enc_fn, pre_dec_fn, enc_stage_fn, dec_stage_fn, post_fn_,
             shared, params["enc_layers"], params["dec_layers"], mb,
             split=split, axis_name=pp_axis,
         )
-        grads = {**g_sh, "enc_layers": g_enc, "dec_layers": g_dec}
+        return loss, {**g_sh, "enc_layers": g_enc, "dec_layers": g_dec}
+
+    def dp_sync(loss, grads):
         if dp_axis is not None:
             loss = jax.lax.pmean(loss, dp_axis)
             grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
+        return loss, grads
+
+    def local_step(params, opt_state, src, dec_in, targets):
+        loss, grads = run_schedule(params, src, dec_in, targets, post_fn)
+        loss, grads = dp_sync(loss, grads)
         params, opt_state = optimizer.update(grads, opt_state, params)
         return params, opt_state, loss
+
+    def scaled_local_step(params, opt_state, scaler_state, src, dec_in,
+                          targets):
+        from apex_tpu.models.gpt import _apply_scaled_update
+
+        scale = scaler_state.loss_scale
+
+        def post_scaled(shared, y, mb_):
+            # the schedule seeds backward from post_fn's output:
+            # scaling here scales every cotangent in BOTH streams
+            return post_fn(shared, y, mb_) * scale
+
+        scaled_loss, grads = run_schedule(params, src, dec_in, targets,
+                                          post_scaled)
+        loss, grads = dp_sync(scaled_loss / scale, grads)
+        # stage- (pp) and tp-sharded grads can overflow on one rank
+        # only; every model axis must agree on the skip decision
+        params, opt_state, scaler_state = _apply_scaled_update(
+            loss_scaler, scaler_state, grads, optimizer, opt_state,
+            params, [tp_axis, pp_axis])
+        return params, opt_state, scaler_state, loss
 
     from apex_tpu.optimizers.fused_adam import AdamState
 
     sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
     data = P(dp_axis) if dp_axis else P()
+    if loss_scaler is not None:
+        return jax.jit(jax.shard_map(
+            scaled_local_step, mesh=mesh,
+            in_specs=(specs, sspec, P(), data, data, data),
+            out_specs=(specs, sspec, P(), P()),
+            check_vma=False,
+        ))
     return jax.jit(jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, sspec, data, data, data),

@@ -138,8 +138,11 @@ def main():
     from apex_tpu.models.gpt import init_params
 
     cfg = _config(args)
-    shapes = jax.eval_shape(lambda k: init_params(cfg, k),
-                            jax.random.PRNGKey(0))
+    # abstract key: the parent must NOT touch the (possibly wedged)
+    # backend — a concrete PRNGKey would initialize it; eval_shape with
+    # a ShapeDtypeStruct stays purely abstract
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    shapes = jax.eval_shape(lambda k: init_params(cfg, k), key)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(shapes))
     budget = analytic_budget(n_params, args.layers, args.hidden, args.seq,
                              args.batch, args.vocab)

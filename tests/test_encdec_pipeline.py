@@ -160,6 +160,69 @@ class TestEncDecPipelineParity:
         ref_loss = t5_loss(params, src, dec_in, tgt, cfg)
         np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
 
+    def test_fp16_loss_scaling_matches_oracle(self, devices8):
+        """make_pp_train_step(loss_scaler=...) through the dual-stream
+        pipeline vs a single-device scaled oracle: identical discrete
+        scaler decisions (incl. the engineered first-step overflow and
+        the held Adam counter), matching losses and params."""
+        from apex_tpu.amp import DynamicLossScaler
+
+        scaler = DynamicLossScaler(
+            init_scale=2.0 ** 127, backoff_factor=2.0 ** -4,
+            growth_factor=2.0, growth_interval=3, hysteresis=1,
+        )
+        mesh = Mesh(np.array(devices8[:4]).reshape(4, 1), ("pp", "tp"))
+        params = init_params(CFG, jax.random.PRNGKey(7))
+        opt = FusedAdam(lr=1e-2)
+        src, dec_in, tgt = _data(seed=7)
+        STEPS = 6
+
+        # single-device scaled oracle
+        o_params, o_state, o_sstate = params, opt.init(params), scaler.init()
+        o_losses, o_scales = [], []
+
+        @jax.jit
+        def oracle_step(p, s, ss):
+            def f(p):
+                return t5_loss(p, src, dec_in, tgt, CFG) * ss.loss_scale
+
+            sloss, grads = jax.value_and_grad(f)(p)
+            grads, finite = scaler.unscale(ss, grads)
+            p, s = opt.update(grads, s, p, grads_finite=finite)
+            return p, s, scaler.update(ss, finite), sloss / ss.loss_scale
+
+        for _ in range(STEPS):
+            o_params, o_state, o_sstate, loss = oracle_step(
+                o_params, o_state, o_sstate)
+            o_losses.append(float(loss))
+            o_scales.append(float(o_sstate.loss_scale))
+
+        pp_params = params_to_pp_layout(params, pp=4, split=2)
+        state, sstate = opt.init(pp_params), scaler.init()
+        step = make_pp_train_step(CFG, opt, mesh, num_microbatches=4,
+                                  split=2, loss_scaler=scaler)
+        losses, scales = [], []
+        for _ in range(STEPS):
+            pp_params, state, sstate, loss = step(
+                pp_params, state, sstate, src, dec_in, tgt)
+            losses.append(float(loss))
+            scales.append(float(sstate.loss_scale))
+
+        np.testing.assert_array_equal(np.asarray(scales),
+                                      np.asarray(o_scales))
+        assert int(state.step) == int(o_state.step)
+        assert np.isinf(losses[0]) and np.isinf(o_losses[0])
+        np.testing.assert_allclose(losses[1:], o_losses[1:], rtol=1e-4)
+        enc_u, dec_u = unpad_stage_layout_encdec(
+            pp_params["enc_layers"], pp_params["dec_layers"], 4, 2)
+        np.testing.assert_allclose(
+            np.asarray(enc_u["wq"]),
+            np.asarray(o_params["enc_layers"]["wq"]), rtol=5e-3, atol=5e-5)
+        np.testing.assert_allclose(
+            np.asarray(dec_u["co"]),
+            np.asarray(o_params["dec_layers"]["co"]), rtol=5e-3, atol=5e-5)
+        assert losses[-1] < losses[1]  # trained after the overflow step
+
     def test_training_reduces_loss(self, devices8):
         mesh = Mesh(np.array(devices8[:4]).reshape(4, 1), ("pp", "tp"))
         params = params_to_pp_layout(
