@@ -597,6 +597,88 @@ def _device_preflight(timeout_s=420.0) -> Optional[str]:
     return None
 
 
+def _load_sections(path):
+    """Parse a sections sidecar: ``({section: result}, [timestamps])``,
+    newest record winning on duplicates.  Tolerates a missing file and
+    skips corrupt lines individually — a wedge can kill the process
+    mid-write, and one truncated line must not discard the rest.
+    Error-only results (skips/timeouts) and the preflight marker are
+    filtered out.  The ONE sidecar parser: the banked fallback and the
+    resume-headline path both read through here."""
+    sections, times = {}, []
+    try:
+        with open(path) as f:
+            lines = list(f)
+    except OSError:
+        return sections, times
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        name, result = rec.get("section"), rec.get("result")
+        if name and name != "preflight" and isinstance(result, (dict, float, int)):
+            if not (isinstance(result, dict) and set(result) == {"error"}):
+                sections[name] = result
+                times.append(rec.get("t", ""))
+    return sections, times
+
+
+def _banked_fallback(err: str) -> dict:
+    """The JSON to emit when the chip is unreachable.
+
+    The tunnel has wedged MID-ROUND twice after real sections completed
+    and streamed to the sidecar; a preflight-error-only JSON would erase
+    that audited evidence from the round artifact.  So: report the
+    banked sections, clearly labeled — ``live: false``, the sidecar
+    timestamps, and the preflight error — never pretending they were
+    measured now.  Sources, newest first: the working sidecar, then the
+    newest committed ``benchmarks/BENCH_sections_r*_partial.jsonl``
+    archive.  With no banked sections anywhere, the old error-only
+    shape stands."""
+    import glob
+
+    candidates = [_SECTIONS_PATH] + sorted(
+        glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "BENCH_sections_r*_partial.jsonl")),
+        reverse=True)
+    for path in candidates:
+        sections, times = _load_sections(path)
+        if not sections:
+            continue
+        adam = sections.get("fused_adam") or {}
+        headline = adam.get("speedup_vs_eager") if isinstance(adam, dict) else None
+        out = {
+            "metric": "fused_adam_step_speedup_vs_eager",
+            "value": headline if headline is not None else -1.0,
+            "unit": "x",
+            "vs_baseline": round(headline / 1.5, 3) if headline is not None else -1.0,
+            "error": err,
+            "live": False,
+            "banked_from": path,
+            "banked_measured_at": [min(times), max(times)] if times else None,
+            "note": ("preflight failed NOW, but these sections were measured "
+                     "on the real chip earlier (streamed+fsynced per section "
+                     "at the timestamps shown) before the tunnel wedged"),
+        }
+        roof = sections.get("matmul_roofline")
+        if isinstance(roof, (int, float)):
+            out["matmul_roofline_tflops"] = round(float(roof), 1)
+        for name in ("fused_adam", "gpt124_s1024", "gpt124_s4096", "gpt345_s1024",
+                     "resnet50_b64", "bert_base_lamb", "flash_attn",
+                     "zero2_vs_fused"):
+            if name in sections:
+                out[name if name != "fused_adam" else "adam"] = sections[name]
+        return out
+    return {
+        "metric": "fused_adam_step_speedup_vs_eager",
+        "value": -1.0,
+        "unit": "x",
+        "vs_baseline": -1.0,
+        "error": err,
+    }
+
+
 def main():
     global _DEADLINE
     import argparse
@@ -626,11 +708,6 @@ def main():
     def want(name):
         return only is None or name in only
 
-    if only is None:
-        try:  # fresh sidecar per full run: stale sections must not mix in
-            open(_SECTIONS_PATH, "w").close()
-        except OSError:
-            pass
     err = _device_preflight()
     if err is not None and "timed out" in err:
         # one retry after a backoff: transient tunnel hiccups recover in
@@ -640,16 +717,19 @@ def main():
         _progress(f"preflight failed ({err}); retrying in 90s")
         time.sleep(90)
         err = _device_preflight()
-    _record_section("preflight", {"error": err} if err else {"ok": True})
     if err is not None:
-        print(json.dumps({
-            "metric": "fused_adam_step_speedup_vs_eager",
-            "value": -1.0,
-            "unit": "x",
-            "vs_baseline": -1.0,
-            "error": err,
-        }), flush=True)
+        # no truncation on a failed preflight: the working sidecar may
+        # hold the previous wedged run's banked sections — the exact
+        # evidence the fallback exists to preserve
+        _record_section("preflight", {"error": err})
+        print(json.dumps(_banked_fallback(err)), flush=True)
         return
+    if only is None:
+        try:  # fresh sidecar per full run: stale sections must not mix in
+            open(_SECTIONS_PATH, "w").close()
+        except OSError:
+            pass
+    _record_section("preflight", {"ok": True})
     # re-arm the deadline now that the chip answered: preflight (and its
     # possible retry) must not eat the section budget
     _DEADLINE = time.monotonic() + _BUDGET_SEC
@@ -683,16 +763,9 @@ def main():
         # a resume run that deliberately excludes fused_adam must not
         # report the -1.0 whole-bench-failure sentinel: reuse the last
         # streamed fused_adam section from the sidecar it is resuming
-        try:
-            with open(_SECTIONS_PATH) as f:
-                for line in f:
-                    rec = json.loads(line)
-                    if rec.get("section") == "fused_adam":
-                        prior = rec.get("result") or {}
-                        if "speedup_vs_eager" in prior:
-                            headline = prior["speedup_vs_eager"]
-        except OSError:
-            pass
+        prior = _load_sections(_SECTIONS_PATH)[0].get("fused_adam")
+        if isinstance(prior, dict) and "speedup_vs_eager" in prior:
+            headline = prior["speedup_vs_eager"]
     out = {
         "metric": "fused_adam_step_speedup_vs_eager",
         "value": headline if headline is not None else -1.0,
