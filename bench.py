@@ -751,7 +751,12 @@ def main():
                  if want("gpt124_s4096") else skipped)
     gpt345_1k = (_try("gpt345_s1024", bench_gpt, 24, 1024, 16, 1024, 8, roof, iters=10)
                  if want("gpt345_s1024") else skipped)
-    resnet = _try("resnet50_b64", bench_resnet) if want("resnet50_b64") else skipped
+    # 900s: the ResNet-50 train step is the widest graph in the suite and
+    # its first compile over the tunnel is the one that hit the 600s
+    # watchdog in round 5 — give the compile headroom before concluding
+    # the tunnel wedged
+    resnet = (_try("resnet50_b64", bench_resnet, section_budget=900.0)
+              if want("resnet50_b64") else skipped)
     bert = _try("bert_base_lamb", bench_bert_lamb) if want("bert_base_lamb") else skipped
     flash = (_try("flash_attn", bench_flash_attn, roof, section_budget=300.0)
              if want("flash_attn") else skipped)
