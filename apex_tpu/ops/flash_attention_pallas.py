@@ -37,6 +37,15 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 _LANES = 128
 
+# Shared by all three kernels: batch·head and q-block (resp. k-block)
+# grid revisits are order-free; only the innermost accumulation dim —
+# where the scratch carry, its init, and its finalize live — is
+# sequential.  Declaring this lets Mosaic software-pipeline the block
+# DMAs across grid steps instead of serializing on the conservative
+# default.
+_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
 
 # ------------------------------------------------------------ block tuning
 # Measured per-shape block targets, keyed (seq_q, head_dim, dtype name)
@@ -222,6 +231,7 @@ def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
         ],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(*inputs)
     return out, lse
@@ -409,6 +419,7 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), dq_dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(*inputs)
 
@@ -453,6 +464,7 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(*inputs)
     return dq, dk, dv

@@ -498,7 +498,12 @@ def _progress(msg):
     print(f"[bench {_t.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
-_BUDGET_SEC = float(os.environ.get("BENCH_DEADLINE_SEC", "1500"))
+#: 2700s: the round-5 audited pace was ~14 min through the GPT sections
+#: before ResNet; 1500s would clamp ResNet's 900s compile headroom to
+#: less than the old 600s watchdog it was raised from.  45 min bounds
+#: the worst case (every section slow but alive) while still letting a
+#: full healthy run finish with room.
+_BUDGET_SEC = float(os.environ.get("BENCH_DEADLINE_SEC", "2700"))
 _DEADLINE = time.monotonic() + _BUDGET_SEC  # re-armed in main() post-preflight
 _DEVICE_WEDGED = False
 _SECTIONS_PATH = os.environ.get("BENCH_SECTIONS_PATH", "BENCH_sections.jsonl")
