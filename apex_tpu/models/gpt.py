@@ -54,6 +54,15 @@ class GPTConfig:
     layernorm_eps: float = 1e-5
     compute_dtype: Any = jnp.bfloat16
     checkpoint_layers: bool = True
+    # What layer remat may keep: "full" saves only the layer inputs (the
+    # reference's tensor_parallel.random.checkpoint semantics — maximum
+    # HBM savings, re-runs the whole layer forward in the backward);
+    # "dots" saves MXU (matmul) outputs and recomputes only the cheap
+    # elementwise/VPU work (LN, gelu, softmax) — trades a little HBM for
+    # skipping the expensive recompute, often the best step time on TPU
+    # where the backward is MXU-bound.  Ignored when checkpoint_layers
+    # is False.
+    remat_policy: str = "full"
     sequence_parallel: bool = False
     # memory-efficient attention core (ops.attention.flash_attention);
     # automatic when context parallelism is active
@@ -75,6 +84,11 @@ class GPTConfig:
             raise ValueError(
                 f"position_embedding_type must be 'learned' or 'rope' "
                 f"(got {self.position_embedding_type!r})"
+            )
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots' "
+                f"(got {self.remat_policy!r})"
             )
 
     @property
@@ -333,6 +347,23 @@ def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None, ep_a
     return x, aux
 
 
+def _remat(layer, config: GPTConfig):
+    """Wrap a layer fn in ``jax.checkpoint`` under the config's policy.
+
+    ``"full"``: save only inputs (reference semantics,
+    ``apex/transformer/tensor_parallel/random.py:236`` checkpoint).
+    ``"dots"``: ``dots_with_no_batch_dims_saveable`` — matmul outputs
+    are kept, the backward recomputes only elementwise work, so the
+    +1×-forward recompute cost of full remat mostly disappears while
+    activations between matmuls still never hit HBM."""
+    if config.remat_policy == "dots":
+        return jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    return jax.checkpoint(layer)
+
+
 def gpt_forward(
     params, tokens, config: GPTConfig, axis_name: Optional[str] = None,
     cp_axis: Optional[str] = None, ep_axis: Optional[str] = None,
@@ -378,7 +409,7 @@ def gpt_forward(
         cp_axis=cp_axis, ep_axis=ep_axis,
     )
     if config.checkpoint_layers:
-        layer = jax.checkpoint(layer)
+        layer = _remat(layer, config)
 
     # _layer's (carry, lp) -> (x, aux) is exactly the scan contract
     x, aux_per_layer = jax.lax.scan(layer, x, params["layers"])
@@ -746,7 +777,7 @@ def make_pp_train_step(
                         n_local_heads=n_local_heads, ep_axis=ep_axis,
                         cp_axis=cp_axis)
         if config.checkpoint_layers:
-            layer = jax.checkpoint(layer)
+            layer = _remat(layer, config)
         out, aux = jax.lax.scan(lambda c, lp: layer(c, lp), x, stage_params)
         if config.moe:
             # pre-weight the load-balancing aux; the schedule adds it to

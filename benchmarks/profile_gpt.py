@@ -114,6 +114,14 @@ def main():
     s, p, st = make_step(cfg)
     report("no_remat", timed_step(s, p, st), "delta vs full = remat recompute")
 
+    # ---- dots-saveable remat: keeps matmul outputs, recomputes only
+    # elementwise work — the candidate middle ground between full remat
+    # (+1x fwd recompute) and no remat (all activations in HBM)
+    cfg = dataclasses.replace(base, remat_policy="dots")
+    s, p, st = make_step(cfg)
+    report("remat_dots", timed_step(s, p, st),
+           "vs full/no_remat: best of three remat strategies wins")
+
     # ---- no optimizer: bounds FusedAdam's share
     s, p, st = make_step(base, use_opt=False)
     report("no_optimizer", timed_step(s, p, st), "delta vs full = Adam update")

@@ -35,6 +35,39 @@ def test_forward_shapes(batch):
     assert logits.shape == (16, 2, CFG.vocab_size)
 
 
+def test_remat_policies_same_loss_and_grads(batch):
+    """Remat must not change math: loss AND grads identical (bitwise up
+    to reduction order) across no-remat, full remat, and dots-saveable
+    remat."""
+    import dataclasses
+
+    results = {}
+    for name, kw in {
+        "none": {"checkpoint_layers": False},
+        "full": {"checkpoint_layers": True, "remat_policy": "full"},
+        "dots": {"checkpoint_layers": True, "remat_policy": "dots"},
+    }.items():
+        cfg = dataclasses.replace(CFG, **kw)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        targets = jnp.roll(batch, -1, axis=1)
+        loss, grads = jax.value_and_grad(gpt_loss)(params, batch, targets, cfg)
+        results[name] = (float(loss), grads)
+    for name in ("full", "dots"):
+        assert np.isclose(results[name][0], results["none"][0], rtol=1e-6), name
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            results[name][1], results["none"][1],
+        )
+
+
+def test_remat_policy_validated():
+    import dataclasses
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        dataclasses.replace(CFG, remat_policy="dotz")
+
+
 @pytest.mark.slow
 def test_tp_matches_single_device(batch, devices8):
     params = init_params(CFG, jax.random.PRNGKey(0))
