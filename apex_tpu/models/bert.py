@@ -17,6 +17,8 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.models._remat import remat_layer, validate_policy
 import numpy as np
 
 from apex_tpu.normalization import fused_layer_norm_affine
@@ -41,6 +43,11 @@ class BertConfig:
     layernorm_eps: float = 1e-12
     compute_dtype: Any = jnp.bfloat16
     checkpoint_layers: bool = True
+    # "full" | "dots" — see apex_tpu.models._remat
+    remat_policy: str = "full"
+
+    def __post_init__(self):
+        validate_policy(self.remat_policy)
 
     @property
     def ffn(self):
@@ -179,7 +186,7 @@ def bert_forward(params, tokens, token_types=None, pad_mask=None, config: BertCo
         _layer, pad_mask=pad_mask, config=config, axis_name=axis_name, n_local_heads=n_local_heads
     )
     if config.checkpoint_layers:
-        layer = jax.checkpoint(layer)
+        layer = remat_layer(layer, config.remat_policy)
     x, _ = jax.lax.scan(lambda c, lp: (layer(c, lp), None), x, params["layers"])
 
     # MLM head: dense + gelu + LN + tied decoder

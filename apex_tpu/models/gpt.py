@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.normalization import fused_layer_norm_affine
+from apex_tpu.models._remat import remat_layer, validate_policy
 from apex_tpu.transformer.functional import scaled_upper_triang_masked_softmax
 from apex_tpu.transformer.tensor_parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.transformer.tensor_parallel.layers import (
@@ -85,11 +86,7 @@ class GPTConfig:
                 f"position_embedding_type must be 'learned' or 'rope' "
                 f"(got {self.position_embedding_type!r})"
             )
-        if self.remat_policy not in ("full", "dots"):
-            raise ValueError(
-                f"remat_policy must be 'full' or 'dots' "
-                f"(got {self.remat_policy!r})"
-            )
+        validate_policy(self.remat_policy)
 
     @property
     def ffn(self):
@@ -347,23 +344,6 @@ def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None, ep_a
     return x, aux
 
 
-def _remat(layer, config: GPTConfig):
-    """Wrap a layer fn in ``jax.checkpoint`` under the config's policy.
-
-    ``"full"``: save only inputs (reference semantics,
-    ``apex/transformer/tensor_parallel/random.py:236`` checkpoint).
-    ``"dots"``: ``dots_with_no_batch_dims_saveable`` — matmul outputs
-    are kept, the backward recomputes only elementwise work, so the
-    +1×-forward recompute cost of full remat mostly disappears while
-    activations between matmuls still never hit HBM."""
-    if config.remat_policy == "dots":
-        return jax.checkpoint(
-            layer,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        )
-    return jax.checkpoint(layer)
-
-
 def gpt_forward(
     params, tokens, config: GPTConfig, axis_name: Optional[str] = None,
     cp_axis: Optional[str] = None, ep_axis: Optional[str] = None,
@@ -409,7 +389,7 @@ def gpt_forward(
         cp_axis=cp_axis, ep_axis=ep_axis,
     )
     if config.checkpoint_layers:
-        layer = _remat(layer, config)
+        layer = remat_layer(layer, config.remat_policy)
 
     # _layer's (carry, lp) -> (x, aux) is exactly the scan contract
     x, aux_per_layer = jax.lax.scan(layer, x, params["layers"])
@@ -777,7 +757,7 @@ def make_pp_train_step(
                         n_local_heads=n_local_heads, ep_axis=ep_axis,
                         cp_axis=cp_axis)
         if config.checkpoint_layers:
-            layer = _remat(layer, config)
+            layer = remat_layer(layer, config.remat_policy)
         out, aux = jax.lax.scan(lambda c, lp: layer(c, lp), x, stage_params)
         if config.moe:
             # pre-weight the load-balancing aux; the schedule adds it to

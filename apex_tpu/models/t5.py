@@ -31,6 +31,8 @@ pipeline parallelism drives the dual-stream tick schedule
 
 import dataclasses
 from functools import partial
+
+from apex_tpu.models._remat import remat_layer, validate_policy
 from typing import Optional
 
 import jax
@@ -66,6 +68,11 @@ class T5Config:
     layernorm_eps: float = 1e-6
     compute_dtype: jnp.dtype = jnp.bfloat16
     checkpoint_layers: bool = True
+    # "full" | "dots" — see apex_tpu.models._remat
+    remat_policy: str = "full"
+
+    def __post_init__(self):
+        validate_policy(self.remat_policy)
 
     @property
     def head_dim(self) -> int:
@@ -288,13 +295,13 @@ def t5_forward(params, src_tokens, dec_tokens, config: T5Config,
     x = _embed(src_tokens, params, "pos_enc", config, axis_name)
     enc = partial(encoder_layer, config=config, axis_name=axis_name)
     if config.checkpoint_layers:
-        enc = jax.checkpoint(enc)
+        enc = remat_layer(enc, config.remat_policy)
     x = jax.lax.scan(lambda c, lp: (enc(c, lp), None),
                      x, params["enc_layers"])[0]
     y = _embed(dec_tokens, params, "pos_dec", config, axis_name)
     dec = partial(decoder_layer, config=config, axis_name=axis_name)
     if config.checkpoint_layers:
-        dec = jax.checkpoint(dec)
+        dec = remat_layer(dec, config.remat_policy)
     y = jax.lax.scan(lambda c, lp: (dec(c, x, lp), None),
                      y, params["dec_layers"])[0]
     return _lm_head(y, params, config, axis_name)
@@ -428,13 +435,13 @@ def make_pp_train_step(
     def enc_stage_fn(chunk, x):
         layer = partial(encoder_layer, config=config, axis_name=tp_axis)
         if config.checkpoint_layers:
-            layer = jax.checkpoint(layer)
+            layer = remat_layer(layer, config.remat_policy)
         return jax.lax.scan(lambda c, lp: (layer(c, lp), None), x, chunk)[0]
 
     def dec_stage_fn(chunk, x, enc_out):
         layer = partial(decoder_layer, config=config, axis_name=tp_axis)
         if config.checkpoint_layers:
-            layer = jax.checkpoint(layer)
+            layer = remat_layer(layer, config.remat_policy)
         return jax.lax.scan(
             lambda c, lp: (layer(c, enc_out, lp), None), x, chunk)[0]
 
