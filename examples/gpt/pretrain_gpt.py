@@ -52,6 +52,9 @@ def parse_args():
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-2: shard optimizer state over dp")
     p.add_argument("--sequence-parallel", action="store_true")
+    p.add_argument("--remat-policy", default="full", choices=["full", "dots"],
+                   help="layer remat: 'full' saves only layer inputs, "
+                        "'dots' keeps matmul outputs (cheaper backward)")
     p.add_argument("--checkpoint", default=None, help="save dir (async)")
     p.add_argument("--save-every", type=int, default=4)
     p.add_argument("--keep", type=int, default=3,
@@ -96,6 +99,7 @@ def main():
         max_seq_len=args.seq,
         compute_dtype=jnp.float16 if args.fp16 else jnp.bfloat16,
         checkpoint_layers=True,
+        remat_policy=args.remat_policy,
         sequence_parallel=args.sequence_parallel,
         position_embedding_type="rope" if args.rope else "learned",
         num_query_groups=args.num_query_groups,
