@@ -42,9 +42,15 @@ _LANES = 128
 # where the scratch carry, its init, and its finalize live — is
 # sequential.  Declaring this lets Mosaic software-pipeline the block
 # DMAs across grid steps instead of serializing on the conservative
-# default.
-_DIM_SEMANTICS = pltpu.CompilerParams(
-    dimension_semantics=("parallel", "parallel", "arbitrary"))
+# default.  APEX_TPU_FLASH_DIMSEM=0 reverts to the default semantics so
+# the win is measurable A/B on hardware (numerics are identical either
+# way — the arbitrary dim still runs in order).
+_DIM_SEMANTICS = (
+    pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if os.environ.get("APEX_TPU_FLASH_DIMSEM", "1") != "0"
+    else pltpu.CompilerParams()
+)
 
 
 # ------------------------------------------------------------ block tuning
