@@ -1,0 +1,73 @@
+"""Install flash_sweep.py results into the kernel's per-shape block table.
+
+Reads a flash_sweep.py output file (JSONL; the last ``tuned_blocks_table``
+line wins), merges it into the ``_TUNED_BLOCKS`` literal in
+``apex_tpu/ops/flash_attention_pallas.py``, and rewrites the file — so the
+measured defaults ship in source with their provenance, instead of living
+only in a runtime ``set_tuned_blocks`` call someone has to remember.
+
+    python benchmarks/install_tuned_blocks.py /tmp/runbook/flash_sweep.out \
+        --provenance "v5e-lite 2026-07-31 flash_sweep"
+
+Idempotent: re-running with the same sweep output produces the same file.
+"""
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+KERNEL = Path(__file__).resolve().parents[1] / "apex_tpu" / "ops" / "flash_attention_pallas.py"
+
+
+def read_table(sweep_path: str):
+    table = None
+    with open(sweep_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "tuned_blocks_table" in rec:
+                table = rec["tuned_blocks_table"]
+    if table is None:
+        raise SystemExit(f"no tuned_blocks_table line in {sweep_path}")
+    return table
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("sweep_output")
+    ap.add_argument("--provenance", required=True,
+                    help="hardware + date string recorded above the table")
+    args = ap.parse_args()
+
+    entries = {}
+    for key, val in read_table(args.sweep_output):
+        s, d, dtype = key
+        bq, bk = val
+        entries[(int(s), int(d), str(dtype))] = (int(bq), int(bk))
+    if not entries:
+        raise SystemExit("tuned_blocks_table was empty")
+
+    body = "".join(
+        f"    ({s}, {d}, {dtype!r}): ({bq}, {bk}),\n"
+        for (s, d, dtype), (bq, bk) in sorted(entries.items())
+    )
+    new_literal = (
+        f"_TUNED_BLOCKS: dict = {{\n"
+        f"    # measured: {args.provenance} (benchmarks/flash_sweep.py)\n"
+        f"{body}}}"
+    )
+
+    src = KERNEL.read_text()
+    pattern = re.compile(r"_TUNED_BLOCKS: dict = \{[^}]*\}", re.S)
+    if not pattern.search(src):
+        raise SystemExit(f"_TUNED_BLOCKS literal not found in {KERNEL}")
+    KERNEL.write_text(pattern.sub(new_literal.replace("\\", r"\\"), src, count=1))
+    print(f"installed {len(entries)} entries into {KERNEL}")
+
+
+if __name__ == "__main__":
+    main()
