@@ -15,7 +15,6 @@ Idempotent: re-running with the same sweep output produces the same file.
 import argparse
 import json
 import re
-import sys
 from pathlib import Path
 
 KERNEL = Path(__file__).resolve().parents[1] / "apex_tpu" / "ops" / "flash_attention_pallas.py"
@@ -42,8 +41,20 @@ def main():
     ap.add_argument("--provenance", required=True,
                     help="hardware + date string recorded above the table")
     args = ap.parse_args()
+    if "}" in args.provenance or "{" in args.provenance:
+        raise SystemExit("--provenance must not contain braces (it is "
+                         "embedded in the rewritten dict literal)")
 
+    src0 = KERNEL.read_text()
+    m = re.search(r"_TUNED_BLOCKS: dict = \{(.*?)\}", src0, re.S)
+    if m is None:
+        raise SystemExit(f"_TUNED_BLOCKS literal not found in {KERNEL}")
+    # merge with whatever is already installed (a narrower follow-up
+    # sweep must not delete other shapes' measured defaults)
     entries = {}
+    for s, d, dtype, bq, bk in re.findall(
+            r"\((\d+), (\d+), '([^']+)'\): \((\d+), (\d+)\)", m.group(1)):
+        entries[(int(s), int(d), dtype)] = (int(bq), int(bk))
     for key, val in read_table(args.sweep_output):
         s, d, dtype = key
         bq, bk = val
@@ -61,11 +72,8 @@ def main():
         f"{body}}}"
     )
 
-    src = KERNEL.read_text()
-    pattern = re.compile(r"_TUNED_BLOCKS: dict = \{[^}]*\}", re.S)
-    if not pattern.search(src):
-        raise SystemExit(f"_TUNED_BLOCKS literal not found in {KERNEL}")
-    KERNEL.write_text(pattern.sub(new_literal.replace("\\", r"\\"), src, count=1))
+    pattern = re.compile(r"_TUNED_BLOCKS: dict = \{.*?\}", re.S)
+    KERNEL.write_text(pattern.sub(new_literal.replace("\\", r"\\"), src0, count=1))
     print(f"installed {len(entries)} entries into {KERNEL}")
 
 
