@@ -690,6 +690,18 @@ def main():
     global _DEADLINE
     import argparse
 
+    # Persistent compile cache: a wedge-killed or --only-resumed run must
+    # not pay every section's 20-60s tunnel compile again (the dryrun
+    # and test suite already do this; same default location family).
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           os.path.expanduser("~/.cache/jax_bench_cache")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        pass
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--only", default=None,

@@ -50,11 +50,22 @@ def main():
     if m is None:
         raise SystemExit(f"_TUNED_BLOCKS literal not found in {KERNEL}")
     # merge with whatever is already installed (a narrower follow-up
-    # sweep must not delete other shapes' measured defaults)
-    entries = {}
-    for s, d, dtype, bq, bk in re.findall(
-            r"\((\d+), (\d+), '([^']+)'\): \((\d+), (\d+)\)", m.group(1)):
-        entries[(int(s), int(d), dtype)] = (int(bq), int(bk))
+    # sweep must not delete other shapes' measured defaults); parse the
+    # literal with ast so hand-edits/reformatting can't be silently
+    # dropped — anything unparseable fails loudly instead
+    import ast
+
+    body_src = "\n".join(ln for ln in m.group(1).splitlines()
+                         if not ln.strip().startswith("#"))
+    try:
+        existing = ast.literal_eval("{" + body_src + "}")
+    except (SyntaxError, ValueError) as e:
+        raise SystemExit(
+            f"could not parse the existing _TUNED_BLOCKS literal: {e}")
+    entries = {
+        (int(s), int(d), str(dtype)): (int(bq), int(bk))
+        for (s, d, dtype), (bq, bk) in existing.items()
+    }
     for key, val in read_table(args.sweep_output):
         s, d, dtype = key
         bq, bk = val
