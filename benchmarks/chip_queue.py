@@ -1,0 +1,257 @@
+"""Probe-and-drain runner for the chip-bound measurement queue.
+
+The axon tunnel's observed failure mode (rounds 3-5) is: answers a
+small probe, wedges minutes later inside a larger compile, recovers at
+an unpredictable time.  A human babysitting the tunnel loses the
+recovery window; this runner doesn't.  It loops:
+
+  1. probe the chip in a SUBPROCESS (the only killable guard — a
+     wedged PJRT client creation holds the GIL, see
+     bench._device_preflight),
+  2. when the probe answers, run the next step of the queue with a
+     hard per-step timeout,
+  3. a step that exits 0 (and, for bench, whose sidecar holds a good
+     result for every wanted section) is retired; a timeout/failure
+     sends us back to the probe loop — 3 straight failures rotate the
+     step to the tail, MAX_ATTEMPTS total retire it as gave_up.
+
+Every step is itself resumable (bench.py --only merges its sidecar;
+flash_sweep/profile/memfit stream JSON lines), so a wedge mid-step
+loses only the uncommitted tail of that step.  State is written
+atomically to ``benchmarks/chip_queue_state.json`` after every
+transition so a killed runner restarts where it left off.
+
+    python benchmarks/chip_queue.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+# Sections this round still needs measured (the five good ones from the
+# wedged earlier session are banked in BENCH_sections_r05_partial.jsonl;
+# fused_adam is re-run for the drift-corrected interleaved timing).
+BENCH_WANTED = ["matmul_roofline", "fused_adam", "resnet50_b64",
+                "bert_base_lamb", "flash_attn", "zero2_vs_fused"]
+
+
+def _read_sections():
+    """Newest-wins {section: result} from the working sidecar."""
+    sections = {}
+    try:
+        for line in open(REPO / "BENCH_sections.jsonl"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            sections[rec.get("section")] = rec.get("result")
+    except OSError:
+        pass
+    return sections
+
+
+def _good(name, r):
+    if isinstance(r, (int, float)):
+        return True
+    if not (isinstance(r, dict) and "error" not in r):
+        return False
+    if name == "flash_attn" and r.get("pct_roofline") is None:
+        # flash can "succeed" against a null MFU denominator when the
+        # roofline section failed earlier in the same run; that record
+        # is degraded, not done — keep it in the retry list so it
+        # re-measures once a roofline lands
+        return False
+    return True
+
+
+def bench_missing():
+    """Sections from BENCH_WANTED without a good result in the sidecar.
+
+    bench.py exits 0 even when every section wedges (the banked-fallback
+    JSON is a feature), so retirement must be judged on the sidecar, not
+    the exit code — and each retry should re-run only what's missing."""
+    sections = _read_sections()
+    return [s for s in BENCH_WANTED if not _good(s, sections.get(s))]
+
+
+def _bench_argv():
+    """Resume argv: shrink --only to what's missing, and when the
+    roofline is already banked (so the retry won't re-measure it), pass
+    it through --roofline — otherwise flash_attn's %%-of-roofline would
+    silently report against a null denominator and retire degraded."""
+    missing = bench_missing()
+    argv = [sys.executable, "bench.py", "--only", ",".join(missing)]
+    roof = _read_sections().get("matmul_roofline")
+    if "matmul_roofline" not in missing and isinstance(roof, (int, float)):
+        argv += ["--roofline", str(float(roof))]
+    return argv
+
+
+# (name, argv-or-callable, per-step timeout seconds).  Order = VERDICT
+# r4 task 1's runbook.  bench.py re-preflights internally; the others
+# are small enough that the probe above is the gate.
+# 4500s: bench's own sanctioned worst case is ~930s of preflight+retry
+# before the 2700s section budget re-arms — a 3600s cap would SIGKILL a
+# legitimately recovering run near completion
+QUEUE = [
+    ("bench_resume", _bench_argv, 4500),
+    ("flash_sweep",
+     [sys.executable, "benchmarks/flash_sweep.py"],
+     5400),
+    ("profile_gpt",
+     [sys.executable, "benchmarks/profile_gpt.py"],
+     2400),
+    ("memfit_gpt",
+     [sys.executable, "benchmarks/memfit_gpt.py"],
+     2400),
+]
+
+PROBE_CODE = ("import jax; jax.devices(); import jax.numpy as jnp; "
+              "a=jnp.ones((1024,1024),jnp.bfloat16); "
+              "print(float((a@a)[0,0]))")
+
+
+def log(msg):
+    print(f"[queue {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe(timeout_s=150):
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_CODE],
+                           timeout=timeout_s, capture_output=True, text=True,
+                           cwd=REPO)
+        if r.returncode != 0:
+            # a deterministic local failure (broken venv, bad env var)
+            # must be distinguishable from a wedged tunnel in the log,
+            # or an unattended runner burns days on an ImportError
+            tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+            log(f"probe rc={r.returncode}: {tail[0]}")
+            return False
+        return True
+    except subprocess.TimeoutExpired:
+        return False
+
+
+# Total per-step attempt ceiling: a deterministic failure (real OOM, a
+# code bug in one section) repeats identically — after this many tries
+# the step retires as gave_up instead of occupying the chip forever.
+MAX_ATTEMPTS = 8
+
+
+def _save_state(state_path, done, gave_up, total_attempts):
+    # atomic: a kill mid-write must not truncate the file and silently
+    # discard hours of retirement state on restart.  total_attempts
+    # persists too — otherwise a supervisor auto-restarting the runner
+    # resets the MAX_ATTEMPTS ceiling and a deterministic failure
+    # re-occupies the chip indefinitely
+    tmp = state_path.with_suffix(".tmp")
+    tmp.write_text(json.dumps({"done": sorted(done),
+                               "gave_up": sorted(gave_up),
+                               "attempts": total_attempts}))
+    os.replace(tmp, state_path)
+
+
+def main():
+    state_path = REPO / "benchmarks" / "chip_queue_state.json"
+    done, gave_up, total_attempts = set(), set(), {}
+    if state_path.exists():
+        try:
+            st = json.loads(state_path.read_text())
+            done = set(st.get("done", []))
+            gave_up = set(st.get("gave_up", []))
+            total_attempts = dict(st.get("attempts", {}))
+        except ValueError:
+            pass
+
+    pending = [s for s in QUEUE if s[0] not in done | gave_up]
+    attempts = {}
+    log(f"queue: {[s[0] for s in pending]} (done: {sorted(done)}, "
+        f"gave_up: {sorted(gave_up)})")
+
+    while pending:
+        if not probe():
+            log("chip unreachable; sleeping 300s")
+            time.sleep(300)
+            continue
+        name, argv, step_timeout = pending[0]
+        if name == "bench_resume" and not bench_missing():
+            log("bench_resume: all sections banked; retiring")
+            done.add(name)
+            pending.pop(0)
+            _save_state(state_path, done, gave_up, total_attempts)
+            continue
+        if callable(argv):
+            argv = argv()
+        log(f"chip healthy -> running {name} (timeout {step_timeout}s)")
+        logfile = REPO / "benchmarks" / f"queue_{name}.log"
+        with open(logfile, "a") as lf:
+            lf.write(f"\n=== attempt {time.strftime('%F %T')} ===\n")
+            lf.flush()
+            # start_new_session + killpg: several steps re-exec probe
+            # subprocesses (bench preflight, memfit batch probes) that
+            # hold device memory — killing only the direct child would
+            # orphan them on the chip and every later probe would
+            # misread the contention as "unreachable"
+            p = subprocess.Popen(argv, cwd=REPO, stdout=lf,
+                                 stderr=subprocess.STDOUT,
+                                 start_new_session=True)
+            try:
+                rc = p.wait(timeout=step_timeout)
+            except subprocess.TimeoutExpired:
+                import signal
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                p.wait()
+                rc = -1
+        if rc == 0 and not (name == "bench_resume" and bench_missing()):
+            log(f"{name} DONE")
+            done.add(name)
+            pending.pop(0)
+            _save_state(state_path, done, gave_up, total_attempts)
+            attempts.pop(name, None)
+            continue
+        if name == "bench_resume" and not bench_missing():
+            # killed (e.g. at the step timeout) AFTER the sidecar
+            # filled in — that's a success; let the top-of-loop
+            # banked-check retire it rather than burning an attempt
+            log("bench_resume: nonzero exit but all sections banked")
+            continue
+        if rc == 0:
+            log(f"{name} exited 0 but sections still missing: "
+                f"{bench_missing()}")
+        else:
+            log(f"{name} rc={rc}")
+        # anti-starvation: a step failing deterministically (real OOM, a
+        # code bug in one section — not a wedge) must not pin the queue
+        # head forever while flash_sweep/profile/memfit starve; after 3
+        # straight failures rotate it to the tail, and after
+        # MAX_ATTEMPTS total retire it as gave_up — otherwise, once it
+        # is the only step left, it would re-occupy the chip every
+        # 300s until a human kills the runner
+        attempts[name] = attempts.get(name, 0) + 1
+        total_attempts[name] = total_attempts.get(name, 0) + 1
+        if total_attempts[name] >= MAX_ATTEMPTS:
+            log(f"{name} failed {total_attempts[name]}x total; giving up "
+                f"(see benchmarks/queue_{name}.log)")
+            gave_up.add(name)
+            pending.pop(0)
+            _save_state(state_path, done, gave_up, total_attempts)
+        elif attempts[name] >= 3 and len(pending) > 1:
+            log(f"{name} failed {attempts[name]}x; rotating to queue tail")
+            pending.append(pending.pop(0))
+            attempts[name] = 0
+        else:
+            log("back to probing in 300s")
+            time.sleep(300)
+    log("queue drained")
+
+
+if __name__ == "__main__":
+    main()
