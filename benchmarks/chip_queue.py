@@ -79,6 +79,13 @@ def bench_missing():
     return [s for s in BENCH_WANTED if not _good(s, sections.get(s))]
 
 
+def _roofline_args():
+    roof = _read_sections().get("matmul_roofline")
+    if isinstance(roof, (int, float)):
+        return ["--roofline", str(float(roof))]
+    return []
+
+
 def _bench_argv():
     """Resume argv: shrink --only to what's missing, and when the
     roofline is already banked (so the retry won't re-measure it), pass
@@ -86,10 +93,17 @@ def _bench_argv():
     silently report against a null denominator and retire degraded."""
     missing = bench_missing()
     argv = [sys.executable, "bench.py", "--only", ",".join(missing)]
-    roof = _read_sections().get("matmul_roofline")
-    if "matmul_roofline" not in missing and isinstance(roof, (int, float)):
-        argv += ["--roofline", str(float(roof))]
+    if "matmul_roofline" not in missing:
+        argv += _roofline_args()
     return argv
+
+
+def _flash_retuned_argv():
+    """Re-measure the flash section after install_blocks rewrote the
+    kernel's per-shape table from the sweep — the sidecar's newest-wins
+    merge makes this the round's flash number."""
+    return ([sys.executable, "bench.py", "--only", "flash_attn"]
+            + _roofline_args())
 
 
 # (name, argv-or-callable, per-step timeout seconds).  Order = VERDICT
@@ -103,6 +117,15 @@ QUEUE = [
     ("flash_sweep",
      [sys.executable, "benchmarks/flash_sweep.py"],
      5400),
+    # feed the sweep's tuned_blocks_table into the kernel source, then
+    # re-measure the flash section against it (VERDICT r4 task 4);
+    # install reads the sweep step's own log, tolerant of non-JSON lines
+    ("install_blocks",
+     [sys.executable, "benchmarks/install_tuned_blocks.py",
+      "benchmarks/queue_flash_sweep.log",
+      "--provenance", "v5e-lite r5 flash_sweep via chip_queue"],
+     300),
+    ("flash_retuned", _flash_retuned_argv, 900),
     ("profile_gpt",
      [sys.executable, "benchmarks/profile_gpt.py"],
      2400),
