@@ -153,6 +153,18 @@ def main():
     report("no_lm_head", timed_step(s, p, st),
            "delta vs full = logits matmul + vocab CE (+ its bwd)")
 
+    # ---- chunked fused LM-head+CE (ops/fused_ce.py): candidate fix for
+    # whatever share no_lm_head attributes — trades one extra head
+    # matmul (backward recompute) for never writing the fp32 (S,B,V)
+    # logits + d_logits to HBM (~3.3 GB/step at these shapes)
+    for chunk in (128, 256, 512):
+        if args.seq % chunk:
+            continue
+        cfg = dataclasses.replace(base, fused_ce=True, fused_ce_chunk=chunk)
+        s, p, st = make_step(cfg)
+        report(f"fused_ce_c{chunk}", timed_step(s, p, st),
+               "vs full: wins if the head was bandwidth-bound")
+
     # ---- identity attention: bounds the attention core.  The patch
     # works because gpt._attention imports flash_attention from the
     # module at trace time — the `engaged` flag makes a future import

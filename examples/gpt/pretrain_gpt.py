@@ -55,6 +55,9 @@ def parse_args():
     p.add_argument("--remat-policy", default="full", choices=["full", "dots"],
                    help="layer remat: 'full' saves only layer inputs, "
                         "'dots' keeps matmul outputs (cheaper backward)")
+    p.add_argument("--fused-ce", action="store_true",
+                   help="chunked fused LM-head+CE: never materializes "
+                        "the fp32 (S,B,V) logits (ops/fused_ce.py)")
     p.add_argument("--checkpoint", default=None, help="save dir (async)")
     p.add_argument("--save-every", type=int, default=4)
     p.add_argument("--keep", type=int, default=3,
@@ -103,6 +106,11 @@ def main():
         sequence_parallel=args.sequence_parallel,
         position_embedding_type="rope" if args.rope else "learned",
         num_query_groups=args.num_query_groups,
+        fused_ce=args.fused_ce,
+        # largest divisor of seq <= 128, so the flag always engages
+        # (the gpt_loss guard silently falls back on indivisibility)
+        fused_ce_chunk=next(c for c in range(min(128, args.seq), 0, -1)
+                            if args.seq % c == 0),
     )
     params = init_params(config, jax.random.PRNGKey(0))
 
