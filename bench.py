@@ -236,7 +236,7 @@ def bench_fused_adam():
 
 
 def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
-              vocab=50304):
+              vocab=50304, fused_ce=False):
     """GPT train-step throughput.  On HBM exhaustion the batch halves
     (at most twice) and the result records the batch that actually ran —
     an audited number at a smaller batch beats an OOM error (GPT-345M
@@ -248,7 +248,8 @@ def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
     for retries_left in (2, 1, 0):
         try:
             return _bench_gpt_at_batch(layers, hidden, heads, seq, batch,
-                                       roofline_tflops, iters, vocab)
+                                       roofline_tflops, iters, vocab,
+                                       fused_ce)
         except Exception as e:  # noqa: BLE001 — only OOM is retried
             oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
             if not oom or batch <= 1 or retries_left == 0:
@@ -258,7 +259,7 @@ def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
 
 
 def _bench_gpt_at_batch(layers, hidden, heads, seq, batch, roofline_tflops,
-                        iters, vocab):
+                        iters, vocab, fused_ce=False):
     from apex_tpu.models.gpt import GPTConfig, gpt_loss, init_params
     from apex_tpu.optimizers import FusedAdam
 
@@ -266,7 +267,7 @@ def _bench_gpt_at_batch(layers, hidden, heads, seq, batch, roofline_tflops,
         vocab_size=vocab, hidden_size=hidden, num_layers=layers,
         num_attention_heads=heads, max_seq_len=seq,
         compute_dtype=jnp.bfloat16, use_flash_attention=True,
-        checkpoint_layers=True,
+        checkpoint_layers=True, fused_ce=fused_ce,
     )
     params = init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
@@ -715,8 +716,8 @@ def main():
              "re-measuring (pair with --only to resume)")
     cli = ap.parse_args()
     known = {"matmul_roofline", "fused_adam", "gpt124_s1024", "gpt124_s4096",
-             "gpt345_s1024", "resnet50_b64", "bert_base_lamb", "flash_attn",
-             "zero2_vs_fused"}
+             "gpt345_s1024", "gpt124_s1024_fce", "resnet50_b64",
+             "bert_base_lamb", "flash_attn", "zero2_vs_fused"}
     only = set(cli.only.split(",")) if cli.only else None
     if only is not None and not only <= known:
         # a typo'd section name must fail loudly BEFORE the multi-minute
@@ -770,6 +771,11 @@ def main():
                  if want("gpt124_s4096") else skipped)
     gpt345_1k = (_try("gpt345_s1024", bench_gpt, 24, 1024, 16, 1024, 8, roof, iters=10)
                  if want("gpt345_s1024") else skipped)
+    # the chunked fused LM-head+CE A/B vs gpt124_s1024 (ops/fused_ce.py):
+    # the audited record of whether eliding the (S,B,V) logits pays
+    if want("gpt124_s1024_fce"):
+        _try("gpt124_s1024_fce", bench_gpt, 12, 768, 12, 1024, 8, roof,
+             fused_ce=True)
     # 900s: the ResNet-50 train step is the widest graph in the suite and
     # its first compile over the tunnel is the one that hit the 600s
     # watchdog in round 5 — give the compile headroom before concluding
