@@ -23,7 +23,6 @@ import numpy as np
 
 from apex_tpu.normalization import fused_layer_norm_affine
 from apex_tpu.ops.attention import flash_attention
-from apex_tpu.transformer.tensor_parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.transformer.tensor_parallel.layers import (
     column_parallel_linear,
     row_parallel_linear,
@@ -45,6 +44,9 @@ class BertConfig:
     checkpoint_layers: bool = True
     # "full" | "dots" — see apex_tpu.models._remat
     remat_policy: str = "full"
+    # chunked fused MLM-head+CE (ops/fused_ce.py; see GPTConfig.fused_ce)
+    fused_ce: bool = False
+    fused_ce_chunk: int = 128
 
     def __post_init__(self):
         validate_policy(self.remat_policy)
@@ -164,8 +166,11 @@ def _layer(x, p, pad_mask, config, axis_name, n_local_heads):
     return x.astype(config.compute_dtype)
 
 
-def bert_forward(params, tokens, token_types=None, pad_mask=None, config: BertConfig = None, axis_name=None):
-    """tokens (B, S) → MLM logits (S, B, V or V/tp)."""
+def bert_forward(params, tokens, token_types=None, pad_mask=None,
+                 config: BertConfig = None, axis_name=None,
+                 return_hidden=False):
+    """tokens (B, S) → MLM logits (S, B, V or V/tp); ``return_hidden``:
+    the pre-decoder (S, B, H) MLM-head activations instead."""
     B, S = tokens.shape
     tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
     n_local_heads = config.num_attention_heads // tp
@@ -201,18 +206,23 @@ def bert_forward(params, tokens, token_types=None, pad_mask=None, config: BertCo
         )
 
         h = copy_to_tensor_model_parallel_region(h, axis_name)
+    if return_hidden:
+        return h
     return jnp.matmul(h, params["embed"].T.astype(jnp.float32))
 
 
 def bert_mlm_loss(params, tokens, targets, loss_mask, config: BertConfig, axis_name=None, pad_mask=None):
-    """Mean MLM CE over masked positions (loss_mask (B, S) 1=predict)."""
-    logits = bert_forward(params, tokens, pad_mask=pad_mask, config=config, axis_name=axis_name)
+    """Mean MLM CE over masked positions (loss_mask (B, S) 1=predict).
+
+    Routes through the ONE head dispatch (models/gpt.lm_head_loss):
+    chunked fused CE when ``config.fused_ce`` (the MLM decoder is a
+    tied (S,B,H)x(H,V) head exactly like GPT's), dense logits + CE
+    otherwise."""
+    from apex_tpu.models.gpt import lm_head_loss
+
+    h = bert_forward(params, tokens, pad_mask=pad_mask, config=config,
+                     axis_name=axis_name, return_hidden=True)
     t = targets.transpose(1, 0)
     lm = loss_mask.transpose(1, 0).astype(jnp.float32)
-    if axis_name is None:
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
-        loss = lse - tgt
-    else:
-        loss = vocab_parallel_cross_entropy(logits, t, 0.0, axis_name)
+    loss = lm_head_loss(h, params["embed"], t, config, axis_name)
     return jnp.sum(loss * lm) / jnp.maximum(jnp.sum(lm), 1.0)

@@ -70,6 +70,9 @@ class T5Config:
     checkpoint_layers: bool = True
     # "full" | "dots" — see apex_tpu.models._remat
     remat_policy: str = "full"
+    # chunked fused LM-head+CE (ops/fused_ce.py; see GPTConfig.fused_ce)
+    fused_ce: bool = False
+    fused_ce_chunk: int = 128
 
     def __post_init__(self):
         validate_policy(self.remat_policy)
@@ -262,8 +265,9 @@ def _embed(tokens, params, pos_key, config, axis_name):
     return x.astype(config.compute_dtype)
 
 
-def _lm_head(x, params, config, axis_name):
-    """Tied head: (S_tgt, B, H) -> vocab(-parallel) logits fp32."""
+def _pre_head(x, params, config, axis_name):
+    """Final RMS norm + tp copy-region: the activations the tied head
+    consumes, shared by the logits oracle and the fused-CE path."""
     x = fused_rms_norm_affine(x, params["lnf_scale"],
                               (config.hidden_size,), config.layernorm_eps)
     if axis_name is not None:
@@ -272,6 +276,12 @@ def _lm_head(x, params, config, axis_name):
         )
 
         x = copy_to_tensor_model_parallel_region(x, axis_name)
+    return x
+
+
+def _lm_head(x, params, config, axis_name):
+    """Tied head: (S_tgt, B, H) -> vocab(-parallel) logits fp32."""
+    x = _pre_head(x, params, config, axis_name)
     return jnp.matmul(x.astype(jnp.float32),
                       params["embed"].T.astype(jnp.float32))
 
@@ -286,11 +296,23 @@ def _ce(logits, targets, axis_name):
     return jnp.mean(vocab_parallel_cross_entropy(logits, t, 0.0, axis_name))
 
 
+def _head_loss(y, params, targets, config, axis_name):
+    """Decoder output -> mean CE through the ONE head dispatch
+    (models/gpt.lm_head_loss): fused chunked CE when configured, the
+    dense logits oracle otherwise."""
+    from apex_tpu.models.gpt import lm_head_loss
+
+    x = _pre_head(y, params, config, axis_name)
+    t = targets.transpose(1, 0)
+    return jnp.mean(lm_head_loss(x, params["embed"], t, config, axis_name))
+
+
 # ---------------------------------------------------------------- oracle
 def t5_forward(params, src_tokens, dec_tokens, config: T5Config,
-               axis_name: Optional[str] = None):
+               axis_name: Optional[str] = None, return_hidden: bool = False):
     """Full forward: (B, S_src), (B, S_tgt) token ids -> (S_tgt, B, V)
-    fp32 logits.  The single-device (or tp-only) oracle the pipeline
+    fp32 logits (``return_hidden``: the pre-head (S_tgt, B, H) decoder
+    stream instead).  The single-device (or tp-only) oracle the pipeline
     schedules are parity-tested against."""
     x = _embed(src_tokens, params, "pos_enc", config, axis_name)
     enc = partial(encoder_layer, config=config, axis_name=axis_name)
@@ -304,13 +326,16 @@ def t5_forward(params, src_tokens, dec_tokens, config: T5Config,
         dec = remat_layer(dec, config.remat_policy)
     y = jax.lax.scan(lambda c, lp: (dec(c, x, lp), None),
                      y, params["dec_layers"])[0]
+    if return_hidden:
+        return y  # pre-head decoder stream (S_tgt, B, H)
     return _lm_head(y, params, config, axis_name)
 
 
 def t5_loss(params, src_tokens, dec_tokens, targets, config: T5Config,
             axis_name: Optional[str] = None):
-    logits = t5_forward(params, src_tokens, dec_tokens, config, axis_name)
-    return _ce(logits, targets, axis_name)
+    y = t5_forward(params, src_tokens, dec_tokens, config, axis_name,
+                   return_hidden=True)
+    return _head_loss(y, params, targets, config, axis_name)
 
 
 def make_train_step(config: T5Config, optimizer, mesh=None,
@@ -446,8 +471,7 @@ def make_pp_train_step(
             lambda c, lp: (layer(c, enc_out, lp), None), x, chunk)[0]
 
     def post_fn(shared, y, mb):
-        logits = _lm_head(y, shared, config, tp_axis)
-        return _ce(logits, mb["targets"], tp_axis)
+        return _head_loss(y, shared, mb["targets"], config, tp_axis)
 
     def run_schedule(params, src, dec_in, targets, post_fn_):
         shared = {k: v for k, v in params.items()

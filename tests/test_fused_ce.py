@@ -136,6 +136,55 @@ def test_gpt_loss_fused_falls_back_on_indivisible():
     np.testing.assert_allclose(float(got), float(ref), rtol=1e-7)
 
 
+def test_bert_fused_matches_dense():
+    from apex_tpu.models.bert import BertConfig, bert_mlm_loss
+    from apex_tpu.models.bert import init_params as bert_init
+
+    rng = np.random.RandomState(1)
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_attention_heads=4, max_seq_len=16,
+                     compute_dtype=jnp.float32, checkpoint_layers=False,
+                     fused_ce=True, fused_ce_chunk=8)
+    params = bert_init(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(rng.randint(0, 64, size=(2, 16)))
+    targets = jnp.asarray(rng.randint(0, 64, size=(2, 16)))
+    mask = jnp.asarray(rng.randint(0, 2, size=(2, 16)))
+    dense_cfg = dataclasses.replace(cfg, fused_ce=False)
+    ref, ref_g = jax.value_and_grad(bert_mlm_loss)(
+        params, tokens, targets, mask, dense_cfg)
+    got, got_g = jax.value_and_grad(bert_mlm_loss)(
+        params, tokens, targets, mask, cfg)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        got_g, ref_g)
+
+
+def test_t5_fused_matches_dense():
+    from apex_tpu.models.t5 import T5Config, t5_loss
+    from apex_tpu.models.t5 import init_params as t5_init
+
+    rng = np.random.RandomState(2)
+    cfg = T5Config(vocab_size=64, hidden_size=32, num_encoder_layers=2,
+                   num_decoder_layers=2, num_attention_heads=4,
+                   max_src_len=16, max_tgt_len=16,
+                   compute_dtype=jnp.float32, checkpoint_layers=False,
+                   fused_ce=True, fused_ce_chunk=8)
+    params = t5_init(cfg, jax.random.PRNGKey(0))
+    src = jnp.asarray(rng.randint(0, 64, size=(2, 16)))
+    dec = jnp.asarray(rng.randint(0, 64, size=(2, 16)))
+    targets = jnp.asarray(rng.randint(0, 64, size=(2, 16)))
+    dense_cfg = dataclasses.replace(cfg, fused_ce=False)
+    ref, ref_g = jax.value_and_grad(t5_loss)(params, src, dec, targets, dense_cfg)
+    got, got_g = jax.value_and_grad(t5_loss)(params, src, dec, targets, cfg)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        got_g, ref_g)
+
+
 def test_pp_fused_matches_dense_oracle(devices8):
     """The pipeline post-stage head (models/gpt.py post_fn) must produce
     the same loss/params through the fused path as the dense oracle."""
