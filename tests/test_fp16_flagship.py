@@ -127,6 +127,35 @@ def test_scaled_tp_dp_matches_oracle(devices8):
     assert losses[-1] < losses[1]
 
 
+def test_scaled_tp_dp_fused_ce_matches_oracle(devices8):
+    """The chunked fused LM-head+CE (ops/fused_ce.py) under dynamic loss
+    scaling at tp=2 × dp=4: the custom_vjp must carry the scaled
+    cotangent (incl. the saturating overflow step) identically to the
+    dense head — discrete scaler decisions AND the post-recovery
+    trajectory match the dense-head oracle."""
+    config = tiny_config(sequence_parallel=True, fused_ce=True,
+                         fused_ce_chunk=8)
+    scaler = make_scaler()
+    mesh = Mesh(np.array(devices8).reshape(4, 2), ("dp", "tp"))
+    params = init_params(config, jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init(params)
+    sstate = scaler.init()
+    step = make_train_step(config, opt, mesh, loss_scaler=scaler)
+    tok, tgt = data(batch=8)
+
+    losses, scales = [], []
+    for _ in range(STEPS):
+        params, state, sstate, loss = step(params, state, sstate, tok, tgt)
+        losses.append(float(loss))
+        scales.append(float(sstate.loss_scale))
+
+    oracle = oracle_trajectory(tiny_config(), scaler, tok, tgt)
+    assert_trajectory_matches(params, state, sstate,
+                              np.asarray(losses), np.asarray(scales), oracle)
+    assert losses[-1] < losses[1]
+
+
 def test_scaled_pp_tp_dp_matches_oracle(devices8):
     """make_pp_train_step(loss_scaler=...) at tp=2 × pp=2 × dp=2 vs the
     oracle — found_inf agreed across stages, skip in lockstep."""
