@@ -181,6 +181,13 @@ def _save_state(state_path, done, gave_up, total_attempts):
 
 
 def main():
+    # Hard wall-clock deadline (epoch seconds, CHIP_QUEUE_DEADLINE): the
+    # round driver runs bench.py itself at round end — a queue step
+    # still holding the chip then would wedge the DRIVER's audited run.
+    # A step is only started if it can finish (worst case) before the
+    # deadline; past it the runner exits, leaving banked state.
+    deadline = float(os.environ.get("CHIP_QUEUE_DEADLINE", "0")) or None
+
     state_path = REPO / "benchmarks" / "chip_queue_state.json"
     done, gave_up, total_attempts = set(), set(), {}
     if state_path.exists():
@@ -198,6 +205,17 @@ def main():
         f"gave_up: {sorted(gave_up)})")
 
     while pending:
+        if deadline is not None:
+            fits = [s for s in pending if time.time() + s[2] <= deadline]
+            if len(fits) < len(pending):
+                dropped = [s[0] for s in pending if s not in fits]
+                log(f"deadline {time.strftime('%H:%M', time.localtime(deadline))}: "
+                    f"dropping {dropped} (cannot finish in time)")
+                pending = fits
+            if not pending:
+                log("deadline: nothing fits; exiting to leave the chip "
+                    "to the driver")
+                break
         if not probe():
             log("chip unreachable; sleeping 300s")
             time.sleep(300)
