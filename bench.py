@@ -28,6 +28,8 @@ for:
   measured bf16 matmul roofline, per (D, S) shape.
 - ``zero2_vs_fused``: DistributedFusedAdam (ZeRO-2) step vs replicated
   FusedAdam at 25.6M and GPT-345M param counts, dp=1 degenerate.
+- ``fused_ln``: FusedLayerNorm fwd+bwd vs the jnp composite at
+  8192×4096 bf16 (BASELINE config 2's second half).
 
 Model FLOPs use the standard 6·N·tokens + 12·L·S·H attention term
 (no recompute credit, the usual MFU convention).
@@ -178,6 +180,53 @@ def timed_steps_ms_interleaved(body_a, carry_a, body_b, carry_b, K=200, repeats=
         block(chain_b(carry_b))
         best_b = min(best_b, (time.perf_counter() - t0) / K)
     return best_a * 1e3, best_b * 1e3
+
+
+def bench_fused_ln(rows=8192, cols=4096, iters=50):
+    """FusedLayerNorm fwd+bwd microbench — the second half of BASELINE
+    config 2 ("FusedAdam + FusedLayerNorm microbench", mirrors the
+    reference's tests/L0 layer_norm timing against
+    ``csrc/layer_norm_cuda.cu``).  On the chip the Pallas kernel
+    engages (ops/layer_norm_pallas.py); the composite ratio prices it
+    against the plain jnp mean/var lowering.  The chain feeds dx back
+    as the next x so the fori_loop body stays data-dependent
+    (DCE-proof, per _timed_chain's contract)."""
+    from apex_tpu.normalization import fused_layer_norm_affine
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.bfloat16)
+    w = jnp.ones((cols,), jnp.float32)
+    b = jnp.zeros((cols,), jnp.float32)
+
+    def fwd_bwd(fn):
+        def body(x):
+            y, dx = jax.value_and_grad(
+                lambda x_: jnp.sum(fn(x_).astype(jnp.float32)))(x)
+            return (dx * 1e-6).astype(x.dtype) + x
+        return body
+
+    fused = fwd_bwd(lambda x_: fused_layer_norm_affine(
+        x_, w, b, (cols,), 1e-5))
+
+    def composite_ln(x_):
+        xf = x_.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + b).astype(x_.dtype)
+
+    fused_ms, composite_ms = (
+        timed_steps_ms_interleaved(fused, x, fwd_bwd(composite_ln), x,
+                                   K=iters)
+    )
+    # fwd reads+writes x-sized arrays, bwd reads x/dy writes dx: ~5
+    # x-sized HBM touches per fwd+bwd at bf16
+    gbytes = 5 * rows * cols * 2 / 1e9
+    return {
+        "shape": [rows, cols],
+        "fused_ms": round(fused_ms, 4),
+        "composite_ms": round(composite_ms, 4),
+        "effective_gb_s": round(gbytes / (fused_ms / 1e3), 1),
+        "vs_composite": round(composite_ms / fused_ms, 3),
+    }
 
 
 def bench_fused_adam():
@@ -736,9 +785,10 @@ def main():
         help="use this TFLOP/s as the MFU denominator instead of "
              "re-measuring (pair with --only to resume)")
     cli = ap.parse_args()
-    known = {"matmul_roofline", "fused_adam", "gpt124_s1024", "gpt124_s4096",
-             "gpt345_s1024", "gpt124_s1024_fce", "resnet50_b64",
-             "bert_base_lamb", "flash_attn", "zero2_vs_fused"}
+    known = {"matmul_roofline", "fused_adam", "fused_ln", "gpt124_s1024",
+             "gpt124_s4096", "gpt345_s1024", "gpt124_s1024_fce",
+             "resnet50_b64", "bert_base_lamb", "flash_attn",
+             "zero2_vs_fused"}
     only = set(cli.only.split(",")) if cli.only else None
     if only is not None and not only <= known:
         # a typo'd section name must fail loudly BEFORE the multi-minute
@@ -786,6 +836,8 @@ def main():
     # (--roofline supplies a prior session's measurement on resume).
     roof = roofline if isinstance(roofline, float) else cli.roofline
     adam = _try("fused_adam", bench_fused_adam) if want("fused_adam") else skipped
+    if want("fused_ln"):
+        _try("fused_ln", bench_fused_ln)
     gpt124_1k = (_try("gpt124_s1024", bench_gpt, 12, 768, 12, 1024, 8, roof)
                  if want("gpt124_s1024") else skipped)
     gpt124_4k = (_try("gpt124_s4096", bench_gpt, 12, 768, 12, 4096, 2, roof)

@@ -36,9 +36,9 @@ REPO = Path(__file__).resolve().parents[1]
 # Sections this round still needs measured (the five good ones from the
 # wedged earlier session are banked in BENCH_sections_r05_partial.jsonl;
 # fused_adam is re-run for the drift-corrected interleaved timing).
-BENCH_WANTED = ["matmul_roofline", "fused_adam", "gpt124_s1024_fce",
-                "resnet50_b64", "bert_base_lamb", "flash_attn",
-                "zero2_vs_fused"]
+BENCH_WANTED = ["matmul_roofline", "fused_adam", "fused_ln",
+                "gpt124_s1024_fce", "resnet50_b64", "bert_base_lamb",
+                "flash_attn", "zero2_vs_fused"]
 
 
 def _read_sections():
