@@ -710,51 +710,60 @@ def _banked_fallback(err: str) -> dict:
     that audited evidence from the round artifact.  So: report the
     banked sections, clearly labeled — ``live: false``, the sidecar
     timestamps, and the preflight error — never pretending they were
-    measured now.  Sources, newest first: the working sidecar, then the
-    newest committed ``benchmarks/BENCH_sections_r*_partial.jsonl``
-    archive.  With no banked sections anywhere, the old error-only
-    shape stands."""
+    measured now.
+
+    Sections MERGE across every source, newest file winning per
+    section: the working sidecar first, then the committed
+    ``benchmarks/BENCH_sections_r*_partial.jsonl`` archives newest
+    first.  (The full-bench path truncates the working sidecar at
+    start, so a driver run that wedges after two sections must not
+    mask the archived record of the other six — first-non-empty-file
+    semantics did exactly that.)  With no banked sections anywhere,
+    the old error-only shape stands."""
     import glob
 
     candidates = [_SECTIONS_PATH] + sorted(
         glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "benchmarks", "BENCH_sections_r*_partial.jsonl")),
         reverse=True)
+    sections, times, sources = {}, [], []
     for path in candidates:
-        sections, times = _load_sections(path)
-        if not sections:
+        found, ftimes = _load_sections(path)
+        fresh = {k: v for k, v in found.items() if k not in sections}
+        if not fresh:
             continue
-        adam = sections.get("fused_adam") or {}
-        headline = adam.get("speedup_vs_eager") if isinstance(adam, dict) else None
-        out = {
+        sections.update(fresh)
+        times.extend(ftimes)
+        sources.append(path)
+    if not sections:
+        return {
             "metric": "fused_adam_step_speedup_vs_eager",
-            "value": headline if headline is not None else -1.0,
-            "unit": "x",
-            "vs_baseline": round(headline / 1.5, 3) if headline is not None else -1.0,
-            "error": err,
-            "live": False,
-            "banked_from": path,
-            "banked_measured_at": [min(times), max(times)] if times else None,
-            "note": ("preflight failed NOW, but these sections were measured "
-                     "on the real chip earlier (streamed+fsynced per section "
-                     "at the timestamps shown) before the tunnel wedged"),
+            "value": -1.0, "unit": "x", "vs_baseline": -1.0, "error": err,
         }
-        roof = sections.get("matmul_roofline")
-        if isinstance(roof, (int, float)):
-            out["matmul_roofline_tflops"] = round(float(roof), 1)
-        for name in ("fused_adam", "gpt124_s1024", "gpt124_s4096", "gpt345_s1024",
-                     "resnet50_b64", "bert_base_lamb", "flash_attn",
-                     "zero2_vs_fused"):
-            if name in sections:
-                out[name if name != "fused_adam" else "adam"] = sections[name]
-        return out
-    return {
+    adam = sections.get("fused_adam") or {}
+    headline = adam.get("speedup_vs_eager") if isinstance(adam, dict) else None
+    out = {
         "metric": "fused_adam_step_speedup_vs_eager",
-        "value": -1.0,
+        "value": headline if headline is not None else -1.0,
         "unit": "x",
-        "vs_baseline": -1.0,
+        "vs_baseline": round(headline / 1.5, 3) if headline is not None else -1.0,
         "error": err,
+        "live": False,
+        "banked_from": sources,
+        "banked_measured_at": [min(times), max(times)] if times else None,
+        "note": ("preflight failed NOW, but these sections were measured "
+                 "on the real chip earlier (streamed+fsynced per section "
+                 "at the timestamps shown) before the tunnel wedged"),
     }
+    roof = sections.get("matmul_roofline")
+    if isinstance(roof, (int, float)):
+        out["matmul_roofline_tflops"] = round(float(roof), 1)
+    for name in ("fused_adam", "fused_ln", "gpt124_s1024", "gpt124_s4096",
+                 "gpt345_s1024", "gpt124_s1024_fce", "resnet50_b64",
+                 "bert_base_lamb", "flash_attn", "zero2_vs_fused"):
+        if name in sections:
+            out[name if name != "fused_adam" else "adam"] = sections[name]
+    return out
 
 
 def main():
