@@ -36,6 +36,7 @@ all-reduce (Megatron parallel_lm_logits pairing, reference
 layers.py:141-156).
 """
 
+import os
 from functools import partial
 
 import numpy as np
@@ -44,6 +45,33 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["fused_lm_head_ce"]
+
+
+def _pallas_mode() -> str:
+    """"on" (real TPU), "interpret" (forced, CPU tests), or "off".
+
+    On TPU the Pallas kernels (ops/fused_ce_pallas.py) replace the
+    chunked scan: XLA still materializes each scan chunk's logits in
+    HBM between the matmul and its reductions, so the scan bounds peak
+    memory but not traffic — the kernels keep every logits tile in
+    VMEM.  APEX_TPU_FUSED_CE_PALLAS=0 forces the scan path (A/B lever);
+    =interpret runs the kernels through the Pallas interpreter."""
+    env = os.environ.get("APEX_TPU_FUSED_CE_PALLAS", "auto").lower()
+    if env in ("0", "false", "off", "no"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    if env not in ("auto", "1", "true", "on", "yes"):
+        # an unrecognized spelling silently falling through to "auto"
+        # would invalidate the exact A/B the knob exists for
+        raise ValueError(f"APEX_TPU_FUSED_CE_PALLAS={env!r}: use 0/1, "
+                         f"on/off, true/false, yes/no, auto, or interpret")
+    try:
+        if jax.devices()[0].platform == "tpu":
+            return "on"
+    except Exception:  # noqa: BLE001 — no backend yet: scan path
+        pass
+    return "off"
 
 
 def _chunk(a, n_chunks):
@@ -85,7 +113,10 @@ def _chunk_grads(x_c, embed, t_c, lse_c, g_c, axis_name):
     p = jnp.exp(logits - lse_c[..., None])              # global softmax
     partition = logits.shape[-1]
     if axis_name is None:
-        local_t = t_c
+        # clamp to match the forward's take_along_axis (and the Pallas
+        # path): an unclamped scatter would silently DROP out-of-range
+        # ids while the forward counted their clamped logit
+        local_t = jnp.clip(t_c, 0, partition - 1)
         onehot_scale = 1.0
     else:
         rank = jax.lax.axis_index(axis_name)
@@ -117,8 +148,40 @@ def fused_lm_head_ce(x, embed, targets, chunk_size=128, axis_name=None):
     return loss
 
 
+def _local_targets(targets, partition, axis_name):
+    """Shard-local ids; out-of-shard rows go out of [0, partition) and
+    naturally miss every kernel tile (contributing the 0 the psum
+    contract expects).  Dense mode clamps instead: the scan path's
+    ``take_along_axis`` clamps out-of-range ids, and the kernel must
+    produce the same loss/grads for the same inputs on every
+    platform."""
+    if axis_name is None:
+        return jnp.clip(targets, 0, partition - 1)
+    return targets - jax.lax.axis_index(axis_name) * partition
+
+
 def _fwd(x, embed, targets, chunk_size, axis_name):
-    S = x.shape[0]
+    S, B = targets.shape
+    mode = _pallas_mode()
+    if mode != "off":
+        from apex_tpu.ops.fused_ce_pallas import fused_ce_fwd_pallas
+
+        H = x.shape[-1]
+        local_t = _local_targets(targets, embed.shape[0], axis_name)
+        m, l, tgt = fused_ce_fwd_pallas(
+            x.reshape(S * B, H), embed, local_t.reshape(S * B),
+            interpret=(mode == "interpret"))
+        if axis_name is not None:
+            m_g = jax.lax.pmax(m, axis_name)
+            l_g = jax.lax.psum(l * jnp.exp(m - m_g), axis_name)
+            lse = m_g + jnp.log(l_g)
+            tgt = jax.lax.psum(tgt, axis_name)
+        else:
+            lse = m + jnp.log(l)
+        lse = lse.reshape(S, B)
+        loss = lse - tgt.reshape(S, B)
+        return loss, (x, embed, targets, lse)
+
     assert S % chunk_size == 0, (S, chunk_size)
     n = S // chunk_size
 
@@ -136,6 +199,19 @@ def _fwd(x, embed, targets, chunk_size, axis_name):
 def _bwd(chunk_size, axis_name, res, g):
     x, embed, targets, lse = res
     S = x.shape[0]
+    dt = np.zeros(targets.shape, dtype=jax.dtypes.float0)
+    mode = _pallas_mode()
+    if mode != "off":
+        from apex_tpu.ops.fused_ce_pallas import fused_ce_bwd_pallas
+
+        B, H = targets.shape[1], x.shape[-1]
+        local_t = _local_targets(targets, embed.shape[0], axis_name)
+        dx2, dembed = fused_ce_bwd_pallas(
+            x.reshape(S * B, H), embed, local_t.reshape(S * B),
+            lse.reshape(S * B), g.reshape(S * B),
+            interpret=(mode == "interpret"))
+        return dx2.reshape(x.shape), dembed.astype(embed.dtype), dt
+
     n = S // chunk_size
 
     def step(dembed, xs):
@@ -148,7 +224,6 @@ def _bwd(chunk_size, axis_name, res, g):
         (_chunk(x, n), _chunk(targets, n), _chunk(lse, n), _chunk(g, n)))
     dx = dx.reshape(x.shape)
     # int targets: cotangent is the symbolic float0 zero
-    dt = np.zeros(targets.shape, dtype=jax.dtypes.float0)
     return dx, dembed.astype(embed.dtype), dt
 
 

@@ -1,0 +1,168 @@
+"""Pallas fused-CE kernels (ops/fused_ce_pallas.py) — interpreter-mode
+parity on CPU (the kernels engage for real only on TPU; see
+tests/test_layer_norm_pallas.py for the same convention).
+
+The scan path's tests (test_fused_ce.py) re-run on this path too when
+APEX_TPU_FUSED_CE_PALLAS=interpret is exported; here we pin the
+highest-value cases permanently: raw kernel parity, the dispatch
+integration through gpt_loss, and the tp pmax/psum recombination."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.models.gpt import GPTConfig, gpt_loss, init_params
+from apex_tpu.ops.fused_ce_pallas import (
+    fused_ce_bwd_pallas,
+    fused_ce_fwd_pallas,
+)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_FUSED_CE_PALLAS", "interpret")
+    monkeypatch.setenv("APEX_TPU_FUSED_CE_DOT", "float32")
+
+
+def _data(N=64, H=32, V=96):
+    x = jax.random.normal(jax.random.PRNGKey(0), (N, H), jnp.float32)
+    e = jax.random.normal(jax.random.PRNGKey(1), (V, H), jnp.float32)
+    t = jax.random.randint(jax.random.PRNGKey(2), (N,), 0, V)
+    return x, e, t
+
+
+def test_fwd_kernel_matches_dense():
+    x, e, t = _data()
+    logits = x @ e.T
+    m, l, tgt = fused_ce_fwd_pallas(x, e, t, block_n=16, block_v=32,
+                                    interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(m + jnp.log(l)),
+        np.asarray(jax.scipy.special.logsumexp(logits, -1)), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(tgt),
+        np.asarray(jnp.take_along_axis(logits, t[:, None], -1)[:, 0]),
+        rtol=1e-6)
+
+
+@pytest.mark.parametrize("shape", [(90, 32, 393), (24, 8, 100)])
+def test_edge_shapes_ceil_grid(shape):
+    """Non-lane-aligned N and V (e.g. a tp8 vocab shard 6288 = 2^4·3·131
+    has NO aligned divisor): the ceil-grid edge tiles must mask their
+    overrun rows/cols — including zeroing garbage operand rows before
+    the MXU dots (0 × NaN = NaN inside a contraction)."""
+    N, H, V = shape
+    x = jax.random.normal(jax.random.PRNGKey(0), (N, H), jnp.float32)
+    e = jax.random.normal(jax.random.PRNGKey(1), (V, H), jnp.float32)
+    t = jax.random.randint(jax.random.PRNGKey(2), (N,), 0, V)
+    g = jax.random.normal(jax.random.PRNGKey(3), (N,)) / N
+    logits = x @ e.T
+    lse_ref = jax.scipy.special.logsumexp(logits, -1)
+    m, l, tgt = fused_ce_fwd_pallas(x, e, t, block_n=64, block_v=128,
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(m + jnp.log(l)),
+                               np.asarray(lse_ref), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(tgt),
+        np.asarray(jnp.take_along_axis(logits, t[:, None], -1)[:, 0]),
+        rtol=1e-5, atol=1e-5)
+
+    def loss(x, e):
+        lg = x @ e.T
+        return jnp.sum(g * (jax.scipy.special.logsumexp(lg, -1)
+                            - jnp.take_along_axis(lg, t[:, None], -1)[:, 0]))
+
+    dx_ref, de_ref = jax.grad(loss, argnums=(0, 1))(x, e)
+    dx, de = fused_ce_bwd_pallas(x, e, t, lse_ref, g, block_n=64,
+                                 block_v=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(de), np.asarray(de_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bwd_kernels_match_autodiff():
+    x, e, t = _data()
+    g = jax.random.normal(jax.random.PRNGKey(3), (x.shape[0],))
+
+    def loss(x, e):
+        lg = x @ e.T
+        ls = jax.scipy.special.logsumexp(lg, -1)
+        tg = jnp.take_along_axis(lg, t[:, None], -1)[:, 0]
+        return jnp.sum(g * (ls - tg))
+
+    dx_ref, de_ref = jax.grad(loss, argnums=(0, 1))(x, e)
+    lse = jax.scipy.special.logsumexp(x @ e.T, -1)
+    dx, de = fused_ce_bwd_pallas(x, e, t, lse, g, block_n=16, block_v=32,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(de), np.asarray(de_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+CFG = GPTConfig(
+    vocab_size=64, hidden_size=32, num_layers=2, num_attention_heads=4,
+    max_seq_len=16, compute_dtype=jnp.float32, checkpoint_layers=False,
+    fused_ce=True, fused_ce_chunk=8,
+)
+
+
+def test_gpt_loss_via_kernels_matches_dense():
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, size=(2, 16)))
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    dense = dataclasses.replace(CFG, fused_ce=False)
+    ref, ref_g = jax.value_and_grad(gpt_loss)(params, tokens, targets, dense)
+    got, got_g = jax.value_and_grad(gpt_loss)(params, tokens, targets, CFG)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        got_g, ref_g)
+
+
+def test_tp_recombination_matches_dense(devices8):
+    """Kernel per shard + pmax/psum outside == global softmax: the
+    (m, l, tgt) recombination is the load-bearing tp contract."""
+    from apex_tpu.ops.fused_ce import fused_lm_head_ce
+
+    S, B, H, V, tp = 16, 2, 32, 64, 4
+    x = jax.random.normal(jax.random.PRNGKey(0), (S, B, H), jnp.float32)
+    e = jax.random.normal(jax.random.PRNGKey(1), (V, H), jnp.float32)
+    t = jax.random.randint(jax.random.PRNGKey(2), (S, B), 0, V)
+
+    def dense(x, e):
+        lg = jnp.matmul(x, e.T)
+        ls = jax.scipy.special.logsumexp(lg, -1)
+        tg = jnp.take_along_axis(lg, t[..., None], -1)[..., 0]
+        return jnp.mean(ls - tg)
+
+    ref = dense(x, e)
+    dx_ref, de_ref = jax.grad(dense, argnums=(0, 1))(x, e)
+
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+
+    def local(x, e_local):
+        def f(x, e_local):
+            return jnp.mean(fused_lm_head_ce(x, e_local, t, 8, "tp"))
+
+        loss = f(x, e_local)
+        dx, de = jax.grad(f, argnums=(0, 1))(x, e_local)
+        return loss, jax.lax.psum(dx, "tp"), de
+
+    f = jax.shard_map(local, mesh=mesh,
+                      in_specs=(P(), P("tp", None)),
+                      out_specs=(P(), P(), P("tp", None)),
+                      check_vma=False)
+    loss, dx, de = f(x, e)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(de), np.asarray(de_ref),
+                               rtol=1e-5, atol=1e-6)
