@@ -854,10 +854,33 @@ def main():
     gpt345_1k = (_try("gpt345_s1024", bench_gpt, 24, 1024, 16, 1024, 8, roof, iters=10)
                  if want("gpt345_s1024") else skipped)
     # the chunked fused LM-head+CE A/B vs gpt124_s1024 (ops/fused_ce.py):
-    # the audited record of whether eliding the (S,B,V) logits pays
+    # the audited record of whether eliding the (S,B,V) logits pays.
+    # This is the Pallas CE kernels' first-ever hardware execution — if
+    # Mosaic rejects them, fall back to the scan impl for the section
+    # so the A/B still lands, recording which impl actually ran.
+    def bench_gpt_fce():
+        from apex_tpu.ops import fused_ce as _fce_mod
+
+        try:
+            r = bench_gpt(12, 768, 12, 1024, 8, roof, fused_ce=True)
+            r["impl"] = _fce_mod._pallas_mode()
+            return r
+        except Exception as e:  # noqa: BLE001 — OOM is real, re-raise
+            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                raise
+            _progress(f"fce pallas path failed ({type(e).__name__}); "
+                      f"retrying on the scan impl")
+            os.environ["APEX_TPU_FUSED_CE_PALLAS"] = "0"
+            try:
+                r = bench_gpt(12, 768, 12, 1024, 8, roof, fused_ce=True)
+            finally:
+                os.environ.pop("APEX_TPU_FUSED_CE_PALLAS", None)
+            r["impl"] = "scan-fallback"
+            r["pallas_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            return r
+
     if want("gpt124_s1024_fce"):
-        _try("gpt124_s1024_fce", bench_gpt, 12, 768, 12, 1024, 8, roof,
-             fused_ce=True)
+        _try("gpt124_s1024_fce", bench_gpt_fce, section_budget=900.0)
     # 900s: the ResNet-50 train step is the widest graph in the suite and
     # its first compile over the tunnel is the one that hit the 600s
     # watchdog in round 5 — give the compile headroom before concluding
