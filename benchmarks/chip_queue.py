@@ -133,6 +133,11 @@ QUEUE = [
     ("memfit_gpt",
      [sys.executable, "benchmarks/memfit_gpt.py"],
      2400),
+    # the fused-CE peak-HBM A/B: same config with the (S,B,V) logits
+    # elided — the measured counterpart of the ~3.3 GB/step claim
+    ("memfit_gpt_fce",
+     [sys.executable, "benchmarks/memfit_gpt.py", "--fused-ce"],
+     2400),
 ]
 
 PROBE_CODE = ("import jax; jax.devices(); import jax.numpy as jnp; "

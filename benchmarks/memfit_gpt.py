@@ -72,6 +72,7 @@ def _config(args):
         num_layers=args.layers, num_attention_heads=args.heads,
         max_seq_len=args.seq, compute_dtype=jnp.bfloat16,
         use_flash_attention=True, checkpoint_layers=True,
+        fused_ce=args.fused_ce,
     )
 
 
@@ -127,6 +128,10 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=50304)
+    ap.add_argument("--fused-ce", action="store_true",
+                    help="measure with the chunked fused LM-head+CE — "
+                         "the A/B for its claimed ~3.3 GB/step peak-HBM "
+                         "saving (the (S,B,V) fp32 logits + d_logits)")
     ap.add_argument("--probe-batch", type=int, default=None,
                     help=argparse.SUPPRESS)  # internal: child mode
     ap.add_argument("--probe-timeout", type=float, default=600.0)
@@ -156,7 +161,7 @@ def main():
         "--layers", str(args.layers), "--hidden", str(args.hidden),
         "--heads", str(args.heads), "--seq", str(args.seq),
         "--vocab", str(args.vocab),
-    ]
+    ] + (["--fused-ce"] if args.fused_ce else [])
     fit_batch = None
     b = args.batch
     while b >= 1:
