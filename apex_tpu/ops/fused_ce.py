@@ -84,7 +84,11 @@ def _chunk_stats(x_c, embed, t_c, axis_name):
                         embed.T.astype(jnp.float32))  # (C, B, Vl)
     if axis_name is None:
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        # explicit clamp: bare take_along_axis WRAPS negative ids and
+        # NaN-fills past-V ones under jit — clamping pins ONE
+        # deterministic semantic that the Pallas path reproduces exactly
+        t_cl = jnp.clip(t_c, 0, logits.shape[-1] - 1)
+        tgt = jnp.take_along_axis(logits, t_cl[..., None], axis=-1)[..., 0]
         return lse, tgt
     # vocab-parallel: global max / sum-exp / target-gather per chunk
     partition = logits.shape[-1]

@@ -105,6 +105,33 @@ def test_bwd_kernels_match_autodiff():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_out_of_range_targets_match_scan_path(monkeypatch):
+    """Dense-mode ids outside [0, V) must clamp IDENTICALLY on both
+    impls (the scan path's take_along_axis clamps; the kernel clamps in
+    _local_targets) — platform-dependent losses for the same inputs
+    would be a silent correctness trap."""
+    from apex_tpu.ops.fused_ce import fused_lm_head_ce
+
+    S, B, H, V = 16, 2, 32, 48
+    x = jax.random.normal(jax.random.PRNGKey(0), (S, B, H), jnp.float32)
+    e = jax.random.normal(jax.random.PRNGKey(1), (V, H), jnp.float32)
+    t = jax.random.randint(jax.random.PRNGKey(2), (S, B), 0, V)
+    t = t.at[0, 0].set(-1).at[1, 1].set(V + 7)
+
+    def mean_loss(x, e):
+        return jnp.mean(fused_lm_head_ce(x, e, t, 8))
+
+    got = float(mean_loss(x, e))
+    got_g = jax.grad(mean_loss, argnums=(0, 1))(x, e)
+    monkeypatch.setenv("APEX_TPU_FUSED_CE_PALLAS", "0")
+    ref = float(mean_loss(x, e))
+    ref_g = jax.grad(mean_loss, argnums=(0, 1))(x, e)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    for a, b in zip(got_g, ref_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 CFG = GPTConfig(
     vocab_size=64, hidden_size=32, num_layers=2, num_attention_heads=4,
     max_seq_len=16, compute_dtype=jnp.float32, checkpoint_layers=False,
