@@ -41,6 +41,7 @@ completes, so a mid-run tunnel wedge preserves every finished section.
 
 import json
 import os
+from functools import partial
 import threading
 import time
 from typing import Optional
@@ -328,7 +329,11 @@ def _bench_gpt_at_batch(layers, hidden, heads, seq, batch, roofline_tflops,
     tokens = jnp.asarray(rng.randint(0, vocab, size=(batch, seq)))
     targets = jnp.roll(tokens, -1, axis=1)
 
-    @jax.jit
+    # donation: the loop immediately rebinds params/state, and without
+    # aliasing XLA holds input AND output copies of ~3x param bytes
+    # (params + adam m/v) across the step — the difference between
+    # fitting and halving the batch at 345M/bert-large scale
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, state):
         loss, grads = jax.value_and_grad(gpt_loss)(params, tokens, targets, cfg)
         params, state = opt.update(grads, state, params)
@@ -476,7 +481,7 @@ def _bench_bert_at_batch(layers, hidden, heads, seq, batch, vocab, iters):
         (rng.rand(batch, seq) < 0.15) & np.asarray(pad)
     ).astype(jnp.float32)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, state):
         loss, grads = jax.value_and_grad(bert_mlm_loss)(
             params, tokens, targets, loss_mask, cfg, pad_mask=pad
