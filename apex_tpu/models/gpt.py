@@ -494,6 +494,7 @@ def make_train_step(
     cp_axis: Optional[str] = None,
     opt_state_spec=None,
     loss_scaler=None,
+    donate_state: bool = False,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
 
@@ -501,6 +502,13 @@ def make_train_step(
     default the FusedAdam state shape is assumed (m/v mirror the param
     sharding, scalars replicated) and ZeRO optimizers supply their own —
     pass this for other state shapes (e.g. ``SGDState``).
+
+    ``donate_state``: donate the params and optimizer-state buffers to
+    the step (``jax.jit`` ``donate_argnums``) — XLA otherwise holds
+    input AND output copies (~3x param bytes with Adam) across the
+    step.  The caller must rebind both on every call and never touch
+    the previous values (the examples do; oracle tests that reuse
+    params after stepping must not set this).
 
     ``loss_scaler``: an :class:`apex_tpu.amp.DynamicLossScaler` /
     ``StaticLossScaler`` — the flagship fp16 path (reference
@@ -620,6 +628,7 @@ def make_train_step(
         sspec = state_spec_of(specs)
     data_spec = P(dp_axis, cp_axis)  # batch over dp, sequence over cp
 
+    donate = (0, 1) if donate_state else ()
     if loss_scaler is not None:
         sharded = jax.shard_map(
             scaled_local_step,
@@ -628,7 +637,7 @@ def make_train_step(
             out_specs=(specs, sspec, P(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded)
+        return jax.jit(sharded, donate_argnums=donate)
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
@@ -636,7 +645,7 @@ def make_train_step(
         out_specs=(specs, sspec, P()),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    return jax.jit(sharded, donate_argnums=donate)
 
 
 def params_to_vpp_layout(params, pp: int, vpp: int):
@@ -693,6 +702,7 @@ def make_pp_train_step(
     opt_state_spec=None,
     cp_axis: Optional[str] = None,
     loss_scaler=None,
+    donate_state: bool = False,
 ):
     """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
 
@@ -927,6 +937,7 @@ def make_pp_train_step(
         sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
     data_spec = P(dp_axis, cp_axis) if dp_axis is not None else P(None, cp_axis)
 
+    donate = (0, 1) if donate_state else ()
     if loss_scaler is not None:
         sharded = jax.shard_map(
             scaled_local_step,
@@ -935,7 +946,7 @@ def make_pp_train_step(
             out_specs=(specs, sspec, P(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded)
+        return jax.jit(sharded, donate_argnums=donate)
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
@@ -943,7 +954,7 @@ def make_pp_train_step(
         out_specs=(specs, sspec, P()),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    return jax.jit(sharded, donate_argnums=donate)
 
 
 def gpt_loss(

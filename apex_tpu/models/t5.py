@@ -339,10 +339,15 @@ def t5_loss(params, src_tokens, dec_tokens, targets, config: T5Config,
 
 
 def make_train_step(config: T5Config, optimizer, mesh=None,
-                    tp_axis: str = "tp", dp_axis: Optional[str] = None):
-    """(tp × dp) train step without pipeline parallelism."""
+                    tp_axis: str = "tp", dp_axis: Optional[str] = None,
+                    donate_state: bool = False):
+    """(tp × dp) train step without pipeline parallelism.
+
+    ``donate_state``: donate params/opt-state buffers (see
+    models/gpt.make_train_step — callers must rebind every call)."""
     from jax.sharding import PartitionSpec as P
 
+    donate = (0, 1) if donate_state else ()
     if mesh is None:
         def step(params, opt_state, src, dec_in, targets):
             loss, grads = jax.value_and_grad(t5_loss)(
@@ -350,7 +355,7 @@ def make_train_step(config: T5Config, optimizer, mesh=None,
             params, opt_state = optimizer.update(grads, opt_state, params)
             return params, opt_state, loss
 
-        return jax.jit(step)
+        return jax.jit(step, donate_argnums=donate)
 
     specs = param_specs(config)
 
@@ -372,7 +377,7 @@ def make_train_step(config: T5Config, optimizer, mesh=None,
         in_specs=(specs, sspec, data, data, data),
         out_specs=(specs, sspec, P()),
         check_vma=False,
-    ))
+    ), donate_argnums=donate)
 
 
 # -------------------------------------------------------------- pipeline
@@ -400,6 +405,7 @@ def make_pp_train_step(
     pp_axis: str = "pp",
     dp_axis: Optional[str] = None,
     loss_scaler=None,
+    donate_state: bool = False,
 ):
     """Encoder-decoder pipeline train step (tp × pp × dp) over the
     dual-stream 1F1B schedule.  ``split`` defaults to
@@ -528,16 +534,17 @@ def make_pp_train_step(
 
     sspec = AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs, master=None)
     data = P(dp_axis) if dp_axis else P()
+    donate = (0, 1) if donate_state else ()
     if loss_scaler is not None:
         return jax.jit(jax.shard_map(
             scaled_local_step, mesh=mesh,
             in_specs=(specs, sspec, P(), data, data, data),
             out_specs=(specs, sspec, P(), P()),
             check_vma=False,
-        ))
+        ), donate_argnums=donate)
     return jax.jit(jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, sspec, data, data, data),
         out_specs=(specs, sspec, P()),
         check_vma=False,
-    ))
+    ), donate_argnums=donate)

@@ -147,11 +147,15 @@ def main():
     scaler_state = scaler.init() if scaler else None
 
     if args.pp > 1:
+        # donate_state: the loop rebinds params/state every step and the
+        # async checkpointer host-snapshots at save() time, so donation
+        # is safe — and saves ~3x param bytes of transient HBM
         step = make_pp_train_step(config, optimizer, mesh,
                                   num_microbatches=args.micro_batches,
-                                  loss_scaler=scaler)
+                                  loss_scaler=scaler, donate_state=True)
     else:
-        step = make_train_step(config, optimizer, mesh, loss_scaler=scaler)
+        step = make_train_step(config, optimizer, mesh, loss_scaler=scaler,
+                               donate_state=True)
 
     # Corpus: a memmapped token file (--data, the real-pretraining path:
     # the OS pages in only the rows each batch touches) or a synthetic

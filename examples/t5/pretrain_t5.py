@@ -111,12 +111,13 @@ def main():
         step = make_pp_train_step(config, opt, mesh,
                                   num_microbatches=args.micro_batches,
                                   split=split, dp_axis="dp",
-                                  loss_scaler=scaler)
+                                  loss_scaler=scaler, donate_state=True)
     else:
         mesh = Mesh(np.array(jax.devices()).reshape(dp, args.tp),
                     ("dp", "tp"))
         state = opt.init(params)
-        step = make_train_step(config, opt, mesh, dp_axis="dp")
+        step = make_train_step(config, opt, mesh, dp_axis="dp",
+                               donate_state=True)
         assert scaler is None, "--fp16 demo path requires --pp > 1"
 
     # a small fixed pool of batches: a fresh random batch per step keeps
