@@ -413,7 +413,7 @@ def bench_resnet(batch=64, iters=15):
     opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4, master_weights=True)
     state = opt.init(params)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, state, bs):
         def loss_fn(p, bs):
             logits, upd = model.apply(
