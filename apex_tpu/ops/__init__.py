@@ -1,6 +1,7 @@
 """Kernel/ops layer: pytree multi-tensor primitives, Pallas kernels, and
 fused composites.  Reference: ``csrc/`` (see SURVEY.md §2.2)."""
 
+from apex_tpu.ops.fused_ce import fused_lm_head_ce
 from apex_tpu.ops.multi_tensor import (
     multi_tensor_axpby,
     multi_tensor_l2norm,
@@ -17,4 +18,5 @@ __all__ = [
     "multi_tensor_norm_blend",
     "tree_not_finite",
     "tree_where",
+    "fused_lm_head_ce",
 ]
