@@ -59,9 +59,12 @@ def test_cp_forward_matches_single_device(devices8):
 
 
 @pytest.mark.slow
-def test_cp_train_step_matches_single_device(devices8):
-    """cp=2 × dp=2 × tp=2 full train step == single-device step."""
-    cfg = GPTConfig(**BASE)
+@pytest.mark.parametrize("fused_ce", [False, True])
+def test_cp_train_step_matches_single_device(devices8, fused_ce):
+    """cp=2 × dp=2 × tp=2 full train step == single-device step — with
+    and without the chunked fused LM-head+CE (its per-local-chunk loss
+    + the cp-mean calculus must agree with the dense head)."""
+    cfg = GPTConfig(**BASE, fused_ce=fused_ce, fused_ce_chunk=8)
     mesh = Mesh(np.array(devices8).reshape(2, 2, 2), ("dp", "cp", "tp"))
     params = init_params(cfg, jax.random.PRNGKey(0))
     opt = FusedAdam(lr=1e-2)
@@ -74,7 +77,11 @@ def test_cp_train_step_matches_single_device(devices8):
     step = make_train_step(cfg, opt, mesh, cp_axis="cp")
     new_params, _, loss = step(params, state, tokens, targets)
 
-    ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(params, tokens, targets, cfg)
+    import dataclasses
+
+    dense_cfg = dataclasses.replace(cfg, fused_ce=False)
+    ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(
+        params, tokens, targets, dense_cfg)
     ref_params, _ = opt.update(ref_grads, opt.init(params), params)
 
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
