@@ -190,6 +190,28 @@ class TestMoEGPT:
         loss = gpt_loss(params, tokens, jnp.roll(tokens, -1, 1), cfg)
         assert np.isfinite(float(loss))
 
+    def test_fused_ce_matches_dense_head(self):
+        """MoE's (hidden, aux) return threads through the fused head:
+        loss and grads match the dense-head config exactly."""
+        import dataclasses
+
+        from apex_tpu.models.gpt import gpt_loss, init_params
+
+        cfg = self._cfg(fused_ce=True, fused_ce_chunk=8)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(3)
+        tokens = jnp.asarray(rng.randint(0, 64, (4, 32)))
+        targets = jnp.roll(tokens, -1, 1)
+        dense_cfg = dataclasses.replace(cfg, fused_ce=False)
+        ref, ref_g = jax.value_and_grad(gpt_loss)(
+            params, tokens, targets, dense_cfg)
+        got, got_g = jax.value_and_grad(gpt_loss)(params, tokens, targets, cfg)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            got_g, ref_g)
+
     def test_sharded_loss_matches_dense(self, devices8):
         from apex_tpu.models.gpt import GPTConfig, gpt_loss, init_params, make_train_step
         from apex_tpu.optimizers import FusedAdam
