@@ -1,0 +1,52 @@
+"""TPU-target lowering guard for the flash attention kernels.
+
+``jax.export`` (platforms=['tpu']) runs the full Pallas→Mosaic
+lowering without a device.  The per-shape tuned-block table
+(``flash_attention_pallas._TUNED_BLOCKS``) is installed from sweep
+output by ``benchmarks/install_tuned_blocks.py`` — a bad entry must
+fail HERE, not inside an audited bench section on the chip."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import export as jexport
+
+from apex_tpu.ops import flash_attention_pallas as fap
+
+
+def _lower(fn, *avals):
+    exp = jexport.export(jax.jit(fn), platforms=["tpu"])(*avals)
+    assert len(exp.mlir_module_serialized) > 0
+
+
+@pytest.mark.parametrize("shape", [
+    (8, 12, 1024, 64),    # GPT-124M attention
+    (2, 12, 4096, 64),    # long-context
+    (8, 8, 1024, 128),    # wide head
+])
+def test_fwd_lowers_for_tpu(shape):
+    B, H, S, D = shape
+    q = jax.ShapeDtypeStruct((B * H, S, D), jnp.bfloat16)
+    _lower(lambda q, k, v: fap.flash_fwd_pallas(
+        q, k, v, 1.0 / D ** 0.5, True, 0, 0, heads=H), q, q, q)
+
+
+def test_bwd_lowers_for_tpu():
+    B, H, S, D = 8, 12, 1024, 64
+    q = jax.ShapeDtypeStruct((B * H, S, D), jnp.bfloat16)
+    r = jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32)
+    _lower(lambda q, k, v, o, lse, do: fap.flash_bwd_pallas(
+        q, k, v, o, lse, do, 1.0 / D ** 0.5, True, 0, 0, heads=H),
+        q, q, q, q, r, q)
+
+
+def test_tuned_blocks_lower_for_tpu():
+    """Whatever the sweep installed must lower for its own shape."""
+    table = dict(fap._TUNED_BLOCKS)
+    if not table:
+        pytest.skip("no tuned blocks installed yet")
+    for (S, D, dtype), (bq, bk) in table.items():
+        q = jax.ShapeDtypeStruct((4, S, D), jnp.dtype(dtype))
+        _lower(lambda q, k, v: fap.flash_fwd_pallas(
+            q, k, v, 1.0 / D ** 0.5, True, 0, 0,
+            block_q=bq, block_k=bk, heads=4), q, q, q)
