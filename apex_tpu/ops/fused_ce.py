@@ -61,7 +61,9 @@ def _pallas_mode() -> str:
         return "off"
     if env == "interpret":
         return "interpret"
-    if env not in ("auto", "1", "true", "on", "yes"):
+    if env in ("1", "true", "on", "yes"):
+        return "on"  # forced — even off-TPU (compile will fail loudly)
+    if env != "auto":
         # an unrecognized spelling silently falling through to "auto"
         # would invalidate the exact A/B the knob exists for
         raise ValueError(f"APEX_TPU_FUSED_CE_PALLAS={env!r}: use 0/1, "

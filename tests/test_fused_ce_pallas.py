@@ -133,6 +133,26 @@ def test_kernels_lower_for_tpu_target(shape):
     assert len(bwd.mlir_module_serialized) > 0
 
 
+def test_gpt_loss_grad_lowers_for_tpu_with_kernels(monkeypatch):
+    """value_and_grad(gpt_loss) with the kernels FORCED on lowers for
+    the TPU target — the CE kernels validated inside the real model
+    graph (residual threading, float0 cotangent, reshapes), not just
+    standalone."""
+    from jax import export as jexport
+
+    monkeypatch.setenv("APEX_TPU_FUSED_CE_PALLAS", "1")
+    cfg = dataclasses.replace(CFG, compute_dtype=jnp.bfloat16)
+    params = jax.eval_shape(
+        lambda k: init_params(cfg, k), jax.ShapeDtypeStruct((2,), jnp.uint32))
+    tok = jax.ShapeDtypeStruct((2, 16), jnp.int32)
+
+    def step(params, tokens, targets):
+        return jax.value_and_grad(gpt_loss)(params, tokens, targets, cfg)
+
+    exp = jexport.export(jax.jit(step), platforms=["tpu"])(params, tok, tok)
+    assert len(exp.mlir_module_serialized) > 0
+
+
 def test_out_of_range_targets_match_scan_path(monkeypatch):
     """Dense-mode ids outside [0, V) must clamp IDENTICALLY on both
     impls (the scan path's take_along_axis clamps; the kernel clamps in
