@@ -114,7 +114,12 @@ def _flash_retuned_argv():
 # before the 2700s section budget re-arms — a 3600s cap would SIGKILL a
 # legitimately recovering run near completion
 QUEUE = [
-    ("bench_resume", _bench_argv, 4500),
+    # timeout tunable: near the deadline a SHORTER cap keeps the step
+    # eligible — a killed-at-timeout bench still banks every completed
+    # section (streaming sidecar + killpg), strictly better than the
+    # deadline filter dropping it for not fitting
+    ("bench_resume", _bench_argv,
+     int(os.environ.get("CHIP_QUEUE_BENCH_TIMEOUT", "4500"))),
     ("flash_sweep",
      [sys.executable, "benchmarks/flash_sweep.py"],
      5400),
