@@ -25,6 +25,8 @@ XLA collectives over a device mesh (ICI/DCN) instead of NCCL process groups.
 
 import logging as _logging
 
+import apex_tpu._compat  # noqa: F401 — installs jax version aliases
+
 __version__ = "0.1.0"
 
 from apex_tpu.utils.logging import RankInfoFormatter, get_logger
@@ -34,6 +36,7 @@ from apex_tpu.utils.logging import RankInfoFormatter, get_logger
 # import structure of apex/__init__.py:20-30).
 _LAZY_SUBMODULES = (
     "amp",
+    "analysis",
     "optimizers",
     "normalization",
     "multi_tensor_apply",

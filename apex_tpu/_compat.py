@@ -1,0 +1,85 @@
+"""JAX version compatibility aliases.
+
+The chip image runs a newer jax than some dev/CI environments (0.4.x).
+Rather than pinning, alias the small set of renamed APIs this codebase
+uses onto their old names so both environments import and run:
+
+- ``jax.shard_map`` — promoted from ``jax.experimental.shard_map`` with
+  the ``check_rep`` kwarg renamed to ``check_vma``.
+- ``jax.lax.axis_size`` — new accessor; ``psum(1, axis)`` of a static
+  unit is the long-standing equivalent (constant-folded, no collective).
+- ``pltpu.CompilerParams`` — renamed from ``TPUCompilerParams``
+  (importing any submodule runs this first, so the Pallas modules can
+  use the new name unconditionally).
+- ``Lowered.as_text(debug_info=True)`` — old jax exposes location
+  metadata (named_scope names) only through the MLIR printer's debug
+  flag; the wrapper routes the kwarg there.
+
+Each alias installs only when the new name is missing, so on current
+jax this module is a no-op.  Imported for its side effects by
+``apex_tpu/__init__.py`` before any submodule can hit the new names.
+"""
+
+def _install() -> None:
+    try:
+        import jax
+        import jax.lax
+    except Exception:  # noqa: BLE001 — no/broken jax: nothing to alias.
+        # The one consumer that must still work here is the jax-free
+        # static analyzer (`python -m apex_tpu.analysis`), whose import
+        # of the parent package runs this module.
+        return
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f=None, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            if f is None:  # decorator form: jax.shard_map(mesh=...)(f)
+                return lambda g: shard_map(g, **kwargs)
+            return _shard_map(f, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams"):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pallas optional at import time
+        pass
+
+    import inspect
+
+    try:
+        from jax._src import stages
+    except Exception:  # noqa: BLE001 — private path; never break import
+        return
+
+    if "debug_info" not in inspect.signature(
+            stages.Lowered.as_text).parameters:
+        _orig_as_text = stages.Lowered.as_text
+
+        def as_text(self, dialect=None, *, debug_info=False):
+            if debug_info:
+                # old jax prints location metadata (named_scope names
+                # etc.) only through the MLIR printer's debug flag
+                import io
+
+                ir = self.compiler_ir(dialect) if dialect \
+                    else self.compiler_ir()
+                buf = io.StringIO()
+                ir.operation.print(file=buf, enable_debug_info=True)
+                return buf.getvalue()
+            return _orig_as_text(self, dialect)
+
+        stages.Lowered.as_text = as_text
+
+
+_install()

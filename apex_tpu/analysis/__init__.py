@@ -1,0 +1,62 @@
+"""apex_tpu.analysis — JAX/Pallas-aware static linter for TPU hazards.
+
+Catches, before code ever reaches the chip, the failure classes that
+are silent and deferred on TPU (each found at least once by a human
+reviewer in this repo's history — the rules scale those findings into
+machine-checked invariants):
+
+- **APX101/102** trace-time host-state capture and process-global env
+  mutation (``rules_trace``) — the ``bench.py:876`` class.
+- **APX201/202** collective-axis consistency against the
+  ``parallel_state.py`` mesh registry (``rules_collectives``).
+- **APX301/302** Mosaic dtype-dependent tiling contracts for Pallas
+  block shapes (``rules_tiling``) — the ``_ceil_block(..., 8)``-on-bf16
+  class.
+- **APX401/402** indexing/precision hygiene: unclamped vocab gathers
+  and fp32 constants in bf16 paths (``rules_precision``) — the
+  ``gpt.py:447`` class.
+
+CLI: ``python -m apex_tpu.analysis [paths] [--baseline FILE]`` — see
+``docs/static_analysis.md`` for rule details, the baseline format, and
+how to add a rule.  This package imports NO jax: it must run in
+containers where jax is broken and over trees that do not import.
+"""
+
+from apex_tpu.analysis.baseline import (
+    BaselineEntry, BaselineError, apply_baseline, load_baseline,
+)
+from apex_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, analyze_file, analyze_paths,
+    discover_axis_registry,
+)
+from apex_tpu.analysis.rules_collectives import (
+    CollectiveOutsideSpmdContext, UnknownCollectiveAxis,
+)
+from apex_tpu.analysis.rules_precision import (
+    Fp32ConstantInBf16Path, UnclampedTakeAlongAxis,
+)
+from apex_tpu.analysis.rules_tiling import (
+    BlockShapeTilingViolation, HardCodedSublaneAlignment,
+)
+from apex_tpu.analysis.rules_trace import (
+    ProcessGlobalEnvMutation, TraceTimeHostStateRead,
+)
+
+#: Every shipped rule, instantiated — the CLI's and the test suite's
+#: single source of truth for "what does a full run check".
+DEFAULT_RULES = (
+    TraceTimeHostStateRead(),
+    ProcessGlobalEnvMutation(),
+    UnknownCollectiveAxis(),
+    CollectiveOutsideSpmdContext(),
+    BlockShapeTilingViolation(),
+    HardCodedSublaneAlignment(),
+    UnclampedTakeAlongAxis(),
+    Fp32ConstantInBf16Path(),
+)
+
+__all__ = [
+    "BaselineEntry", "BaselineError", "DEFAULT_RULES", "Finding",
+    "ModuleContext", "Rule", "analyze_file", "analyze_paths",
+    "apply_baseline", "discover_axis_registry", "load_baseline",
+]

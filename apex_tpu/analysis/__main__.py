@@ -1,0 +1,112 @@
+"""CLI: ``python -m apex_tpu.analysis [paths] [options]``.
+
+Exit codes: 0 clean (modulo baseline), 1 findings, 2 usage/baseline
+error.  With no paths, scans the repo's default surface (``apex_tpu``,
+``bench.py``, ``examples`` — whichever exist under the current
+directory) against ``analysis_baseline.json`` when present.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from apex_tpu.analysis import (
+    DEFAULT_RULES, BaselineError, analyze_paths, apply_baseline,
+    discover_axis_registry, load_baseline,
+)
+
+DEFAULT_PATHS = ("apex_tpu", "bench.py", "examples")
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def _find_default_baseline(paths):
+    """The committed baseline lives at the repo root; the CLI may be
+    invoked from anywhere (pre-commit hooks, CI jobs with their own
+    CWD).  Search the CWD, then each scanned root and its parents, so
+    absolute-path invocations still pick the suppressions up instead of
+    silently reporting baselined findings as live."""
+    candidates = [os.getcwd()]
+    for p in paths:
+        d = os.path.abspath(p) if os.path.isdir(p) \
+            else os.path.dirname(os.path.abspath(p))
+        while True:
+            candidates.append(d)
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    for c in candidates:
+        f = os.path.join(c, DEFAULT_BASELINE)
+        if os.path.isfile(f):
+            return f
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description=__doc__.split("\n\n")[0])
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to scan (default: "
+                         f"{' '.join(DEFAULT_PATHS)} where present)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"suppression file (default: the first "
+                         f"{DEFAULT_BASELINE} found in the CWD or above "
+                         f"any scanned path)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: report everything")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--axes", default=None,
+                    help="comma-separated collective-axis registry "
+                         "override (default: *_AXIS constants parsed "
+                         "from any scanned parallel_state.py)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [p for p in DEFAULT_PATHS if os.path.exists(p)]
+    if not paths:
+        ap.error("no paths given and none of the defaults exist here")
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        ap.error(f"no such path: {missing}")
+
+    registry = (set(a for a in args.axes.split(",") if a)
+                if args.axes is not None else discover_axis_registry(paths))
+    findings = analyze_paths(paths, DEFAULT_RULES, registry)
+
+    entries = []
+    if not args.no_baseline:
+        baseline_path = args.baseline or _find_default_baseline(paths)
+        if baseline_path:
+            try:
+                entries = load_baseline(baseline_path)
+            except BaselineError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+    kept, suppressed, stale = apply_baseline(findings, entries)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in kept],
+            "suppressed": [f.to_json() for f in suppressed],
+            "stale_baseline_entries": [
+                {"rule": e.rule, "path": e.path, "symbol": e.symbol}
+                for e in stale],
+            "axes": sorted(registry),
+        }, indent=2))
+    else:
+        for f in kept:
+            print(f.render())
+        for e in stale:
+            print(f"note: stale baseline entry ({e.rule} {e.path} "
+                  f"{e.symbol}) suppresses nothing — remove it",
+                  file=sys.stderr)
+        print(f"{len(kept)} finding(s), {len(suppressed)} baselined, "
+              f"{len(stale)} stale baseline entr(ies)", file=sys.stderr)
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

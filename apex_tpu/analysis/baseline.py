@@ -1,0 +1,91 @@
+"""Baseline (suppression) file handling.
+
+The analyzer's job is to keep NEW instances of each hazard class out of
+the tree; a few existing findings are deliberate (a trace-time env read
+that *is* the documented impl-selection mechanism, a gather whose ids
+are in-range by construction).  Those live in a committed JSON baseline
+where every entry MUST carry a justification — an unexplained
+suppression is itself an error, because six months from now nobody can
+tell a reviewed exception from a rubber stamp.
+
+Entries match on rule id + path suffix + enclosing symbol + a substring
+of the finding message (never on line numbers, which drift with every
+edit above them).  Stale entries — suppressing nothing — are reported
+so the baseline shrinks as code gets fixed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from apex_tpu.analysis.core import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path: str           # suffix-matched, '/'-separated
+    symbol: str         # enclosing qualname ("*" matches any)
+    contains: str       # substring of the finding message ("" matches any)
+    justification: str
+
+    def matches(self, f: Finding) -> bool:
+        return (f.rule == self.rule
+                and f.path.replace("\\", "/").endswith(self.path)
+                and (self.symbol == "*" or f.symbol == self.symbol)
+                and self.contains in f.message)
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError as e:
+        raise BaselineError(f"cannot read baseline {path}: {e}")
+    except ValueError as e:
+        raise BaselineError(f"baseline {path} is not valid JSON: {e}")
+    entries: List[BaselineEntry] = []
+    for i, raw in enumerate(data.get("entries", [])):
+        missing = {"rule", "path", "justification"} - set(raw)
+        if missing:
+            raise BaselineError(
+                f"baseline entry #{i} missing {sorted(missing)}")
+        if not str(raw["justification"]).strip():
+            raise BaselineError(
+                f"baseline entry #{i} ({raw['rule']} {raw['path']}): "
+                f"empty justification — every suppression must explain "
+                f"WHY the finding is acceptable")
+        entries.append(BaselineEntry(
+            rule=raw["rule"], path=raw["path"],
+            symbol=raw.get("symbol", "*"),
+            contains=raw.get("contains", ""),
+            justification=raw["justification"]))
+    return entries
+
+
+def apply_baseline(
+    findings: Sequence[Finding], entries: Sequence[BaselineEntry],
+) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+    """(kept, suppressed, stale-entries)."""
+    used: Dict[int, int] = {}
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        hit = None
+        for i, e in enumerate(entries):
+            if e.matches(f):
+                hit = i
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            used[hit] = used.get(hit, 0) + 1
+            suppressed.append(f)
+    stale = [e for i, e in enumerate(entries) if i not in used]
+    return kept, suppressed, stale

@@ -1,0 +1,404 @@
+"""Rule engine for the apex_tpu static analyzer.
+
+Pure-stdlib ``ast`` analysis — importing this package must never import
+jax (the analyzer has to run in a crippled CI container, in a pre-commit
+hook, and against a tree that does not even import).  Each rule is a
+class with an ``id``, ``severity``, and ``fix_hint`` that visits one
+:class:`ModuleContext` and yields :class:`Finding`s; the contexts carry
+the per-module facts every rule family needs — above all the
+*traced-function index*, the set of functions whose bodies execute at
+JAX trace time (jitted, ``custom_vjp``'d, passed to ``pl.pallas_call``
+or a ``lax`` control-flow combinator, or reachable from one of those
+through the module-local call graph).
+
+Why trace-reachability is the load-bearing fact: Apex's CUDA extensions
+fail at build time, but this rebuild's failure modes are deferred —
+host state read during tracing is frozen into the jaxpr and silently
+stale forever after.  The index turns "is this ``os.environ.get`` a
+bug?" into a static question.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+SEVERITIES = ("error", "warning", "info")
+
+# Entry points whose function-valued arguments are traced.  Last dotted
+# component only: ``jax.jit``, ``jit``, and ``api.jit`` all match — a
+# linter that misses ``from jax import jit`` is worse than one that
+# over-asks, and the baseline absorbs deliberate cases.
+TRACE_ENTRYPOINTS: Set[str] = {
+    "jit", "pallas_call", "custom_vjp", "custom_jvp", "defvjp", "defjvp",
+    "checkpoint", "remat", "grad", "value_and_grad", "vmap", "pmap",
+    "shard_map", "xmap", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "named_call", "eval_shape",
+}
+
+# Decorators that make the decorated function traced.
+TRACE_DECORATORS: Set[str] = {
+    "jit", "custom_vjp", "custom_jvp", "checkpoint", "remat", "vmap",
+    "pmap", "shard_map",
+}
+
+# Default collective-axis registry, used only when no parallel_state.py
+# is found among the scanned roots (its ``*_AXIS`` constants are the
+# source of truth; see discover_axis_registry).
+DEFAULT_AXES: Tuple[str, ...] = ("dp", "pp", "cp", "tp", "dcn")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    symbol: str          # enclosing function qualname, or "<module>"
+    message: str
+    fix_hint: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.severity}: {self.message}\n    fix: {self.fix_hint}")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """One checkable invariant.  Subclasses set the class attributes and
+    implement :meth:`check`."""
+
+    rule_id: str = "APX000"
+    severity: str = "error"
+    fix_hint: str = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "ModuleContext", node: ast.AST,
+                message: str, fix_hint: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=self.rule_id, severity=self.severity, path=ctx.path,
+            line=getattr(node, "lineno", 0), col=getattr(node, "col_offset", 0),
+            symbol=ctx.enclosing_qualname(node),
+            message=message, fix_hint=fix_hint or self.fix_hint)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_name(node: ast.AST) -> Optional[str]:
+    """Final dotted component of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_partial(call: ast.Call) -> bool:
+    return last_name(call.func) == "partial"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef | Lambda
+    qualname: str
+    params: Set[str]
+
+
+class ModuleContext:
+    """Everything the rules need to know about one parsed module."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 axis_registry: Set[str]):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.axis_registry = axis_registry
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._collect_functions()
+        # qualname -> human-readable reason the function is traced
+        self.traced: Dict[str, str] = {}
+        # Lambda node -> reason (lambdas have no qualname; tracked by
+        # identity so `jax.jit(lambda x: ...)` bodies are still scanned)
+        self.traced_lambdas: Dict[ast.Lambda, str] = {}
+        self._build_traced_index()
+
+    # -------------------------------------------------------- structure
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self._parents.get(cur)
+        return None
+
+    def enclosing_qualname(self, node: ast.AST) -> str:
+        fn = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) else \
+            self.enclosing_function(node)
+        while fn is not None:
+            for info in self.functions.values():
+                if info.node is fn:
+                    return info.qualname
+            fn = self.enclosing_function(fn)
+        return "<module>"
+
+    def _collect_functions(self) -> None:
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    params = {a.arg for a in (
+                        child.args.posonlyargs + child.args.args +
+                        child.args.kwonlyargs)}
+                    self.functions[qn] = FunctionInfo(child, qn, params)
+                    visit(child, qn + ".")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.")
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+
+    def resolve_function(self, name: str,
+                         from_qualname: str = "") -> Optional[str]:
+        """Bare name -> qualname: innermost lexical match first."""
+        scope = from_qualname
+        while True:
+            candidate = f"{scope}.{name}" if scope else name
+            if candidate in self.functions:
+                return candidate
+            if "." not in scope:
+                break
+            scope = scope.rsplit(".", 1)[0]
+        return name if name in self.functions else None
+
+    # ---------------------------------------------------- traced index
+    def _function_args_of_call(self, call: ast.Call) -> Iterator[ast.AST]:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            yield arg
+
+    def _mark(self, qualname: Optional[str], reason: str) -> None:
+        if qualname is not None and qualname not in self.traced:
+            self.traced[qualname] = reason
+
+    def _mark_value(self, value: ast.AST, reason: str, scope: str,
+                    aliases: Dict[str, str]) -> None:
+        """Mark the function a call argument refers to: a bare Name, a
+        ``partial(f, ...)`` wrapper, or a name previously aliased to
+        either (``kernel = functools.partial(_fwd_kernel, ...)``)."""
+        if isinstance(value, ast.Lambda):
+            self.traced_lambdas.setdefault(value, reason)
+        elif isinstance(value, ast.Name):
+            target = aliases.get(value.id, value.id)
+            self._mark(self.resolve_function(target, scope), reason)
+        elif isinstance(value, ast.Call) and _is_partial(value) and value.args:
+            inner = value.args[0]
+            if isinstance(inner, ast.Name):
+                target = aliases.get(inner.id, inner.id)
+                self._mark(self.resolve_function(target, scope), reason)
+        elif isinstance(value, ast.Attribute):
+            name = last_name(value)
+            if name:
+                self._mark(self.resolve_function(name, scope), reason)
+
+    def _build_traced_index(self) -> None:
+        # 1. decorator seeds
+        for qn, info in self.functions.items():
+            node = info.node
+            for dec in getattr(node, "decorator_list", []):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = last_name(target)
+                if name in TRACE_DECORATORS:
+                    self._mark(qn, f"decorated @{name}")
+                elif name == "partial" and isinstance(dec, ast.Call) and dec.args:
+                    inner = last_name(dec.args[0])
+                    if inner in TRACE_DECORATORS:
+                        self._mark(qn, f"decorated @partial({inner}, ...)")
+
+        # 2. alias map (name -> function name via `x = f` / `x = partial(f,..)`)
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                if isinstance(node.value, ast.Name):
+                    aliases[tgt] = node.value.id
+                elif isinstance(node.value, ast.Call) \
+                        and _is_partial(node.value) and node.value.args \
+                        and isinstance(node.value.args[0], ast.Name):
+                    aliases[tgt] = node.value.args[0].id
+
+        # 3. call-site seeds: f passed to jit/pallas_call/scan/defvjp/...
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            entry = last_name(node.func)
+            if entry not in TRACE_ENTRYPOINTS:
+                continue
+            scope = self.enclosing_qualname(node)
+            scope = "" if scope == "<module>" else scope
+            for arg in self._function_args_of_call(node):
+                self._mark_value(arg, f"passed to {entry}", scope, aliases)
+
+        # 4. fixpoint propagation: lexical nesting + module-local calls
+        changed = True
+        while changed:
+            changed = False
+            for lam, reason in list(self.traced_lambdas.items()):
+                scope = self.enclosing_qualname(lam)
+                scope = "" if scope == "<module>" else scope
+                for sub in ast.walk(lam.body):
+                    if isinstance(sub, ast.Call):
+                        callee = last_name(sub.func)
+                        resolved = callee and self.resolve_function(
+                            callee, scope)
+                        if resolved and resolved not in self.traced:
+                            self.traced[resolved] = \
+                                f"called from traced lambda ({reason})"
+                            changed = True
+            for qn in list(self.traced):
+                reason = self.traced[qn]
+                info = self.functions.get(qn)
+                if info is None:
+                    continue
+                # nested defs run under the same trace
+                for other_qn in self.functions:
+                    if other_qn.startswith(qn + ".") \
+                            and other_qn not in self.traced:
+                        self.traced[other_qn] = f"nested in traced {qn}"
+                        changed = True
+                # module-local callees are traced too
+                for sub in ast.walk(info.node):
+                    if isinstance(sub, ast.Call):
+                        callee = last_name(sub.func)
+                        if callee is None:
+                            continue
+                        resolved = self.resolve_function(callee, qn)
+                        if resolved is not None \
+                                and resolved not in self.traced:
+                            self.traced[resolved] = \
+                                f"called from traced {qn} ({reason})"
+                            changed = True
+
+    def traced_reason(self, node: ast.AST) -> Optional[str]:
+        """Why the function (or lambda) enclosing ``node`` executes at
+        trace time, or None if it does not (as far as this module
+        shows).  Walks the whole lexical chain so code nested anywhere
+        under a traced def/lambda is covered."""
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if isinstance(fn, ast.Lambda):
+                if fn in self.traced_lambdas:
+                    return self.traced_lambdas[fn]
+            else:
+                qn = self.enclosing_qualname(fn)
+                if qn in self.traced:
+                    return self.traced[qn]
+            fn = self.enclosing_function(fn)
+        return None
+
+    def mentions(self, *needles: str) -> bool:
+        return any(n in self.source for n in needles)
+
+
+# ------------------------------------------------------------------ engine
+def discover_axis_registry(paths: Iterable[str]) -> Set[str]:
+    """Mesh axis names from ``*_AXIS = "..."`` constants in any
+    ``parallel_state.py`` under the scanned roots — the same constants
+    ``initialize_model_parallel`` builds the Mesh from, so the linter
+    and the runtime cannot drift.  Falls back to the well-known set."""
+    axes: Set[str] = set()
+    for ps in _find_files(paths, basename="parallel_state.py"):
+        try:
+            tree = ast.parse(open(ps, encoding="utf-8").read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name) and tgt.id.endswith("_AXIS") \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    axes.add(node.value.value)
+    return axes or set(DEFAULT_AXES)
+
+
+def _find_files(paths: Iterable[str], basename: Optional[str] = None,
+                suffix: str = ".py") -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(suffix) and (basename is None
+                                       or os.path.basename(p) == basename):
+                out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(suffix) and (basename is None
+                                               or f == basename):
+                        out.append(os.path.join(root, f))
+    return out
+
+
+def analyze_file(path: str, rules: Iterable[Rule], axis_registry: Set[str],
+                 display_path: Optional[str] = None) -> List[Finding]:
+    try:
+        source = open(path, encoding="utf-8").read()
+    except OSError as e:
+        return [Finding("APX000", "error", display_path or path, 0, 0,
+                        "<module>", f"unreadable: {e}", "fix file access")]
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("APX000", "error", display_path or path,
+                        e.lineno or 0, e.offset or 0, "<module>",
+                        f"syntax error: {e.msg}", "fix the syntax error")]
+    ctx = ModuleContext(display_path or path, source, tree, axis_registry)
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    return findings
+
+
+def analyze_paths(paths: Iterable[str], rules: Iterable[Rule],
+                  axis_registry: Optional[Set[str]] = None,
+                  rel_to: Optional[str] = None) -> List[Finding]:
+    """Run every rule over every ``*.py`` under ``paths``; findings are
+    sorted by (path, line, rule) for stable output and baselines."""
+    paths = list(paths)
+    registry = axis_registry if axis_registry is not None \
+        else discover_axis_registry(paths)
+    rules = list(rules)
+    findings: List[Finding] = []
+    for f in _find_files(paths):
+        display = os.path.relpath(f, rel_to) if rel_to else f
+        findings.extend(analyze_file(f, rules, registry, display))
+    findings.sort(key=lambda x: (x.path, x.line, x.rule))
+    return findings

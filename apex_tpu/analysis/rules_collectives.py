@@ -1,0 +1,109 @@
+"""Collective-axis consistency rules.
+
+``lax.psum("tq")`` is a one-character typo away from ``"tp"`` and
+nothing catches it before the chip: off-mesh axis names fail only when
+the collective actually executes under ``shard_map``, and the tp=1 CI
+configurations never execute it at all.  The registry of legal names
+comes from ``transformer/parallel_state.py``'s ``*_AXIS`` constants
+(discovered by the engine), so the linter tracks the mesh definition
+instead of a hand-maintained list.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from apex_tpu.analysis.core import Finding, ModuleContext, Rule, last_name
+
+# collective -> positional index of its axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "pshuffle": 1, "all_gather": 1, "all_to_all": 1, "psum_scatter": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+_SPMD_MARKERS = ("shard_map", "pmap", "xmap", "Mesh(", "mesh=")
+
+
+def _axis_literals(call: ast.Call, pos: int) -> List[Tuple[ast.AST, str]]:
+    """(node, literal) pairs for every string literal in the axis-name
+    argument — handles both ``"tp"`` and ``("dcn", "dp")``.  Dynamic
+    axis names (parameters, variables) yield nothing: threading the
+    axis as an argument is exactly the pattern we want."""
+    arg = None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            arg = kw.value
+    if arg is None and len(call.args) > pos:
+        arg = call.args[pos]
+    if arg is None:
+        return []
+    out: List[Tuple[ast.AST, str]] = []
+    nodes = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+    for n in nodes:
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append((n, n.value))
+    return out
+
+
+def _collective_calls(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = last_name(node.func)
+            if name in _COLLECTIVES:
+                yield node, name, _COLLECTIVES[name]
+
+
+class UnknownCollectiveAxis(Rule):
+    """APX201: collective with a literal axis name not in the mesh
+    registry."""
+
+    rule_id = "APX201"
+    severity = "error"
+    fix_hint = ("use an axis name registered in transformer/"
+                "parallel_state.py (its *_AXIS constants define the "
+                "mesh), or thread the axis in as an argument")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call, name, pos in _collective_calls(ctx):
+            for node, literal in _axis_literals(call, pos):
+                if literal not in ctx.axis_registry:
+                    known = ", ".join(sorted(ctx.axis_registry))
+                    yield self.finding(
+                        ctx, node,
+                        f"lax.{name} over unknown axis {literal!r}: the "
+                        f"mesh registry defines only {{{known}}}, so "
+                        f"this collective can never bind — it fails "
+                        f"only when first executed under shard_map on "
+                        f"the chip")
+
+
+class CollectiveOutsideSpmdContext(Rule):
+    """APX202: hard-coded collective axis in a module with no visible
+    shard_map/pmap/mesh machinery.
+
+    A ``psum("dp")`` whose module never touches shard_map depends on a
+    caller somewhere else binding "dp" — an invisible contract that
+    breaks unexecuted (tp=1 CI never runs it).  Threading ``axis_name``
+    as a parameter makes the contract explicit and silences this rule.
+    """
+
+    rule_id = "APX202"
+    severity = "warning"
+    fix_hint = ("accept axis_name as a parameter (making the caller's "
+                "shard_map contract explicit) or bring the shard_map "
+                "that binds this axis into the module")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.mentions(*_SPMD_MARKERS):
+            return
+        for call, name, pos in _collective_calls(ctx):
+            for node, literal in _axis_literals(call, pos):
+                if literal in ctx.axis_registry:
+                    yield self.finding(
+                        ctx, node,
+                        f"lax.{name}({literal!r}) in a module with no "
+                        f"shard_map/pmap/mesh in sight: nothing here "
+                        f"binds {literal!r}, so correctness rests on an "
+                        f"undocumented caller contract")

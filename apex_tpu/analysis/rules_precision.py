@@ -1,0 +1,137 @@
+"""Precision and indexing hygiene rules.
+
+- APX401: unclamped ``take_along_axis`` (the ``gpt.py:447`` class).
+  Under jit, JAX's gather clamps *some* out-of-bounds reads and fills
+  others depending on mode and sign — a negative id silently WRAPS.
+  Three loss-head implementations that disagree on out-of-range ids
+  diverge only on corrupt data, the hardest moment to debug; one
+  explicit ``jnp.clip`` pins one semantic everywhere.
+- APX402: an explicitly-materialized fp32 constant meeting a bf16
+  operand.  Binary-op promotion silently upcasts the whole bf16 tensor
+  to fp32 — doubling its HBM traffic in a compute path someone already
+  paid to keep in bf16.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from apex_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, dotted_name, last_name,
+)
+
+_F32_FACTORIES = {"array", "asarray", "full", "ones", "zeros", "arange",
+                  "linspace", "full_like", "ones_like", "zeros_like"}
+_BINOPS = (ast.BinOp,)
+
+
+def _contains_clip(node: ast.AST, clipped: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and last_name(sub.func) == "clip":
+            return True
+        if isinstance(sub, ast.Name) and sub.id in clipped:
+            return True
+    return False
+
+
+def _clipped_names(fn: ast.AST) -> Set[str]:
+    """Names assigned (directly or through arithmetic on a clipped
+    name) from a ``clip`` call anywhere in the function."""
+    clipped: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name not in clipped \
+                        and _contains_clip(node.value, clipped):
+                    clipped.add(name)
+                    changed = True
+    return clipped
+
+
+class UnclampedTakeAlongAxis(Rule):
+    """APX401: take_along_axis with indices that are never clamped."""
+
+    rule_id = "APX401"
+    severity = "error"
+    fix_hint = ("clamp the ids first (t = jnp.clip(t, 0, V - 1)) or pass "
+                "an explicit mode=; all loss-head paths must share one "
+                "out-of-range semantic")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and last_name(node.func) == "take_along_axis"):
+                continue
+            if any(kw.arg == "mode" for kw in node.keywords):
+                continue  # explicit out-of-bounds semantic chosen
+            indices = None
+            for kw in node.keywords:
+                if kw.arg == "indices":
+                    indices = kw.value
+            if indices is None and len(node.args) > 1:
+                indices = node.args[1]
+            if indices is None:
+                continue
+            fn = ctx.enclosing_function(node)
+            clipped = _clipped_names(fn) if fn is not None else set()
+            if _contains_clip(indices, clipped):
+                continue
+            yield self.finding(
+                ctx, node,
+                "take_along_axis with unclamped indices: under jit a "
+                "negative id silently WRAPS and a past-end id is "
+                "clamped/filled depending on gather mode — corrupt "
+                "targets produce plausible-looking wrong losses instead "
+                "of failing")
+
+
+class Fp32ConstantInBf16Path(Rule):
+    """APX402: materialized fp32 array meets an explicit bf16 cast in
+    one arithmetic op — promotion upcasts the bf16 side."""
+
+    rule_id = "APX402"
+    severity = "warning"
+    fix_hint = ("build the constant in the compute dtype (dtype=x.dtype "
+                "or the config's compute_dtype) so promotion cannot "
+                "silently upcast the bf16 operand to fp32")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.BinOp):
+                continue
+            sides = (node.left, node.right)
+            if any(self._is_bf16_cast(s) for s in sides) \
+                    and any(self._is_f32_factory(s) for s in sides):
+                yield self.finding(
+                    ctx, node,
+                    "fp32-materialized constant combined with an "
+                    "explicitly bf16-cast operand: dtype promotion "
+                    "upcasts the whole bf16 tensor to fp32, doubling "
+                    "its HBM traffic in a path someone already paid to "
+                    "keep in bf16")
+
+    @staticmethod
+    def _is_bf16_cast(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and any("bfloat16" == (last_name(a) or "")
+                        or (isinstance(a, ast.Constant)
+                            and a.value == "bfloat16")
+                        for a in node.args))
+
+    @staticmethod
+    def _is_f32_factory(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if last_name(node.func) not in _F32_FACTORIES:
+            return False
+        for kw in node.keywords:
+            if kw.arg == "dtype" and (last_name(kw.value) or "") == "float32":
+                return True
+        return False

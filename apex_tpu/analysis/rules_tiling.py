@@ -1,0 +1,122 @@
+"""Pallas/Mosaic TPU tiling-contract rules.
+
+Mosaic tiles the last two dims of every VMEM block as (sublane, lane)
+= (8·32/bitwidth, 128): fp32 packs (8, 128), bf16/fp16 (16, 128),
+int8/fp8 (32, 128).  A block shape that violates this lowers fine in
+interpret mode and on CPU tests, then fails Mosaic layout on the chip
+— with chip time scarce, that error class must die in CI.  (See
+``/opt/skills/guides`` TPU material and ``ops/fused_ce_pallas.py``'s
+``_sublane``.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from apex_tpu.analysis.core import Finding, ModuleContext, Rule, last_name
+
+_LANES = 128
+_MIN_SUBLANE = 8           # fp32's tile — every dtype's is a multiple
+_BF16_MARKERS = ("bfloat16", "bf16")
+_BLOCK_HELPER_MARKERS = ("block", "ceil", "tile")
+
+
+def _literal_shape(call: ast.Call) -> Optional[List[object]]:
+    """The BlockSpec block-shape argument as a list (ints where
+    literal, None where dynamic), or None when absent/not a tuple."""
+    arg = None
+    for kw in call.keywords:
+        if kw.arg == "block_shape":
+            arg = kw.value
+    if arg is None and call.args:
+        arg = call.args[0]
+    if not isinstance(arg, (ast.Tuple, ast.List)):
+        return None
+    out: List[object] = []
+    for el in arg.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+            out.append(el.value)
+        else:
+            out.append(None)
+    return out
+
+
+class BlockShapeTilingViolation(Rule):
+    """APX301: literal ``pl.BlockSpec`` block shape off the TPU tile
+    grid."""
+
+    rule_id = "APX301"
+    severity = "error"
+    fix_hint = ("make the lane (last) dim 128-aligned (or exactly 1 for "
+                "a padded scalar column) and the sublane dim a multiple "
+                "of the dtype tile: 8 fp32 / 16 bf16 / 32 int8-fp8")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and last_name(node.func) == "BlockSpec"):
+                continue
+            shape = _literal_shape(node)
+            if shape is None or len(shape) < 2:
+                continue
+            lane, sublane = shape[-1], shape[-2]
+            if isinstance(lane, int) and lane != 1 and lane % _LANES != 0:
+                yield self.finding(
+                    ctx, node,
+                    f"BlockSpec lane dim {lane} is neither 1 nor a "
+                    f"multiple of {_LANES}: Mosaic lays VMEM out in "
+                    f"(sublane, {_LANES}) tiles, so this block cannot "
+                    f"be tiled and fails only on real hardware")
+            if isinstance(sublane, int) and sublane != 1 \
+                    and sublane % _MIN_SUBLANE != 0:
+                yield self.finding(
+                    ctx, node,
+                    f"BlockSpec sublane dim {sublane} is not a multiple "
+                    f"of {_MIN_SUBLANE} (fp32's tile; bf16 needs 16, "
+                    f"int8/fp8 32): Mosaic rejects the layout on-chip")
+
+
+class HardCodedSublaneAlignment(Rule):
+    """APX302: fp32-only sublane constant in a dtype-generic block
+    computation (the ``_ceil_block(..., align=8)``-on-bf16 class).
+
+    The 8 is correct for fp32 and silently wrong for bf16 (needs 16)
+    and int8/fp8 (need 32).  Flagged only when the module also handles
+    bf16, i.e. when the hard-coded constant provably coexists with a
+    dtype it is wrong for; derive the alignment from the dtype instead
+    (``sublane(x.dtype)`` from ops/_pallas_tiling.py).
+    """
+
+    rule_id = "APX302"
+    severity = "error"
+    fix_hint = ("derive the sublane alignment from the block's dtype "
+                "({4: 8, 2: 16, 1: 32}[dtype.itemsize], cf. "
+                "ops/_pallas_tiling.sublane) instead of hard-coding fp32's 8")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.mentions(*_BF16_MARKERS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = (last_name(node.func) or "").lower()
+            if not any(m in fname for m in _BLOCK_HELPER_MARKERS):
+                continue
+            hits = [kw.value for kw in node.keywords
+                    if kw.arg == "align"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value == 8]
+            # the positional spelling of the same constant: the
+            # alignment rides after (n, target) in every block helper
+            hits += [a for a in node.args[2:]
+                     if isinstance(a, ast.Constant) and a.value == 8]
+            for _ in hits:
+                yield self.finding(
+                    ctx, node,
+                    f"`{last_name(node.func)}(..., align=8)` in a "
+                    f"module that handles bf16: 8 is the fp32 "
+                    f"sublane tile — bf16 blocks need 16 and "
+                    f"int8/fp8 need 32, so this block passes "
+                    f"interpret-mode tests and fails Mosaic layout "
+                    f"on the chip")

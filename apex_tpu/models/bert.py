@@ -47,6 +47,7 @@ class BertConfig:
     # chunked fused MLM-head+CE (ops/fused_ce.py; see GPTConfig.fused_ce)
     fused_ce: bool = False
     fused_ce_chunk: int = 128
+    fused_ce_impl: Optional[str] = None  # see GPTConfig.fused_ce_impl
 
     def __post_init__(self):
         validate_policy(self.remat_policy)

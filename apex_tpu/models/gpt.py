@@ -81,6 +81,12 @@ class GPTConfig:
     # Falls back to the dense head when S % fused_ce_chunk != 0.
     fused_ce: bool = False
     fused_ce_chunk: int = 128
+    # Pin the fused-CE implementation ("on" = Pallas kernels, "off" =
+    # chunked scan, "interpret" = kernels via the Pallas interpreter);
+    # None defers to the platform/env default.  Threaded (not an env
+    # var) so an A/B never mutates process-global state under an
+    # already-traced step function.
+    fused_ce_impl: Optional[str] = None
 
     def __post_init__(self):
         # validate at construction so every path (incl. checkpoint-
@@ -447,11 +453,16 @@ def lm_head_loss(x, embed, targets, config: GPTConfig,
         from apex_tpu.ops.fused_ce import fused_lm_head_ce
 
         return fused_lm_head_ce(x, embed, targets,
-                                config.fused_ce_chunk, axis_name)
+                                config.fused_ce_chunk, axis_name,
+                                config.fused_ce_impl)
     logits = jnp.matmul(x.astype(jnp.float32), embed.T.astype(jnp.float32))
     if axis_name is None:
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        # clamp: bare take_along_axis WRAPS negative ids and NaN-fills
+        # past-V ones under jit — the fused scan and Pallas heads both
+        # clamp, and all three paths must share one out-of-range semantic
+        t_cl = jnp.clip(targets, 0, logits.shape[-1] - 1)
+        tgt = jnp.take_along_axis(logits, t_cl[..., None], axis=-1)[..., 0]
         return lse - tgt
     return vocab_parallel_cross_entropy(logits, targets, 0.0, axis_name)
 

@@ -73,6 +73,7 @@ class T5Config:
     # chunked fused LM-head+CE (ops/fused_ce.py; see GPTConfig.fused_ce)
     fused_ce: bool = False
     fused_ce_chunk: int = 128
+    fused_ce_impl: Optional[str] = None  # see GPTConfig.fused_ce_impl
 
     def __post_init__(self):
         validate_policy(self.remat_policy)
@@ -291,7 +292,10 @@ def _ce(logits, targets, axis_name):
     t = targets.transpose(1, 0)
     if axis_name is None:
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        # clamp: same out-of-range semantic as gpt.lm_head_loss (bare
+        # take_along_axis wraps negatives / NaN-fills past-V under jit)
+        t_cl = jnp.clip(t, 0, logits.shape[-1] - 1)
+        tgt = jnp.take_along_axis(logits, t_cl[..., None], axis=-1)[..., 0]
         return jnp.mean(lse - tgt)
     return jnp.mean(vocab_parallel_cross_entropy(logits, t, 0.0, axis_name))
 

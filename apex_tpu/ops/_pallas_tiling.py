@@ -1,0 +1,20 @@
+"""Shared Mosaic tiling facts for the Pallas kernels.
+
+One copy of the hardware contract: Mosaic lays VMEM blocks out in
+dtype-dependent (sublane, 128-lane) tiles — fp32 (8, 128), bf16/fp16
+(16, 128), int8/fp8 (32, 128).  Both kernel families
+(``fused_ce_pallas``, ``flash_attention_pallas``) size their row blocks
+from this table; keeping it in one place is exactly the per-dtype drift
+the analyzer's APX302 rule polices at the call sites.
+"""
+
+import jax.numpy as jnp
+
+LANES = 128
+
+
+def sublane(dtype) -> int:
+    """The dtype's sublane tile.  Unknown itemsizes (f64 under
+    jax_enable_x64 in CPU/interpret numerics checks — no TPU tile
+    exists) fall back to the minimum 8 rather than crashing."""
+    return {4: 8, 2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 8)

@@ -34,8 +34,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops._pallas_tiling import LANES as _LANES
+from apex_tpu.ops._pallas_tiling import sublane as _sublane
+
 NEG_INF = -1e30
-_LANES = 128
 
 # Shared by all three kernels: batch·head and q-block (resp. k-block)
 # grid revisits are order-free; only the innermost accumulation dim —
@@ -85,7 +87,8 @@ def set_tuned_blocks(table) -> None:
 
 def _pick_block(seq, target, align=_LANES):
     """Largest divisor of ``seq`` ≤ target, preferring ``align``-aligned
-    divisors (128 for the lane dim, 8 for sublanes) — but only when the
+    divisors (128 for the lane dim, the dtype sublane tile — 8 fp32 /
+    16 bf16, via ``_sublane`` — for sublanes) — but only when the
     aligned candidate is at least half the largest divisor: a misaligned
     tile wastes ≤ (align−1) padded lanes, while a much smaller tile
     multiplies grid steps and k/v refetches (e.g. seq=640, target=512:
@@ -194,7 +197,7 @@ def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
     Sk = k.shape[1]
     kv_heads = kv_heads or heads
     out_dtype = out_dtype or q.dtype
-    bq = _pick_block(Sq, block_q, align=8)
+    bq = _pick_block(Sq, block_q, align=_sublane(q.dtype))
     bk = _pick_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
     grid = (BH, nq, nk)
@@ -390,7 +393,7 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
     dq_dtype = out_dtype or q.dtype
     dk_dtype = out_dtype or k.dtype
     dv_dtype = out_dtype or v.dtype
-    bq = _pick_block(Sq, block_q, align=8)
+    bq = _pick_block(Sq, block_q, align=_sublane(q.dtype))
     bk = _pick_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
     has_bias = kv_bias is not None

@@ -76,6 +76,20 @@ def _pallas_mode() -> str:
     return "off"
 
 
+def _resolve_mode(impl):
+    """An explicit ``impl`` ("on"/"off"/"interpret") wins over the
+    env-var/platform default.  Threading the override as an argument is
+    what lets callers A/B the two impls without mutating process-global
+    state under an already-traced function (the bench.py:876 class the
+    static analyzer's APX102 rule flags)."""
+    if impl is None:
+        return _pallas_mode()
+    if impl not in ("on", "off", "interpret"):
+        raise ValueError(f"fused_ce impl={impl!r}: use 'on', 'off', "
+                         f"'interpret', or None for the env/platform default")
+    return impl
+
+
 def _chunk(a, n_chunks):
     return a.reshape((n_chunks, a.shape[0] // n_chunks) + a.shape[1:])
 
@@ -141,16 +155,19 @@ def _chunk_grads(x_c, embed, t_c, lse_c, g_c, axis_name):
     return dx_c, dembed
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def fused_lm_head_ce(x, embed, targets, chunk_size=128, axis_name=None):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_lm_head_ce(x, embed, targets, chunk_size=128, axis_name=None,
+                     impl=None):
     """Per-token CE loss ``(S, B)`` of the tied LM head, chunked over S.
 
     ``x``: (S, B, H) post-final-LN activations; ``embed``: (V, H) tied
     embedding (vocab-LOCAL (V/tp, H) with ``axis_name``); ``targets``:
     (S, B) int ids (GLOBAL ids in tp mode).  S must be divisible by
     ``chunk_size`` (callers pick a divisor; gpt_loss falls back to the
-    dense head otherwise)."""
-    loss, _ = _fwd(x, embed, targets, chunk_size, axis_name)
+    dense head otherwise).  ``impl`` pins the implementation
+    ("on" = Pallas kernels, "off" = chunked scan, "interpret" = kernels
+    through the Pallas interpreter); None defers to ``_pallas_mode``."""
+    loss, _ = _fwd(x, embed, targets, chunk_size, axis_name, impl)
     return loss
 
 
@@ -166,9 +183,9 @@ def _local_targets(targets, partition, axis_name):
     return targets - jax.lax.axis_index(axis_name) * partition
 
 
-def _fwd(x, embed, targets, chunk_size, axis_name):
+def _fwd(x, embed, targets, chunk_size, axis_name, impl=None):
     S, B = targets.shape
-    mode = _pallas_mode()
+    mode = _resolve_mode(impl)
     if mode != "off":
         from apex_tpu.ops.fused_ce_pallas import fused_ce_fwd_pallas
 
@@ -202,11 +219,11 @@ def _fwd(x, embed, targets, chunk_size, axis_name):
     return loss, (x, embed, targets, lse.reshape(S, targets.shape[1]))
 
 
-def _bwd(chunk_size, axis_name, res, g):
+def _bwd(chunk_size, axis_name, impl, res, g):
     x, embed, targets, lse = res
     S = x.shape[0]
     dt = np.zeros(targets.shape, dtype=jax.dtypes.float0)
-    mode = _pallas_mode()
+    mode = _resolve_mode(impl)
     if mode != "off":
         from apex_tpu.ops.fused_ce_pallas import fused_ce_bwd_pallas
 

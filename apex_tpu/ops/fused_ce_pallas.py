@@ -37,8 +37,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops._pallas_tiling import LANES as _LANES
+from apex_tpu.ops._pallas_tiling import sublane as _sublane
+
 NEG_INF = -1e30
-_LANES = 128
 
 
 def _default_dot_dtype():
@@ -57,12 +59,14 @@ _DIMSEM_DE = pltpu.CompilerParams(
 
 def _ceil_block(n, target, align):
     """Aligned block for a ceil-grid: ``target`` when n is big enough,
-    else n rounded up to ``align``.  Unlike the flash kernels' divisor
-    search, blocks here need NOT divide the array — realistic tp vocab
-    shards (e.g. 50304/8 = 6288 = 2^4·3·131) have no lane-aligned
-    divisor at all, and a 393-wide tile would fail Mosaic's sublane
-    tiling.  Edge tiles overrun the array and the kernels mask them
-    (out-of-bounds reads are garbage by the Pallas contract)."""
+    else n rounded up to ``align`` (the dtype's sublane tile from
+    ``_sublane`` for row blocks, the 128-lane unit for vocab blocks).
+    Unlike the flash kernels' divisor search, blocks here need NOT
+    divide the array — realistic tp vocab shards (e.g. 50304/8 = 6288 =
+    2^4·3·131) have no lane-aligned divisor at all, and a 393-wide tile
+    would fail Mosaic's sublane tiling.  Edge tiles overrun the array
+    and the kernels mask them (out-of-bounds reads are garbage by the
+    Pallas contract)."""
     if n >= target:
         return target
     return -(-n // align) * align
@@ -144,7 +148,7 @@ def fused_ce_fwd_pallas(x2, embed, t, dot_dtype=None,
     dot_dtype = dot_dtype or _default_dot_dtype()
     N, H = x2.shape
     V = embed.shape[0]
-    bn = _ceil_block(N, block_n, align=8)
+    bn = _ceil_block(N, block_n, align=_sublane(x2.dtype))
     bv = _ceil_block(V, block_v, align=_LANES)
     nn, nv = _grid(N, bn), _grid(V, bv)
 
@@ -243,7 +247,7 @@ def fused_ce_bwd_pallas(x2, embed, t, lse, g, dot_dtype=None,
     dot_dtype = dot_dtype or _default_dot_dtype()
     N, H = x2.shape
     V = embed.shape[0]
-    bn = _ceil_block(N, block_n, align=8)
+    bn = _ceil_block(N, block_n, align=_sublane(x2.dtype))
     bv = _ceil_block(V, block_v, align=_LANES)
     nn, nv = _grid(N, bn), _grid(V, bv)
     t2 = t.reshape(N, 1).astype(jnp.int32)

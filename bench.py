@@ -287,7 +287,7 @@ def bench_fused_adam():
 
 
 def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
-              vocab=50304, fused_ce=False):
+              vocab=50304, fused_ce=False, fused_ce_impl=None):
     """GPT train-step throughput.  On HBM exhaustion the batch halves
     (at most twice) and the result records the batch that actually ran —
     an audited number at a smaller batch beats an OOM error (GPT-345M
@@ -300,7 +300,7 @@ def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
         try:
             return _bench_gpt_at_batch(layers, hidden, heads, seq, batch,
                                        roofline_tflops, iters, vocab,
-                                       fused_ce)
+                                       fused_ce, fused_ce_impl)
         except Exception as e:  # noqa: BLE001 — only OOM is retried
             oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
             if not oom or batch <= 1 or retries_left == 0:
@@ -310,7 +310,7 @@ def bench_gpt(layers, hidden, heads, seq, batch, roofline_tflops, iters=15,
 
 
 def _bench_gpt_at_batch(layers, hidden, heads, seq, batch, roofline_tflops,
-                        iters, vocab, fused_ce=False):
+                        iters, vocab, fused_ce=False, fused_ce_impl=None):
     from apex_tpu.models.gpt import GPTConfig, gpt_loss, init_params
     from apex_tpu.optimizers import FusedAdam
 
@@ -319,6 +319,7 @@ def _bench_gpt_at_batch(layers, hidden, heads, seq, batch, roofline_tflops,
         num_attention_heads=heads, max_seq_len=seq,
         compute_dtype=jnp.bfloat16, use_flash_attention=True,
         checkpoint_layers=True, fused_ce=fused_ce,
+        fused_ce_impl=fused_ce_impl,
     )
     params = init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
@@ -681,14 +682,17 @@ def _device_preflight(timeout_s=420.0) -> Optional[str]:
 
 
 def _load_sections(path):
-    """Parse a sections sidecar: ``({section: result}, [timestamps])``,
+    """Parse a sections sidecar: ``({section: result}, {section: t})``,
     newest record winning on duplicates.  Tolerates a missing file and
     skips corrupt lines individually — a wedge can kill the process
     mid-write, and one truncated line must not discard the rest.
     Error-only results (skips/timeouts) and the preflight marker are
-    filtered out.  The ONE sidecar parser: the banked fallback and the
-    resume-headline path both read through here."""
-    sections, times = {}, []
+    filtered out.  Timestamps ride PER SECTION so the banked fallback
+    can report the measurement window of exactly the sections it
+    merges, not every record in every file it scanned.  The ONE sidecar
+    parser: the banked fallback and the resume-headline path both read
+    through here."""
+    sections, times = {}, {}
     try:
         with open(path) as f:
             lines = list(f)
@@ -703,7 +707,7 @@ def _load_sections(path):
         if name and name != "preflight" and isinstance(result, (dict, float, int)):
             if not (isinstance(result, dict) and set(result) == {"error"}):
                 sections[name] = result
-                times.append(rec.get("t", ""))
+                times[name] = rec.get("t", "")
     return sections, times
 
 
@@ -738,7 +742,11 @@ def _banked_fallback(err: str) -> dict:
         if not fresh:
             continue
         sections.update(fresh)
-        times.extend(ftimes)
+        # only the timestamps of sections actually merged from THIS
+        # file: a newer file that contributed nothing fresh (or only
+        # some sections) must not stretch banked_measured_at around
+        # records the report does not contain
+        times.extend(t for k in fresh if (t := ftimes.get(k, "")))
         sources.append(path)
     if not sections:
         return {
@@ -875,11 +883,12 @@ def main():
                 raise
             _progress(f"fce pallas path failed ({type(e).__name__}); "
                       f"retrying on the scan impl")
-            os.environ["APEX_TPU_FUSED_CE_PALLAS"] = "0"
-            try:
-                r = bench_gpt(12, 768, 12, 1024, 8, roof, fused_ce=True)
-            finally:
-                os.environ.pop("APEX_TPU_FUSED_CE_PALLAS", None)
+            # explicit impl override, NOT an os.environ mutation: the
+            # first attempt's traces captured the env at trace time, so
+            # a process-global flip is invisible to cached jits (the
+            # trace-time-capture class the static analyzer flags)
+            r = bench_gpt(12, 768, 12, 1024, 8, roof, fused_ce=True,
+                          fused_ce_impl="off")
             r["impl"] = "scan-fallback"
             r["pallas_error"] = f"{type(e).__name__}: {str(e)[:200]}"
             return r

@@ -67,7 +67,10 @@ def build(vocab_head):
         w = sh["embed"].T if vocab_head else sh["w_small"]
         logits = h @ w  # (MB, S, V) tied, or (MB, S, 1) for the no-head probe
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, mb["tgt"][..., None], axis=-1)[..., 0]
+        # clamp: same out-of-range semantic as the production heads
+        # (gpt.lm_head_loss); free here since targets are in-range
+        t_cl = jnp.clip(mb["tgt"], 0, logits.shape[-1] - 1)
+        tgt = jnp.take_along_axis(logits, t_cl[..., None], axis=-1)[..., 0]
         return jnp.mean(lse - tgt)
 
     return pre, stage, post, shared, stages, batch

@@ -40,6 +40,42 @@ def test_bwd_lowers_for_tpu():
         q, q, q, q, r, q)
 
 
+def test_fused_ce_small_n_bf16_lowers_for_tpu():
+    """Small-N bf16 fused-CE: the row block must round up to the bf16
+    (16, 128) sublane tile, not fp32's (8, 128) — ``_ceil_block(N,
+    block_n, align=8)`` on bf16 inputs was exactly the dtype-dependent
+    tiling class ADVICE r5 flagged (and the static analyzer's APX302
+    rule now lints for)."""
+    from apex_tpu.ops import fused_ce_pallas as fcp
+
+    assert fcp._sublane(jnp.bfloat16) == 16
+    assert fcp._sublane(jnp.float32) == 8
+    # N below block_n forces the ceil-rounded edge block the bug lived in
+    assert fcp._ceil_block(8, 256, align=fcp._sublane(jnp.bfloat16)) == 16
+
+    N, H, V = 8, 128, 384
+    x = jax.ShapeDtypeStruct((N, H), jnp.bfloat16)
+    e = jax.ShapeDtypeStruct((V, H), jnp.bfloat16)
+    t = jax.ShapeDtypeStruct((N,), jnp.int32)
+    _lower(lambda x, e, t: fcp.fused_ce_fwd_pallas(x, e, t), x, e, t)
+    lse = jax.ShapeDtypeStruct((N,), jnp.float32)
+    _lower(lambda x, e, t, lse, g: fcp.fused_ce_bwd_pallas(x, e, t, lse, g),
+           x, e, t, lse, lse)
+
+
+def test_odd_seq_bf16_lowers_for_tpu():
+    """Sq=40 bf16 has no 16-multiple divisor, so ``_pick_block`` keeps
+    the misaligned whole-sequence block (bq=40) — pin that this shape
+    still passes the Pallas→Mosaic lowering (``_pick_block`` is a
+    preference, unlike fused-CE's padded ``_ceil_block`` which is a
+    guarantee)."""
+    B, H, S, D = 2, 2, 40, 64
+    assert fap._pick_block(S, 1024, align=fap._sublane(jnp.bfloat16)) == 40
+    q = jax.ShapeDtypeStruct((B * H, S, D), jnp.bfloat16)
+    _lower(lambda q, k, v: fap.flash_fwd_pallas(
+        q, k, v, 1.0 / D ** 0.5, True, 0, 0, heads=H), q, q, q)
+
+
 def test_tuned_blocks_lower_for_tpu():
     """Whatever the sweep installed must lower for its own shape."""
     table = dict(fap._TUNED_BLOCKS)

@@ -35,6 +35,37 @@ def test_forward_shapes(batch):
     assert logits.shape == (16, 2, CFG.vocab_size)
 
 
+def test_dense_head_clamps_out_of_range_targets(batch):
+    """The dense lm_head_loss fallback must share the fused paths'
+    out-of-range semantic: ids are clamped to [0, V-1], never wrapped
+    (negative) or NaN-filled (past-V) by bare take_along_axis under jit
+    (ADVICE r5 gpt.py:447; analyzer rule APX401)."""
+    import dataclasses
+
+    from apex_tpu.models.gpt import lm_head_loss
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(16, 2, CFG.hidden_size).astype(np.float32))
+    embed = jnp.asarray(rng.randn(CFG.vocab_size, CFG.hidden_size)
+                        .astype(np.float32))
+    targets = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(16, 2)))
+    # poison two ids: one negative, one past V
+    bad = targets.at[0, 0].set(-3).at[5, 1].set(CFG.vocab_size + 7)
+    clamped = jnp.clip(bad, 0, CFG.vocab_size - 1)
+
+    dense_cfg = dataclasses.replace(CFG, fused_ce=False)
+    got = jax.jit(lambda t: lm_head_loss(x, embed, t, dense_cfg))(bad)
+    want = jax.jit(lambda t: lm_head_loss(x, embed, t, dense_cfg))(clamped)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    assert np.all(np.isfinite(np.asarray(got)))
+
+    # and the fused scan path agrees on the SAME poisoned input
+    fused_cfg = dataclasses.replace(CFG, fused_ce=True, fused_ce_chunk=8,
+                                    fused_ce_impl="off")
+    fused = jax.jit(lambda t: lm_head_loss(x, embed, t, fused_cfg))(bad)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(got), rtol=1e-5)
+
+
 def test_remat_policies_same_loss_and_grads(batch):
     """Remat must not change math: loss AND grads identical (bitwise up
     to reduction order) across no-remat, full remat, and dots-saveable
