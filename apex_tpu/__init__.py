@@ -43,6 +43,7 @@ _LAZY_SUBMODULES = (
     "fused_dense",
     "mlp",
     "parallel",
+    "resilience",
     "transformer",
     "contrib",
     "models",

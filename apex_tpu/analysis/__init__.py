@@ -7,6 +7,9 @@ machine-checked invariants):
 
 - **APX101/102** trace-time host-state capture and process-global env
   mutation (``rules_trace``) — the ``bench.py:876`` class.
+- **APX103** donated-buffer reuse: a ``donate_argnums`` argument read
+  after the donating call without a rebind (``rules_donation``) — a
+  no-op on CPU, garbage or a deleted-array error on TPU.
 - **APX201/202** collective-axis consistency against the
   ``parallel_state.py`` mesh registry (``rules_collectives``).
 - **APX301/302** Mosaic dtype-dependent tiling contracts for Pallas
@@ -32,6 +35,7 @@ from apex_tpu.analysis.core import (
 from apex_tpu.analysis.rules_collectives import (
     CollectiveOutsideSpmdContext, UnknownCollectiveAxis,
 )
+from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_precision import (
     Fp32ConstantInBf16Path, UnclampedTakeAlongAxis,
 )
@@ -47,6 +51,7 @@ from apex_tpu.analysis.rules_trace import (
 DEFAULT_RULES = (
     TraceTimeHostStateRead(),
     ProcessGlobalEnvMutation(),
+    DonatedBufferReuse(),
     UnknownCollectiveAxis(),
     CollectiveOutsideSpmdContext(),
     BlockShapeTilingViolation(),

@@ -3,6 +3,10 @@ input prefetch."""
 
 from apex_tpu.io import native
 from apex_tpu.io.checkpoint import (
+    AllCheckpointsTornError,
+    checkpoint_step,
+    latest_checkpoint,
+    latest_distributed_step,
     load_checkpoint,
     load_distributed_checkpoint,
     load_sharded_checkpoint,
@@ -10,11 +14,13 @@ from apex_tpu.io.checkpoint import (
     save_checkpoint,
     save_distributed_checkpoint,
     save_sharded_checkpoint,
+    validate_checkpoint,
 )
 from apex_tpu.io.async_checkpoint import AsyncCheckpointer
 from apex_tpu.io.prefetch import PrefetchIterator
 
 __all__ = [
+    "AllCheckpointsTornError",
     "AsyncCheckpointer",
     "native",
     "save_checkpoint",
@@ -24,5 +30,9 @@ __all__ = [
     "save_distributed_checkpoint",
     "load_distributed_checkpoint",
     "make_global_array_tree",
+    "latest_checkpoint",
+    "latest_distributed_step",
+    "validate_checkpoint",
+    "checkpoint_step",
     "PrefetchIterator",
 ]

@@ -158,6 +158,151 @@ def open_checkpoint_lazy(path) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def validate_checkpoint(path) -> dict:
+    """Cheap integrity check of a single-blob checkpoint: magic, header
+    JSON, treedef pickle, and — the torn-write case an interrupted
+    writer or dying filesystem actually produces — that the blob holds
+    EXACTLY the bytes the header promises.  Raises ``ValueError`` with
+    the reason on any mismatch; returns the parsed header.  Reads only
+    the preamble + ``stat`` — never the blob itself."""
+    p = Path(path)
+    try:
+        size = p.stat().st_size
+        with open(p, "rb") as f:
+            header, _ = _read_header(f, path)
+            base = f.tell()
+        need = 0
+        # inside the try: a header whose JSON parses but holds garbage
+        # leaf metadata (bit-flipped dtype string, missing keys) is just
+        # as torn as a short preamble and must stay skippable
+        for m in header.get("leaves", ()):
+            dt = _resolve_dtype(m["dtype"])
+            need += int(np.prod(m["shape"], dtype=np.int64)) * dt.itemsize
+    except Exception as e:  # noqa: BLE001 — short preamble, truncated
+        # pickle, bad JSON, OS errors: all mean "torn/corrupt file".
+        # Only our own already-formatted message (bad magic, which names
+        # the path) passes through unwrapped — json.JSONDecodeError IS a
+        # ValueError subclass and must not escape context-free
+        if type(e) is ValueError and str(path) in str(e):
+            raise
+        raise ValueError(f"{path} is torn or corrupt: "
+                         f"{type(e).__name__}: {e}") from e
+    got = size - base
+    if got != need:
+        raise ValueError(
+            f"{path} is torn: header promises a {need}-byte blob, file "
+            f"holds {got} (interrupted write?)")
+    return header
+
+
+def checkpoint_step(path) -> int:
+    """Step number encoded in a ``step_<N>*`` file/dir name, or -1."""
+    import re
+
+    m = re.match(r"step_(\d+)", Path(path).name)
+    return int(m.group(1)) if m else -1
+
+
+class AllCheckpointsTornError(FileNotFoundError):
+    """Every candidate file in the directory failed validation.
+
+    Distinct from the plain ``FileNotFoundError`` of a missing/empty
+    directory: prior progress EXISTED here, so treating this like a
+    first launch would silently discard it — even an auto-resuming
+    caller must fail loudly on this, never train from scratch
+    pretending it resumed."""
+
+
+def latest_checkpoint(dir_path, suffixes=(".ckpt", ".apex")) -> str:
+    """Newest VALID single-file checkpoint under ``dir_path`` — the
+    restart side of preemption safety.
+
+    Candidates are ``*.ckpt``/``*.apex`` files (``.tmp`` leftovers of an
+    interrupted atomic publish are never candidates), ordered newest
+    first by the ``step_<N>`` number in the name when present, else by
+    mtime.  Each is validated (:func:`validate_checkpoint`); torn or
+    half-written files are SKIPPED with a structured warning so a kill
+    mid-write costs one save interval, not the run.  Raises
+    ``FileNotFoundError`` when the directory is missing or empty, and
+    its subclass :class:`AllCheckpointsTornError` when candidates exist
+    but ALL fail validation — a resume pointed at nothing must fail
+    loudly, never train from scratch pretending it resumed, and a
+    caller that auto-starts fresh on the former must still fail on the
+    latter."""
+    import logging
+
+    from apex_tpu.utils.logging import get_logger, log_structured
+
+    d = Path(dir_path)
+    if not d.is_dir():
+        raise FileNotFoundError(f"checkpoint dir {dir_path} does not exist")
+    cands = [p for p in d.iterdir()
+             if p.is_file() and p.suffix in suffixes]
+    if not cands:
+        raise FileNotFoundError(
+            f"no checkpoint files ({'/'.join(suffixes)}) under {dir_path}"
+            " — empty or not a checkpoint directory")
+    def _mtime(p):
+        try:
+            return p.stat().st_mtime
+        except OSError:
+            # pruned by a concurrent writer between listing and sort
+            # (two runs sharing a dir): sort last, validation skips it
+            return 0.0
+
+    cands.sort(key=lambda p: (checkpoint_step(p), _mtime(p)),
+               reverse=True)
+    skipped = []
+    for p in cands:
+        try:
+            validate_checkpoint(p)
+        except ValueError as e:
+            skipped.append((str(p), str(e)))
+            log_structured(
+                get_logger("apex_tpu.io"), logging.WARNING,
+                "checkpoint.torn_file_skipped", path=str(p), error=str(e))
+            continue
+        return str(p)
+    raise AllCheckpointsTornError(
+        f"no valid checkpoint under {dir_path}: all {len(skipped)} "
+        f"candidate(s) torn/corrupt — " +
+        "; ".join(f"{p}: {e}" for p, e in skipped))
+
+
+def latest_distributed_step(dir_path) -> int:
+    """Newest fully-published ``step_*`` directory under ``dir_path`` —
+    the pod-scale sibling of :func:`latest_checkpoint`.
+
+    A complete directory holds an ``index.json`` and at least its
+    ``world_size`` many ``shard_*.ckpt`` files (per-step dirs mean an
+    interrupted save can only leave an INCOMPLETE newest dir, never a
+    torn mix of steps).  Returns the step number; returns ``-1`` when
+    no ``step_*`` dirs exist at all (a legitimate fresh start); raises
+    :class:`AllCheckpointsTornError` when dirs EXIST but none is
+    complete — prior progress would be silently discarded by starting
+    fresh, so even an auto-resuming caller must fail loudly."""
+    d = Path(dir_path)
+    dirs = sorted(d.glob("step_*"), reverse=True) if d.is_dir() else []
+    for sd in dirs:
+        idx = sd / "index.json"
+        if not idx.exists():
+            continue
+        try:
+            # int() inside the try: a parseable index.json whose
+            # world_size is null/garbage is just as torn as no index
+            world = int(json.loads(idx.read_text())["world_size"])
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+        if len(list(sd.glob("shard_*.ckpt"))) >= world:
+            return checkpoint_step(sd)
+    if dirs:
+        raise AllCheckpointsTornError(
+            f"no complete checkpoint under {dir_path}: {len(dirs)} "
+            f"step_* dir(s) exist but none is fully published "
+            f"(interrupted save?)")
+    return -1
+
+
 def _atomic_write(path: str, tree: Any) -> None:
     """tmp + fsync + rename + dir-fsync around :func:`save_checkpoint`:
     a crash mid-save never leaves a truncated file under the final

@@ -481,11 +481,17 @@ def sp_grad_sync(grads, axis_name: str):
 
 
 def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
-                         opt_state, params, sync_axes):
+                         opt_state, params, sync_axes,
+                         step_guard=None, guard_state=None):
     """The shared unscale → found_inf vote → predicated step → scale
     update tail of both scaled train steps (reference §3.2 ctx-exit:
     ``apex/amp/handle.py:119-158`` + the model-parallel found_inf
-    agreement of ``apex/transformer/amp/grad_scaler.py:49,102``)."""
+    agreement of ``apex/transformer/amp/grad_scaler.py:49,102``).
+
+    With a ``step_guard`` (:class:`apex_tpu.resilience.StepGuard`) the
+    same agreed predicate also feeds the guard's device-side bad-step
+    accounting, and the tuple grows a new guard state — ONE vote drives
+    the optimizer skip, the scaler hysteresis, and the abort budget."""
     from apex_tpu.transformer.amp.grad_scaler import sync_found_inf
 
     grads, finite = loss_scaler.unscale(scaler_state, grads)
@@ -493,7 +499,45 @@ def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
     new_params, new_state = optimizer.update(
         grads, opt_state, params, grads_finite=finite
     )
-    return new_params, new_state, loss_scaler.update(scaler_state, finite)
+    new_scaler_state = loss_scaler.update(scaler_state, finite)
+    if step_guard is None:
+        return new_params, new_state, new_scaler_state
+    return (new_params, new_state, new_scaler_state,
+            step_guard.update(guard_state, finite))
+
+
+def _apply_guarded_update(grads, optimizer, opt_state, params, sync_axes,
+                          step_guard, guard_state):
+    """Unscaled step-guard tail: the amp ``all_finite`` predicate alone
+    (no loss scaler) gates the optimizer commit and feeds the guard —
+    fp32/bf16 runs get the same survive-a-NaN-step semantics the fp16
+    path has always had."""
+    from apex_tpu.amp.scaler import all_finite
+    from apex_tpu.transformer.amp.grad_scaler import sync_found_inf
+
+    finite = sync_found_inf(all_finite(grads), sync_axes)
+    new_params, new_state = optimizer.update(
+        grads, opt_state, params, grads_finite=finite
+    )
+    return new_params, new_state, step_guard.update(guard_state, finite)
+
+
+def _step_variant(loss_scaler, step_guard, variants, specs, sspec,
+                  data_spec):
+    """Pick the local-step variant and its shard_map specs for a
+    scaler×guard combination.  ``variants`` maps (has_scaler, has_guard)
+    to the local step fn; each enabled feature adds one replicated
+    scalar-state arg (scaler state, then guard state) between the
+    optimizer state and the data, and one replicated output before the
+    loss."""
+    from jax.sharding import PartitionSpec as P
+
+    fn = variants[(loss_scaler is not None, step_guard is not None)]
+    n_state = int(loss_scaler is not None) + int(step_guard is not None)
+    state_specs = (P(),) * n_state
+    in_specs = (specs, sspec, *state_specs, data_spec, data_spec)
+    out_specs = (specs, sspec, *state_specs, P())
+    return fn, in_specs, out_specs
 
 
 def make_train_step(
@@ -506,6 +550,8 @@ def make_train_step(
     opt_state_spec=None,
     loss_scaler=None,
     donate_state: bool = False,
+    step_guard=None,
+    chaos=None,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
 
@@ -532,6 +578,22 @@ def make_train_step(
     device-side.  The step then takes/returns a scaler state:
     ``step(params, opt_state, scaler_state, tokens, targets) ->
     (params, opt_state, scaler_state, loss)``.
+
+    ``step_guard``: an :class:`apex_tpu.resilience.StepGuard` — a
+    :class:`~apex_tpu.resilience.step_guard.GuardState` rides the step
+    right after the scaler state (or in its place without a scaler):
+    non-finite steps are skipped device-side (the existing predicated
+    update) AND counted, so the loop can enforce a consecutive-bad-step
+    abort budget with ``guard.check`` at its own sync cadence.  Without
+    a scaler the guard brings its own ``all_finite`` vote, agreed over
+    the same model-parallel axes.
+
+    ``chaos``: an armed :class:`apex_tpu.resilience.ChaosMonkey` whose
+    planned NaN-grad steps are baked (as constants) into the compiled
+    step — the loss is multiplied by the plan's 1.0/NaN scalar at the
+    guard's step counter, poisoning every gradient of exactly the
+    planned steps with zero per-step host work.  Requires
+    ``step_guard`` (the counter lives in its state).
 
     The TPU shape of reference §3.2's iteration: value_and_grad inside
     ``shard_map`` (TP collectives via the mappings), gradient ``pmean``
@@ -596,6 +658,18 @@ def make_train_step(
                 grads = pmean_grads(grads, ax, skip_experts=(ax == dp_axis))
         return loss, grads
 
+    if chaos is not None and step_guard is None:
+        raise ValueError("chaos NaN injection needs step_guard (the "
+                         "injection step counter lives in GuardState)")
+
+    # tp-sharded grad shards can overflow on one rank only; with
+    # ZeRO (local dp grads) or MoE (dp-sharded expert grads) the dp
+    # ranks can disagree too — every such axis must join the vote
+    # (pmean'd axes already agree: a nan poisons every rank's copy)
+    sync_axes = [tp_axis]
+    if (zero_opt or config.moe) and dp_axis is not None:
+        sync_axes.append(dp_axis)
+
     def local_step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(gpt_loss)(
             params, tokens, targets, config, tp_axis, cp_axis, ep_axis
@@ -603,6 +677,21 @@ def make_train_step(
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
+
+    def guarded_local_step(params, opt_state, guard_state, tokens, targets):
+        fault = chaos.grad_fault(guard_state.step) if chaos is not None else None
+
+        def loss_fn(p):
+            l = gpt_loss(p, tokens, targets, config, tp_axis, cp_axis, ep_axis)
+            return l * fault if fault is not None else l
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss, grads = sync_loss_and_grads(loss, grads)
+        new_params, new_state, new_guard = _apply_guarded_update(
+            grads, optimizer, opt_state, params, sync_axes,
+            step_guard, guard_state,
+        )
+        return new_params, new_state, new_guard, loss
 
     def scaled_local_step(params, opt_state, scaler_state, tokens, targets):
         def scaled_loss_fn(p):
@@ -612,18 +701,32 @@ def make_train_step(
         scaled_loss, grads = jax.value_and_grad(scaled_loss_fn)(params)
         loss = scaled_loss / scaler_state.loss_scale
         loss, grads = sync_loss_and_grads(loss, grads)
-        # tp-sharded grad shards can overflow on one rank only; with
-        # ZeRO (local dp grads) or MoE (dp-sharded expert grads) the dp
-        # ranks can disagree too — every such axis must join the vote
-        # (pmean'd axes already agree: a nan poisons every rank's copy)
-        sync_axes = [tp_axis]
-        if (zero_opt or config.moe) and dp_axis is not None:
-            sync_axes.append(dp_axis)
         new_params, new_state, new_scaler_state = _apply_scaled_update(
             loss_scaler, scaler_state, grads, optimizer, opt_state, params,
             sync_axes,
         )
         return new_params, new_state, new_scaler_state, loss
+
+    def guarded_scaled_local_step(params, opt_state, scaler_state,
+                                  guard_state, tokens, targets):
+        fault = chaos.grad_fault(guard_state.step) if chaos is not None else None
+
+        def scaled_loss_fn(p):
+            l = gpt_loss(p, tokens, targets, config, tp_axis, cp_axis, ep_axis)
+            if fault is not None:
+                l = l * fault
+            return loss_scaler.scale(scaler_state, l)
+
+        scaled_loss, grads = jax.value_and_grad(scaled_loss_fn)(params)
+        loss = scaled_loss / scaler_state.loss_scale
+        loss, grads = sync_loss_and_grads(loss, grads)
+        new_params, new_state, new_scaler_state, new_guard = \
+            _apply_scaled_update(
+                loss_scaler, scaler_state, grads, optimizer, opt_state,
+                params, sync_axes,
+                step_guard=step_guard, guard_state=guard_state,
+            )
+        return new_params, new_state, new_scaler_state, new_guard, loss
 
     # optimizer state mirrors param sharding for m/v/master; scalars replicated
     def state_spec_of(params_spec):
@@ -640,20 +743,15 @@ def make_train_step(
     data_spec = P(dp_axis, cp_axis)  # batch over dp, sequence over cp
 
     donate = (0, 1) if donate_state else ()
-    if loss_scaler is not None:
-        sharded = jax.shard_map(
-            scaled_local_step,
-            mesh=mesh,
-            in_specs=(specs, sspec, P(), data_spec, data_spec),
-            out_specs=(specs, sspec, P(), P()),
-            check_vma=False,
-        )
-        return jax.jit(sharded, donate_argnums=donate)
+    fn, in_specs, out_specs = _step_variant(
+        loss_scaler, step_guard,
+        {(True, True): guarded_scaled_local_step,
+         (True, False): scaled_local_step,
+         (False, True): guarded_local_step,
+         (False, False): local_step},
+        specs, sspec, data_spec)
     sharded = jax.shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(specs, sspec, data_spec, data_spec),
-        out_specs=(specs, sspec, P()),
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=donate)
@@ -714,6 +812,8 @@ def make_pp_train_step(
     cp_axis: Optional[str] = None,
     loss_scaler=None,
     donate_state: bool = False,
+    step_guard=None,
+    chaos=None,
 ):
     """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
 
@@ -741,6 +841,12 @@ def make_pp_train_step(
     ``virtual_pipeline_size > 1`` — in that case ``params["layers"]``
     (and the matching optimizer state) must be in the stage-major vpp
     layout from :func:`params_to_vpp_layout`.
+
+    ``step_guard``/``chaos``: same contract as :func:`make_train_step`
+    — a guard state rides after the scaler state (or in its place),
+    the skip vote is pmax-agreed over tp AND pp (every stage skips
+    together), and chaos NaN injection scales the schedule's backward
+    seed so the poisoned step is skipped pipeline-wide.
     Returns ``step(params, opt_state, tokens, targets) -> (params,
     opt_state, loss)`` (jitted).
     """
@@ -889,45 +995,80 @@ def make_pp_train_step(
         # IS the gradient sync (reduce-scatter fused with the update)
         return loss, grads
 
+    if chaos is not None and step_guard is None:
+        raise ValueError("chaos NaN injection needs step_guard (the "
+                         "injection step counter lives in GuardState)")
+
+    def _scaled_fns(factor):
+        """(stage_fn, post_fn) with every backward seed scaled by
+        ``factor`` — the loss-scale multiply, the chaos fault, or both
+        folded into one scalar (the schedule seeds backward from
+        post_fn's output, so scaling HERE scales every cotangent in the
+        pipeline; the MoE aux loss enters inside the schedule and must
+        ride the same scaled backward)."""
+        def post_scaled(shared, x, mb_):
+            return post_fn(shared, x, mb_) * factor
+
+        if config.moe:
+            def stage_scaled(stage_params, x):
+                out, aux = stage_fn(stage_params, x)
+                return out, aux * factor
+        else:
+            stage_scaled = stage_fn
+        return stage_scaled, post_scaled
+
     def local_step(params, opt_state, tokens, targets):
         loss, grads = run_schedule(params, tokens, targets, stage_fn, post_fn)
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
 
+    def guarded_local_step(params, opt_state, guard_state, tokens, targets):
+        fault = chaos.grad_fault(guard_state.step) if chaos is not None else None
+        if fault is not None:
+            stage, post = _scaled_fns(fault)
+        else:
+            stage, post = stage_fn, post_fn
+        loss, grads = run_schedule(params, tokens, targets, stage, post)
+        loss, grads = sync_loss_and_grads(loss, grads)
+        new_params, new_state, new_guard = _apply_guarded_update(
+            grads, optimizer, opt_state, params, guard_sync_axes,
+            step_guard, guard_state,
+        )
+        return new_params, new_state, new_guard, loss
+
     def scaled_local_step(params, opt_state, scaler_state, tokens, targets):
         scale = scaler_state.loss_scale
-
-        def post_scaled(shared, x, mb_):
-            # the schedule seeds backward from post_fn's output, so
-            # scaling HERE scales every cotangent in the pipeline
-            return post_fn(shared, x, mb_) * scale
-
-        if config.moe:
-            def stage_scaled(stage_params, x):
-                out, aux = stage_fn(stage_params, x)
-                # the aux loss enters the total inside the schedule;
-                # scale it so expert grads ride the same scaled backward
-                return out, aux * scale
-        else:
-            stage_scaled = stage_fn
-
+        stage_scaled, post_scaled = _scaled_fns(scale)
         scaled_loss, grads = run_schedule(
             params, tokens, targets, stage_scaled, post_scaled
         )
         loss = scaled_loss / scale
         loss, grads = sync_loss_and_grads(loss, grads)
-        # stage-sharded (pp) and tp-sharded grads can overflow on one
-        # rank only — every such axis must agree on the skip decision;
-        # ZeRO (local dp grads) and MoE (dp-sharded expert grads) add dp
-        sync_axes = [tp_axis, pp_axis]
-        if (zero_opt or config.moe) and dp_axis is not None:
-            sync_axes.append(dp_axis)
         new_params, new_state, new_scaler_state = _apply_scaled_update(
             loss_scaler, scaler_state, grads, optimizer, opt_state, params,
-            sync_axes,
+            guard_sync_axes,
         )
         return new_params, new_state, new_scaler_state, loss
+
+    def guarded_scaled_local_step(params, opt_state, scaler_state,
+                                  guard_state, tokens, targets):
+        scale = scaler_state.loss_scale
+        fault = chaos.grad_fault(guard_state.step) if chaos is not None else None
+        factor = scale * fault if fault is not None else scale
+        stage_scaled, post_scaled = _scaled_fns(factor)
+        scaled_loss, grads = run_schedule(
+            params, tokens, targets, stage_scaled, post_scaled
+        )
+        loss = scaled_loss / scale
+        loss, grads = sync_loss_and_grads(loss, grads)
+        new_params, new_state, new_scaler_state, new_guard = \
+            _apply_scaled_update(
+                loss_scaler, scaler_state, grads, optimizer, opt_state,
+                params, guard_sync_axes,
+                step_guard=step_guard, guard_state=guard_state,
+            )
+        return new_params, new_state, new_scaler_state, new_guard, loss
 
     from apex_tpu.optimizers.fused_adam import AdamState
 
@@ -940,6 +1081,12 @@ def make_pp_train_step(
         raise NotImplementedError(
             "ZeRO + MoE expert sharding both claim the dp axis; not wired"
         )
+    # stage-sharded (pp) and tp-sharded grads can overflow on one rank
+    # only — every such axis must agree on the skip decision; ZeRO
+    # (local dp grads) and MoE (dp-sharded expert grads) add dp
+    guard_sync_axes = [tp_axis, pp_axis]
+    if (zero_opt or config.moe) and dp_axis is not None:
+        guard_sync_axes.append(dp_axis)
     if opt_state_spec is not None:
         sspec = opt_state_spec
     elif zero_opt:
@@ -949,20 +1096,15 @@ def make_pp_train_step(
     data_spec = P(dp_axis, cp_axis) if dp_axis is not None else P(None, cp_axis)
 
     donate = (0, 1) if donate_state else ()
-    if loss_scaler is not None:
-        sharded = jax.shard_map(
-            scaled_local_step,
-            mesh=mesh,
-            in_specs=(specs, sspec, P(), data_spec, data_spec),
-            out_specs=(specs, sspec, P(), P()),
-            check_vma=False,
-        )
-        return jax.jit(sharded, donate_argnums=donate)
+    fn, in_specs, out_specs = _step_variant(
+        loss_scaler, step_guard,
+        {(True, True): guarded_scaled_local_step,
+         (True, False): scaled_local_step,
+         (False, True): guarded_local_step,
+         (False, False): local_step},
+        specs, sspec, data_spec)
     sharded = jax.shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(specs, sspec, data_spec, data_spec),
-        out_specs=(specs, sspec, P()),
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=donate)

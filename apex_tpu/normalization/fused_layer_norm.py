@@ -59,23 +59,35 @@ def _ln_fwd_impl(x, weight, bias, normalized_shape, eps):
     x2, lead, n = _rows_view(x, normalized_shape)
     from apex_tpu.ops.layer_norm_pallas import layer_norm_fwd_pallas, pallas_available
 
-    if pallas_available(x2, n):
+    def pallas_impl():
         w = weight.reshape(n) if weight is not None else None
         b = bias.reshape(n) if bias is not None else None
         y, mean, rstd = layer_norm_fwd_pallas(x2, w, b, eps)
         return y.reshape(x.shape), mean[:, 0], rstd[:, 0]
-    xf = x2.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=1, keepdims=True)
-    var = jnp.mean(jnp.square(xf - mean), axis=1, keepdims=True)
-    invvar = jax.lax.rsqrt(var + eps)
-    xhat = (xf - mean) * invvar
-    y = xhat
-    if weight is not None:
-        y = y * weight.reshape(1, n).astype(jnp.float32)
-    if bias is not None:
-        y = y + bias.reshape(1, n).astype(jnp.float32)
-    out = y.astype(x.dtype).reshape(x.shape)
-    return out, mean[:, 0], invvar[:, 0]
+
+    def jnp_impl():
+        xf = x2.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=1, keepdims=True)
+        invvar = jax.lax.rsqrt(var + eps)
+        xhat = (xf - mean) * invvar
+        y = xhat
+        if weight is not None:
+            y = y * weight.reshape(1, n).astype(jnp.float32)
+        if bias is not None:
+            y = y + bias.reshape(1, n).astype(jnp.float32)
+        out = y.astype(x.dtype).reshape(x.shape)
+        return out, mean[:, 0], invvar[:, 0]
+
+    if pallas_available(x2, n):
+        # no registry_engaged gate (here or in the bwd): both impls are
+        # collective-free per-row math, so a per-process degrade cannot
+        # desync a pod's collective programs, and there is no forced-
+        # impl knob to honor (pallas_available gates by platform)
+        from apex_tpu.resilience.fallback import get_registry
+
+        return get_registry().call("layer_norm", pallas_impl, jnp_impl)
+    return jnp_impl()
 
 
 def _ln_fwd(x, weight, bias, normalized_shape, eps, memory_efficient):
@@ -94,16 +106,32 @@ def _ln_bwd(normalized_shape, eps, memory_efficient, res, g):
     from apex_tpu.ops.layer_norm_pallas import layer_norm_bwd_pallas, pallas_available
 
     if not memory_efficient and pallas_available(g2, n):
-        x2 = saved.reshape((-1, n))
-        w = weight.reshape(n) if weight is not None else None
-        dx, dw_p, db_p = layer_norm_bwd_pallas(
-            x2, w, g2, mean[:, None], invvar[:, None], with_bias=bias is not None
-        )
-        dx = dx.reshape(g.shape).astype(g.dtype)
-        dw = dw_p.sum(0).reshape(weight.shape).astype(weight.dtype) if weight is not None else None
-        db = db_p.sum(0).reshape(bias.shape).astype(bias.dtype) if (bias is not None and db_p is not None) else None
-        return dx, dw, db
+        def pallas_impl():
+            x2 = saved.reshape((-1, n))
+            w = weight.reshape(n) if weight is not None else None
+            dx, dw_p, db_p = layer_norm_bwd_pallas(
+                x2, w, g2, mean[:, None], invvar[:, None], with_bias=bias is not None
+            )
+            dx = dx.reshape(g.shape).astype(g.dtype)
+            dw = dw_p.sum(0).reshape(weight.shape).astype(weight.dtype) if weight is not None else None
+            db = db_p.sum(0).reshape(bias.shape).astype(bias.dtype) if (bias is not None and db_p is not None) else None
+            return dx, dw, db
 
+        from apex_tpu.resilience.fallback import get_registry
+
+        return get_registry().call(
+            "layer_norm", pallas_impl,
+            lambda: _ln_bwd_jnp(saved, mean, invvar, weight, bias, g2, g,
+                                n, memory_efficient))
+
+    return _ln_bwd_jnp(saved, mean, invvar, weight, bias, g2, g, n,
+                       memory_efficient)
+
+
+def _ln_bwd_jnp(saved, mean, invvar, weight, bias, g2, g, n,
+                memory_efficient):
+    """The jnp composite backward — the specification the Pallas kernel
+    is checked against, and the registry's fallback when it trips."""
     gf = g2.astype(jnp.float32)
     inv = invvar[:, None]
 
@@ -154,10 +182,21 @@ def _rms_fwd_impl(x, weight, normalized_shape, eps):
     x2, lead, n = _rows_view(x, normalized_shape)
     from apex_tpu.ops.layer_norm_pallas import layer_norm_fwd_pallas, pallas_available
 
-    if pallas_available(x2, n):
+    def pallas_impl():
         w = weight.reshape(n) if weight is not None else None
         y, _, rstd = layer_norm_fwd_pallas(x2, w, None, eps, rms=True)
         return y.reshape(x.shape), rstd[:, 0]
+
+    if pallas_available(x2, n):
+        from apex_tpu.resilience.fallback import get_registry
+
+        return get_registry().call(
+            "layer_norm", pallas_impl,
+            lambda: _rms_fwd_jnp(x, x2, weight, n, eps))
+    return _rms_fwd_jnp(x, x2, weight, n, eps)
+
+
+def _rms_fwd_jnp(x, x2, weight, n, eps):
     xf = x2.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=1, keepdims=True)
     invvar = jax.lax.rsqrt(var + eps)
@@ -180,16 +219,28 @@ def _rms_bwd(normalized_shape, eps, memory_efficient, res, g):
     from apex_tpu.ops.layer_norm_pallas import layer_norm_bwd_pallas, pallas_available
 
     if not memory_efficient and pallas_available(g2, n):
-        x2 = saved.reshape((-1, n))
-        w = weight.reshape(n) if weight is not None else None
-        dx, dw_p, _ = layer_norm_bwd_pallas(
-            x2, w, g2, jnp.zeros_like(invvar)[:, None], invvar[:, None],
-            rms=True, with_bias=False,
-        )
-        dx = dx.reshape(g.shape).astype(g.dtype)
-        dw = dw_p.sum(0).reshape(weight.shape).astype(weight.dtype) if weight is not None else None
-        return dx, dw
+        def pallas_impl():
+            x2 = saved.reshape((-1, n))
+            w = weight.reshape(n) if weight is not None else None
+            dx, dw_p, _ = layer_norm_bwd_pallas(
+                x2, w, g2, jnp.zeros_like(invvar)[:, None], invvar[:, None],
+                rms=True, with_bias=False,
+            )
+            dx = dx.reshape(g.shape).astype(g.dtype)
+            dw = dw_p.sum(0).reshape(weight.shape).astype(weight.dtype) if weight is not None else None
+            return dx, dw
 
+        from apex_tpu.resilience.fallback import get_registry
+
+        return get_registry().call(
+            "layer_norm", pallas_impl,
+            lambda: _rms_bwd_jnp(saved, invvar, weight, g2, g, n,
+                                 memory_efficient))
+
+    return _rms_bwd_jnp(saved, invvar, weight, g2, g, n, memory_efficient)
+
+
+def _rms_bwd_jnp(saved, invvar, weight, g2, g, n, memory_efficient):
     gf = g2.astype(jnp.float32)
     inv = invvar[:, None]
 

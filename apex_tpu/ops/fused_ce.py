@@ -47,22 +47,28 @@ import jax.numpy as jnp
 __all__ = ["fused_lm_head_ce"]
 
 
-def _pallas_mode() -> str:
-    """"on" (real TPU), "interpret" (forced, CPU tests), or "off".
+def _pallas_mode() -> tuple:
+    """(mode, forced): mode is "on" (real TPU), "interpret" (CPU
+    tests), or "off"; forced is True when the env DEMANDED that mode.
 
     On TPU the Pallas kernels (ops/fused_ce_pallas.py) replace the
     chunked scan: XLA still materializes each scan chunk's logits in
     HBM between the matmul and its reductions, so the scan bounds peak
     memory but not traffic — the kernels keep every logits tile in
     VMEM.  APEX_TPU_FUSED_CE_PALLAS=0 forces the scan path (A/B lever);
-    =interpret runs the kernels through the Pallas interpreter."""
+    =interpret runs the kernels through the Pallas interpreter.  Any
+    explicit setting is *forced* — it bypasses the fallback registry so
+    a broken kernel fails loudly instead of silently degrading to the
+    scan path (which would turn the env-driven kernel-vs-oracle tests
+    into the reference checking itself); only "auto"'s platform default
+    is eligible for registry-mediated degradation."""
     env = os.environ.get("APEX_TPU_FUSED_CE_PALLAS", "auto").lower()
     if env in ("0", "false", "off", "no"):
-        return "off"
+        return "off", True
     if env == "interpret":
-        return "interpret"
+        return "interpret", True
     if env in ("1", "true", "on", "yes"):
-        return "on"  # forced — even off-TPU (compile will fail loudly)
+        return "on", True  # forced — even off-TPU (compile fails loudly)
     if env != "auto":
         # an unrecognized spelling silently falling through to "auto"
         # would invalidate the exact A/B the knob exists for
@@ -70,28 +76,41 @@ def _pallas_mode() -> str:
                          f"on/off, true/false, yes/no, auto, or interpret")
     try:
         if jax.devices()[0].platform == "tpu":
-            return "on"
+            return "on", False
     except Exception:  # noqa: BLE001 — no backend yet: scan path
         pass
-    return "off"
+    return "off", False
 
 
-def _resolve_mode(impl):
-    """An explicit ``impl`` ("on"/"off"/"interpret") wins over the
-    env-var/platform default.  Threading the override as an argument is
-    what lets callers A/B the two impls without mutating process-global
-    state under an already-traced function (the bench.py:876 class the
-    static analyzer's APX102 rule flags)."""
+def _resolve_mode(impl) -> tuple:
+    """(mode, forced): an explicit ``impl`` ("on"/"off"/"interpret")
+    wins over the env-var/platform default, and both explicit sources
+    count as forced (fail-loudly, no registry fallback).  Threading the
+    override as an argument is what lets callers A/B the two impls
+    without mutating process-global state under an already-traced
+    function (the bench.py:876 class the static analyzer's APX102 rule
+    flags)."""
     if impl is None:
         return _pallas_mode()
     if impl not in ("on", "off", "interpret"):
         raise ValueError(f"fused_ce impl={impl!r}: use 'on', 'off', "
                          f"'interpret', or None for the env/platform default")
-    return impl
+    return impl, True
 
 
 def _chunk(a, n_chunks):
     return a.reshape((n_chunks, a.shape[0] // n_chunks) + a.shape[1:])
+
+
+def _safe_chunk(S, chunk_size):
+    """Largest divisor of S that is <= chunk_size.  The scan path needs
+    a divisor; the Pallas kernels do not — so when the fallback registry
+    degrades a kernel call, the scan must accept whatever shape the
+    kernel path already accepted rather than trip the caller's assert."""
+    c = max(1, min(int(chunk_size), int(S)))
+    while S % c:
+        c -= 1
+    return c
 
 
 def _chunk_stats(x_c, embed, t_c, axis_name):
@@ -185,8 +204,9 @@ def _local_targets(targets, partition, axis_name):
 
 def _fwd(x, embed, targets, chunk_size, axis_name, impl=None):
     S, B = targets.shape
-    mode = _resolve_mode(impl)
-    if mode != "off":
+    mode, forced = _resolve_mode(impl)
+
+    def pallas_fwd():
         from apex_tpu.ops.fused_ce_pallas import fused_ce_fwd_pallas
 
         H = x.shape[-1]
@@ -198,33 +218,52 @@ def _fwd(x, embed, targets, chunk_size, axis_name, impl=None):
             m_g = jax.lax.pmax(m, axis_name)
             l_g = jax.lax.psum(l * jnp.exp(m - m_g), axis_name)
             lse = m_g + jnp.log(l_g)
-            tgt = jax.lax.psum(tgt, axis_name)
+            tgt_g = jax.lax.psum(tgt, axis_name)
         else:
             lse = m + jnp.log(l)
-        lse = lse.reshape(S, B)
-        loss = lse - tgt.reshape(S, B)
-        return loss, (x, embed, targets, lse)
+            tgt_g = tgt
+        lse2 = lse.reshape(S, B)
+        loss = lse2 - tgt_g.reshape(S, B)
+        return loss, (x, embed, targets, lse2)
 
-    assert S % chunk_size == 0, (S, chunk_size)
-    n = S // chunk_size
+    def scan_fwd(cs):
+        assert S % cs == 0, (S, cs)
+        n = S // cs
 
-    def step(_, xs):
-        x_c, t_c = xs
-        lse, tgt = _chunk_stats(x_c, embed, t_c, axis_name)
-        return None, (lse, tgt)
+        def step(_, xs):
+            x_c, t_c = xs
+            lse, tgt = _chunk_stats(x_c, embed, t_c, axis_name)
+            return None, (lse, tgt)
 
-    _, (lse, tgt) = jax.lax.scan(
-        step, None, (_chunk(x, n), _chunk(targets, n)))
-    loss = (lse - tgt).reshape(S, targets.shape[1])
-    return loss, (x, embed, targets, lse.reshape(S, targets.shape[1]))
+        _, (lse, tgt) = jax.lax.scan(
+            step, None, (_chunk(x, n), _chunk(targets, n)))
+        loss = (lse - tgt).reshape(S, targets.shape[1])
+        return loss, (x, embed, targets, lse.reshape(S, targets.shape[1]))
+
+    if mode != "off":
+        # both impls return (loss, (x, embed, targets, GLOBAL lse)), so
+        # a degraded forward still pairs with either backward; an
+        # explicitly forced impl bypasses the registry and fails loudly
+        from apex_tpu.resilience.fallback import (
+            get_registry,
+            registry_engaged,
+        )
+
+        if registry_engaged(forced=forced):
+            return get_registry().call(
+                "fused_ce", pallas_fwd,
+                lambda: scan_fwd(_safe_chunk(S, chunk_size)))
+        return pallas_fwd()
+    return scan_fwd(chunk_size)
 
 
 def _bwd(chunk_size, axis_name, impl, res, g):
     x, embed, targets, lse = res
     S = x.shape[0]
     dt = np.zeros(targets.shape, dtype=jax.dtypes.float0)
-    mode = _resolve_mode(impl)
-    if mode != "off":
+    mode, forced = _resolve_mode(impl)
+
+    def pallas_bwd():
         from apex_tpu.ops.fused_ce_pallas import fused_ce_bwd_pallas
 
         B, H = targets.shape[1], x.shape[-1]
@@ -235,19 +274,36 @@ def _bwd(chunk_size, axis_name, impl, res, g):
             interpret=(mode == "interpret"))
         return dx2.reshape(x.shape), dembed.astype(embed.dtype), dt
 
-    n = S // chunk_size
+    def scan_bwd(cs):
+        n = S // cs
 
-    def step(dembed, xs):
-        x_c, t_c, lse_c, g_c = xs
-        dx_c, de = _chunk_grads(x_c, embed, t_c, lse_c, g_c, axis_name)
-        return dembed + de, dx_c
+        def step(dembed, xs):
+            x_c, t_c, lse_c, g_c = xs
+            dx_c, de = _chunk_grads(x_c, embed, t_c, lse_c, g_c, axis_name)
+            return dembed + de, dx_c
 
-    dembed, dx = jax.lax.scan(
-        step, jnp.zeros(embed.shape, jnp.float32),
-        (_chunk(x, n), _chunk(targets, n), _chunk(lse, n), _chunk(g, n)))
-    dx = dx.reshape(x.shape)
-    # int targets: cotangent is the symbolic float0 zero
-    return dx, dembed.astype(embed.dtype), dt
+        dembed, dx = jax.lax.scan(
+            step, jnp.zeros(embed.shape, jnp.float32),
+            (_chunk(x, n), _chunk(targets, n), _chunk(lse, n), _chunk(g, n)))
+        dx = dx.reshape(x.shape)
+        # int targets: cotangent is the symbolic float0 zero
+        return dx, dembed.astype(embed.dtype), dt
+
+    if mode != "off":
+        # the residuals (x, embed, targets, global lse) feed either
+        # backward, so a kernel tripped between fwd and bwd still works;
+        # an explicitly forced impl bypasses the registry and fails loudly
+        from apex_tpu.resilience.fallback import (
+            get_registry,
+            registry_engaged,
+        )
+
+        if registry_engaged(forced=forced):
+            return get_registry().call(
+                "fused_ce", pallas_bwd,
+                lambda: scan_bwd(_safe_chunk(S, chunk_size)))
+        return pallas_bwd()
+    return scan_bwd(chunk_size)
 
 
 fused_lm_head_ce.defvjp(_fwd, _bwd)

@@ -1,0 +1,63 @@
+"""apex_tpu.resilience — keep long training runs alive on flaky hardware.
+
+Apex's production value was never just speed: dynamic loss scaling with
+hysteresis and found-inf semantics in every multi-tensor op exist so a
+run *survives* bad steps.  This package is that pillar rebuilt on TPU
+preemption semantics (TorchTitan treats the same concerns as a
+first-class pillar of a pre-training stack):
+
+- :mod:`~apex_tpu.resilience.fallback` — kernel fallback registry: a
+  Pallas lowering/launch failure degrades once, with a structured
+  warning, to the XLA reference impl instead of crashing the run.
+- :mod:`~apex_tpu.resilience.step_guard` — device-side bad-step
+  accounting over the amp ``all_finite`` predicate, with a host-side
+  consecutive-bad-step budget that aborts cleanly to a checkpoint.
+- :mod:`~apex_tpu.resilience.preemption` — SIGTERM/deadline hook that
+  flushes the async checkpoint queue; pairs with
+  :func:`apex_tpu.io.latest_checkpoint` torn-file-safe discovery.
+- :mod:`~apex_tpu.resilience.chaos` — deterministic fault injection
+  (NaN grads, kernel-launch failures, preemptions, wedges) so all of
+  the above is testable on the virtual 8-device CPU mesh today.
+
+See ``docs/resilience.md`` for the fault model and usage.
+"""
+
+from apex_tpu.resilience.chaos import (
+    ChaosKernelFailure,
+    ChaosMonkey,
+    ChaosPlan,
+    active_monkey,
+)
+from apex_tpu.resilience.fallback import (
+    KernelFallbackRegistry,
+    get_registry,
+    registry_engaged,
+    trip_from_exception,
+)
+from apex_tpu.resilience.preemption import (
+    PreemptionHandler,
+    load_rng_tracker_state_dict,
+    rng_tracker_state_dict,
+)
+from apex_tpu.resilience.step_guard import (
+    BadStepBudgetExceeded,
+    GuardState,
+    StepGuard,
+)
+
+__all__ = [
+    "BadStepBudgetExceeded",
+    "ChaosKernelFailure",
+    "ChaosMonkey",
+    "ChaosPlan",
+    "GuardState",
+    "KernelFallbackRegistry",
+    "PreemptionHandler",
+    "StepGuard",
+    "active_monkey",
+    "get_registry",
+    "load_rng_tracker_state_dict",
+    "registry_engaged",
+    "rng_tracker_state_dict",
+    "trip_from_exception",
+]

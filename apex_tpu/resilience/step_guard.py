@@ -1,0 +1,112 @@
+"""Step guard: device-side bad-step accounting over the amp predicate.
+
+The repo already has the two halves of step skipping (the reference's
+``noop_flag`` design): :func:`apex_tpu.amp.scaler.all_finite` produces
+the predicate, and every fused optimizer predicates its whole update on
+``grads_finite`` (``optimizers/base.predicate_step``/``select``).  What
+was missing is the *survivability* layer above them — apex keeps runs
+alive not just by skipping one bad step but by noticing when bad steps
+stop being transient:
+
+- :class:`GuardState` rides the train step as a tiny pytree: a step
+  counter, the CONSECUTIVE bad-step count, and the total skipped.  The
+  update is branch-free device arithmetic fused into the compiled step
+  — no host sync per step, exactly like the scaler it composes with.
+- :meth:`StepGuard.check` is the **host-side** budget check, run at
+  whatever cadence the loop already syncs (the loss print, a
+  checkpoint boundary): ``consecutive_bad >= max_consecutive_bad``
+  raises :class:`BadStepBudgetExceeded` so the loop can flush its
+  checkpointer and abort cleanly instead of burning hours skipping
+  every step of a diverged run (hysteresis backoff can only save a run
+  whose loss surface is still sane).
+
+Wiring: ``make_train_step(..., step_guard=guard)`` threads the state
+through the jitted step; see :mod:`apex_tpu.models.gpt`.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["GuardState", "StepGuard", "BadStepBudgetExceeded"]
+
+
+class GuardState(NamedTuple):
+    step: jnp.ndarray             # i32: steps attempted (incl. skipped)
+    consecutive_bad: jnp.ndarray  # i32: current bad streak
+    total_skipped: jnp.ndarray    # i32: lifetime skipped steps
+
+
+class BadStepBudgetExceeded(RuntimeError):
+    """The consecutive-bad-step budget is exhausted; abort to the last
+    checkpoint.  Carries the offending (host-synced) guard state."""
+
+    def __init__(self, msg: str, state: "GuardState"):
+        super().__init__(msg)
+        self.guard_state = state
+
+
+class StepGuard:
+    """Counts skipped steps device-side; enforces a budget host-side."""
+
+    def __init__(self, max_consecutive_bad: int = 10):
+        if max_consecutive_bad < 1:
+            raise ValueError("max_consecutive_bad must be >= 1")
+        self.max_consecutive_bad = int(max_consecutive_bad)
+
+    # ----------------------------------------------------------- state
+    def init(self) -> GuardState:
+        return GuardState(
+            step=jnp.int32(0),
+            consecutive_bad=jnp.int32(0),
+            total_skipped=jnp.int32(0),
+        )
+
+    def update(self, state: GuardState, all_finite_flag) -> GuardState:
+        """Device-side accounting for one step outcome (branch-free)."""
+        finite = jnp.asarray(all_finite_flag)
+        bad = jnp.where(finite, jnp.int32(0),
+                        state.consecutive_bad + jnp.int32(1))
+        skipped = state.total_skipped + jnp.where(
+            finite, jnp.int32(0), jnp.int32(1))
+        return GuardState(
+            step=state.step + jnp.int32(1),
+            consecutive_bad=bad,
+            total_skipped=skipped,
+        )
+
+    # ----------------------------------------------------- budget check
+    def exhausted(self, state: GuardState) -> jnp.ndarray:
+        """Device-side bool: budget blown (no host sync; usable inside
+        jit, e.g. to gate a donated-state freeze)."""
+        return state.consecutive_bad >= self.max_consecutive_bad
+
+    def check(self, state: GuardState) -> GuardState:
+        """HOST-side budget enforcement — call at a cadence that already
+        syncs (the loss print).  Raises :class:`BadStepBudgetExceeded`
+        when the streak hits the budget; returns the state otherwise."""
+        if int(state.consecutive_bad) >= self.max_consecutive_bad:
+            raise BadStepBudgetExceeded(
+                f"{int(state.consecutive_bad)} consecutive non-finite "
+                f"steps (budget {self.max_consecutive_bad}); "
+                f"{int(state.total_skipped)} skipped of "
+                f"{int(state.step)} total — aborting to the last "
+                f"checkpoint", state)
+        return state
+
+    # -------------------------------------------------- checkpoint I/O
+    def state_dict(self, state: GuardState) -> dict:
+        return {
+            "step": int(state.step),
+            "consecutive_bad": int(state.consecutive_bad),
+            "total_skipped": int(state.total_skipped),
+        }
+
+    def load_state_dict(self, d: Optional[dict]) -> GuardState:
+        if d is None:
+            return self.init()
+        return GuardState(
+            step=jnp.int32(d["step"]),
+            consecutive_bad=jnp.int32(d["consecutive_bad"]),
+            total_skipped=jnp.int32(d["total_skipped"]),
+        )

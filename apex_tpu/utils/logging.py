@@ -6,6 +6,7 @@ index plus the local device set, read lazily so logging works before
 ``jax.distributed.initialize``.
 """
 
+import json
 import logging
 import sys
 
@@ -43,3 +44,19 @@ def get_logger(name: str = "apex_tpu") -> logging.Logger:
 def set_logging_level(level) -> None:
     """Reference: apex/transformer/log_util.py (set_logging_level)."""
     get_logger().setLevel(level)
+
+
+def log_structured(logger: logging.Logger, level: int, event: str,
+                   **fields) -> None:
+    """One-line machine-parseable log record: ``EVENT {json fields}``.
+
+    The resilience runtime (kernel fallback, step guard, preemption)
+    reports through this so a wedged-run postmortem can grep one event
+    name and get every occurrence with its context as JSON — the same
+    greppability contract as bench.py's section sidecar."""
+    try:
+        payload = json.dumps(fields, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        payload = json.dumps({k: repr(v) for k, v in fields.items()},
+                             sort_keys=True)
+    logger.log(level, "%s %s", event, payload)

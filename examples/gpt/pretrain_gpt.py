@@ -16,6 +16,9 @@ Runs out of the box on the virtual CPU mesh (synthetic data):
     ... --tp 2 --fp16                  # fp16 + dynamic loss scaling
     ... --tp 2 --zero                  # ZeRO-2 state sharding over dp
     ... --checkpoint /tmp/gpt_ck --steps 4   # then: --resume /tmp/gpt_ck
+    ... --checkpoint /tmp/gpt_ck --auto-resume   # preemption-safe: SIGTERM
+    #   saves+flushes and exits; rerunning the same line resumes from the
+    #   newest valid checkpoint (torn files skipped) — apex_tpu.resilience
 """
 
 import argparse
@@ -71,13 +74,22 @@ def parse_args():
                    choices=["uint16", "int32"],
                    help="token id dtype of --data")
     p.add_argument("--resume", default=None, help="checkpoint dir to resume")
+    p.add_argument("--auto-resume", action="store_true",
+                   help="preemption-safe mode (needs --checkpoint): resume "
+                        "from the newest VALID checkpoint in the dir if one "
+                        "exists (torn files from a killed writer are "
+                        "skipped), install a SIGTERM hook that saves and "
+                        "flushes before exiting, and degrade kernel compile "
+                        "failures to the XLA fallback instead of dying — "
+                        "the same command line works for the first launch "
+                        "and every restart")
     return p.parse_args()
 
 
 def main():
     args = parse_args()
 
-    from apex_tpu import io
+    from apex_tpu import io, resilience
     from apex_tpu.amp import DynamicLossScaler
     from apex_tpu.contrib.optimizers import DistributedFusedAdam
     from apex_tpu.models.gpt import (
@@ -87,6 +99,10 @@ def main():
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer import parallel_state as ps
     from apex_tpu.transformer._data import MegatronPretrainingSampler
+
+    if args.auto_resume and not args.checkpoint:
+        raise SystemExit("--auto-resume needs --checkpoint (the dir it "
+                         "both resumes from and saves into)")
 
     mesh = ps.initialize_model_parallel(
         tensor_model_parallel_size_=args.tp,
@@ -146,16 +162,20 @@ def main():
     scaler = DynamicLossScaler(init_scale=2.0 ** 12) if args.fp16 else None
     scaler_state = scaler.init() if scaler else None
 
-    if args.pp > 1:
+    def build_step():
         # donate_state: the loop rebinds params/state every step and the
         # async checkpointer host-snapshots at save() time, so donation
-        # is safe — and saves ~3x param bytes of transient HBM
-        step = make_pp_train_step(config, optimizer, mesh,
-                                  num_microbatches=args.micro_batches,
-                                  loss_scaler=scaler, donate_state=True)
-    else:
-        step = make_train_step(config, optimizer, mesh, loss_scaler=scaler,
+        # is safe — and saves ~3x param bytes of transient HBM.  A
+        # builder (not a one-shot) so a kernel compile failure can
+        # rebuild the step against the tripped fallback registry.
+        if args.pp > 1:
+            return make_pp_train_step(config, optimizer, mesh,
+                                      num_microbatches=args.micro_batches,
+                                      loss_scaler=scaler, donate_state=True)
+        return make_train_step(config, optimizer, mesh, loss_scaler=scaler,
                                donate_state=True)
+
+    step = build_step()
 
     # Corpus: a memmapped token file (--data, the real-pretraining path:
     # the OS pages in only the rows each batch touches) or a synthetic
@@ -206,7 +226,14 @@ def main():
         return {"params": pspecs, "state": sspec, "step": P(),
                 "scaler": scaler_spec}
 
-    if args.resume:
+    # --resume points at a dir and fails loudly if nothing valid is
+    # there; --auto-resume resumes from --checkpoint when it holds a
+    # valid checkpoint and silently starts fresh otherwise (first
+    # launch and post-preemption restart share one command line).
+    resume_dir = args.resume or (args.checkpoint if args.auto_resume
+                                 else None)
+    ck = None
+    if resume_dir:
         if multiproc:
             # pod-scale restore: every process reads only the pieces its
             # own devices need (lazy shard files, no host materializes
@@ -216,43 +243,68 @@ def main():
             # broadcasts it so the whole pod resumes the same step even
             # if a shared FS shows processes different file listings;
             # load errors (template/shape mismatch) propagate loudly.
-            import json as _json
-
             from jax.experimental import multihost_utils
 
             def newest_complete():
-                for d in sorted(Path(args.resume).glob("step_*"),
-                                reverse=True):
-                    idx = d / "index.json"
-                    if not idx.exists():
-                        continue
-                    try:
-                        world = _json.loads(idx.read_text())["world_size"]
-                    except (ValueError, KeyError):
-                        continue
-                    if len(list(d.glob("shard_*.ckpt"))) >= world:
-                        return int(d.name.split("_")[1])
-                return -1
+                try:
+                    return io.latest_distributed_step(resume_dir)
+                except io.AllCheckpointsTornError:
+                    # encode over the broadcast so every process raises
+                    # together instead of peers hanging in the collective
+                    return -2
 
             chosen = newest_complete() if jax.process_index() == 0 else 0
             chosen = int(multihost_utils.broadcast_one_to_all(
                 np.int64(chosen)))
-            if chosen < 0:
+            if chosen == -2 or (chosen < 0 and args.resume):
+                # -2: step_* dirs EXIST but none is fully published —
+                # prior progress would be silently discarded, so loud
+                # even under --auto-resume (the single-process
+                # AllCheckpointsTornError invariant, pod-scale)
                 raise FileNotFoundError(
-                    f"no complete checkpoint under {args.resume}")
-            ck = io.load_distributed_checkpoint(
-                Path(args.resume) / f"step_{chosen:08d}",
-                ckpt_tree(params, state, 0, scaler_state),
-                mesh=mesh, spec_tree=ckpt_specs())
+                    f"no complete checkpoint under {resume_dir}" +
+                    (": step_* dirs exist but none is fully published; "
+                     "refusing to silently restart from step 0"
+                     if chosen == -2 else ""))
+            if chosen >= 0:
+                ck = io.load_distributed_checkpoint(
+                    Path(resume_dir) / f"step_{chosen:08d}",
+                    ckpt_tree(params, state, 0, scaler_state),
+                    mesh=mesh, spec_tree=ckpt_specs())
         else:
-            ck = io.load_checkpoint(Path(args.resume) / "latest.ckpt")
-            ck = jax.tree.map(jnp.asarray, ck)
+            # torn-file-safe discovery: a file the preempted writer was
+            # killed inside (bad header, short blob) is skipped with a
+            # warning; only a VALID checkpoint is ever loaded
+            try:
+                path = io.latest_checkpoint(resume_dir)
+            except io.AllCheckpointsTornError:
+                # candidates EXISTED but every one failed validation:
+                # prior progress would be silently discarded by a fresh
+                # start — loud even under --auto-resume
+                raise
+            except FileNotFoundError:
+                if args.resume:
+                    raise  # explicit --resume with nothing valid: loud
+                path = None  # --auto-resume first launch: fresh start
+            if path is not None:
+                ck = io.load_checkpoint(path)
+                ck = jax.tree.map(jnp.asarray, ck)
+    if ck is not None:
         params = ck["params"]
         # the checkpoint restores the saved pytree structure, so a
         # checkpoint from a different optimizer fails loudly in update()
         state = ck["state"]
         start_step = int(ck["step"])
         if scaler is not None:
+            if ck.get("scaler") is None:
+                # checkpoints from a non---fp16 run carry no scaler
+                # state (one dir mixing runs with different precision
+                # flags hits this); fail with the mismatch, not a
+                # NoneType subscript deep inside load_state_dict
+                raise ValueError(
+                    f"checkpoint in {resume_dir} has no loss-scaler "
+                    "state (saved by a run without --fp16); resume "
+                    "without --fp16 or point at a matching run's dir")
             scaler_state = scaler.load_state_dict(ck["scaler"])
         print(f"resumed at step {start_step}")
 
@@ -295,49 +347,142 @@ def main():
 
     prefetch = io.PrefetchIterator(sampler, size=2, transform=assemble)
 
+    # SIGTERM (Cloud TPU preemption notice) -> finish the current step,
+    # save, flush the async queue, exit 0; the same command resumes.
+    pre = resilience.PreemptionHandler().install() if args.auto_resume \
+        else None
+
+    def preempt_agreed():
+        """Every process must take the same break-or-continue decision:
+        one host seeing SIGTERM while another enters the next step would
+        deadlock that step's cross-host collectives (and produce a
+        partial step_* dir only some processes wrote).  A host-side
+        allgather of the local flag per step is cheap next to a train
+        step; single-process runs skip it."""
+        if not multiproc:
+            return pre.preempted
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.int8(pre.preempted))
+        return bool(np.max(flags))
+
+    def save_at(tree, step_no):
+        if multiproc:
+            # each process snapshots + writes only its addressable
+            # shards (non-addressable global arrays never hit host);
+            # one directory per step keeps every published
+            # checkpoint internally consistent
+            ckpt.save_distributed(
+                Path(args.checkpoint) / f"step_{step_no:08d}", tree)
+            if jax.process_index() == 0:
+                # bounded disk: drop dirs older than the newest
+                # --keep.  The async queue holds ≤2 pending saves
+                # per process, so anything older than the 3 newest
+                # is fully published on every process — with the
+                # default keep=3 a prune can never race a write.
+                import shutil
+
+                old = sorted(Path(args.checkpoint).glob("step_*"))
+                for d in old[:-max(args.keep, 3)]:
+                    shutil.rmtree(d, ignore_errors=True)
+        else:
+            # step-named files (atomic publish) so a preempted restart
+            # picks the newest VALID one; same bounded-disk pruning
+            ckpt.save(Path(args.checkpoint) / f"step_{step_no:08d}.ckpt",
+                      tree)
+            old = sorted(Path(args.checkpoint).glob("step_*.ckpt"))
+            for f in old[:-max(args.keep, 3)]:
+                try:
+                    f.unlink()
+                except OSError:
+                    pass
+
+    def run_step(tokens, targets):
+        nonlocal step
+        step_args = (params, state, scaler_state, tokens, targets) \
+            if scaler is not None else (params, state, tokens, targets)
+        if not args.auto_resume or multiproc:
+            # fail-fast: without --auto-resume, kernel compile errors
+            # surface to the operator (the degrade-and-rebuild retry
+            # below is part of the --auto-resume contract, see --help).
+            # Multi-process ALWAYS fails fast: a kernel error on ONE
+            # host (flaky chip) tripping only that host's registry would
+            # rebuild it on the scan fallback — whose collective count
+            # differs per chunk from the kernel's — deadlocking every
+            # peer inside the step's collectives.  The peers are stuck
+            # device-side, so no host-level agreement (the
+            # preempt_agreed pattern) can run here; a clean job-level
+            # crash + --auto-resume restart is the recoverable path.
+            return step(*step_args)
+        # one rebuild per registered kernel: each retry's fresh trace can
+        # surface the NEXT kernel's deferred compile error (the kernels
+        # have never been proven on real chips — several failing at once
+        # is the expected first-contact mode, and each has a fallback)
+        from apex_tpu.resilience.fallback import KERNELS
+
+        for _ in range(len(KERNELS) + 1):
+            try:
+                return step(*step_args)
+            except Exception as e:  # noqa: BLE001 — kernel failures only
+                # a Mosaic/Pallas failure is DEFERRED to the first call
+                # of the jitted step: attribute it, trip the fallback
+                # registry, rebuild — the fresh trace lowers the XLA
+                # reference impl
+                tripped = resilience.trip_from_exception(e)
+                if not tripped:
+                    raise
+                if any(getattr(x, "is_deleted", lambda: False)()
+                       for tree in step_args
+                       for x in jax.tree.leaves(tree)):
+                    # the failure surfaced AFTER execution started: the
+                    # donated params/state buffers are gone, so a retry
+                    # would read deleted arrays — restart from the
+                    # checkpoint instead of a confusing secondary crash
+                    raise RuntimeError(
+                        "kernel failure after the step consumed its "
+                        "donated inputs; rerun to resume from the last "
+                        "checkpoint (the fallback registry is tripped "
+                        f"for: {', '.join(tripped)})") from e
+                print(f"kernel failure ({', '.join(tripped)}); rebuilt "
+                      f"the step on the XLA fallback impl", flush=True)
+                step = build_step()
+        return step(*step_args)
+
     t0 = time.time()
+    last_saved = None
+    done = 0
     for i in range(start_step, start_step + args.steps):
+        done = i - start_step + 1
         batch = next(prefetch)
         tokens = jnp.asarray(batch[:, :-1])
         targets = jnp.asarray(batch[:, 1:])
         if scaler is not None:
-            params, state, scaler_state, loss = step(
-                params, state, scaler_state, tokens, targets)
+            params, state, scaler_state, loss = run_step(tokens, targets)
             extra = f" scale={float(scaler_state.loss_scale):.0f}"
         else:
-            params, state, loss = step(params, state, tokens, targets)
+            params, state, loss = run_step(tokens, targets)
             extra = ""
         print(f"step {i}: loss={float(loss):.4f}{extra}", flush=True)
         if ckpt and (i + 1) % args.save_every == 0:
-            tree = ckpt_tree(params, state, i + 1, scaler_state)
-            if multiproc:
-                # each process snapshots + writes only its addressable
-                # shards (non-addressable global arrays never hit host);
-                # one directory per step keeps every published
-                # checkpoint internally consistent
-                ckpt.save_distributed(
-                    Path(args.checkpoint) / f"step_{i + 1:08d}", tree)
-                if jax.process_index() == 0:
-                    # bounded disk: drop dirs older than the newest
-                    # --keep.  The async queue holds ≤2 pending saves
-                    # per process, so anything older than the 3 newest
-                    # is fully published on every process — with the
-                    # default keep=3 a prune can never race a write.
-                    import shutil
-
-                    old = sorted(Path(args.checkpoint).glob("step_*"))
-                    for d in old[:-max(args.keep, 3)]:
-                        shutil.rmtree(d, ignore_errors=True)
-            else:
-                ckpt.save(Path(args.checkpoint) / "latest.ckpt", tree)
+            save_at(ckpt_tree(params, state, i + 1, scaler_state), i + 1)
+            last_saved = i + 1
+        if pre is not None and preempt_agreed():
+            if ckpt and last_saved != i + 1:
+                save_at(ckpt_tree(params, state, i + 1, scaler_state),
+                        i + 1)
+            if ckpt:
+                pre.drain(ckpt)  # every accepted save is durable
+            print(f"preempted ({pre.reason or 'peer process'}) after "
+                  f"step {i}; rerun the same command to resume",
+                  flush=True)
+            break
     if ckpt:
         ckpt.close()
-        where = args.checkpoint if multiproc \
-            else f"{args.checkpoint}/latest.ckpt"
-        print(f"checkpoint: {where}")
+        print(f"checkpoint: {args.checkpoint}")
     dt = time.time() - t0
-    print(f"{args.steps} steps in {dt:.1f}s "
-          f"({args.global_batch * args.seq * args.steps / dt:.0f} tokens/s)")
+    print(f"{done} steps in {dt:.1f}s "
+          f"({args.global_batch * args.seq * done / dt:.0f} tokens/s)")
 
 
 if __name__ == "__main__":

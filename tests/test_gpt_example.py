@@ -16,19 +16,27 @@ pytestmark = pytest.mark.slow
 REPO = Path(__file__).resolve().parents[1]
 
 
-def _run(args, extra_env=None):
-    env = {
+def _env(extra=None):
+    return {
         **os.environ,
         "PALLAS_AXON_POOL_IPS": "",
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "PYTHONPATH": str(REPO),
-        **(extra_env or {}),
+        **(extra or {}),
     }
+
+
+def _run(args, extra_env=None, expect_fail=False):
+    """Run the trainer CLI; returns stdout on success.  With
+    ``expect_fail`` asserts a nonzero exit and returns stderr."""
     r = subprocess.run(
         [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"), *args],
-        capture_output=True, text=True, timeout=600, env=env,
+        capture_output=True, text=True, timeout=600, env=_env(extra_env),
     )
+    if expect_fail:
+        assert r.returncode != 0, f"expected failure; stdout:\n{r.stdout[-2000:]}"
+        return r.stderr
     assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
     return r.stdout
 
@@ -52,20 +60,9 @@ def test_data_validation(tmp_path):
     """Token ids beyond --vocab and too-small files fail loudly."""
     bad = tmp_path / "bad.bin"
     np.full(20 * 65, 60000, dtype=np.uint16).tofile(bad)
-    env = {
-        **os.environ,
-        "PALLAS_AXON_POOL_IPS": "",
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-        "PYTHONPATH": str(REPO),
-    }
-    r = subprocess.run(
-        [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
-         "--steps", "1", "--data", str(bad), "--seq", "64"],
-        capture_output=True, text=True, timeout=300, env=env,
-    )
-    assert r.returncode != 0
-    assert "vocab" in r.stderr
+    err = _run(["--steps", "1", "--data", str(bad), "--seq", "64"],
+               expect_fail=True)
+    assert "vocab" in err
 
 
 def test_synthetic_resume_round_trip(tmp_path):
@@ -76,3 +73,102 @@ def test_synthetic_resume_round_trip(tmp_path):
     out = _run(["--tp", "2", "--steps", "2", "--resume", str(ck)])
     assert "resumed at step 4" in out
     assert "step 5:" in out
+
+
+def test_auto_resume_skips_torn_newest(tmp_path):
+    """--auto-resume (apex_tpu.resilience): the same command line does
+    first launch and restart, and a torn newest checkpoint — the
+    leftovers of a writer killed mid-save — costs one save interval,
+    not the run."""
+    ck = tmp_path / "ck"
+    args = ["--tp", "2", "--steps", "4", "--checkpoint", str(ck),
+            "--auto-resume"]
+    out = _run(args)          # first launch: no checkpoint, fresh start
+    assert "resumed" not in out
+    # a torn write from a killed process: valid prefix, truncated blob
+    good = ck / "step_00000004.ckpt"
+    (ck / "step_00000099.ckpt").write_bytes(good.read_bytes()[:-16])
+    out = _run(args)          # identical command line: resumes
+    assert "resumed at step 4" in out
+    assert "step 5:" in out
+
+
+def test_auto_resume_all_torn_fails_loudly(tmp_path):
+    """--auto-resume starts fresh on an EMPTY dir, but when checkpoints
+    existed and every one is torn, silently restarting from step 0
+    would discard the run's progress: fail loudly instead."""
+    ck = tmp_path / "ck"
+    args = ["--tp", "2", "--steps", "4", "--checkpoint", str(ck),
+            "--auto-resume"]
+    _run(args)
+    saved = list(ck.glob("step_*.ckpt"))
+    assert saved
+    for f in saved:
+        f.write_bytes(f.read_bytes()[:-16])
+    assert "torn/corrupt" in _run(args, expect_fail=True)
+
+
+def test_fp16_resume_from_fp32_checkpoint_fails_loudly(tmp_path):
+    """Resuming --fp16 from a checkpoint saved without a loss scaler
+    (e.g. a dir mixing runs with different precision flags) names the
+    mismatch instead of crashing inside load_state_dict."""
+    ck = tmp_path / "ck"
+    _run(["--tp", "2", "--steps", "4", "--checkpoint", str(ck)])
+    err = _run(["--tp", "2", "--steps", "2", "--fp16",
+                "--resume", str(ck)], expect_fail=True)
+    assert "no loss-scaler state" in err
+
+
+def test_sigterm_preempts_saves_and_resumes(tmp_path):
+    """The preemption path end to end as a real process: SIGTERM (the
+    Cloud TPU reclaim notice) makes the loop save, drain the async
+    queue, and exit 0; rerunning the same command resumes."""
+    import select
+    import signal
+    import time
+
+    ck = tmp_path / "ck"
+    args = ["--tp", "2", "--steps", "200", "--checkpoint", str(ck),
+            "--auto-resume", "--save-every", "1000"]
+    # stderr goes to a file, not a pipe: nobody reads it until the end,
+    # and a pipe the child fills past 64KB of JAX warnings would wedge
+    # it (and this test) forever
+    err_path = tmp_path / "stderr.log"
+    with open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
+             *args],
+            stdout=subprocess.PIPE, stderr=err_f, text=True, env=_env(),
+        )
+        try:
+            deadline = time.monotonic() + 300
+            lines = []
+            saw_step = False
+            while time.monotonic() < deadline:
+                # select before readline: a child wedged pre-output must
+                # fail this test at the deadline, not hang the suite
+                ready, _, _ = select.select(
+                    [proc.stdout], [], [],
+                    max(0.0, deadline - time.monotonic()))
+                if not ready:
+                    break
+                line = proc.stdout.readline()
+                if not line:          # EOF: child exited early
+                    break
+                lines.append(line)
+                if line.startswith("step 1:"):
+                    proc.send_signal(signal.SIGTERM)
+                    saw_step = True
+                    break
+            if not saw_step:
+                pytest.fail("never saw step 1:\n" + "".join(lines))
+            out, _ = proc.communicate(timeout=120)
+        finally:
+            proc.kill()
+    err = err_path.read_text()
+    assert proc.returncode == 0, err[-2000:]
+    assert "preempted (signal SIGTERM)" in out
+    assert list(ck.glob("step_*.ckpt")), "no durable checkpoint"
+    out2 = _run(["--tp", "2", "--steps", "1", "--checkpoint", str(ck),
+                 "--auto-resume"])
+    assert "resumed at step" in out2

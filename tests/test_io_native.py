@@ -1,11 +1,21 @@
 """Native runtime + checkpoint I/O tests (the apex_C flatten/unflatten
 parity of reference tests, host-side)."""
 
+from pathlib import Path
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from apex_tpu.io import PrefetchIterator, load_checkpoint, native, save_checkpoint
+from apex_tpu.io import (
+    PrefetchIterator,
+    checkpoint_step,
+    latest_checkpoint,
+    load_checkpoint,
+    native,
+    save_checkpoint,
+    validate_checkpoint,
+)
 
 
 class TestNativeLib:
@@ -62,6 +72,200 @@ class TestCheckpoint:
         p.write_bytes(b"NOTAPEX!xxxx")
         with pytest.raises(ValueError):
             load_checkpoint(p)
+
+
+class TestTornWriteRecovery:
+    """Preemption-safe resume (apex_tpu.resilience): a writer killed
+    mid-save — the exact fault a TPU reclaim produces — must cost one
+    save interval, never the run.  ``latest_checkpoint`` skips torn
+    files with a warning and fails LOUDLY when nothing valid remains
+    (training from scratch while claiming to resume is the worst
+    outcome)."""
+
+    TREE = {"w": jnp.arange(12.0).reshape(3, 4), "step": jnp.int32(3)}
+
+    def _save(self, path):
+        save_checkpoint(path, self.TREE)
+        return path
+
+    def test_validate_accepts_good_and_reports_header(self, tmp_path):
+        p = self._save(tmp_path / "step_00000003.ckpt")
+        header = validate_checkpoint(p)
+        assert [m["shape"] for m in header["leaves"]] == [[], [3, 4]]
+
+    def test_truncated_blob_rejected(self, tmp_path):
+        """The torn-write shape a dying writer actually produces: the
+        header promises N blob bytes, the file holds fewer."""
+        p = self._save(tmp_path / "step_00000003.ckpt")
+        p.write_bytes(p.read_bytes()[:-7])
+        with pytest.raises(ValueError, match="torn"):
+            validate_checkpoint(p)
+
+    def test_truncated_preamble_rejected(self, tmp_path):
+        """Killed even earlier: mid-header.  Must be a clean rejection,
+        not a struct/pickle traceback."""
+        p = self._save(tmp_path / "step_00000003.ckpt")
+        p.write_bytes(p.read_bytes()[:20])
+        with pytest.raises(ValueError, match="torn or corrupt"):
+            validate_checkpoint(p)
+
+    def test_corrupt_header_json_wrapped_with_path(self, tmp_path):
+        """Corruption in the JSON header region: json.JSONDecodeError is
+        a ValueError subclass, but it must not escape context-free — the
+        rejection names the file and the 'torn or corrupt' marker."""
+        import json as _json
+
+        p = self._save(tmp_path / "step_00000003.ckpt")
+        raw = bytearray(p.read_bytes())
+        # first header byte is '{'; flip it so the JSON no longer parses
+        start = 8 + 16  # magic + (hlen, tlen)
+        assert raw[start:start + 1] == b"{"
+        raw[start] = ord("X")
+        p.write_bytes(bytes(raw))
+        with pytest.raises(ValueError, match="torn or corrupt") as ei:
+            validate_checkpoint(p)
+        assert p.name in str(ei.value)
+        assert not isinstance(ei.value, _json.JSONDecodeError)
+
+    def test_corrupt_header_metadata_rejected_and_skipped(self, tmp_path):
+        """Corruption INSIDE a parseable header — a bit-flipped dtype
+        string — is just as torn as a short preamble: validate raises
+        ValueError (not an AttributeError from dtype resolution) and
+        latest_checkpoint skips to the older survivor instead of
+        crashing the resume."""
+        self._save(tmp_path / "step_00000004.ckpt")
+        newest = self._save(tmp_path / "step_00000008.ckpt")
+        raw = newest.read_bytes()
+        assert b"<f4" in raw  # same-length garbage keeps offsets valid
+        newest.write_bytes(raw.replace(b"<f4", b"xxx", 1))
+        with pytest.raises(ValueError, match="torn or corrupt"):
+            validate_checkpoint(newest)
+        assert latest_checkpoint(tmp_path).endswith("step_00000004.ckpt")
+
+    def test_checkpoint_step_parses_names(self):
+        assert checkpoint_step("/ck/step_00000042.ckpt") == 42
+        assert checkpoint_step("/ck/latest.ckpt") == -1
+
+    def test_latest_skips_torn_file_to_previous_step(self, tmp_path):
+        self._save(tmp_path / "step_00000004.ckpt")
+        newest = self._save(tmp_path / "step_00000008.ckpt")
+        newest.write_bytes(newest.read_bytes()[:-5])  # torn newest
+        got = latest_checkpoint(tmp_path)
+        assert got.endswith("step_00000004.ckpt")
+        # and the survivor actually loads
+        back = load_checkpoint(got)
+        np.testing.assert_array_equal(back["w"], np.arange(12.0).reshape(3, 4))
+
+    def test_latest_ignores_tmp_leftovers(self, tmp_path):
+        """A ``.tmp`` the atomic publish never renamed is not a
+        candidate at all — even a VALID one (it was never published)."""
+        self._save(tmp_path / "step_00000004.ckpt")
+        self._save(tmp_path / "step_00000009.ckpt.tmp")
+        (tmp_path / "step_00000010.ckpt.tmp").write_bytes(b"garbage")
+        assert latest_checkpoint(tmp_path).endswith("step_00000004.ckpt")
+
+    def test_latest_orders_by_step_number_not_mtime(self, tmp_path):
+        import os
+
+        self._save(tmp_path / "step_00000010.ckpt")
+        older = self._save(tmp_path / "step_00000009.ckpt")
+        os.utime(older, (2_000_000_000, 2_000_000_000))  # newest mtime
+        assert latest_checkpoint(tmp_path).endswith("step_00000010.ckpt")
+
+    def test_empty_dir_fails_loudly(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="empty or not a"):
+            latest_checkpoint(tmp_path)
+
+    def test_missing_dir_fails_loudly(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="does not exist"):
+            latest_checkpoint(tmp_path / "nope")
+
+    def test_all_torn_fails_loudly_with_reasons(self, tmp_path):
+        """All-torn raises the DISTINCT AllCheckpointsTornError subclass:
+        prior progress existed, so an auto-resuming caller must not
+        treat this like an empty first-launch directory."""
+        from apex_tpu.io import AllCheckpointsTornError
+
+        p = self._save(tmp_path / "step_00000004.ckpt")
+        p.write_bytes(p.read_bytes()[:-5])
+        (tmp_path / "step_00000008.ckpt").write_bytes(b"NOTAPEX!xxxx")
+        with pytest.raises(AllCheckpointsTornError,
+                           match="torn/corrupt") as ei:
+            latest_checkpoint(tmp_path)
+        assert "step_00000004" in str(ei.value)
+        assert "step_00000008" in str(ei.value)
+        # empty dir is the PLAIN FileNotFoundError, never the subclass
+        empty = tmp_path / "fresh"
+        empty.mkdir()
+        with pytest.raises(FileNotFoundError) as ei2:
+            latest_checkpoint(empty)
+        assert not isinstance(ei2.value, AllCheckpointsTornError)
+
+    def test_candidate_pruned_during_sort_is_tolerated(self, tmp_path):
+        """A file unlinked between iterdir() and the sort key's stat()
+        (a concurrent run pruning a shared dir) must not crash
+        discovery — the survivor is still found."""
+        import unittest.mock as mock
+
+        from apex_tpu.io import checkpoint as ckpt_mod
+
+        keep = self._save(tmp_path / "step_00000004.ckpt")
+        gone = self._save(tmp_path / "step_00000002.ckpt")
+        real_step = ckpt_mod.checkpoint_step
+
+        def racing_step(p):
+            # fires inside the sort key, AFTER iterdir listed the file
+            # and BEFORE its mtime stat
+            if Path(p).name == gone.name and gone.exists():
+                gone.unlink()
+            return real_step(p)
+
+        with mock.patch.object(ckpt_mod, "checkpoint_step", racing_step):
+            got = latest_checkpoint(tmp_path)
+        assert got.endswith(keep.name)
+
+
+class TestDistributedStepDiscovery:
+    """latest_distributed_step: the pod-scale restart side.  Per-step
+    dirs mean an interrupted save can only leave an INCOMPLETE newest
+    dir; discovery skips those, distinguishes 'nothing saved yet' from
+    'everything is torn', and never lets an auto-resuming pod silently
+    restart from step 0 over real progress."""
+
+    def _publish(self, d, step, world=2, shards=None):
+        import json
+
+        sd = d / f"step_{step:08d}"
+        sd.mkdir(parents=True)
+        (sd / "index.json").write_text(json.dumps({"world_size": world}))
+        for i in range(world if shards is None else shards):
+            (sd / f"shard_{i}.ckpt").write_bytes(b"x")
+        return sd
+
+    def test_newest_complete_dir_wins(self, tmp_path):
+        from apex_tpu.io import latest_distributed_step
+
+        self._publish(tmp_path, 4)
+        self._publish(tmp_path, 8)
+        self._publish(tmp_path, 12, shards=1)  # interrupted newest
+        assert latest_distributed_step(tmp_path) == 8
+
+    def test_no_dirs_is_fresh_start(self, tmp_path):
+        from apex_tpu.io import latest_distributed_step
+
+        assert latest_distributed_step(tmp_path) == -1
+        assert latest_distributed_step(tmp_path / "nope") == -1
+
+    def test_all_incomplete_fails_loudly(self, tmp_path):
+        from apex_tpu.io import (AllCheckpointsTornError,
+                                 latest_distributed_step)
+
+        self._publish(tmp_path, 4, shards=0)   # no shards yet
+        sd = self._publish(tmp_path, 8, shards=1)
+        (sd / "index.json").write_text("{garbage")  # unparseable index
+        with pytest.raises(AllCheckpointsTornError,
+                           match="none is fully published"):
+            latest_distributed_step(tmp_path)
 
 
 class TestPrefetch:

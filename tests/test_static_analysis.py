@@ -30,6 +30,7 @@ from apex_tpu.analysis.rules_collectives import (
     CollectiveOutsideSpmdContext,
     UnknownCollectiveAxis,
 )
+from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_precision import (
     Fp32ConstantInBf16Path,
     UnclampedTakeAlongAxis,
@@ -205,6 +206,211 @@ class TestProcessGlobalEnvMutation:
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
             os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
             """, tmp_path, [ProcessGlobalEnvMutation()])
+        assert got == []
+
+
+# --------------------------------------------- APX103 donated-buffer reuse
+class TestDonatedBufferReuse:
+    def test_positive_read_after_donate_new_name(self, tmp_path):
+        """The classic shape: the step's result is bound to NEW names
+        while the stale donated name is read for logging afterwards —
+        a no-op on CPU, garbage on TPU (ROADMAP donation/aliasing
+        open item)."""
+        got = run("""
+            import jax
+
+            def make(step_fn):
+                return jax.jit(step_fn, donate_argnums=(0, 1))
+
+            step = jax.jit(lambda p, s: (p, s), donate_argnums=(0, 1))
+
+            def train(params, state, norm_of):
+                new_params, new_state = step(params, state)
+                norm = norm_of(params)
+                return new_params, new_state, norm
+            """, tmp_path, [DonatedBufferReuse()])
+        assert rule_ids(got) == ["APX103"]
+        assert "`params` is donated" in got[0].message
+        assert "rebound" in got[0].message
+
+    def test_positive_partial_decorator_spelling(self, tmp_path):
+        """@partial(jax.jit, donate_argnums=...) defs are tracked by
+        their function name (the bench.py step idiom)."""
+        got = run("""
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(params, grads):
+                return params
+
+            def train(params, grads, save):
+                out = step(params, grads)
+                save(params)
+                return out
+            """, tmp_path, [DonatedBufferReuse()])
+        assert rule_ids(got) == ["APX103"]
+
+    def test_negative_early_return_branches(self, tmp_path):
+        """A donating call that is itself a `return` value: nothing
+        later in the function can run after it in the same invocation,
+        so a read on the sibling branch (the early-return shape) is
+        provably safe and must stay silent."""
+        got = run("""
+            import jax
+
+            step = jax.jit(lambda p, s: (p, s), donate_argnums=(0,))
+
+            def train(params, state, cond, norm_of):
+                if cond:
+                    return step(params, state)
+                return norm_of(params)
+            """, tmp_path, [DonatedBufferReuse()])
+        assert got == []
+
+    def test_negative_sibling_branch_read(self, tmp_path):
+        """Assign-in-branch sibling of the early-return shape: the
+        else-arm read can never execute after the if-arm's donating
+        call in one invocation — silent."""
+        got = run("""
+            import jax
+
+            step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+            def train(params, cond, f):
+                if cond:
+                    out = step(params)
+                else:
+                    out = f(params)
+                return out
+            """, tmp_path, [DonatedBufferReuse()])
+        assert got == []
+
+    def test_positive_sibling_branch_inside_loop(self, tmp_path):
+        """The same two arms under a loop ARE a bug: iteration 1 may
+        donate, iteration 2 read the stale name."""
+        got = run("""
+            import jax
+
+            step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+            def train(params, iters, f):
+                for i in range(iters):
+                    if i % 2 == 0:
+                        out = step(params)
+                    else:
+                        out = f(params)
+                return out
+            """, tmp_path, [DonatedBufferReuse()])
+        assert rule_ids(got) == ["APX103"]
+
+    def test_positive_read_after_exclusive_branch(self, tmp_path):
+        """A read BELOW the if/else is reachable after the donating arm
+        ran — the exclusive-branch skip must not silence it."""
+        got = run("""
+            import jax
+
+            step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+            def train(params, cond, f, g):
+                if cond:
+                    out = step(params)
+                else:
+                    out = f(params)
+                return g(params)
+            """, tmp_path, [DonatedBufferReuse()])
+        assert rule_ids(got) == ["APX103"]
+
+    def test_negative_rebound_from_the_call(self, tmp_path):
+        """`params, state, loss = step(params, state)` — the safe
+        idiom every bench section uses — must stay silent, including
+        inside loops (the rebind covers the next iteration's read)."""
+        got = run("""
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def step(params, state):
+                return params, state, 0.0
+
+            def train(params, state, iters):
+                params, state, loss = step(params, state)
+                for _ in range(iters):
+                    params, state, loss = step(params, state)
+                return params, loss
+            """, tmp_path, [DonatedBufferReuse()])
+        assert got == []
+
+    def test_negative_read_before_and_rebind_after(self, tmp_path):
+        got = run("""
+            import jax
+
+            step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+            def train(params, norm_of):
+                norm = norm_of(params)      # read BEFORE donation: fine
+                out = step(params)
+                params = out                # rebound before any read
+                return params, norm
+            """, tmp_path, [DonatedBufferReuse()])
+        assert got == []
+
+    def test_negative_same_name_in_nested_scope(self, tmp_path):
+        """A same-named parameter or local of a NESTED scope after the
+        donating call is a different variable, not the donated buffer —
+        the read search stops at function/class/lambda boundaries (this
+        exact shape was a reproduced false positive)."""
+        got = run("""
+            import jax
+
+            step = jax.jit(lambda p: p, donate_argnums=(0,))
+            params = {"w": 1.0}
+            out = step(params)
+
+            def helper(params):
+                return params["w"] * 2
+
+            scale = lambda params: params["w"] + 1
+            """, tmp_path, [DonatedBufferReuse()])
+        assert got == []
+
+    def test_negative_nested_scope_inside_function(self, tmp_path):
+        """Same boundary one level down: a helper def nested in the
+        donating function reuses the name for its own parameter."""
+        got = run("""
+            import jax
+
+            step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+            def train(params, sink):
+                out = step(params)
+
+                def norm_of(params):
+                    return params["w"]
+
+                sink(norm_of(out))
+                return out
+            """, tmp_path, [DonatedBufferReuse()])
+        assert got == []
+
+    def test_negative_computed_argnums_and_star_args(self, tmp_path):
+        """Non-literal donate_argnums and *args call sites are trusted
+        (the models/gpt.py `donate_argnums=donate` shape)."""
+        got = run("""
+            import jax
+
+            def make(fn, donate_state):
+                donate = (0, 1) if donate_state else ()
+                return jax.jit(fn, donate_argnums=donate)
+
+            step = jax.jit(lambda p, s: (p, s), donate_argnums=(0, 1))
+
+            def train(step_args, params):
+                out = step(*step_args)
+                return out, params
+            """, tmp_path, [DonatedBufferReuse()])
         assert got == []
 
 
