@@ -40,7 +40,8 @@ from apex_tpu.analysis.rules_precision import (
     Fp32ConstantInBf16Path, UnclampedTakeAlongAxis,
 )
 from apex_tpu.analysis.rules_tiling import (
-    BlockShapeTilingViolation, HardCodedSublaneAlignment,
+    BlockShapeTilingViolation, BlockSpecIndexMapArity,
+    HardCodedSublaneAlignment,
 )
 from apex_tpu.analysis.rules_trace import (
     ProcessGlobalEnvMutation, TraceTimeHostStateRead,
@@ -55,6 +56,7 @@ DEFAULT_RULES = (
     UnknownCollectiveAxis(),
     CollectiveOutsideSpmdContext(),
     BlockShapeTilingViolation(),
+    BlockSpecIndexMapArity(),
     HardCodedSublaneAlignment(),
     UnclampedTakeAlongAxis(),
     Fp32ConstantInBf16Path(),

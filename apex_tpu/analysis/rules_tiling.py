@@ -22,6 +22,159 @@ _BF16_MARKERS = ("bfloat16", "bf16")
 _BLOCK_HELPER_MARKERS = ("block", "ceil", "tile")
 
 
+def _arity(fn) -> Optional[int]:
+    """Positional arity of a lambda or function def (both expose the
+    same ``.args``), or None for dynamic spellings (*args / **kw /
+    keyword-only) this rule cannot judge."""
+    a = fn.args
+    if a.vararg or a.kwarg or a.kwonlyargs:
+        return None
+    return len(getattr(a, "posonlyargs", ())) + len(a.args)
+
+
+class BlockSpecIndexMapArity(Rule):
+    """APX105: ``pl.BlockSpec`` index_map arity != the ``grid`` rank of
+    the ``pallas_call`` that consumes it.
+
+    The grid has one index per dimension and Pallas calls the index_map
+    with exactly that many program ids; an arity mismatch is a
+    ``TypeError`` at trace time — but only on the code path that
+    actually traces the kernel, which for TPU-gated kernels is the
+    chip, not the CPU test suite.  Worse, a *smaller* refactor hazard:
+    the grid grows a dimension (e.g. a new batch axis) and every
+    lambda that wasn't updated fails one by one on scarce chip time.
+    The rule resolves BlockSpecs and grids through simple local
+    aliases (``spec = pl.BlockSpec(...)``, ``grid = (a, b)``), the
+    idiom the repo's own kernels use.
+    """
+
+    rule_id = "APX105"
+    severity = "error"
+    fix_hint = ("give every index_map exactly one parameter per grid "
+                "dimension (grid rank N ⇒ ``lambda i0, ..., iN-1``), "
+                "including dimensions the block ignores")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for scope in self._scopes(ctx.tree):
+            aliases = self._local_aliases(scope)
+            for node in self._walk_scope(scope):
+                if not (isinstance(node, ast.Call)
+                        and last_name(node.func) == "pallas_call"):
+                    continue
+                rank = self._grid_rank(node, aliases)
+                if rank is None:
+                    continue
+                for spec in self._blockspecs(node, aliases):
+                    arity = self._index_map_arity(spec, scope, aliases)
+                    if arity is not None and arity != rank:
+                        yield self.finding(
+                            ctx, spec,
+                            f"BlockSpec index_map takes {arity} "
+                            f"argument(s) but the pallas_call grid has "
+                            f"rank {rank}: Pallas passes one program "
+                            f"id per grid dimension, so this traces "
+                            f"only to a TypeError — typically on the "
+                            f"chip, after the CPU suite passed")
+
+    @staticmethod
+    def _scopes(tree):
+        """Each function body is one alias scope; the module is too."""
+        yield tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    @staticmethod
+    def _walk_scope(scope):
+        """Walk one scope WITHOUT descending into nested function
+        bodies (each pallas_call is judged exactly once, in its
+        innermost scope, against that scope's aliases).  Nested def
+        nodes themselves are yielded so name-valued index_maps
+        resolve."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    @classmethod
+    def _local_aliases(cls, scope):
+        """name -> value node for simple single-target assignments in
+        this scope — lexically LAST wins (a linter approximation; the
+        baseline absorbs deliberate shadowing).  ``_walk_scope`` visits
+        siblings in reverse, so order by source position explicitly
+        rather than by visit order."""
+        assigns = [
+            node for node in cls._walk_scope(scope)
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name))
+        ]
+        out = {}
+        for node in sorted(assigns,
+                           key=lambda n: (n.lineno, n.col_offset)):
+            out[node.targets[0].id] = node.value
+        return out
+
+    @staticmethod
+    def _grid_rank(call: ast.Call, aliases) -> Optional[int]:
+        grid = None
+        for kw in call.keywords:
+            if kw.arg == "grid":
+                grid = kw.value
+        if isinstance(grid, ast.Name):
+            grid = aliases.get(grid.id)
+        if isinstance(grid, (ast.Tuple, ast.List)):
+            return len(grid.elts)
+        if isinstance(grid, ast.Constant) and isinstance(grid.value, int):
+            return 1
+        return None  # absent or dynamic: nothing to judge
+
+    @staticmethod
+    def _blockspecs(call: ast.Call, aliases):
+        """Every BlockSpec call reachable from in_specs/out_specs —
+        direct, or through one local-name hop."""
+        def resolve(node):
+            if isinstance(node, ast.Name):
+                node = aliases.get(node.id)
+            if (isinstance(node, ast.Call)
+                    and last_name(node.func) == "BlockSpec"):
+                yield node
+            elif isinstance(node, (ast.Tuple, ast.List)):
+                for el in node.elts:
+                    yield from resolve(el)
+
+        for kw in call.keywords:
+            if kw.arg in ("in_specs", "out_specs"):
+                yield from resolve(kw.value)
+
+    @classmethod
+    def _index_map_arity(cls, spec: ast.Call, scope, aliases
+                         ) -> Optional[int]:
+        im = None
+        for kw in spec.keywords:
+            if kw.arg == "index_map":
+                im = kw.value
+        if im is None and len(spec.args) >= 2:
+            im = spec.args[1]
+        if im is None:
+            return None  # default index_map: always rank-correct
+        if isinstance(im, ast.Name):
+            aliased = aliases.get(im.id)
+            if isinstance(aliased, ast.Lambda):
+                im = aliased
+            else:
+                for node in cls._walk_scope(scope):
+                    if (isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and node.name == im.id):
+                        return _arity(node)
+        if isinstance(im, ast.Lambda):
+            return _arity(im)
+        return None  # partials / attribute refs: out of static reach
+
+
 def _literal_shape(call: ast.Call) -> Optional[List[object]]:
     """The BlockSpec block-shape argument as a list (ints where
     literal, None where dynamic), or None when absent/not a tuple."""

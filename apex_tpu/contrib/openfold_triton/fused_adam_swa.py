@@ -29,6 +29,11 @@ class FusedAdamSWA(FusedAdam):
     (reference swa_decay semantics).
     """
 
+    #: the fused amp tail (update_scaled) would apply the inherited
+    #: Adam step but never this class's SWA blend / n_averaged count —
+    #: train-step builders must use the explicit ``update`` path
+    supports_update_scaled = False
+
     def __init__(self, *args, swa_decay_rate: Optional[float] = None, **kw):
         super().__init__(*args, **kw)
         self.swa_decay_rate = swa_decay_rate
@@ -36,7 +41,11 @@ class FusedAdamSWA(FusedAdam):
     def init(self, params) -> AdamSWAState:
         return AdamSWAState(
             adam=super().init(params),
-            swa_params=jax.tree.map(lambda p: p.astype(jnp.float32), params),
+            # copy=True: astype on fp32 leaves returns the SAME buffer,
+            # and an swa copy aliasing its param crashes donated steps
+            # with "donate the same buffer twice" (cf. base.make_master)
+            swa_params=jax.tree.map(
+                lambda p: jnp.array(p, jnp.float32, copy=True), params),
             n_averaged=jnp.int32(0),
         )
 

@@ -43,8 +43,11 @@ class FP16_Optimizer:
         self.optimizer.master_weights = True
         inner = self.optimizer.init(params)
         if inner.master is None:
+            # copy=True: astype aliases already-fp32 leaves, and a
+            # master aliasing its param double-donates (base.make_master)
             inner = inner._replace(
-                master=jax.tree.map(lambda p: p.astype(jnp.float32), params)
+                master=jax.tree.map(
+                    lambda p: jnp.array(p, jnp.float32, copy=True), params)
             )
         return FP16OptimizerState(inner=inner, scaler=self.loss_scaler.init())
 

@@ -480,13 +480,85 @@ def sp_grad_sync(grads, axis_name: str):
     return {**grads, "layers": layers}
 
 
+def clip_sumsq_reduce(specs):
+    """The cross-rank Σx² agreement for a global-l2 grad clip inside a
+    shard_map step.
+
+    A leaf whose PartitionSpec names mesh axes holds only its LOCAL
+    shard of the grads, so the true global norm needs its Σx² psummed
+    over exactly those axes — while replicated leaves (every rank holds
+    the full grad) must NOT be psummed, or each mesh axis would
+    multiply their contribution by its size.  Group the leaves by the
+    axis set their spec names, sum each group locally, psum the
+    sharded groups over their axes, add.  (Megatron's
+    ``clip_grad_norm_`` does the same split via the
+    ``tensor_model_parallel`` param attribute; here the PartitionSpecs
+    already carry the fact.)  Returns ``reduce(per_leaf_sumsq) ->
+    total_sumsq`` for the optimizer's ``sumsq_reduce=`` hook."""
+    from jax.sharding import PartitionSpec
+
+    spec_leaves = jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def axes_of(p):
+        axes = []
+        for e in tuple(p):
+            if isinstance(e, (tuple, list)):
+                axes.extend(a for a in e if a)
+            elif e is not None:
+                axes.append(e)
+        return frozenset(axes)
+
+    groups: Dict[frozenset, list] = {}
+    for i, sp in enumerate(spec_leaves):
+        groups.setdefault(axes_of(sp), []).append(i)
+
+    def reduce(sq):
+        if len(sq) != len(spec_leaves):
+            raise ValueError(
+                f"clip_sumsq_reduce built for {len(spec_leaves)} param "
+                f"leaves got {len(sq)} sumsq values — param tree and "
+                f"spec tree diverged")
+        total = jnp.float32(0.0)
+        for axes in sorted(groups, key=lambda a: sorted(a)):
+            part = jnp.stack([sq[i] for i in groups[axes]]).sum()
+            if axes:
+                part = jax.lax.psum(part, tuple(sorted(axes)))
+            total = total + part
+        return total
+
+    return reduce
+
+
+def _clip_reduce_for(optimizer, clip_grad_norm, specs):
+    """Shared clip wiring for both step builders: validate the
+    optimizer can fold the clip into its fused grad pass, and build
+    the spec-driven cross-rank sumsq agreement.  Returns None when no
+    clipping is requested."""
+    if clip_grad_norm is None:
+        return None
+    if not getattr(optimizer, "supports_update_scaled", False):
+        raise ValueError(
+            "clip_grad_norm needs an engine optimizer (OptimizerBase "
+            "subclass) — the clip folds into its fused grad pass")
+    return clip_sumsq_reduce(specs)
+
+
 def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
                          opt_state, params, sync_axes,
-                         step_guard=None, guard_state=None):
+                         step_guard=None, guard_state=None,
+                         clip_grad_norm=None, clip_sumsq=None):
     """The shared unscale → found_inf vote → predicated step → scale
     update tail of both scaled train steps (reference §3.2 ctx-exit:
     ``apex/amp/handle.py:119-158`` + the model-parallel found_inf
     agreement of ``apex/transformer/amp/grad_scaler.py:49,102``).
+
+    With an engine optimizer (:class:`apex_tpu.optimizers.base
+    .OptimizerBase`) the whole tail is ONE fused pass over the grad
+    buckets — unscale, optional global-l2 clip, and the finite vote
+    fold into the optimizer's own grad read (``update_scaled``) instead
+    of three separate tree sweeps; other optimizers (ZeRO) keep the
+    explicit sweep composition.
 
     With a ``step_guard`` (:class:`apex_tpu.resilience.StepGuard`) the
     same agreed predicate also feeds the guard's device-side bad-step
@@ -494,11 +566,18 @@ def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
     the optimizer skip, the scaler hysteresis, and the abort budget."""
     from apex_tpu.transformer.amp.grad_scaler import sync_found_inf
 
-    grads, finite = loss_scaler.unscale(scaler_state, grads)
-    finite = sync_found_inf(finite, sync_axes)
-    new_params, new_state = optimizer.update(
-        grads, opt_state, params, grads_finite=finite
-    )
+    if getattr(optimizer, "supports_update_scaled", False):
+        new_params, new_state, finite = optimizer.update_scaled(
+            grads, opt_state, params, scale=scaler_state.loss_scale,
+            clip_norm=clip_grad_norm, sumsq_reduce=clip_sumsq,
+            finite_sync=lambda f: sync_found_inf(f, sync_axes),
+        )
+    else:
+        grads, finite = loss_scaler.unscale(scaler_state, grads)
+        finite = sync_found_inf(finite, sync_axes)
+        new_params, new_state = optimizer.update(
+            grads, opt_state, params, grads_finite=finite
+        )
     new_scaler_state = loss_scaler.update(scaler_state, finite)
     if step_guard is None:
         return new_params, new_state, new_scaler_state
@@ -507,18 +586,28 @@ def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
 
 
 def _apply_guarded_update(grads, optimizer, opt_state, params, sync_axes,
-                          step_guard, guard_state):
+                          step_guard, guard_state, clip_grad_norm=None,
+                          clip_sumsq=None):
     """Unscaled step-guard tail: the amp ``all_finite`` predicate alone
     (no loss scaler) gates the optimizer commit and feeds the guard —
     fp32/bf16 runs get the same survive-a-NaN-step semantics the fp16
-    path has always had."""
+    path has always had.  Engine optimizers fold the vote (and the
+    optional clip) into the update's grad read (``scale=None`` skips
+    the unscale)."""
     from apex_tpu.amp.scaler import all_finite
     from apex_tpu.transformer.amp.grad_scaler import sync_found_inf
 
-    finite = sync_found_inf(all_finite(grads), sync_axes)
-    new_params, new_state = optimizer.update(
-        grads, opt_state, params, grads_finite=finite
-    )
+    if getattr(optimizer, "supports_update_scaled", False):
+        new_params, new_state, finite = optimizer.update_scaled(
+            grads, opt_state, params, clip_norm=clip_grad_norm,
+            sumsq_reduce=clip_sumsq,
+            finite_sync=lambda f: sync_found_inf(f, sync_axes),
+        )
+    else:
+        finite = sync_found_inf(all_finite(grads), sync_axes)
+        new_params, new_state = optimizer.update(
+            grads, opt_state, params, grads_finite=finite
+        )
     return new_params, new_state, step_guard.update(guard_state, finite)
 
 
@@ -552,8 +641,16 @@ def make_train_step(
     donate_state: bool = False,
     step_guard=None,
     chaos=None,
+    clip_grad_norm=None,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
+
+    ``clip_grad_norm``: global-l2 gradient clipping (torch
+    ``clip_grad_norm_`` semantics) folded into the optimizer's fused
+    grad pass — with an engine optimizer the unscale, the clip norm,
+    the finite vote, and the update math share one read of the grads
+    instead of four sweeps.  Requires an
+    :class:`apex_tpu.optimizers.base.OptimizerBase` optimizer.
 
     ``opt_state_spec``: PartitionSpec tree for the optimizer state; by
     default the FusedAdam state shape is assumed (m/v mirror the param
@@ -661,6 +758,9 @@ def make_train_step(
     if chaos is not None and step_guard is None:
         raise ValueError("chaos NaN injection needs step_guard (the "
                          "injection step counter lives in GuardState)")
+    # the clip's global norm must agree across ranks: sharded leaves'
+    # Σx² psum over exactly their spec axes, replicated leaves don't
+    clip_reduce = _clip_reduce_for(optimizer, clip_grad_norm, specs)
 
     # tp-sharded grad shards can overflow on one rank only; with
     # ZeRO (local dp grads) or MoE (dp-sharded expert grads) the dp
@@ -675,7 +775,12 @@ def make_train_step(
             params, tokens, targets, config, tp_axis, cp_axis, ep_axis
         )
         loss, grads = sync_loss_and_grads(loss, grads)
-        new_params, new_state = optimizer.update(grads, opt_state, params)
+        if clip_grad_norm is not None:
+            new_params, new_state = optimizer.update(
+                grads, opt_state, params, clip_norm=clip_grad_norm,
+                sumsq_reduce=clip_reduce)
+        else:
+            new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
 
     def guarded_local_step(params, opt_state, guard_state, tokens, targets):
@@ -689,7 +794,8 @@ def make_train_step(
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state, new_guard = _apply_guarded_update(
             grads, optimizer, opt_state, params, sync_axes,
-            step_guard, guard_state,
+            step_guard, guard_state, clip_grad_norm=clip_grad_norm,
+            clip_sumsq=clip_reduce,
         )
         return new_params, new_state, new_guard, loss
 
@@ -703,7 +809,8 @@ def make_train_step(
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state, new_scaler_state = _apply_scaled_update(
             loss_scaler, scaler_state, grads, optimizer, opt_state, params,
-            sync_axes,
+            sync_axes, clip_grad_norm=clip_grad_norm,
+            clip_sumsq=clip_reduce,
         )
         return new_params, new_state, new_scaler_state, loss
 
@@ -725,6 +832,7 @@ def make_train_step(
                 loss_scaler, scaler_state, grads, optimizer, opt_state,
                 params, sync_axes,
                 step_guard=step_guard, guard_state=guard_state,
+                clip_grad_norm=clip_grad_norm, clip_sumsq=clip_reduce,
             )
         return new_params, new_state, new_scaler_state, new_guard, loss
 
@@ -814,8 +922,12 @@ def make_pp_train_step(
     donate_state: bool = False,
     step_guard=None,
     chaos=None,
+    clip_grad_norm=None,
 ):
     """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
+
+    ``clip_grad_norm``: global-l2 grad clip folded into the engine
+    optimizer's fused grad pass (see :func:`make_train_step`).
 
     ``opt_state_spec`` overrides the optimizer-state PartitionSpec tree
     (default: FusedAdam state shape; ZeRO optimizers supply their own).
@@ -897,6 +1009,11 @@ def make_pp_train_step(
     specs["layers"] = jax.tree.map(
         pp_spec, base["layers"], is_leaf=lambda s: isinstance(s, P)
     )
+    # stage-stacked leaves are pp-sharded (their spec leads with pp), so
+    # the clip's global norm psums their Σx² over pp (+tp for sharded
+    # weights); replicated embeds/norms stay local — the reduce reads
+    # all of that off the specs
+    clip_reduce = _clip_reduce_for(optimizer, clip_grad_norm, specs)
 
     def pre_fn(shared, mb):
         tokens = mb["tokens"]
@@ -1020,7 +1137,12 @@ def make_pp_train_step(
     def local_step(params, opt_state, tokens, targets):
         loss, grads = run_schedule(params, tokens, targets, stage_fn, post_fn)
         loss, grads = sync_loss_and_grads(loss, grads)
-        new_params, new_state = optimizer.update(grads, opt_state, params)
+        if clip_grad_norm is not None:
+            new_params, new_state = optimizer.update(
+                grads, opt_state, params, clip_norm=clip_grad_norm,
+                sumsq_reduce=clip_reduce)
+        else:
+            new_params, new_state = optimizer.update(grads, opt_state, params)
         return new_params, new_state, loss
 
     def guarded_local_step(params, opt_state, guard_state, tokens, targets):
@@ -1033,7 +1155,8 @@ def make_pp_train_step(
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state, new_guard = _apply_guarded_update(
             grads, optimizer, opt_state, params, guard_sync_axes,
-            step_guard, guard_state,
+            step_guard, guard_state, clip_grad_norm=clip_grad_norm,
+            clip_sumsq=clip_reduce,
         )
         return new_params, new_state, new_guard, loss
 
@@ -1047,7 +1170,8 @@ def make_pp_train_step(
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state, new_scaler_state = _apply_scaled_update(
             loss_scaler, scaler_state, grads, optimizer, opt_state, params,
-            guard_sync_axes,
+            guard_sync_axes, clip_grad_norm=clip_grad_norm,
+            clip_sumsq=clip_reduce,
         )
         return new_params, new_state, new_scaler_state, loss
 
@@ -1067,6 +1191,7 @@ def make_pp_train_step(
                 loss_scaler, scaler_state, grads, optimizer, opt_state,
                 params, guard_sync_axes,
                 step_guard=step_guard, guard_state=guard_state,
+                clip_grad_norm=clip_grad_norm, clip_sumsq=clip_reduce,
             )
         return new_params, new_state, new_scaler_state, new_guard, loss
 

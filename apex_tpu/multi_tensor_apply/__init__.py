@@ -2,9 +2,18 @@
 
 Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py:3-30``.  The
 chunked dispatch machinery is unnecessary under XLA; ``multi_tensor_applier``
-here simply calls the op with the tensor lists.  Kept so reference users
-find the familiar entry point.
+here calls the op with the tensor lists.  Kept so reference users find
+the familiar entry point.
+
+The real multi-tensor engine — the TPU analogue of the reference's
+chunked kernels — is the bucket plan in
+:mod:`apex_tpu.optimizers.bucketing`: the ops below all accept a
+:class:`~apex_tpu.optimizers.bucketing.Buckets` wherever a pytree is
+accepted, so one flat dtype bucket plays the role of the reference's
+≤110-pointer chunk table.
 """
+
+import jax.numpy as jnp
 
 from apex_tpu.ops.multi_tensor import (
     multi_tensor_axpby,
@@ -17,8 +26,20 @@ from apex_tpu.ops.multi_tensor import (
 class MultiTensorApply:
     """Callable matching ``multi_tensor_applier(op, noop_flag, lists, *args)``.
 
-    ``noop_flag`` is ignored on input (XLA is functional); the op's returned
-    ``found_inf`` plays its role.
+    Reference semantics (``multi_tensor_apply.cuh``): ``noop_flag`` is a
+    device int buffer — the kernels early-exit when it is already set
+    (``if (*noop_gmem) return;``) and WRITE 1 into it when they see a
+    non-finite value, so the flag accumulates across chained op calls.
+
+    The functional form here: ``__call__`` returns ``(out, noop_flag_out)``
+    where ``noop_flag_out`` is an int32 0/1 scalar that ORs the incoming
+    flag with the op's own found-inf vote — the accumulate-across-calls
+    behavior, as a value instead of a mutated buffer.  Pass
+    ``noop_flag=None`` (or 0) on the first call and thread the returned
+    flag into the next; predicate the final commit on it with
+    :func:`apex_tpu.ops.multi_tensor.tree_where` (the XLA form of the
+    kernels' early-exit).  Ops that do not produce a found-inf vote
+    (``multi_tensor_l2norm``) pass the incoming flag through unchanged.
     """
 
     available = True
@@ -28,7 +49,19 @@ class MultiTensorApply:
         self.chunk_size = chunk_size
 
     def __call__(self, op, noop_flag, tensor_lists, *args):
-        return op(*tensor_lists, *args)
+        out = op(*tensor_lists, *args)
+        flag = jnp.int32(0) if noop_flag is None else (
+            jnp.asarray(noop_flag).astype(jnp.int32))
+        # (result, found_inf) ops: fold the vote into the flag.  The
+        # vote is a 0-d bool — a tuple whose second element is anything
+        # else (l2norm's per-tensor norm list) is not a vote.
+        if (isinstance(out, tuple) and len(out) == 2
+                and getattr(out[1], "dtype", None) == jnp.bool_
+                and getattr(out[1], "ndim", None) == 0):
+            result, found = out
+            flag = flag | found.astype(jnp.int32)
+            return result, flag
+        return out, flag
 
 
 multi_tensor_applier = MultiTensorApply(2048 * 32)

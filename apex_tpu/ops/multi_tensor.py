@@ -13,6 +13,18 @@ region*.  The ``noop_flag`` output buffer becomes a returned boolean
 
 These functions are the building blocks for :mod:`apex_tpu.optimizers`
 and :mod:`apex_tpu.amp`.
+
+Bucket views: every op here also accepts a
+:class:`apex_tpu.optimizers.bucketing.Buckets` (the multi-tensor
+engine's flat dtype-bucket form) anywhere a pytree is accepted —
+``Buckets`` is a registered pytree whose leaves are the 1-D bucket
+buffers, so the elementwise ops (``scale``/``axpby``) map over the
+buffers directly and return ``Buckets`` of the same plan, and the
+reductions (``l2norm`` per-tensor, ``norm_blend``) slice the buffers
+back into per-leaf views via the plan so their results match the tree
+form leaf for leaf.  Padding is zero-filled by ``bucketing.pack``, so
+the finite votes and L2 sums over a bucket equal the votes/sums over
+its leaves.
 """
 
 from typing import Any, Sequence, Tuple
@@ -23,8 +35,21 @@ import jax.numpy as jnp
 Tree = Any
 
 
+def _bucket_view(tree):
+    """``(plan, arrays)`` when ``tree`` is a Buckets, else ``None`` —
+    lazy import so ``ops`` does not import ``optimizers`` at package
+    init (bucketing imports ``ops._pallas_tiling``)."""
+    from apex_tpu.optimizers.bucketing import Buckets
+
+    if isinstance(tree, Buckets):
+        return tree.plan, tree.arrays
+    return None
+
+
 def tree_not_finite(tree: Tree) -> jnp.ndarray:
-    """True if ANY element anywhere in the tree is inf/nan (noop_flag=1)."""
+    """True if ANY element anywhere in the tree is inf/nan (noop_flag=1).
+    On a ``Buckets`` the vote is over the bucket buffers — pad regions
+    are zero-filled, so the vote equals the per-leaf vote."""
     leaves = jax.tree.leaves(tree)
     if not leaves:
         return jnp.bool_(False)
@@ -68,12 +93,25 @@ def multi_tensor_l2norm(tree: Tree, per_tensor: bool = False):
     Reference: ``csrc/multi_tensor_l2norm_kernel.cu`` — used by FusedLAMB,
     clip_grad, and DistributedFusedAdam/LAMB.  Math in fp32.
     Returns ``global_norm`` or ``(global_norm, [per_leaf_norms])``.
+
+    On a ``Buckets`` the per-tensor norms are per ORIGINAL LEAF (the
+    plan's offset table slices each leaf back out of its bucket), not
+    per bucket buffer — same list, same order, as the tree form.
     """
-    leaves = jax.tree.leaves(tree)
-    if not leaves:
+    bv = _bucket_view(tree)
+    if bv is not None:
+        from apex_tpu.optimizers.bucketing import per_leaf_reduce
+
+        plan, arrays = bv
+        sq = per_leaf_reduce(
+            plan, [a.astype(jnp.float32) for a in arrays],
+            lambda x: jnp.sum(jnp.square(x)))
+    else:
+        sq = [jnp.sum(jnp.square(x.astype(jnp.float32)))
+              for x in jax.tree.leaves(tree)]
+    if not sq:
         z = jnp.float32(0)
         return (z, []) if per_tensor else z
-    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
     total = jnp.sqrt(jnp.stack(sq).sum())
     if per_tensor:
         return total, [jnp.sqrt(s) for s in sq]
@@ -86,8 +124,17 @@ def multi_tensor_norm_blend(old_norms: Sequence[jnp.ndarray], tree: Tree, a: flo
     Reference: ``multi_tensor_norm_out_cuda`` in
     ``csrc/multi_tensor_novograd.cu:160-164``:
     L2:   ``gn = sqrt(a*gn^2 + b*n^2)``;  L-inf: ``gn = a*gn + b*n``.
+    ``old_norms`` is per ORIGINAL LEAF; on a ``Buckets`` the fresh
+    norms are taken over the plan's per-leaf slices to match.
     """
-    leaves = jax.tree.leaves(tree)
+    bv = _bucket_view(tree)
+    if bv is not None:
+        from apex_tpu.optimizers.bucketing import per_leaf_reduce
+
+        plan, arrays = bv
+        leaves = per_leaf_reduce(plan, arrays, lambda x: x)
+    else:
+        leaves = jax.tree.leaves(tree)
     out = []
     for gn, x in zip(old_norms, leaves):
         x32 = x.astype(jnp.float32)

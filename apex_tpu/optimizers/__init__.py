@@ -3,8 +3,16 @@
 Each optimizer is a pure pytree transform with exact reference numerics
 (fp32 math regardless of storage dtype), device-side predicated updates
 (the capturable/noop_flag design), and optional fp32 master weights.
+
+All five run on the bucketed **multi-tensor engine** by default (the
+TPU form of ``multi_tensor_apply``): params flatten into a few
+dtype-homogeneous 1-D buckets and each step is one fused elementwise
+pass per bucket, with loss-scale unscale, global-norm grad clip, and
+the all-finite vote folded into the same pass via ``update_scaled``.
+See :mod:`apex_tpu.optimizers.bucketing` and ``docs/optimizers.md``.
 """
 
+from apex_tpu.optimizers.bucketing import BucketPlan, Buckets, plan_of
 from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam
 from apex_tpu.optimizers.fused_adagrad import AdagradState, FusedAdagrad
 from apex_tpu.optimizers.fused_lamb import FusedLAMB, LambState
@@ -24,4 +32,7 @@ __all__ = [
     "FusedAdagrad",
     "AdagradState",
     "FusedMixedPrecisionLamb",
+    "BucketPlan",
+    "Buckets",
+    "plan_of",
 ]

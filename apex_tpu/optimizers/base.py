@@ -14,12 +14,28 @@ Master weights: when params are stored in half precision and
 math reads/writes the master and the returned params are the master cast
 back to storage dtype (reference: ``AdamCapturableMasterFunctor``,
 ``multi_tensor_adam.cu:243``; ``fp16_utils/fp16_optimizer.py``).
+
+Multi-tensor engine: each optimizer's ``update`` dispatches through
+:meth:`OptimizerBase._dispatch` — by default onto the **bucketed
+engine** (``use_buckets=True``): the param pytree flattens into a few
+dtype-homogeneous 1-D buckets (:mod:`apex_tpu.optimizers.bucketing`)
+and the whole step is one fused elementwise pass per bucket, with the
+loss-scale unscale, the global-l2-norm grad clip, and the all-finite
+vote folded into the same pass (``update_scaled``) so grads are read
+once instead of once per sweep.  The per-leaf path remains as the
+numerics specification and the fallback: the engine routes through the
+``resilience.fallback`` registry, so an engine surprise degrades once
+to per-leaf instead of crashing a run.  Both paths are bit-exact in
+fp32 (same elementwise expression trees; ``tests/test_bucketed_engine``
+pins it).
 """
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.optimizers import bucketing
 
 Tree = Any
 
@@ -29,10 +45,15 @@ def is_half(x) -> bool:
 
 
 def make_master(params: Tree, master_weights: bool) -> Optional[Tree]:
-    """fp32 master copy of half params (None leaves where already fp32)."""
+    """fp32 master COPY of the params.  ``copy=True`` is load-bearing:
+    ``astype`` on an already-fp32 leaf returns the same buffer, and a
+    master that aliases its param makes ``donate_argnums`` over
+    (params, state) donate one buffer twice — an Execute()-time crash
+    (caught by ``bench.py --smoke`` on the resnet amp-O2 step)."""
     if not master_weights:
         return None
-    return jax.tree.map(lambda p: p.astype(jnp.float32), params)
+    return jax.tree.map(lambda p: jnp.array(p, jnp.float32, copy=True),
+                        params)
 
 
 def math_params(params: Tree, master: Optional[Tree]) -> Tree:
@@ -140,21 +161,278 @@ def leaf_lr(h: dict, lr):
     return lr * h.get("lr_scale", 1.0)
 
 
-class OptimizerBase:
-    """Common constructor plumbing.  Subclasses define init/update."""
+class PreparedGrads(NamedTuple):
+    """Grads after the fused prepare pass: packed into ``plan``'s f32
+    buckets with loss-scale unscale and global-norm clip folded in, plus
+    the (synced) all-finite vote — the one read of the grad tree."""
 
-    def __init__(self, lr: float, weight_decay: float = 0.0, master_weights: bool = False):
+    plan: Any
+    g: Tuple
+    finite: Optional[jnp.ndarray]
+
+
+def _bucket_all_finite(bucket_arrays) -> jnp.ndarray:
+    """All-finite vote over packed buckets (pad regions are zero-filled
+    by :func:`bucketing.pack`, so they never mask a leaf's inf/nan).
+    ONE vote implementation — the amp scaler's (a list of arrays is a
+    tree), so the engine's step predicate and the scaler's found-inf
+    decision can never diverge."""
+    from apex_tpu.amp.scaler import all_finite
+
+    return all_finite(list(bucket_arrays))
+
+
+def _clip_coef(total_norm, clip_norm):
+    """torch ``clip_grad_norm_`` semantics (contrib/clip_grad):
+    ``min(max_norm / (total_norm + 1e-6), 1.0)``."""
+    return jnp.minimum(clip_norm / (total_norm + 1e-6), jnp.float32(1.0))
+
+
+def prepare_grads_bucketed(params, grads, scale=None, clip_norm=None,
+                           finite_sync=None, want_finite=False,
+                           prescale=None, sumsq_reduce=None) -> PreparedGrads:
+    """The fused prepare pass: one read of the grad tree produces the
+    unscaled (``scale``), clipped (``clip_norm``) f32 buckets and the
+    agreed all-finite predicate — replacing the reference's three
+    separate ``multi_tensor_scale`` / ``multi_tensor_l2norm`` /
+    noop-flag sweeps (``apex/amp/scaler.py:94-119``,
+    ``contrib/clip_grad``).
+
+    ``sumsq_reduce(per_leaf_sumsq) -> total_sumsq``: overrides the
+    plain stack-and-sum for sharded steps — inside a shard_map a
+    tp/pp/ep-sharded leaf's grads are LOCAL shards, so the true global
+    norm needs a psum of those leaves' Σx² across their sharding axes
+    (:func:`apex_tpu.models.gpt.clip_sumsq_reduce` builds this from
+    the param PartitionSpecs)."""
+    plan = bucketing.plan_of(params)
+    mult = None
+    if scale is not None:
+        mult = 1.0 / scale
+    if prescale is not None:
+        mult = prescale if mult is None else mult * prescale
+    g = bucketing.pack(plan, grads, scale=mult)
+    finite = None
+    if want_finite:
+        finite = _bucket_all_finite(g)
+        if finite_sync is not None:
+            finite = finite_sync(finite)
+    if clip_norm is not None:
+        sq = bucketing.per_leaf_reduce(
+            plan, g, lambda x: jnp.sum(jnp.square(x)))
+        total_sq = (jnp.stack(sq).sum() if sumsq_reduce is None
+                    else sumsq_reduce(sq))
+        coef = _clip_coef(jnp.sqrt(total_sq), clip_norm)
+        g = [a * coef for a in g]
+    return PreparedGrads(plan=plan, g=tuple(g), finite=finite)
+
+
+class OptimizerBase:
+    """Common constructor plumbing + the engine dispatch.  Subclasses
+    implement ``init``, ``_leaf_update`` (the per-leaf numerics
+    specification), and ``_bucket_update`` (the fused engine)."""
+
+    #: state field holding the slot that is a :class:`bucketing.Buckets`
+    #: when the state is bucket-resident (subclasses override)
+    _BUCKET_SLOT: Optional[str] = None
+
+    #: True when :meth:`update_scaled` covers this optimizer's FULL
+    #: step semantics.  A subclass whose ``update`` override maintains
+    #: extra state the fused tail doesn't know about (e.g. contrib
+    #: ``FusedAdamSWA``'s SWA average) must set this False so train
+    #: steps route through its ``update`` with the explicit sweep
+    #: composition instead of bypassing the override.
+    supports_update_scaled: bool = True
+
+    def __init__(self, lr: float, weight_decay: float = 0.0,
+                 master_weights: bool = False, use_buckets: bool = True):
         self.lr = lr
         self.weight_decay = weight_decay
         self.master_weights = master_weights
+        self.use_buckets = use_buckets
 
-    # optax-style aliases so these slot into optax training loops
-    def init(self, params):  # pragma: no cover - abstract
+    # ------------------------------------------------------------ engine
+    def _state_is_bucketed(self, state) -> bool:
+        if self._BUCKET_SLOT is None:
+            return False
+        return isinstance(getattr(state, self._BUCKET_SLOT, None),
+                          bucketing.Buckets)
+
+    def _leaf_update(self, grads, state, params, grads_finite=None,
+                     lr=None, **kw):  # pragma: no cover - abstract
         raise NotImplementedError
 
-    def update(self, grads, state, params, **kw):  # pragma: no cover - abstract
+    def _bucket_update(self, prep: PreparedGrads, state, params, pred,
+                       lr=None, **kw):  # pragma: no cover - abstract
         raise NotImplementedError
+
+    def _dispatch(self, grads, state, params, grads_finite=None, lr=None,
+                  scale=None, clip_norm=None, finite_sync=None,
+                  want_finite=False, prescale=None, sumsq_reduce=None,
+                  **kw):
+        """Route one step: bucket-resident state → engine (no fallback
+        possible: the per-leaf path cannot read flat slots); tree state
+        → engine through the resilience fallback registry (an engine
+        failure degrades once to per-leaf); ``use_buckets=False`` →
+        per-leaf.  Returns ``(new_params, new_state, finite)``."""
+
+        def leaf_path():
+            g, finite = grads, grads_finite
+            if scale is not None or prescale is not None:
+                mult = 1.0 if scale is None else 1.0 / scale
+                if prescale is not None:
+                    mult = mult * prescale
+                g = jax.tree.map(
+                    lambda x: x.astype(jnp.float32) * mult, g)
+            if want_finite:
+                from apex_tpu.amp.scaler import all_finite
+
+                finite = all_finite(g)
+                if finite_sync is not None:
+                    finite = finite_sync(finite)
+            if clip_norm is not None:
+                sq = [jnp.sum(jnp.square(x.astype(jnp.float32)))
+                      for x in jax.tree.leaves(g)]
+                total_sq = (jnp.stack(sq).sum() if sumsq_reduce is None
+                            else sumsq_reduce(sq))
+                coef = _clip_coef(jnp.sqrt(total_sq), clip_norm)
+                g = jax.tree.map(
+                    lambda x: x.astype(jnp.float32) * coef, g)
+            p, s = self._leaf_update(g, state, params,
+                                     grads_finite=finite, lr=lr, **kw)
+            return p, s, finite
+
+        def bucket_path():
+            prep = prepare_grads_bucketed(
+                params, grads, scale=scale, clip_norm=clip_norm,
+                finite_sync=finite_sync, want_finite=want_finite,
+                prescale=prescale, sumsq_reduce=sumsq_reduce)
+            pred = prep.finite if want_finite else grads_finite
+            p, s = self._bucket_update(prep, state, params, pred, lr=lr,
+                                       **kw)
+            return p, s, pred
+
+        if self._state_is_bucketed(state):
+            return bucket_path()
+        if self.use_buckets and self._BUCKET_SLOT is not None:
+            from apex_tpu.resilience.fallback import (
+                get_registry,
+                registry_engaged,
+            )
+
+            if registry_engaged(forced=False):
+                return get_registry().call(
+                    "multi_tensor_engine", bucket_path, leaf_path)
+            # multi-process runs never engage the registry (fallback.py:
+            # a per-process degrade-once would lower DIVERGENT programs
+            # of one SPMD step — with the clip psums and the finite-vote
+            # collectives inside): run the engine directly, fail fast
+            return bucket_path()
+        return leaf_path()
+
+    def _init_bucket_slots(self, params, n_slots):
+        """The shared resident-state constructor: ``n_slots`` zeroed
+        f32 bucket slots for ``params``' plan, plus the packed fp32
+        master when ``master_weights`` — ONE place to change the
+        resident layout (e.g. future sharded buckets)."""
+        plan = bucketing.plan_of(params)
+        slots = [
+            bucketing.Buckets(plan, [jnp.zeros((b.total,), jnp.float32)
+                                     for b in plan.buckets])
+            for _ in range(n_slots)
+        ]
+        master = (bucketing.Buckets(plan, bucketing.pack(plan, params))
+                  if self.master_weights else None)
+        return slots, master
+
+    def _bias_corrections(self, step):
+        """Adam-family ``(1-β1^t, 1-β2^t)`` — reads the subclass's
+        ``bias_correction``/``beta1``/``beta2`` attributes (NovoGrad
+        overrides: its second correction is the sqrt form)."""
+        t = step.astype(jnp.float32)
+        if self.bias_correction:
+            return (1.0 - jnp.power(self.beta1, t),
+                    1.0 - jnp.power(self.beta2, t))
+        return jnp.float32(1.0), jnp.float32(1.0)
+
+    # --------------------------------------------------------- public API
+    def init(self, params, bucketed: bool = False):  # pragma: no cover
+        raise NotImplementedError
+
+    def update(self, grads, state, params, grads_finite=None, lr=None,
+               clip_norm=None, sumsq_reduce=None, **kw):
+        """One optimizer step (optax-style signature).  ``grads_finite``
+        predicates the whole commit device-side (the capturable
+        noop_flag design); ``clip_norm`` folds a global-l2 grad clip
+        (torch ``clip_grad_norm_`` semantics) into the grad read, with
+        ``sumsq_reduce`` supplying the cross-rank Σx² agreement inside
+        sharded steps (see :func:`prepare_grads_bucketed`)."""
+        p, s, _ = self._dispatch(grads, state, params,
+                                 grads_finite=grads_finite, lr=lr,
+                                 clip_norm=clip_norm,
+                                 sumsq_reduce=sumsq_reduce, **kw)
+        return p, s
+
+    def update_scaled(self, grads, state, params, scale=None,
+                      clip_norm=None, finite_sync=None, lr=None,
+                      sumsq_reduce=None, **kw):
+        """The fused amp step: unscale by ``1/scale``, (optionally) clip
+        to ``clip_norm`` (global l2, torch semantics), vote all-finite,
+        agree the vote via ``finite_sync`` (the model-parallel pmax),
+        and commit the update predicated on it — one pass over the
+        grads instead of the reference's four separate sweeps
+        (``apex/amp/handle.py:119-158``).  Returns
+        ``(new_params, new_state, all_finite)``; feed ``all_finite`` to
+        :meth:`apex_tpu.amp.DynamicLossScaler.update` and the step
+        guard.  ``scale=None`` skips the unscale (the bf16/fp32 guarded
+        path) but still folds the finite vote into the pass."""
+        return self._dispatch(grads, state, params, lr=lr, scale=scale,
+                              clip_norm=clip_norm, finite_sync=finite_sync,
+                              want_finite=True, sumsq_reduce=sumsq_reduce,
+                              **kw)
 
     def step(self, grads, state, params, **kw):
         """Alias matching the reference's ``optimizer.step()`` naming."""
         return self.update(grads, state, params, **kw)
+
+    # ------------------------------------------------- bucket-side helpers
+    @staticmethod
+    def _hyper_leaves(hypers):
+        """The static per-leaf override dicts in tree_flatten order."""
+        return jax.tree.leaves(
+            hypers, is_leaf=lambda x: isinstance(x, HyperLeaf))
+
+    @staticmethod
+    def _bucket_lr(bucket, hyper_leaves, lr):
+        """Per-element lr operand for one bucket: the runtime scalar
+        when no group overrides it, else a broadcast per-leaf vector
+        (absolute ``lr`` wins; ``lr_scale`` multiplies — exactly
+        :func:`leaf_lr`)."""
+        if not any(("lr" in h or "lr_scale" in h) for h in hyper_leaves):
+            return lr
+        per = [leaf_lr(h, lr) for h in hyper_leaves]
+        return bucketing.seg_broadcast(bucket, per)
+
+    @staticmethod
+    def _slot_buckets(plan, slot):
+        """A state slot as bucket arrays: pass-through when resident,
+        packed (f32) when tree-shaped."""
+        if isinstance(slot, bucketing.Buckets):
+            return slot.arrays, True
+        return tuple(bucketing.pack(plan, slot)), False
+
+    @staticmethod
+    def _emit_slot(plan, arrays, resident):
+        """A new state slot: stays flat when resident (the donated
+        buffers), unpacks to the fp32 per-leaf tree otherwise."""
+        if resident:
+            return bucketing.Buckets(plan, arrays)
+        return bucketing.unpack(plan, arrays, dtype=jnp.float32)
+
+
+def bucket_select(pred, new_arrays, old_arrays):
+    """Predicated commit on bucket buffers (the flat form of
+    :func:`select`)."""
+    if pred is None:
+        return list(new_arrays)
+    p = jnp.asarray(pred)
+    return [jnp.where(p, n, o) for n, o in zip(new_arrays, old_arrays)]

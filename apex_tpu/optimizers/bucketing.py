@@ -1,0 +1,236 @@
+"""Bucket plans: the TPU-native form of ``multi_tensor_apply``.
+
+Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py`` +
+``csrc/multi_tensor_apply.cuh``.  The reference packs ≤110 tensor
+pointers and a chunk table into kernel-launch metadata so one CUDA
+launch sweeps many tensors.  The XLA analogue is a **bucket plan**:
+at optimizer init (or at first trace) the param pytree is flattened in
+stable ``tree_flatten`` order into a few dtype-homogeneous 1-D buckets
+— per-leaf offset table, tail padded to the dtype's (sublane × 128)
+tile (``ops/_pallas_tiling``) — and every optimizer sweep becomes one
+fused elementwise pass per bucket instead of one op chain per leaf.
+The layout is also the prerequisite for cross-replica sharded weight
+updates (PAPERS: arXiv 2004.13336): an equal-size 1-D bucket is what a
+``psum_scatter`` shards cleanly.
+
+Two ways to use a plan:
+
+- **transparent** (default inside the fused optimizers): ``update``
+  packs grads/params/state into buckets per call and unpacks the
+  results — state pytrees keep their per-leaf shape, so sharding specs,
+  checkpoints, and oracle tests are unaffected.
+- **resident** (``opt.init(params, bucketed=True)``): the optimizer
+  state slots are stored as :class:`Buckets` — the flat buffers ride
+  the jit boundary directly, so ``donate_argnums`` donates the bucket
+  buffers themselves (m/v never leave bucket form between steps).
+  Requires an unsharded (single-replica or pure-dp) step: a bucket of
+  concatenated *global* leaves does not slice into per-rank buckets of
+  the leaf *shards*, so ``make_train_step``-style shard_map states stay
+  per-leaf.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops._pallas_tiling import LANES, sublane
+
+Tree = Any
+
+__all__ = [
+    "BucketLeaf", "BucketSpec", "BucketPlan", "Buckets", "plan_of",
+    "pack", "unpack", "per_leaf_reduce", "seg_values", "seg_broadcast",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLeaf:
+    """One leaf's slot inside a bucket."""
+
+    leaf_id: int          # position in tree_flatten order
+    shape: Tuple[int, ...]
+    offset: int           # element offset into the bucket
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """One dtype-homogeneous bucket: leaves back-to-back, padded tail."""
+
+    dtype: str            # canonical storage dtype name (e.g. "float32")
+    leaves: Tuple[BucketLeaf, ...]
+    size: int             # payload elements (sum of leaf sizes)
+    total: int            # padded length: size rounded up to the tile
+
+    @property
+    def pad(self) -> int:
+        return self.total - self.size
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """The static layout: which leaf lives where.  Hashable (jit-cache
+    friendly) and buildable from shapes alone — no arrays are held."""
+
+    treedef: Any
+    leaf_dtypes: Tuple[str, ...]          # storage dtype per leaf
+    buckets: Tuple[BucketSpec, ...]
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.leaf_dtypes)
+
+    def __hash__(self):
+        return hash((self.treedef, self.leaf_dtypes, self.buckets))
+
+
+def _tile(dtype_name: str) -> int:
+    """Pad-to size: the dtype's (sublane × 128) VMEM tile in elements."""
+    return sublane(jnp.dtype(dtype_name)) * LANES
+
+
+@functools.lru_cache(maxsize=64)
+def _plan_from_key(treedef, shapes_dtypes) -> BucketPlan:
+    by_dtype: dict = {}
+    order: List[str] = []  # first-appearance bucket order, deterministic
+    for i, (shape, dt) in enumerate(shapes_dtypes):
+        if dt not in by_dtype:
+            by_dtype[dt] = []
+            order.append(dt)
+        by_dtype[dt].append((i, shape))
+    buckets = []
+    for dt in order:
+        leaves, off = [], 0
+        for i, shape in by_dtype[dt]:
+            leaves.append(BucketLeaf(leaf_id=i, shape=shape, offset=off))
+            off += int(np.prod(shape)) if shape else 1
+        tile = _tile(dt)
+        total = ((off + tile - 1) // tile) * tile if off else 0
+        buckets.append(BucketSpec(dtype=dt, leaves=tuple(leaves),
+                                  size=off, total=total))
+    return BucketPlan(
+        treedef=treedef,
+        leaf_dtypes=tuple(dt for _, dt in shapes_dtypes),
+        buckets=tuple(buckets),
+    )
+
+
+def plan_of(tree: Tree) -> BucketPlan:
+    """The bucket plan for ``tree``'s (treedef, shapes, dtypes) — cached,
+    so repeated traces of the same step reuse one plan object."""
+    leaves, treedef = jax.tree.flatten(tree)
+    key = tuple((tuple(x.shape), jnp.dtype(x.dtype).name) for x in leaves)
+    return _plan_from_key(treedef, key)
+
+
+class Buckets:
+    """A tree of 1-D bucket buffers + its plan, registered as a pytree
+    (children = the buffers, aux = the plan).  ``jax.tree.map`` over a
+    ``Buckets`` maps over the buffers, so the amp scaler, ``clip_grad``,
+    and the ``multi_tensor_*`` ops all operate on bucket views with no
+    special cases."""
+
+    __slots__ = ("plan", "arrays")
+
+    def __init__(self, plan: BucketPlan, arrays: Sequence):
+        self.plan = plan
+        self.arrays = tuple(arrays)
+
+    def __repr__(self):
+        shapes = [getattr(a, "shape", ()) for a in self.arrays]
+        return f"Buckets({[b.dtype for b in self.plan.buckets]}, {shapes})"
+
+    def unpack(self, dtype=None) -> Tree:
+        """Back to the per-leaf tree (storage dtypes, or ``dtype``)."""
+        return unpack(self.plan, self.arrays, dtype=dtype)
+
+
+jax.tree_util.register_pytree_node(
+    Buckets,
+    lambda b: (b.arrays, b.plan),
+    lambda plan, arrays: Buckets(plan, arrays),
+)
+
+
+def pack(plan: BucketPlan, tree: Tree, dtype=jnp.float32,
+         scale=None) -> List[jnp.ndarray]:
+    """Flatten ``tree`` into ``plan``'s buckets, cast to the math dtype,
+    with an optional scalar multiply (the loss-scale unscale) fused into
+    the same pass.  Padding is zero-filled, so an all-finite vote over a
+    packed bucket is exactly the vote over the leaves."""
+    leaves = jax.tree.leaves(tree)
+    if len(leaves) != plan.n_leaves:
+        raise ValueError(
+            f"tree has {len(leaves)} leaves; plan expects {plan.n_leaves}")
+    out = []
+    for b in plan.buckets:
+        parts = [jnp.ravel(leaves[bl.leaf_id]).astype(dtype)
+                 for bl in b.leaves]
+        arr = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        if scale is not None:
+            arr = arr * scale
+        if b.pad:
+            arr = jnp.pad(arr, (0, b.pad))
+        out.append(arr)
+    return out
+
+
+def unpack(plan: BucketPlan, arrays: Sequence, dtype=None) -> Tree:
+    """Slice the buckets back into the per-leaf tree.  ``dtype=None``
+    casts each leaf to its storage dtype from the plan; pass
+    ``jnp.float32`` for fp32 state slots."""
+    leaves: List[Optional[jnp.ndarray]] = [None] * plan.n_leaves
+    for b, arr in zip(plan.buckets, arrays):
+        for bl in b.leaves:
+            dt = dtype if dtype is not None else plan.leaf_dtypes[bl.leaf_id]
+            leaves[bl.leaf_id] = jax.lax.slice(
+                arr, (bl.offset,), (bl.offset + bl.size,)
+            ).reshape(bl.shape).astype(dt)
+    return jax.tree.unflatten(plan.treedef, leaves)
+
+
+def per_leaf_reduce(plan: BucketPlan, arrays: Sequence,
+                    fn: Callable) -> List[jnp.ndarray]:
+    """``fn`` over each leaf's flat slice, returned in tree_flatten
+    order.  This is how per-tensor reductions (LAMB trust ratios,
+    NovoGrad norms, per-leaf l2) read a bucket: static slices, so the
+    reduction order per leaf matches the per-leaf code path."""
+    out: List[Optional[jnp.ndarray]] = [None] * plan.n_leaves
+    for b, arr in zip(plan.buckets, arrays):
+        for bl in b.leaves:
+            out[bl.leaf_id] = fn(
+                jax.lax.slice(arr, (bl.offset,), (bl.offset + bl.size,)))
+    return out
+
+
+def seg_values(bucket: BucketSpec, per_leaf: Sequence[float]):
+    """Per-element hyperparameter operand for one bucket: a python
+    scalar when every leaf agrees (the common case — no per-element
+    memory traffic), else an np.float32 constant vector (pad region 0).
+    ``per_leaf`` is indexed by ``leaf_id``."""
+    vals = [float(per_leaf[bl.leaf_id]) for bl in bucket.leaves]
+    if all(v == vals[0] for v in vals):
+        return vals[0]
+    parts = [np.full(bl.size, v, np.float32)
+             for bl, v in zip(bucket.leaves, vals)]
+    if bucket.pad:
+        parts.append(np.zeros(bucket.pad, np.float32))
+    return jnp.asarray(np.concatenate(parts))
+
+
+def seg_broadcast(bucket: BucketSpec, per_leaf: Sequence):
+    """Broadcast traced per-leaf scalars (indexed by ``leaf_id``) to a
+    per-element bucket vector via a static-repeats gather (pad = 0)."""
+    vals = [per_leaf[bl.leaf_id] for bl in bucket.leaves]
+    sizes = [bl.size for bl in bucket.leaves]
+    stacked = jnp.stack([jnp.asarray(v, jnp.float32) for v in vals]
+                        + [jnp.float32(0.0)])
+    reps = np.asarray(sizes + [bucket.pad])
+    return jnp.repeat(stacked, reps, total_repeat_length=bucket.total)

@@ -15,6 +15,15 @@ The capturable behavior is default here: pass ``grads_finite`` (from
 :meth:`apex_tpu.amp.DynamicLossScaler.unscale`) and the whole step —
 including the step counter — commits only when grads are finite, exactly
 like the reference's device-side noop_flag path.
+
+The update runs on the bucketed multi-tensor engine by default
+(``use_buckets=True``; see :mod:`apex_tpu.optimizers.base`): one fused
+elementwise pass per dtype bucket, bit-exact in fp32 with both the
+per-leaf path and ``optax.adamw`` (the second-moment update is
+``(1-β2)·(g·g)``, optax's association).  ``init(params, bucketed=True)``
+stores m/v (and the fp32 master) as flat bucket buffers that ride the
+jit boundary directly — ``donate_argnums`` then donates the bucket
+buffers themselves.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -22,17 +31,20 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.optimizers import base
+from apex_tpu.optimizers import base, bucketing
 
 
 class AdamState(NamedTuple):
     step: jnp.ndarray  # i32 scalar
-    exp_avg: Any  # m, fp32
-    exp_avg_sq: Any  # v, fp32
+    exp_avg: Any  # m, fp32 (tree or Buckets)
+    exp_avg_sq: Any  # v, fp32 (tree or Buckets)
     master: Optional[Any] = None  # fp32 master params (if enabled)
 
 
 class FusedAdam(base.OptimizerBase):
+
+    _BUCKET_SLOT = "exp_avg"
+
     def __init__(
         self,
         lr: float = 1e-3,
@@ -45,6 +57,7 @@ class FusedAdam(base.OptimizerBase):
         master_weights: bool = False,
         param_group_fn=None,
         group_hypers=None,
+        use_buckets: bool = True,
     ):
         """``param_group_fn(path, leaf) -> group_name`` +
         ``group_hypers={name: {"lr": ..., "weight_decay": ...}}`` is the
@@ -52,7 +65,8 @@ class FusedAdam(base.OptimizerBase):
         hyperparameters, e.g. no weight decay on norms/biases)."""
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
-        super().__init__(lr, weight_decay, master_weights)
+        super().__init__(lr, weight_decay, master_weights,
+                         use_buckets=use_buckets)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -60,7 +74,10 @@ class FusedAdam(base.OptimizerBase):
         self.param_group_fn = param_group_fn
         self.group_hypers = group_hypers
 
-    def init(self, params) -> AdamState:
+    def init(self, params, bucketed: bool = False) -> AdamState:
+        if bucketed:
+            (m, v), master = self._init_bucket_slots(params, 2)
+            return AdamState(jnp.int32(0), m, v, master)
         zeros = lambda t: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t)
         return AdamState(
             step=jnp.int32(0),
@@ -69,35 +86,37 @@ class FusedAdam(base.OptimizerBase):
             master=base.make_master(params, self.master_weights),
         )
 
-    def update(self, grads, state: AdamState, params, grads_finite=None, lr=None):
+    def _adam_math(self, g, p32, m, v, wd_i, lr_i, bc1, bc2):
+        """The one Adam expression tree — shared verbatim by the
+        per-leaf and bucket paths (elementwise code is shape-blind), so
+        the two cannot drift even by a rounding."""
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+        if not self.adam_w_mode:  # ADAM_MODE_0: L2 regularization
+            g = g + wd_i * p32
+        m_new = b1 * m + (1.0 - b1) * g
+        # (1-β2)·(g·g): optax's association, pinned for bit-exact parity
+        v_new = b2 * v + (1.0 - b2) * (g * g)
+        denom = jnp.sqrt(v_new / bc2) + eps
+        update = (m_new / bc1) / denom
+        if self.adam_w_mode:  # ADAM_MODE_1: decoupled weight decay
+            update = update + wd_i * p32
+        return p32 - lr_i * update, m_new, v_new
+
+    # ------------------------------------------------------- per-leaf path
+    def _leaf_update(self, grads, state: AdamState, params,
+                     grads_finite=None, lr=None):
         lr = self.lr if lr is None else lr
-        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        wd = self.weight_decay
 
         step = base.predicate_step(grads_finite, state.step)
-        t = step.astype(jnp.float32)
-        if self.bias_correction:
-            bc1 = 1.0 - jnp.power(b1, t)
-            bc2 = 1.0 - jnp.power(b2, t)
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
-
+        bc1, bc2 = self._bias_corrections(step)
         p_math = base.math_params(params, state.master)
         hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers)
 
         def one(g, p, m, v, h):
-            wd_i = h.get("weight_decay", wd)
-            lr_i = base.leaf_lr(h, lr)
-            g = g.astype(jnp.float32)
-            p32 = p.astype(jnp.float32)
-            if not self.adam_w_mode:  # ADAM_MODE_0: L2 regularization
-                g = g + wd_i * p32
-            m_new = b1 * m + (1.0 - b1) * g
-            v_new = b2 * v + (1.0 - b2) * g * g
-            denom = jnp.sqrt(v_new / bc2) + eps
-            update = (m_new / bc1) / denom
-            if self.adam_w_mode:  # ADAM_MODE_1: decoupled weight decay
-                update = update + wd_i * p32
-            return p32 - lr_i * update, m_new, v_new
+            return self._adam_math(
+                g.astype(jnp.float32), p.astype(jnp.float32), m, v,
+                h.get("weight_decay", wd), base.leaf_lr(h, lr), bc1, bc2)
 
         treedef = jax.tree.structure(grads)
         # tree.map validates all five trees share grads' structure
@@ -113,3 +132,48 @@ class FusedAdam(base.OptimizerBase):
 
         new_params, new_master = base.emit_params(p_new, params, state.master)
         return new_params, AdamState(step, m_new, v_new, new_master)
+
+    # --------------------------------------------------------- bucket path
+    def _bucket_update(self, prep: base.PreparedGrads, state: AdamState,
+                       params, pred, lr=None):
+        lr = self.lr if lr is None else lr
+        wd = self.weight_decay
+        plan = prep.plan
+
+        step = base.predicate_step(pred, state.step)
+        bc1, bc2 = self._bias_corrections(step)
+
+        m_b, resident = self._slot_buckets(plan, state.exp_avg)
+        v_b, _ = self._slot_buckets(plan, state.exp_avg_sq)
+        has_master = state.master is not None
+        if has_master:
+            p_b, _ = self._slot_buckets(plan, state.master)
+        else:
+            p_b = bucketing.pack(plan, params)
+        hl = self._hyper_leaves(
+            base.leaf_hypers(params, self.param_group_fn, self.group_hypers))
+        wd_leaf = [h.get("weight_decay", wd) for h in hl]
+
+        new_p, new_m, new_v = [], [], []
+        for bi, b in enumerate(plan.buckets):
+            p_out, m_out, v_out = self._adam_math(
+                prep.g[bi], p_b[bi], m_b[bi], v_b[bi],
+                bucketing.seg_values(b, wd_leaf),
+                self._bucket_lr(b, hl, lr), bc1, bc2)
+            new_p.append(p_out)
+            new_m.append(m_out)
+            new_v.append(v_out)
+
+        new_p = base.bucket_select(pred, new_p, p_b)
+        new_m = base.bucket_select(pred, new_m, m_b)
+        new_v = base.bucket_select(pred, new_v, v_b)
+
+        new_params = bucketing.unpack(plan, new_p)
+        new_master = (self._emit_slot(plan, new_p, resident)
+                      if has_master else None)
+        return new_params, AdamState(
+            step,
+            self._emit_slot(plan, new_m, resident),
+            self._emit_slot(plan, new_v, resident),
+            new_master,
+        )

@@ -14,6 +14,12 @@ Two-phase semantics reproduced exactly:
 3. Stage 2 per tensor: trust ratio ``r = ‖p‖/‖u‖`` applied when
    ``use_nvlamb or wd != 0`` and both norms are nonzero;
    ``p -= lr·r·u``.
+
+Runs on the bucketed multi-tensor engine by default (see
+:mod:`apex_tpu.optimizers.base`): stage 1 is one fused pass per dtype
+bucket; the per-tensor norms of stage 2 read the buckets through the
+plan's static offset table, and the trust ratios broadcast back as one
+per-element gather.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -21,7 +27,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.optimizers import base
+from apex_tpu.optimizers import base, bucketing
 
 
 class LambState(NamedTuple):
@@ -35,6 +41,8 @@ class FusedLAMB(base.OptimizerBase):
 
     #: group-override keys beyond the base lr/lr_scale/weight_decay set
     _HYPER_KEYS = ("use_trust_ratio",)
+
+    _BUCKET_SLOT = "exp_avg"
 
     def __init__(
         self,
@@ -51,6 +59,7 @@ class FusedLAMB(base.OptimizerBase):
         master_weights: bool = False,
         param_group_fn=None,
         group_hypers=None,
+        use_buckets: bool = True,
     ):
         """``param_group_fn``/``group_hypers``: functional param_groups
         (see :class:`~apex_tpu.optimizers.FusedAdam`).  LAMB additionally
@@ -59,7 +68,8 @@ class FusedLAMB(base.OptimizerBase):
         norms/biases)."""
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
-        super().__init__(lr, weight_decay, master_weights)
+        super().__init__(lr, weight_decay, master_weights,
+                         use_buckets=use_buckets)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -70,7 +80,10 @@ class FusedLAMB(base.OptimizerBase):
         self.param_group_fn = param_group_fn
         self.group_hypers = group_hypers
 
-    def init(self, params) -> LambState:
+    def init(self, params, bucketed: bool = False) -> LambState:
+        if bucketed:
+            (m, v), master = self._init_bucket_slots(params, 2)
+            return LambState(jnp.int32(0), m, v, master)
         zeros = lambda t: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t)
         return LambState(
             step=jnp.int32(0),
@@ -79,28 +92,49 @@ class FusedLAMB(base.OptimizerBase):
             master=base.make_master(params, self.master_weights),
         )
 
-    def update(self, grads, state: LambState, params, grads_finite=None, lr=None):
-        lr = self.lr if lr is None else lr
-        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
-        b3 = (1.0 - b1) if self.grad_averaging else 1.0
-
-        step = base.predicate_step(grads_finite, state.step)
-        t = step.astype(jnp.float32)
-        if self.bias_correction:
-            bc1 = 1.0 - jnp.power(b1, t)
-            bc2 = 1.0 - jnp.power(b2, t)
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
-
-        # Global grad norm over every param (fused_lamb.py:121-136).
-        g32 = base.f32(grads)
-        sq = [jnp.sum(jnp.square(g)) for g in jax.tree.leaves(g32)]
-        global_grad_norm = jnp.sqrt(jnp.stack(sq).sum())
-        clip = jnp.where(
+    def _grad_clip(self, global_grad_norm):
+        """fused_lamb.py:121-136: divide every grad by
+        ``gn/max_grad_norm`` when the global norm exceeds the max."""
+        return jnp.where(
             global_grad_norm > self.max_grad_norm,
             global_grad_norm / self.max_grad_norm,
             jnp.float32(1.0),
         )
+
+    def _stage1_math(self, g, p32, m, v, wd_i, bc1, bc2):
+        """Shared stage-1 expression tree (per-leaf == bucket)."""
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+        b3 = (1.0 - b1) if self.grad_averaging else 1.0
+        if not self.adam_w_mode:  # MOMENT_MODE_0: L2 on scaled grad
+            g = g + wd_i * p32
+        m_new = b1 * m + b3 * g
+        v_new = b2 * v + (1.0 - b2) * (g * g)
+        u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        if self.adam_w_mode:  # MOMENT_MODE_1: decoupled
+            u = u + wd_i * p32
+        return u, m_new, v_new
+
+    def _trust_ratio(self, h, wd_i, lr_i, p_norm, u_norm):
+        """Stage-2 per-tensor ratio (multi_tensor_lamb.cu:255-262)."""
+        if h.get("use_trust_ratio", True) and (self.use_nvlamb or wd_i != 0.0):
+            return jnp.where(
+                (p_norm != 0.0) & (u_norm != 0.0),
+                lr_i * (p_norm / u_norm), lr_i)
+        return jnp.asarray(lr_i, jnp.float32)
+
+    # ------------------------------------------------------- per-leaf path
+    def _leaf_update(self, grads, state: LambState, params,
+                     grads_finite=None, lr=None):
+        lr = self.lr if lr is None else lr
+        wd = self.weight_decay
+
+        step = base.predicate_step(grads_finite, state.step)
+        bc1, bc2 = self._bias_corrections(step)
+
+        # Global grad norm over every param (fused_lamb.py:121-136).
+        g32 = base.f32(grads)
+        sq = [jnp.sum(jnp.square(g)) for g in jax.tree.leaves(g32)]
+        clip = self._grad_clip(jnp.sqrt(jnp.stack(sq).sum()))
 
         p_math = base.math_params(params, state.master)
         hypers = base.leaf_hypers(params, self.param_group_fn, self.group_hypers,
@@ -108,17 +142,9 @@ class FusedLAMB(base.OptimizerBase):
         treedef = jax.tree.structure(grads)
 
         def stage1(g, p, m, v, h):
-            wd_i = h.get("weight_decay", wd)
-            g = g.astype(jnp.float32) / clip
-            p32 = p.astype(jnp.float32)
-            if not self.adam_w_mode:  # MOMENT_MODE_0: L2 on scaled grad
-                g = g + wd_i * p32
-            m_new = b1 * m + b3 * g
-            v_new = b2 * v + (1.0 - b2) * g * g
-            u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-            if self.adam_w_mode:  # MOMENT_MODE_1: decoupled
-                u = u + wd_i * p32
-            return u, m_new, v_new
+            return self._stage1_math(
+                g.astype(jnp.float32) / clip, p.astype(jnp.float32), m, v,
+                h.get("weight_decay", wd), bc1, bc2)
 
         out = jax.tree.map(stage1, grads, p_math, state.exp_avg, state.exp_avg_sq, hypers)
         flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
@@ -131,14 +157,10 @@ class FusedLAMB(base.OptimizerBase):
             wd_i = h.get("weight_decay", wd)
             lr_i = base.leaf_lr(h, lr)
             p32 = p.astype(jnp.float32)
-            if h.get("use_trust_ratio", True) and (self.use_nvlamb or wd_i != 0.0):
-                p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
-                u_norm = jnp.sqrt(jnp.sum(jnp.square(u)))
-                ratio = jnp.where(
-                    (p_norm != 0.0) & (u_norm != 0.0), lr_i * (p_norm / u_norm), lr_i
-                )
-            else:
-                ratio = lr_i
+            ratio = self._trust_ratio(
+                h, wd_i, lr_i,
+                jnp.sqrt(jnp.sum(jnp.square(p32))),
+                jnp.sqrt(jnp.sum(jnp.square(u))))
             return p32 - ratio * u
 
         p_new = jax.tree.map(stage2, p_math, updates, hypers)
@@ -149,3 +171,71 @@ class FusedLAMB(base.OptimizerBase):
 
         new_params, new_master = base.emit_params(p_new, params, state.master)
         return new_params, LambState(step, m_new, v_new, new_master)
+
+    # --------------------------------------------------------- bucket path
+    def _bucket_update(self, prep: base.PreparedGrads, state: LambState,
+                       params, pred, lr=None):
+        lr = self.lr if lr is None else lr
+        wd = self.weight_decay
+        plan = prep.plan
+
+        step = base.predicate_step(pred, state.step)
+        bc1, bc2 = self._bias_corrections(step)
+
+        # global grad norm through the offset table: per-leaf Σg² in
+        # flat order, combined exactly like the per-leaf path
+        sq = bucketing.per_leaf_reduce(
+            plan, prep.g, lambda x: jnp.sum(jnp.square(x)))
+        clip = self._grad_clip(jnp.sqrt(jnp.stack(sq).sum()))
+
+        m_b, resident = self._slot_buckets(plan, state.exp_avg)
+        v_b, _ = self._slot_buckets(plan, state.exp_avg_sq)
+        has_master = state.master is not None
+        if has_master:
+            p_b, _ = self._slot_buckets(plan, state.master)
+        else:
+            p_b = bucketing.pack(plan, params)
+        hl = self._hyper_leaves(base.leaf_hypers(
+            params, self.param_group_fn, self.group_hypers,
+            extra_keys=self._HYPER_KEYS))
+        wd_leaf = [h.get("weight_decay", wd) for h in hl]
+
+        # stage 1: one fused pass per bucket
+        u_b, new_m, new_v = [], [], []
+        for bi, b in enumerate(plan.buckets):
+            u, m_out, v_out = self._stage1_math(
+                prep.g[bi] / clip, p_b[bi], m_b[bi], v_b[bi],
+                bucketing.seg_values(b, wd_leaf), bc1, bc2)
+            u_b.append(u)
+            new_m.append(m_out)
+            new_v.append(v_out)
+
+        # stage 2: per-tensor trust ratios from the offset table
+        p_sq = bucketing.per_leaf_reduce(
+            plan, p_b, lambda x: jnp.sum(jnp.square(x)))
+        u_sq = bucketing.per_leaf_reduce(
+            plan, u_b, lambda x: jnp.sum(jnp.square(x)))
+        ratios = [
+            self._trust_ratio(
+                h, h.get("weight_decay", wd), base.leaf_lr(h, lr),
+                jnp.sqrt(p_sq[i]), jnp.sqrt(u_sq[i]))
+            for i, h in enumerate(hl)
+        ]
+        new_p = [
+            p_b[bi] - bucketing.seg_broadcast(b, ratios) * u_b[bi]
+            for bi, b in enumerate(plan.buckets)
+        ]
+
+        new_p = base.bucket_select(pred, new_p, p_b)
+        new_m = base.bucket_select(pred, new_m, m_b)
+        new_v = base.bucket_select(pred, new_v, v_b)
+
+        new_params = bucketing.unpack(plan, new_p)
+        new_master = (self._emit_slot(plan, new_p, resident)
+                      if has_master else None)
+        return new_params, LambState(
+            step,
+            self._emit_slot(plan, new_m, resident),
+            self._emit_slot(plan, new_v, resident),
+            new_master,
+        )

@@ -18,6 +18,11 @@ Elementwise (fp32), with ``denom = gn/√(1-β2^t) + eps``:
 
 Note ``bias_correction2 = sqrt(1-β2^t)`` here (unlike Adam) —
 ``multi_tensor_novograd.cu:150-152``.
+
+Runs on the bucketed multi-tensor engine by default (see
+:mod:`apex_tpu.optimizers.base`): the per-tensor norms read the grad
+bucket through the plan's offset table; ``exp_avg_sq`` stays a tree of
+per-leaf scalars in both layouts (it is one float per tensor).
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -25,7 +30,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.optimizers import base
+from apex_tpu.optimizers import base, bucketing
 
 
 class NovoGradState(NamedTuple):
@@ -36,6 +41,9 @@ class NovoGradState(NamedTuple):
 
 
 class FusedNovoGrad(base.OptimizerBase):
+
+    _BUCKET_SLOT = "exp_avg"
+
     def __init__(
         self,
         lr: float = 1e-3,
@@ -49,12 +57,14 @@ class FusedNovoGrad(base.OptimizerBase):
         norm_type: int = 2,
         init_zero: bool = False,
         master_weights: bool = False,
+        use_buckets: bool = True,
     ):
         if amsgrad:
             raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
         if norm_type not in (0, 2):
             raise RuntimeError("FusedNovoGrad only supports l2/inf norm.")
-        super().__init__(lr, weight_decay, master_weights)
+        super().__init__(lr, weight_decay, master_weights,
+                         use_buckets=use_buckets)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -64,15 +74,19 @@ class FusedNovoGrad(base.OptimizerBase):
         self.norm_type = norm_type
         self.init_zero = init_zero
 
-    def init(self, params) -> NovoGradState:
+    def init(self, params, bucketed: bool = False) -> NovoGradState:
+        # -1 sentinel: "not yet initialized"; replaced by the first
+        # grad norm unless init_zero (fused_novograd.py:160-180).
+        gn0 = jax.tree.map(
+            lambda p: jnp.float32(0.0 if self.init_zero else -1.0), params
+        )
+        if bucketed:
+            (m,), master = self._init_bucket_slots(params, 1)
+            return NovoGradState(jnp.int32(0), m, gn0, master)
         return NovoGradState(
             step=jnp.int32(0),
             exp_avg=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
-            # -1 sentinel: "not yet initialized"; replaced by the first
-            # grad norm unless init_zero (fused_novograd.py:160-180).
-            exp_avg_sq=jax.tree.map(
-                lambda p: jnp.float32(0.0 if self.init_zero else -1.0), params
-            ),
+            exp_avg_sq=gn0,
             master=base.make_master(params, self.master_weights),
         )
 
@@ -81,40 +95,52 @@ class FusedNovoGrad(base.OptimizerBase):
             return jnp.sqrt(jnp.sum(jnp.square(g32)))
         return jnp.max(jnp.abs(g32))
 
-    def update(self, grads, state: NovoGradState, params, grads_finite=None, lr=None):
-        lr = self.lr if lr is None else lr
-        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
-        b3 = (1.0 - b1) if self.grad_averaging else 1.0
+    def _blend(self, gn, fresh):
+        """Norm blend (multi_tensor_novograd.cu:160-164) with the -1
+        lazy-init sentinel resolved."""
+        gn0 = jnp.where(gn < 0, fresh, gn)
+        if self.norm_type == 2:
+            return jnp.sqrt(self.beta2 * jnp.square(gn0)
+                            + (1.0 - self.beta2) * jnp.square(fresh))
+        return self.beta2 * gn0 + (1.0 - self.beta2) * fresh
 
-        step = base.predicate_step(grads_finite, state.step)
+    def _bias_corrections(self, step):
         t = step.astype(jnp.float32)
         if self.bias_correction:
-            bc1 = 1.0 - jnp.power(b1, t)
-            bc2 = jnp.sqrt(1.0 - jnp.power(b2, t))
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
+            return (1.0 - jnp.power(self.beta1, t),
+                    jnp.sqrt(1.0 - jnp.power(self.beta2, t)))
+        return jnp.float32(1.0), jnp.float32(1.0)
 
+    def _moment_math(self, g, p32, m, denom, lr, bc1):
+        """Shared elementwise tail (per-leaf == bucket); ``denom`` is a
+        per-element operand (broadcast per-tensor norm)."""
+        b1, wd = self.beta1, self.weight_decay
+        b3 = (1.0 - b1) if self.grad_averaging else 1.0
+        if self.moment_mode == 0:
+            gp = g / denom + wd * p32
+            m_new = b1 * m + b3 * gp
+            p_out = p32 - lr * (m_new / bc1)
+        else:
+            m_new = b1 * m + b3 * g
+            update = (m_new / bc1) / denom + wd * p32
+            p_out = p32 - lr * update
+        return p_out, m_new
+
+    # ------------------------------------------------------- per-leaf path
+    def _leaf_update(self, grads, state: NovoGradState, params,
+                     grads_finite=None, lr=None):
+        lr = self.lr if lr is None else lr
+
+        step = base.predicate_step(grads_finite, state.step)
+        bc1, bc2 = self._bias_corrections(step)
         p_math = base.math_params(params, state.master)
 
         def one(g, p, m, gn):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
-            fresh = self._norm(g)
-            # lazily init norm to the first step's norm (-1 sentinel)
-            gn0 = jnp.where(gn < 0, fresh, gn)
-            if self.norm_type == 2:
-                gn_new = jnp.sqrt(b2 * jnp.square(gn0) + (1.0 - b2) * jnp.square(fresh))
-            else:
-                gn_new = b2 * gn0 + (1.0 - b2) * fresh
-            denom = gn_new / bc2 + eps
-            if self.moment_mode == 0:
-                gp = g / denom + wd * p32
-                m_new = b1 * m + b3 * gp
-                p_out = p32 - lr * (m_new / bc1)
-            else:
-                m_new = b1 * m + b3 * g
-                update = (m_new / bc1) / denom + wd * p32
-                p_out = p32 - lr * update
+            gn_new = self._blend(gn, self._norm(g))
+            denom = gn_new / bc2 + self.eps
+            p_out, m_new = self._moment_math(g, p32, m, denom, lr, bc1)
             return p_out, m_new, gn_new
 
         out = jax.tree.map(one, grads, p_math, state.exp_avg, state.exp_avg_sq)
@@ -130,3 +156,59 @@ class FusedNovoGrad(base.OptimizerBase):
 
         new_params, new_master = base.emit_params(p_new, params, state.master)
         return new_params, NovoGradState(step, m_new, gn_new, new_master)
+
+    # --------------------------------------------------------- bucket path
+    def _bucket_update(self, prep: base.PreparedGrads, state: NovoGradState,
+                       params, pred, lr=None):
+        lr = self.lr if lr is None else lr
+        plan = prep.plan
+
+        step = base.predicate_step(pred, state.step)
+        bc1, bc2 = self._bias_corrections(step)
+
+        m_b, resident = self._slot_buckets(plan, state.exp_avg)
+        has_master = state.master is not None
+        if has_master:
+            p_b, _ = self._slot_buckets(plan, state.master)
+        else:
+            p_b = bucketing.pack(plan, params)
+
+        # per-tensor fresh norms + blend: one read of the grad bucket
+        # through the offset table, exactly the per-leaf reduction order
+        fresh = bucketing.per_leaf_reduce(plan, prep.g, self._norm)
+        gn_leaves = jax.tree.leaves(state.exp_avg_sq)
+        gn_new_leaves = [self._blend(gn, f)
+                         for gn, f in zip(gn_leaves, fresh)]
+        denoms = [gn / bc2 + self.eps for gn in gn_new_leaves]
+
+        new_p, new_m = [], []
+        for bi, b in enumerate(plan.buckets):
+            denom = bucketing.seg_broadcast(b, denoms)
+            # pad elements would divide by the pad's 0-denominator;
+            # keep them finite so a bucket-level isfinite stays usable.
+            # Mask by PAD POSITION, not by value: a real leaf can have
+            # denom 0 too (eps=0 + zero grads) and must keep the
+            # per-leaf path's NaN there — the two paths may not
+            # silently disagree.
+            if b.pad:
+                is_pad = jnp.arange(b.total) >= b.size
+                denom = jnp.where(is_pad, jnp.float32(1.0), denom)
+            p_out, m_out = self._moment_math(
+                prep.g[bi], p_b[bi], m_b[bi], denom, lr, bc1)
+            new_p.append(p_out)
+            new_m.append(m_out)
+
+        new_p = base.bucket_select(pred, new_p, p_b)
+        new_m = base.bucket_select(pred, new_m, m_b)
+        if pred is not None:
+            w = jnp.asarray(pred)
+            gn_new_leaves = [jnp.where(w, n, o)
+                             for n, o in zip(gn_new_leaves, gn_leaves)]
+        gn_new = jax.tree.unflatten(
+            jax.tree.structure(state.exp_avg_sq), gn_new_leaves)
+
+        new_params = bucketing.unpack(plan, new_p)
+        new_master = (self._emit_slot(plan, new_p, resident)
+                      if has_master else None)
+        return new_params, NovoGradState(
+            step, self._emit_slot(plan, new_m, resident), gn_new, new_master)

@@ -1,0 +1,40 @@
+"""bench.py --smoke rides tier-1: every bench section's step fn must
+still trace and compile on the CPU mesh, so bench bitrot (an API the
+bench calls that a refactor moved, a step that no longer traces) is
+caught here instead of on scarce chip time.  The smoke run executes
+each section once at a tiny config — ~30-60 s total on this box, most
+of it amortized by the persistent compile cache across runs."""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def test_bench_smoke_all_sections_build():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the bench child must not inherit a test-process TPU tunnel
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--smoke"],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    report = None
+    for line in reversed((proc.stdout or "").splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "smoke" in rec:
+            report = rec
+            break
+    assert report is not None, (
+        f"no smoke JSON on stdout; rc={proc.returncode}\n"
+        f"stderr tail: {(proc.stderr or '')[-2000:]}")
+    broken = {k: v for k, v in report["sections"].items()
+              if not v.get("ok")}
+    assert proc.returncode == 0 and not broken, (
+        f"bench sections no longer build: {json.dumps(broken, indent=2)}")

@@ -1,0 +1,528 @@
+"""Bucketed multi-tensor engine tests.
+
+The engine (``optimizers/bucketing.py`` + the ``_bucket_update`` paths)
+is the TPU form of the reference's ``multi_tensor_apply`` chunk tables:
+one fused elementwise pass per dtype bucket.  Its correctness contract:
+
+- **bit-exact vs per-leaf in fp32** — both paths evaluate the same
+  elementwise expression tree per element and share one per-leaf-Σx²
+  reduction shape for the clip norm, so the bucket layout may not
+  change a single ulp on elementwise-only steps;
+- **bit-exact vs optax.adamw in fp32** for FusedAdam (the audited
+  bench baseline — the ≥1.0× claim is only meaningful if the two
+  compute the same function);
+- the amp path (``update_scaled``) folds unscale/clip/finite-vote into
+  the same grad read with identical results to the separate sweeps;
+- a non-finite step is a device-side NO-OP (params, state, step
+  counter all unchanged);
+- resident bucket state (``init(params, bucketed=True)``) is actually
+  donated through a jitted step (the HLO aliases the buffers).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.optimizers import (
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedNovoGrad,
+    FusedSGD,
+)
+from apex_tpu.optimizers import bucketing
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    tree_not_finite,
+)
+
+OPTS = {
+    "adam": lambda **kw: FusedAdam(lr=1e-2, weight_decay=0.01, **kw),
+    "sgd": lambda **kw: FusedSGD(lr=1e-2, momentum=0.9, weight_decay=0.01,
+                                 **kw),
+    "lamb": lambda **kw: FusedLAMB(lr=1e-2, weight_decay=0.01, **kw),
+    "novograd": lambda **kw: FusedNovoGrad(lr=1e-2, weight_decay=0.01, **kw),
+    "adagrad": lambda **kw: FusedAdagrad(lr=1e-2, weight_decay=0.01, **kw),
+}
+
+#: Adam/SGD/Adagrad steps are elementwise-only, so the bucket layout
+#: cannot change a single bit.  LAMB and NovoGrad reduce per-leaf norms
+#: — the bucket form reduces over a 1-D slice of the concatenated
+#: buffer where the leaf form reduces over the original 2-D leaf, and
+#: XLA:CPU vectorizes the two reductions differently (few-ulp drift),
+#: so they get a tight allclose instead.  The same applies to any path
+#: with ``clip_norm`` (the clip coefficient is reduction-fed).
+BITEXACT = {"adam", "sgd", "adagrad"}
+
+
+def make_tree(seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(rng.randn(9, 17).astype(np.float32)).astype(dtype),
+        "sub": {
+            "b": jnp.asarray(rng.randn(33).astype(np.float32)).astype(dtype),
+            # scalar leaf: exercises the shape-() packing path
+            "s": jnp.asarray(np.float32(rng.randn())).astype(dtype),
+        },
+    }
+
+
+def make_mixed_tree(seed=0):
+    """fp32 and bf16 leaves interleaved → a two-bucket plan."""
+    t = make_tree(seed)
+    t["h"] = jnp.asarray(
+        np.random.RandomState(seed + 1).randn(21).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    t["sub"]["h2"] = jnp.asarray(
+        np.random.RandomState(seed + 2).randn(5, 7).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    return t
+
+
+def grads_like(params, seed=7, dtype=None):
+    rng = np.random.RandomState(seed)
+    return jax.tree.map(
+        lambda p: jnp.asarray(
+            np.asarray(rng.randn(*p.shape), np.float32)).astype(
+            dtype or p.dtype),
+        params,
+    )
+
+
+def assert_trees(a, b, exact=True, err=""):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        xa, ya = np.asarray(x, np.float32), np.asarray(y, np.float32)
+        if exact:
+            np.testing.assert_array_equal(xa, ya, err_msg=err)
+        else:
+            np.testing.assert_allclose(xa, ya, rtol=1e-5, atol=1e-6,
+                                       err_msg=err)
+
+
+# --------------------------------------------------------------- the plan
+class TestBucketPlan:
+    def test_layout(self):
+        t = make_mixed_tree()
+        plan = bucketing.plan_of(t)
+        assert len(plan.buckets) == 2  # one fp32 + one bf16 bucket
+        assert {b.dtype for b in plan.buckets} == {"float32", "bfloat16"}
+        # bucket order is the dtypes' first appearance in tree_flatten
+        # order — deterministic for a fixed treedef
+        first_seen = list(dict.fromkeys(plan.leaf_dtypes))
+        assert [b.dtype for b in plan.buckets] == first_seen
+        for b in plan.buckets:
+            # leaves back-to-back, tail padded to the dtype tile
+            off = 0
+            for bl in b.leaves:
+                assert bl.offset == off
+                off += bl.size
+            assert b.size == off
+            assert b.total >= b.size and b.total % 128 == 0
+
+    def test_plan_is_cached_and_hashable(self):
+        t = make_mixed_tree()
+        assert bucketing.plan_of(t) is bucketing.plan_of(
+            jax.tree.map(lambda x: x + 1, t))
+        hash(bucketing.plan_of(t))
+
+    def test_pack_unpack_roundtrip(self):
+        t = make_mixed_tree()
+        plan = bucketing.plan_of(t)
+        back = bucketing.unpack(plan, bucketing.pack(plan, t))
+        for x, y in zip(jax.tree.leaves(t), jax.tree.leaves(back)):
+            assert x.dtype == y.dtype
+            np.testing.assert_array_equal(
+                np.asarray(x, np.float32), np.asarray(y, np.float32))
+
+    def test_pad_region_is_zero(self):
+        t = make_tree()
+        plan = bucketing.plan_of(t)
+        (arr,) = bucketing.pack(plan, t)
+        b = plan.buckets[0]
+        if b.pad:
+            assert not np.asarray(arr[b.size:]).any()
+
+
+# ------------------------------------------------- bucket vs leaf parity
+class TestBucketLeafParity:
+    @pytest.mark.parametrize("name", sorted(OPTS))
+    @pytest.mark.parametrize("mixed", [False, True])
+    def test_update_parity(self, name, mixed):
+        params = make_mixed_tree() if mixed else make_tree()
+        grads = grads_like(params)
+        ob = OPTS[name]()
+        ol = OPTS[name](use_buckets=False)
+        pb, pl = params, params
+        sb, sl = ob.init(params), ol.init(params)
+        for _ in range(3):
+            pb, sb = ob.update(grads, sb, pb)
+            pl, sl = ol.update(grads, sl, pl)
+        assert_trees(pb, pl, exact=(name in BITEXACT and not mixed),
+                     err=f"{name} bucket vs leaf params")
+        # state parity: same structure (transparent mode keeps trees)
+        assert jax.tree.structure(sb) == jax.tree.structure(sl)
+        assert_trees(sb, sl, exact=(name in BITEXACT and not mixed),
+                     err=f"{name} bucket vs leaf state")
+
+    @pytest.mark.parametrize("name", sorted(OPTS))
+    def test_clip_parity(self, name):
+        params = make_tree()
+        grads = grads_like(params)
+        ob, ol = OPTS[name](), OPTS[name](use_buckets=False)
+        pb, sb = ob.update(grads, ob.init(params), params, clip_norm=0.5)
+        pl, sl = ol.update(grads, ol.init(params), params, clip_norm=0.5)
+        assert_trees(pb, pl, exact=False,
+                     err=f"{name} clip_norm bucket vs leaf")
+
+    @pytest.mark.parametrize("name", sorted(OPTS))
+    def test_master_weights_parity(self, name):
+        params = make_tree(dtype=jnp.bfloat16)
+        grads = grads_like(params)
+        ob = OPTS[name](master_weights=True)
+        ol = OPTS[name](master_weights=True, use_buckets=False)
+        pb, sb = ob.update(grads, ob.init(params), params)
+        pl, sl = ol.update(grads, ol.init(params), params)
+        assert_trees(pb, pl, exact=name in BITEXACT,
+                     err=f"{name} master bucket vs leaf")
+        assert_trees(sb.master, sl.master, exact=name in BITEXACT)
+
+
+# -------------------------------------------------------- optax parity
+class TestOptaxParity:
+    @pytest.mark.parametrize("wd", [0.0, 0.01])
+    def test_adamw_bit_exact_fp32(self, wd):
+        """The bench A/B's correctness leg: FusedAdam (bucketed, the
+        default) computes bit-for-bit the same fp32 function as
+        ``optax.adamw`` — so any measured speed gap is implementation,
+        not numerics.  Run op-by-op (unjitted): each primitive compiles
+        alone, so XLA cannot form different FMA groupings in the two
+        trajectories — bit-exactness of the MATH, isolated from
+        program-level codegen (the jitted comparison below)."""
+        params = make_tree()
+        grads = grads_like(params)
+        opt = FusedAdam(lr=1e-2, weight_decay=wd)
+        ox = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
+
+        p_f, s_f = params, opt.init(params)
+        p_o, s_o = params, ox.init(params)
+        for _ in range(4):
+            p_f, s_f = opt.update(grads, s_f, p_f)
+            upd, s_o = ox.update(grads, s_o, p_o)
+            p_o = optax.apply_updates(p_o, upd)
+        assert_trees(p_f, p_o, exact=True, err="FusedAdam vs optax.adamw")
+
+    @pytest.mark.parametrize("wd", [0.0, 0.01])
+    def test_adamw_jitted_trajectory(self, wd):
+        """Whole-step jitted, 4 steps: identical math, but two
+        SEPARATELY compiled programs — XLA:CPU forms FMAs differently
+        per program, so the trajectories may drift by ulps (measured
+        ~3e-8 abs at step 2).  Pinned to a few-ulp band: a real
+        numerics bug (wrong β association, dropped bias correction)
+        shows up orders of magnitude above it."""
+        params = make_tree()
+        grads = grads_like(params)
+        opt = FusedAdam(lr=1e-2, weight_decay=wd)
+        ox = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
+
+        step_f = jax.jit(lambda g, s, p: opt.update(g, s, p))
+
+        def _o(g, s, p):
+            upd, s = ox.update(g, s, p)
+            return optax.apply_updates(p, upd), s
+
+        step_o = jax.jit(_o)
+        p_f, s_f = params, opt.init(params)
+        p_o, s_o = params, ox.init(params)
+        for _ in range(4):
+            p_f, s_f = step_f(grads, s_f, p_f)
+            p_o, s_o = step_o(grads, s_o, p_o)
+        for x, y in zip(jax.tree.leaves(p_f), jax.tree.leaves(p_o)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=0, atol=5e-7)
+
+    def test_adamw_bf16_storage_close_to_optax_fp32(self):
+        """bf16 params: fp32 math inside, storage rounding outside —
+        within one bf16 ulp of the fp32 optax trajectory per step."""
+        params32 = make_tree()
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params32)
+        grads = grads_like(params32)
+        opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+        p_f, s_f = opt.update(grads, opt.init(params), params)
+        ox = optax.adamw(1e-2, weight_decay=0.01)
+        upd, _ = ox.update(grads, ox.init(params32), params32)
+        p_o = optax.apply_updates(params32, upd)
+        for x, y in zip(jax.tree.leaves(p_f), jax.tree.leaves(p_o)):
+            np.testing.assert_allclose(
+                np.asarray(x, np.float32), np.asarray(y), rtol=1e-2)
+
+
+# ------------------------------------------------------ the fused amp path
+class TestScaledPath:
+    @pytest.mark.parametrize("name", sorted(OPTS))
+    def test_update_scaled_matches_separate_sweeps(self, name):
+        """unscale+clip+vote folded into the grad read ≡ the explicit
+        sweep composition (scaler.unscale → clip → update)."""
+        params = make_tree()
+        scale = jnp.float32(1024.0)
+        grads16 = jax.tree.map(
+            lambda g: (g * scale).astype(jnp.float16), grads_like(params))
+        opt = OPTS[name]()
+        leaf = OPTS[name](use_buckets=False)
+        p1, s1, fin = opt.update_scaled(
+            grads16, opt.init(params), params, scale=scale, clip_norm=1.0)
+        assert bool(fin)
+        # reference composition on the per-leaf path
+        g = jax.tree.map(lambda x: x.astype(jnp.float32) / scale, grads16)
+        p2, s2, fin2 = leaf.update_scaled(
+            g, leaf.init(params), params, clip_norm=1.0)
+        assert_trees(p1, p2, exact=name in BITEXACT,
+                     err=f"{name} fused vs composed amp tail")
+
+    @pytest.mark.parametrize("name", sorted(OPTS))
+    @pytest.mark.parametrize("resident", [False, True])
+    def test_nonfinite_step_is_noop(self, name, resident):
+        """grads_finite=False: params, state slots, and the step counter
+        all hold (the capturable noop_flag semantics) — on both the
+        transparent and the resident-bucket state."""
+        params = make_tree()
+        grads = grads_like(params)
+        bad = jax.tree.map(lambda g: g.at[..., 0].set(jnp.inf)
+                           if g.ndim else g, grads)
+        opt = OPTS[name]()
+        state0 = opt.init(params, bucketed=resident)
+        # one clean step first so momentum buffers are nonzero
+        p1, s1, fin1 = opt.update_scaled(grads, state0, params)
+        assert bool(fin1)
+        p2, s2, fin2 = opt.update_scaled(bad, s1, p1)
+        assert not bool(fin2)
+        assert_trees(p2, p1, exact=True, err=f"{name} params moved on inf")
+        assert int(s2.step) == int(s1.step)
+        assert_trees(jax.tree.leaves(s2), jax.tree.leaves(s1), exact=True,
+                     err=f"{name} state moved on inf")
+
+    def test_scaler_integration(self):
+        """update_scaled's vote drives DynamicLossScaler.update: backoff
+        on inf, growth bookkeeping on clean steps."""
+        from apex_tpu.amp import DynamicLossScaler
+
+        params = make_tree()
+        scaler = DynamicLossScaler(init_scale=2.0 ** 10)
+        sstate = scaler.init()
+        opt = FusedAdam(lr=1e-2)
+        ostate = opt.init(params)
+        bad = jax.tree.map(lambda g: g * jnp.inf, grads_like(params))
+        p, s, fin = opt.update_scaled(bad, ostate, params,
+                                      scale=sstate.loss_scale)
+        s2 = scaler.update(sstate, fin)
+        assert float(s2.loss_scale) < float(sstate.loss_scale)
+
+
+# ----------------------------------------------------------- residency
+class TestResidentBuckets:
+    def test_resident_trajectory_matches_transparent(self):
+        params = make_tree()
+        grads = grads_like(params)
+        opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+        pr, sr = params, opt.init(params, bucketed=True)
+        pt, st = params, opt.init(params)
+        for _ in range(3):
+            pr, sr = opt.update(grads, sr, pr)
+            pt, st = opt.update(grads, st, pt)
+        assert isinstance(sr.exp_avg, bucketing.Buckets)
+        assert_trees(pr, pt, exact=True, err="resident vs transparent")
+        assert_trees(sr.exp_avg.unpack(dtype=jnp.float32), st.exp_avg,
+                     exact=True)
+
+    def test_resident_buffers_are_donated(self):
+        """The jaxpr-level donation assertion the engine exists for:
+        every bucket buffer input of a ``donate_argnums`` step carries
+        an aliased output (``tf.aliasing_output`` in the lowering) —
+        m/v/master update in place instead of doubling HBM."""
+        params = make_tree()
+        grads = grads_like(params)
+        opt = FusedAdam(lr=1e-2, master_weights=True)
+        state = opt.init(params, bucketed=True)
+        n_buckets = len(bucketing.plan_of(params).buckets)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, params):
+            p, s = opt.update(grads, state, params)
+            return s, p
+
+        txt = step.lower(state, params).as_text()
+        n_donated = txt.count("tf.aliasing_output")
+        # step counter + m/v/master bucket buffers all alias
+        assert n_donated >= 1 + 3 * n_buckets, txt[:2000]
+
+    def test_resident_state_rides_tree_map(self):
+        """Buckets is a pytree: the amp scaler and multi_tensor ops see
+        the buffers as leaves with no special cases."""
+        params = make_tree()
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params, bucketed=True)
+        doubled = jax.tree.map(lambda x: x * 2, state.exp_avg)
+        assert isinstance(doubled, bucketing.Buckets)
+        assert not bool(tree_not_finite(state.exp_avg))
+
+
+# ------------------------------------- optimizers outside the fused tail
+class TestUpdateScaledRouting:
+    def test_swa_routes_through_its_update_override(self):
+        """``FusedAdamSWA`` overrides ``update`` with extra SWA state
+        the fused tail doesn't maintain: it declares
+        ``supports_update_scaled = False`` and the scaled train-step
+        tail must take the explicit sweep path — calling the override,
+        so the SWA average and n_averaged actually advance."""
+        from apex_tpu.amp import DynamicLossScaler
+        from apex_tpu.contrib.openfold_triton import FusedAdamSWA
+        from apex_tpu.models.gpt import _apply_scaled_update
+
+        opt = FusedAdamSWA(lr=1e-2)
+        assert not opt.supports_update_scaled
+
+        params = make_tree()
+        scaler = DynamicLossScaler(init_scale=4.0)
+        sstate = scaler.init()
+        state = opt.init(params)
+        grads = jax.tree.map(lambda g: g * sstate.loss_scale,
+                             grads_like(params))
+        new_p, new_state, new_sstate = _apply_scaled_update(
+            scaler, sstate, grads, opt, state, params, sync_axes=[])
+        assert int(new_state.n_averaged) == 1
+        assert int(new_state.adam.step) == 1
+
+    def test_plain_optimizers_support_the_fused_tail(self):
+        for name, mk in OPTS.items():
+            assert mk().supports_update_scaled, name
+
+
+# ----------------------------------------------- sharded clip agreement
+class TestClipSumsqReduce:
+    def test_sharded_and_replicated_leaves_agree_with_oracle(self):
+        """Inside a tp=2 shard_map, a tp-sharded leaf's Σx² must psum
+        over tp while a replicated leaf's must NOT — the grouped
+        reduction :func:`models.gpt.clip_sumsq_reduce` builds from the
+        PartitionSpecs.  The oracle is the plain unsharded Σx²."""
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from apex_tpu.models.gpt import clip_sumsq_reduce
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+        specs = {"w": P("tp", None), "b": P(None)}
+        grads = {
+            "w": jnp.arange(8.0, dtype=jnp.float32).reshape(4, 2),
+            "b": jnp.asarray([3.0, -1.0], jnp.float32),
+        }
+        oracle = sum(float(jnp.sum(jnp.square(g)))
+                     for g in jax.tree.leaves(grads))
+        reduce = clip_sumsq_reduce(specs)
+
+        def local(g):
+            sq = [jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g)]
+            return reduce(sq)
+
+        total = jax.shard_map(
+            local, mesh=mesh, in_specs=(specs,), out_specs=P(),
+            check_vma=False)(grads)
+        np.testing.assert_allclose(np.asarray(total), oracle, rtol=1e-6)
+
+    def test_engine_clip_inside_shard_map_matches_unsharded(self):
+        """The whole fused pass under a tp shard_map: update with
+        clip_norm + the spec-built sumsq_reduce on sharded params
+        equals the unsharded update with clip_norm."""
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from apex_tpu.models.gpt import clip_sumsq_reduce
+
+        params = {"w": jnp.asarray(
+            np.random.RandomState(0).randn(8, 6), jnp.float32),
+            "b": jnp.asarray(np.random.RandomState(1).randn(6),
+                             jnp.float32)}
+        grads = grads_like(params, seed=3)
+        specs = {"w": P("tp", None), "b": P(None)}
+        opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+        state = opt.init(params)
+
+        p_ref, _ = opt.update(grads, state, params, clip_norm=0.1)
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+        reduce = clip_sumsq_reduce(specs)
+        sspec = type(state)(step=P(), exp_avg=specs, exp_avg_sq=specs,
+                            master=None)
+
+        def local(p, s, g):
+            new_p, _ = opt.update(g, s, p, clip_norm=0.1,
+                                  sumsq_reduce=reduce)
+            return new_p
+
+        p_sh = jax.shard_map(
+            local, mesh=mesh, in_specs=(specs, sspec, specs),
+            out_specs=specs, check_vma=False)(params, state, grads)
+        assert_trees(jax.device_get(p_sh), jax.device_get(p_ref),
+                     exact=False, err="sharded clip vs unsharded oracle")
+
+
+# --------------------------------------- multi_tensor ops on bucket views
+class TestMultiTensorBucketViews:
+    def test_l2norm_per_leaf_matches_tree(self):
+        t = make_tree()
+        plan = bucketing.plan_of(t)
+        b = bucketing.Buckets(plan, bucketing.pack(plan, t))
+        g1, per1 = multi_tensor_l2norm(t, per_tensor=True)
+        g2, per2 = multi_tensor_l2norm(b, per_tensor=True)
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+        assert len(per1) == len(per2) == len(jax.tree.leaves(t))
+        for a, c in zip(per1, per2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+    def test_scale_on_buckets_returns_buckets(self):
+        t = make_tree()
+        plan = bucketing.plan_of(t)
+        b = bucketing.Buckets(plan, bucketing.pack(plan, t))
+        out, found = multi_tensor_scale(b, 2.0)
+        assert isinstance(out, bucketing.Buckets)
+        assert not bool(found)
+        assert_trees(out.unpack(), jax.tree.map(lambda x: x * 2, t),
+                     exact=True)
+
+
+# ----------------------------------------------- the applier conventions
+class TestMultiTensorApplier:
+    """Parity with the reference calling convention
+    ``multi_tensor_applier(op, noop_flag, tensor_lists, *args)``:
+    the returned flag accumulates (OR) across calls exactly as the
+    kernels' shared noop buffer does."""
+
+    def test_returns_result_and_flag(self):
+        t = make_tree()
+        out, flag = multi_tensor_applier(multi_tensor_scale, None, [t], 2.0)
+        assert flag.dtype == jnp.int32 and int(flag) == 0
+        assert_trees(out, jax.tree.map(lambda x: x * 2, t), exact=True)
+
+    def test_found_inf_sets_flag(self):
+        t = {"a": jnp.asarray([1.0, jnp.nan])}
+        _, flag = multi_tensor_applier(multi_tensor_scale, 0, [t], 1.0)
+        assert int(flag) == 1
+
+    def test_flag_is_sticky_across_calls(self):
+        """Reference: a set noop buffer stays set — chained clean calls
+        cannot clear a previous call's overflow vote."""
+        t = make_tree()
+        _, flag = multi_tensor_applier(
+            multi_tensor_scale, jnp.int32(1), [t], 1.0)
+        assert int(flag) == 1
+        _, flag = multi_tensor_applier(multi_tensor_scale, flag, [t], 1.0)
+        assert int(flag) == 1
+
+    def test_voteless_op_passes_flag_through(self):
+        t = make_tree()
+        norm, flag = multi_tensor_applier(multi_tensor_l2norm, None, [t])
+        assert norm.ndim == 0 and int(flag) == 0
+        _, flag = multi_tensor_applier(multi_tensor_l2norm, 1, [t])
+        assert int(flag) == 1

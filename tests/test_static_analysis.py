@@ -37,6 +37,7 @@ from apex_tpu.analysis.rules_precision import (
 )
 from apex_tpu.analysis.rules_tiling import (
     BlockShapeTilingViolation,
+    BlockSpecIndexMapArity,
     HardCodedSublaneAlignment,
 )
 from apex_tpu.analysis.rules_trace import (
@@ -510,6 +511,109 @@ class TestBlockShapeTilingViolation:
                                  memory_space=pltpu.VMEM)
                 return a, b, c
             """, tmp_path, [BlockShapeTilingViolation()])
+        assert got == []
+
+
+# ------------------------------- APX105 BlockSpec index_map arity vs grid
+class TestBlockSpecIndexMapArity:
+    def test_positive_arity_mismatch_direct_and_aliased(self, tmp_path):
+        """The refactor hazard: a grid grown to rank 3 while the
+        lambdas still take 2 ids — both the inline spec and one built
+        through a local alias (the flash-kernel idiom)."""
+        got = run("""
+            import functools
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def kernel(x):
+                kv_spec = pl.BlockSpec((1, 128, 64), lambda b, j: (b, j, 0),
+                                       memory_space=pltpu.VMEM)
+                grid = (4, 8, 2)
+                return pl.pallas_call(
+                    functools.partial(_body),
+                    grid=grid,
+                    in_specs=[
+                        pl.BlockSpec((1, 128, 64), lambda b, i: (b, i, 0),
+                                     memory_space=pltpu.VMEM),
+                        kv_spec,
+                    ],
+                    out_specs=pl.BlockSpec((1, 128, 64),
+                                           lambda b, i, j: (b, i, 0),
+                                           memory_space=pltpu.VMEM),
+                )(x)
+            """, tmp_path, [BlockSpecIndexMapArity()])
+        assert rule_ids(got) == ["APX105", "APX105"]
+        assert "takes 2 argument(s)" in got[0].message
+        assert "rank 3" in got[0].message
+
+    def test_shadowed_alias_last_assignment_wins(self, tmp_path):
+        """``grid = (4, 8)`` rebound to ``(4, 8, 2)`` before the call:
+        the lexically LAST assignment is the one the call sees, so
+        rank-3 lambdas are clean and a rank-2 lambda is flagged (the
+        reverse-visit-order bug flagged the correct ones instead)."""
+        got = run("""
+            from jax.experimental import pallas as pl
+
+            def kernel(x):
+                grid = (4, 8)
+                grid = (4, 8, 2)
+                return pl.pallas_call(
+                    _body, grid=grid,
+                    in_specs=[
+                        pl.BlockSpec((8, 128), lambda b, i, j: (b, i, 0)),
+                        pl.BlockSpec((8, 128), lambda b, i: (b, i)),
+                    ],
+                    out_specs=pl.BlockSpec((8, 128),
+                                           lambda b, i, j: (b, i, 0)),
+                )(x)
+            """, tmp_path, [BlockSpecIndexMapArity()])
+        assert rule_ids(got) == ["APX105"]
+        assert "takes 2 argument(s)" in got[0].message
+
+    def test_positive_int_grid_is_rank_one(self, tmp_path):
+        got = run("""
+            from jax.experimental import pallas as pl
+
+            def kernel(x):
+                return pl.pallas_call(
+                    _body, grid=8,
+                    in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+                    out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                )(x)
+            """, tmp_path, [BlockSpecIndexMapArity()])
+        assert rule_ids(got) == ["APX105"]
+
+    def test_negative_matching_named_default_and_dynamic(self, tmp_path):
+        """Matching lambdas, a named index_map def of the right arity,
+        a default index_map, a *args lambda, and a dynamic grid are
+        all silent — the rule only speaks when the mismatch is
+        provable."""
+        got = run("""
+            from jax.experimental import pallas as pl
+
+            def imap(b, i, j):
+                return (b, i, 0)
+
+            def kernel(x, grid_from_caller):
+                inline = pl.BlockSpec((1, 128, 64),
+                                      lambda b, i, j: (b, j, 0))
+                return pl.pallas_call(
+                    _body,
+                    grid=(4, 8, 2),
+                    in_specs=[
+                        inline,
+                        pl.BlockSpec((1, 128, 64), imap),
+                        pl.BlockSpec((1, 128, 64)),
+                        pl.BlockSpec((1, 128, 64), lambda *ids: ids),
+                    ],
+                    out_specs=pl.BlockSpec((1, 128, 64), index_map=imap),
+                )(x) + pl.pallas_call(
+                    _body,
+                    grid=grid_from_caller,
+                    in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                )(x)
+            """, tmp_path, [BlockSpecIndexMapArity()])
         assert got == []
 
 
