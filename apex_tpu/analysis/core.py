@@ -125,17 +125,26 @@ class ModuleContext:
     """Everything the rules need to know about one parsed module."""
 
     def __init__(self, path: str, source: str, tree: ast.Module,
-                 axis_registry: Set[str]):
+                 axis_registry: Set[str], module_name: str = "",
+                 is_package: bool = False):
         self.path = path
         self.source = source
         self.tree = tree
         self.axis_registry = axis_registry
+        #: dotted module name (``apex_tpu.ops.fused_ce``) — what the
+        #: cross-module linker resolves imports against; empty for
+        #: single-file analysis (no linking possible)
+        self.module_name = module_name
+        #: True for a package ``__init__.py``: its level-1 relative
+        #: imports resolve against the package ITSELF, not its parent
+        self.is_package = is_package
         self._parents: Dict[ast.AST, ast.AST] = {}
         for parent in ast.walk(tree):
             for child in ast.iter_child_nodes(parent):
                 self._parents[child] = parent
         self.functions: Dict[str, FunctionInfo] = {}
         self._collect_functions()
+        self._collect_imports()
         # qualname -> human-readable reason the function is traced
         self.traced: Dict[str, str] = {}
         # Lambda node -> reason (lambdas have no qualname; tracked by
@@ -183,6 +192,94 @@ class ModuleContext:
                     visit(child, prefix)
 
         visit(self.tree, "")
+
+    def _collect_imports(self) -> None:
+        """Local-name → module bindings, for the cross-module linker.
+        Function-local imports count too (the fused_ce shape: ``from
+        ...fused_ce_pallas import fused_ce_fwd_pallas`` inside the
+        traced closure)."""
+        self.import_aliases: Dict[str, str] = {}      # alias -> module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, attr)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.import_aliases[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    parts = self.module_name.split(".") if self.module_name \
+                        else []
+                    # level=1 in pkg/mod.py → pkg; in pkg/__init__.py →
+                    # pkg itself (python relative-import semantics)
+                    keep = len(parts) - node.level + (1 if self.is_package
+                                                      else 0)
+                    base = ".".join(parts[: max(0, keep)])
+                    mod = f"{base}.{node.module}" if node.module and base \
+                        else (node.module or base)
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = (mod, a.name)
+
+    def cross_module_calls(self):
+        """``(module, func_name, reason)`` for every call inside traced
+        code that resolves through this module's imports instead of the
+        module-local call graph — the seeds the cross-module linker
+        plants into OTHER modules' traced indexes."""
+        out: List[Tuple[str, str, str]] = []
+        src = self.module_name or self.path
+
+        def scan(body_node, scope, reason):
+            for sub in ast.walk(body_node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = dotted_name(sub.func)
+                if d is None:
+                    continue
+                parts = d.split(".")
+                if len(parts) == 1:
+                    if self.resolve_function(parts[0], scope) is not None:
+                        continue  # module-local binding shadows the import
+                    tgt = self.from_imports.get(parts[0])
+                    if tgt is not None:
+                        out.append((*self._from_target(tgt), reason))
+                    continue
+                head, attr = parts[:-1], parts[-1]
+                if head[0] in self.import_aliases:
+                    mod = ".".join([self.import_aliases[head[0]]] + head[1:])
+                elif head[0] in self.from_imports:
+                    m0, a0 = self.from_imports[head[0]]
+                    mod = ".".join([f"{m0}.{a0}" if m0 else a0] + head[1:])
+                else:
+                    # plain `import a.b.c` binds `a`; the dotted call
+                    # carries the full module path already
+                    mod = ".".join(head)
+                out.append((mod, attr, reason))
+
+        for qn in list(self.traced):
+            info = self.functions.get(qn)
+            if info is not None:
+                scan(info.node, qn,
+                     f"called (cross-module) from traced {src}:{qn}")
+        for lam in list(self.traced_lambdas):
+            scope = self.enclosing_qualname(lam)
+            scan(lam, "" if scope == "<module>" else scope,
+                 f"called (cross-module) from a traced lambda in {src}")
+        return out
+
+    @staticmethod
+    def _from_target(tgt: Tuple[str, str]) -> Tuple[str, str]:
+        mod, attr = tgt
+        return (mod, attr) if mod else (attr, "")
+
+    def mark_external(self, qualname: str, reason: str) -> bool:
+        """Seed a function as traced from ANOTHER module's call graph
+        and re-run local propagation; True if anything new was marked."""
+        if qualname not in self.functions or qualname in self.traced:
+            return False
+        self.traced[qualname] = reason
+        self._propagate()
+        return True
 
     def resolve_function(self, name: str,
                          from_qualname: str = "") -> Optional[str]:
@@ -266,6 +363,12 @@ class ModuleContext:
                 self._mark_value(arg, f"passed to {entry}", scope, aliases)
 
         # 4. fixpoint propagation: lexical nesting + module-local calls
+        self._propagate()
+
+    def _propagate(self) -> None:
+        """The traced-index fixpoint (lexical nesting + module-local
+        calls) — separated from seeding so the cross-module linker can
+        re-run it after planting external seeds."""
         changed = True
         while changed:
             changed = False
@@ -367,38 +470,123 @@ def _find_files(paths: Iterable[str], basename: Optional[str] = None,
     return out
 
 
-def analyze_file(path: str, rules: Iterable[Rule], axis_registry: Set[str],
-                 display_path: Optional[str] = None) -> List[Finding]:
+def _load_module(path: str, display: str, axis_registry: Set[str],
+                 module_name: str = "", is_package: bool = False):
+    """Parse one file into a :class:`ModuleContext`, or the APX000
+    :class:`Finding` describing why it could not be parsed — the ONE
+    read/parse/error shape both entry points share."""
     try:
         source = open(path, encoding="utf-8").read()
     except OSError as e:
-        return [Finding("APX000", "error", display_path or path, 0, 0,
-                        "<module>", f"unreadable: {e}", "fix file access")]
+        return Finding("APX000", "error", display, 0, 0,
+                       "<module>", f"unreadable: {e}", "fix file access")
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
-        return [Finding("APX000", "error", display_path or path,
-                        e.lineno or 0, e.offset or 0, "<module>",
-                        f"syntax error: {e.msg}", "fix the syntax error")]
-    ctx = ModuleContext(display_path or path, source, tree, axis_registry)
+        return Finding("APX000", "error", display,
+                       e.lineno or 0, e.offset or 0, "<module>",
+                       f"syntax error: {e.msg}", "fix the syntax error")
+    return ModuleContext(display, source, tree, axis_registry,
+                         module_name=module_name, is_package=is_package)
+
+
+def analyze_file(path: str, rules: Iterable[Rule], axis_registry: Set[str],
+                 display_path: Optional[str] = None) -> List[Finding]:
+    loaded = _load_module(path, display_path or path, axis_registry)
+    if isinstance(loaded, Finding):
+        return [loaded]
     findings: List[Finding] = []
     for rule in rules:
-        findings.extend(rule.check(ctx))
+        findings.extend(rule.check(loaded))
     return findings
+
+
+def _module_name_for(file: str, root: str) -> str:
+    """Dotted module name of ``file`` as imported from ``root``'s
+    parent: a package root (dir with ``__init__.py``) contributes its
+    own name (``apex_tpu/ops/x.py`` scanned via root ``apex_tpu`` →
+    ``apex_tpu.ops.x``); a bare dir's files are top-level modules; a
+    file root is its own module (``bench.py`` → ``bench``)."""
+    if os.path.isfile(root):
+        rel = os.path.basename(file)
+    else:
+        rel = os.path.relpath(file, root)
+        if os.path.isfile(os.path.join(root, "__init__.py")):
+            rel = os.path.join(
+                os.path.basename(os.path.abspath(root.rstrip(os.sep))), rel)
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace(os.sep, ".").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _link_cross_module(ctxs: Dict[str, Optional["ModuleContext"]]) -> None:
+    """Global traced-reachability fixpoint: a function called from a
+    traced function in ANOTHER module is traced too (the per-module
+    index misses exactly this — e.g. ``fused_ce_pallas.
+    _default_dot_dtype``'s env read reached from ``fused_ce._fwd``).
+    ``None`` entries mark ambiguous module names (two scanned files
+    claimed the same dotted name) — never linked through, so a seed
+    cannot land in the wrong file.  Each module's call list is
+    recomputed only when its traced set grew (``cross_module_calls``
+    walks every traced body — a per-round full rescan would be
+    O(rounds × corpus))."""
+    live = [c for c in ctxs.values() if c is not None]
+    memo: Dict[int, Tuple[int, list]] = {}
+    changed = True
+    while changed:
+        changed = False
+        for ctx in live:
+            n = len(ctx.traced) + len(ctx.traced_lambdas)
+            if memo.get(id(ctx), (-1,))[0] != n:
+                memo[id(ctx)] = (n, ctx.cross_module_calls())
+            for mod, attr, reason in memo[id(ctx)][1]:
+                target = ctxs.get(mod)
+                if target is None or target is ctx:
+                    continue
+                if target.mark_external(attr, reason):
+                    changed = True
 
 
 def analyze_paths(paths: Iterable[str], rules: Iterable[Rule],
                   axis_registry: Optional[Set[str]] = None,
                   rel_to: Optional[str] = None) -> List[Finding]:
     """Run every rule over every ``*.py`` under ``paths``; findings are
-    sorted by (path, line, rule) for stable output and baselines."""
+    sorted by (path, line, rule) for stable output and baselines.
+
+    Unlike :func:`analyze_file`, this multi-file entry point links the
+    per-module traced indexes across modules first (import-resolved
+    call-graph reachability), so trace-time hazards in helpers reached
+    only from another module's jitted code are still flagged."""
     paths = list(paths)
     registry = axis_registry if axis_registry is not None \
         else discover_axis_registry(paths)
     rules = list(rules)
     findings: List[Finding] = []
-    for f in _find_files(paths):
-        display = os.path.relpath(f, rel_to) if rel_to else f
-        findings.extend(analyze_file(f, rules, registry, display))
+    ctxs: Dict[str, Optional[ModuleContext]] = {}
+    ordered: List[ModuleContext] = []
+    for root in paths:
+        for f in _find_files([root]):
+            display = os.path.relpath(f, rel_to) if rel_to else f
+            loaded = _load_module(
+                f, display, registry, module_name=_module_name_for(f, root),
+                is_package=os.path.basename(f) == "__init__.py")
+            if isinstance(loaded, Finding):
+                findings.append(loaded)
+                continue
+            if loaded.module_name in ctxs:
+                # two scanned files claim one dotted name (e.g. utils.py
+                # under two bare roots): linking through the name would
+                # plant seeds in whichever file happened to win — mark
+                # ambiguous and never link through it
+                ctxs[loaded.module_name] = None
+            else:
+                ctxs[loaded.module_name] = loaded
+            ordered.append(loaded)
+    _link_cross_module(ctxs)
+    for ctx in ordered:
+        for rule in rules:
+            findings.extend(rule.check(ctx))
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings

@@ -1,0 +1,717 @@
+"""The ZeRO bucket engine: resident dp-sharded optimizer state on the
+:class:`~apex_tpu.optimizers.bucketing.BucketPlan` layout.
+
+Reference: ``apex/contrib/optimizers/distributed_fused_adam.py`` (3,078
+LoC) — ``ParameterFragment``/``StateBucket`` fragment maps, fixed-size
+buckets, reduce-scatter grad sync overlapped with backward, all-gather
+param sync optionally overlapped with forward, optimizer state sharded
+over the distributed process group.
+
+TPU shape of that machinery (this module):
+
+- **the bucket plan IS the fragment map**: params flatten (in
+  ``tree_flatten`` order) into dtype-homogeneous 1-D buckets, split by
+  ``bucket_cap_mb`` at leaf granularity and padded so each bucket slices
+  into ``dp`` tile-aligned shards (``bucketing.plan_of(cap_bytes=...,
+  shard_pad=dp)``);
+- **state is resident as the local 1/dp shard of each bucket**: m/v
+  (and the fp32 master or the uint16 param remainders) are per-bucket
+  flat arrays sharded over ``(model axes…, dp)`` — no per-step tree
+  flatten, no whole-tree fp32 concat, and the buffers donate through
+  ``jax.jit`` like any other state leaf;
+- **grad sync is one ``psum_scatter`` per bucket in
+  ``grad_sync_dtype``** (storage dtype for half buckets by default — a
+  bf16 bucket's gradient crosses the wire in bf16, half the traffic of
+  the old monolithic fp32 concat), so XLA's latency-hiding scheduler
+  can overlap each bucket's collective with the remaining backward and
+  with other buckets' math;
+- **param sync is one ``all_gather`` per bucket in
+  ``param_sync_dtype``**; with ``overlap_param_sync`` the gather runs
+  on the pre-commit update (before the cross-rank finite vote
+  completes) and the commit is predicated per leaf afterwards, so the
+  gather is not serialized behind the vote's collectives.
+
+Fail-fast contract: the collectives live INSIDE the optimizer, so this
+engine never routes through the per-process
+:mod:`apex_tpu.resilience.fallback` registry — a per-process degrade
+would lower divergent SPMD programs (mismatched collective counts
+deadlock the pod device-side, the exact hazard ``registry_engaged``
+documents).  An engine failure surfaces loudly and ``--auto-resume``
+restarts the job.
+"""
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.optimizers import bucketing
+from apex_tpu.optimizers.base import bias_corrections
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+Tree = Any
+
+#: Sync dtypes the engine knows how to reduce/gather in.  fp8 (and any
+#: integer) sync would need the reference's scaled-quantization support
+#: (``distributed_fused_adam.py`` fp8 buffers + per-bucket amax) that
+#: this port does not have — constructor-time rejection beats the old
+#: accept-and-silently-drop behavior.
+_SUPPORTED_SYNC = ("float32", "bfloat16", "float16")
+
+
+def resolve_sync_dtype(value, knob: str):
+    """Validate a ``grad_sync_dtype``/``param_sync_dtype`` knob; None
+    means the per-bucket default (the bucket's storage dtype for half
+    buckets, fp32 otherwise)."""
+    if value is None:
+        return None
+    dt = jnp.dtype(value)
+    if dt.name not in _SUPPORTED_SYNC:
+        raise ValueError(
+            f"{knob}={dt.name!r} is not supported: fp8/integer sync needs "
+            "the reference's scaled-quantization machinery (per-bucket "
+            "amax + stochastic rounding) this port does not implement; "
+            f"pass one of {_SUPPORTED_SYNC} or None (per-bucket default: "
+            "the bucket's storage dtype for bf16/fp16 buckets, float32 "
+            "otherwise)")
+    return dt
+
+
+def _spec_dim_axes(entry) -> Tuple[str, ...]:
+    return tuple(ax for ax in (entry if isinstance(entry, tuple) else (entry,))
+                 if ax is not None)
+
+
+def local_leaf_info(params, param_specs, axis_sizes, zero_axis):
+    """Per-leaf LOCAL shard shapes when ``params`` are sharded over
+    model-parallel mesh axes per ``param_specs``, plus the sorted model
+    axes and — per leaf — the replication factor a psum over those axes
+    over-counts it by (1 for fully sharded leaves).  Raises if a param
+    is sharded over the ZeRO axis itself, or if any sharded DIMENSION
+    is indivisible (floor division would silently misalign the flat
+    layout)."""
+    leaves, treedef = jax.tree.flatten(params)
+    spec_leaves = treedef.flatten_up_to(param_specs)
+    used_axes: List[str] = []
+    leaf_axes = []
+    local_shapes = []
+    for leaf, spec in zip(leaves, spec_leaves):
+        shape = list(leaf.shape)
+        axes_here = set()
+        for dim, entry in enumerate(tuple(spec)):
+            dim_axes = _spec_dim_axes(entry)
+            if not dim_axes:
+                continue
+            for ax in dim_axes:
+                if ax == zero_axis:
+                    raise ValueError(
+                        f"params must not be sharded over the ZeRO axis {ax!r}")
+            shard = int(np.prod([axis_sizes[ax] for ax in dim_axes]))
+            # per-DIMENSION check: a divisible total with an indivisible
+            # sharded dim (e.g. (13, 5) split 5-way on dim 0) still
+            # pads/misaligns the flat layout
+            if leaf.shape[dim] % shard != 0:
+                raise ValueError(
+                    f"param dim {dim} of shape {leaf.shape} is not divisible "
+                    f"by mesh axes {dim_axes!r} (total size {shard}); the "
+                    "flat ZeRO layout would silently misalign")
+            shape[dim] //= shard
+            for ax in dim_axes:
+                axes_here.add(ax)
+                if ax not in used_axes:
+                    used_axes.append(ax)
+        leaf_axes.append(axes_here)
+        local_shapes.append(tuple(shape))
+    model_axes = tuple(sorted(used_axes))
+    repl = [
+        int(np.prod([axis_sizes[ax] for ax in model_axes if ax not in s]
+                    or [1]))
+        for s in leaf_axes
+    ]
+    return local_shapes, model_axes, repl
+
+
+def _leaf_shard_np(leaf, spec, combo: Dict[str, int], axis_sizes):
+    """The numpy block of ``leaf`` that mesh-rank ``combo`` holds under
+    ``spec`` — jax shards each dim into row-major blocks, multi-axis
+    dims major-to-minor left to right, which this mirrors exactly."""
+    x = np.asarray(leaf)
+    for dim, entry in enumerate(tuple(spec)):
+        dim_axes = _spec_dim_axes(entry)
+        if not dim_axes:
+            continue
+        n_shards = int(np.prod([axis_sizes[ax] for ax in dim_axes]))
+        size = x.shape[dim] // n_shards
+        idx = 0
+        for ax in dim_axes:
+            idx = idx * axis_sizes[ax] + combo[ax]
+        x = np.take(x, range(idx * size, (idx + 1) * size), axis=dim)
+    return x
+
+
+class ZeroOptimizerBase:
+    """Shared constructor plumbing + the bucket-shard machinery for the
+    ZeRO optimizers.  Subclasses implement ``_shard_update`` (the
+    per-shard math, reusing the per-leaf oracle's expression trees) and
+    their state NamedTuple."""
+
+    #: ``update_scaled`` covers the full step: the gpt step builders
+    #: fold unscale/clip/finite-vote into the sharded grad read.
+    supports_update_scaled = True
+
+    def __init__(
+        self,
+        lr: float,
+        weight_decay: float,
+        axis_name: str = DATA_AXIS,
+        grad_average: bool = True,
+        overlap_grad_sync: bool = True,
+        overlap_param_sync: bool = False,
+        bucket_cap_mb: float = 100.0,
+        grad_sync_dtype=None,
+        param_sync_dtype=None,
+        store_param_remainders: bool = False,
+        dtype=jnp.float32,
+        process_group=None,
+        distributed_process_group=None,
+        redundant_process_group=None,
+    ):
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.axis_name = axis_name
+        self.grad_average = grad_average
+        # per-bucket collectives are independently schedulable by
+        # construction — overlap_grad_sync is the reference's knob for
+        # its side-stream engine and is structural here (recorded for
+        # parity).  overlap_param_sync is real: True gathers the
+        # PRE-commit update so the all-gather is not serialized behind
+        # the finite vote (per-leaf predicated select afterwards).
+        self.overlap_grad_sync = overlap_grad_sync
+        self.overlap_param_sync = overlap_param_sync
+        if bucket_cap_mb is not None and bucket_cap_mb <= 0:
+            raise ValueError(f"bucket_cap_mb must be positive, got {bucket_cap_mb}")
+        self.bucket_cap_mb = bucket_cap_mb
+        self._cap_bytes = (None if bucket_cap_mb is None
+                           else int(bucket_cap_mb * 2 ** 20))
+        self.grad_sync_dtype = resolve_sync_dtype(grad_sync_dtype,
+                                                  "grad_sync_dtype")
+        self.param_sync_dtype = resolve_sync_dtype(param_sync_dtype,
+                                                   "param_sync_dtype")
+        # halve master-weight memory for bf16 params: store only the 16
+        # mantissa bits the bf16 param is missing (reference
+        # ``store_param_remainders``); param sync gathers bf16
+        self.store_param_remainders = store_param_remainders
+        if store_param_remainders and self.param_sync_dtype not in (
+                None, jnp.dtype(jnp.bfloat16)):
+            raise ValueError(
+                "store_param_remainders gathers the master's bf16 high "
+                "half; param_sync_dtype must be None or bfloat16, got "
+                f"{self.param_sync_dtype.name!r}")
+
+    # ------------------------------------------------------------- plan
+    def _plan_of_local(self, params) -> bucketing.BucketPlan:
+        """The plan over the LOCAL (model-sharded) param leaves — inside
+        shard_map the traced leaves already have local shapes, so this
+        is the same cached object ``init`` built."""
+        world = getattr(self, "_world", None)
+        if world is None:
+            raise ValueError("call init() before update: the bucket plan "
+                             "and dp shard layout live on the optimizer")
+        return bucketing.plan_of(params, cap_bytes=self._cap_bytes,
+                                 shard_pad=world)
+
+    def _grad_dtype(self, bucket) -> jnp.dtype:
+        if self.grad_sync_dtype is not None:
+            return self.grad_sync_dtype
+        dt = jnp.dtype(bucket.dtype)
+        return dt if dt in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)) \
+            else jnp.dtype(jnp.float32)
+
+    def _param_dtype(self, bucket) -> jnp.dtype:
+        if self.param_sync_dtype is not None:
+            return self.param_sync_dtype
+        return jnp.dtype(bucket.dtype)
+
+    # ------------------------------------------------------------- init
+    def _init_plan(self, params, world_size, param_specs, axis_sizes):
+        if world_size is None:
+            raise ValueError("pass world_size= (the dp axis size)")
+        self._world = int(world_size)
+        if param_specs is not None:
+            if axis_sizes is None:
+                raise ValueError("param_specs requires axis_sizes")
+            local_shapes, self._model_axes, self._leaf_repl = \
+                local_leaf_info(params, param_specs, axis_sizes,
+                                self.axis_name)
+        else:
+            local_shapes = [tuple(l.shape) for l in jax.tree.leaves(params)]
+            self._model_axes, self._leaf_repl = (), None
+        self._axis_sizes = dict(axis_sizes or {})
+        self._model_mult = int(np.prod(
+            [self._axis_sizes[ax] for ax in self._model_axes] or [1]))
+        leaves, treedef = jax.tree.flatten(params)
+        if self._leaf_repl is None:
+            self._leaf_repl = [1] * len(leaves)
+        if self.store_param_remainders:
+            bad = [l.dtype for l in leaves if l.dtype != jnp.bfloat16]
+            if bad:
+                raise ValueError(
+                    f"store_param_remainders requires bf16 params (got "
+                    f"{bad[:3]}): the master's high 16 bits must BE the "
+                    "param")
+        self._plan = bucketing.plan_of_shapes(
+            treedef,
+            [(s, jnp.dtype(l.dtype).name) for s, l in zip(local_shapes, leaves)],
+            cap_bytes=self._cap_bytes, shard_pad=self._world)
+        self._param_spec_leaves = (
+            treedef.flatten_up_to(param_specs) if param_specs is not None
+            else None)
+        return self._plan
+
+    def _zero_slot(self, dtype=jnp.float32) -> Tuple[jnp.ndarray, ...]:
+        """One zeroed state slot: a flat (model_mult · bucket_total,)
+        array per bucket, to be sharded over (model axes…, dp)."""
+        return tuple(jnp.zeros((self._model_mult * b.total,), dtype)
+                     for b in self._plan.buckets)
+
+    def _master_slot(self, params) -> Tuple[jnp.ndarray, ...]:
+        """The resident master: fp32 pack of every mesh rank's local
+        leaf shards, model-major per bucket (the layout
+        ``P((*model_axes, dp))`` slices back into exactly each rank's
+        shard), or zeroed uint16 remainders (zero remainder ≡ the fp32
+        extension of the bf16 param — no lazy init needed)."""
+        if self.store_param_remainders:
+            return self._zero_slot(jnp.uint16)
+        plan = self._plan
+        leaves = jax.tree.leaves(params)
+        if self._param_spec_leaves is None:
+            return tuple(jnp.asarray(a) for a in
+                         bucketing.pack(plan, params, dtype=jnp.float32))
+        combos = [dict(zip(self._model_axes, c)) for c in np.ndindex(
+            *[self._axis_sizes[ax] for ax in self._model_axes])] or [{}]
+        out = []
+        for b in plan.buckets:
+            segs = []
+            for cmap in combos:
+                parts = [
+                    _leaf_shard_np(leaves[bl.leaf_id],
+                                   self._param_spec_leaves[bl.leaf_id],
+                                   cmap, self._axis_sizes)
+                    .astype(np.float32).reshape(-1)
+                    for bl in b.leaves
+                ]
+                seg = np.concatenate(parts) if parts else np.zeros(0, np.float32)
+                segs.append(np.pad(seg, (0, b.total - seg.size)))
+            out.append(jnp.asarray(np.concatenate(segs)))
+        return tuple(out)
+
+    def _flat_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        axes = getattr(self, "_model_axes", ())
+        flat = P((*axes, self.axis_name)) if axes else P(self.axis_name)
+        return tuple(flat for _ in self._require_plan().buckets)
+
+    def _require_plan(self) -> bucketing.BucketPlan:
+        plan = getattr(self, "_plan", None)
+        if plan is None:
+            raise ValueError("call init() first: the shard layout (bucket "
+                             "plan / total_numel) lives on the optimizer")
+        return plan
+
+    def state_partition_spec(self):
+        """The shard_map / pjit PartitionSpec tree for the state: each
+        bucket's flat array sharded jointly over (model axes…, dp) —
+        model-major, matching the layout ``init`` builds."""
+        from jax.sharding import PartitionSpec as P
+
+        flat = self._flat_spec()
+        fields = {"step": P()}
+        for f in [f for f in self._STATE_CLS._fields if f != "step"]:
+            fields[f] = flat
+        return self._STATE_CLS(**fields)
+
+    # ---------------------------------------------------------- prepare
+    def _check_state_shards(self, plan, slot, world, name):
+        if len(slot) != len(plan.buckets):
+            raise ValueError(
+                f"optimizer state has {len(slot)} {name} buckets but the "
+                f"param tree plans {len(plan.buckets)} (bucket_cap_mb or "
+                "the param tree changed since this state was created — "
+                "reshard it with load_sharded_state_dicts)")
+        for arr, b in zip(slot, plan.buckets):
+            if arr.shape[0] != b.total // world:
+                raise ValueError(
+                    f"{name} bucket shard has {arr.shape[0]} elements; the "
+                    f"plan expects {b.total // world} (= {b.total}/dp={world})"
+                    " — state saved at a different dp world size must be "
+                    "resharded with load_sharded_state_dicts")
+
+    def _check_master_precision(self, master_slot):
+        """A state restored from a checkpoint saved in the OTHER master
+        precision must fail with this message at trace time, never a
+        shape/NoneType crash deep in the math: the bit patterns cannot
+        be value-converted silently (uint16 remainders are mantissa
+        bits, not numbers)."""
+        want = jnp.dtype(jnp.uint16 if self.store_param_remainders
+                         else jnp.float32)
+        for arr in master_slot:
+            if arr.dtype != want:
+                have_kind = ("remainder_u16" if arr.dtype == jnp.uint16
+                             else str(arr.dtype))
+                raise ValueError(
+                    f"master-precision mismatch: optimizer state holds "
+                    f"{have_kind} master shards but this optimizer runs "
+                    f"with store_param_remainders="
+                    f"{self.store_param_remainders} (expects {want.name}); "
+                    "a checkpoint saved in the other master precision "
+                    "cannot be value-converted silently — construct the "
+                    "optimizer with the matching store_param_remainders")
+
+    def _pack_bucket(self, leaves, bucket, dtype, scale=None):
+        """One bucket's concat in ``dtype`` (the grad read / the bf16
+        param read of remainder mode) — per-BUCKET and in the sync
+        dtype, never a whole-tree fp32 flatten."""
+        parts = [jnp.ravel(leaves[bl.leaf_id]).astype(dtype)
+                 for bl in bucket.leaves]
+        arr = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        if scale is not None:
+            arr = arr * jnp.asarray(scale, dtype)
+        if bucket.pad:
+            arr = jnp.pad(arr, (0, bucket.pad))
+        return arr
+
+    def _prepare_grads(self, plan, grads, scale, clip_norm, finite_sync,
+                       want_finite, grads_finite, sumsq_reduce):
+        """The sharded grad read: per-bucket reduce-scatter in
+        ``grad_sync_dtype`` (grad-average pre-division folded in — the
+        reference's predivide, overflow-safe for large worlds), fp32
+        unscale on the 1/dp shard, the all-finite vote, and the
+        global-l2 clip with per-leaf Σx² recovered from the shards via
+        the plan's static segment map.  Returns
+        ``(g32_shards, pred, rank, world)``."""
+        ax = self.axis_name
+        world = jax.lax.axis_size(ax)
+        rank = jax.lax.axis_index(ax)
+        leaves = jax.tree.leaves(grads)
+        if len(leaves) != plan.n_leaves:
+            raise ValueError(f"grad tree has {len(leaves)} leaves; plan "
+                             f"expects {plan.n_leaves}")
+        g_shards = []
+        for b in plan.buckets:
+            sdt = self._grad_dtype(b)
+            # fp16 sync pre-divides (the reference's predivide: the
+            # world-sized sum would overflow fp16's range); fp32/bf16
+            # sync post-divides in fp32 — same association the
+            # replicated path's psum-then-pmean takes, so ZeRO vs
+            # replicated trajectories agree to the grad's own rounding
+            predivide = (self.grad_average
+                         and sdt == jnp.dtype(jnp.float16))
+            bucket = self._pack_bucket(
+                leaves, b, sdt, scale=(1.0 / world) if predivide else None)
+            # ZeRO grad sync: each rank owns 1/dp of the dp-SUM — the
+            # one collective read of this bucket's gradient
+            g_loc = jax.lax.psum_scatter(bucket, ax, scatter_dimension=0,
+                                         tiled=True)
+            g32 = g_loc.astype(jnp.float32)
+            if self.grad_average and not predivide:
+                g32 = g32 / world
+            if scale is not None:
+                # loss-scale unscale AFTER the sync, in fp32: half-dtype
+                # wires carry the scaled grads (no underflow), the math
+                # sees unscaled fp32
+                g32 = g32 * (1.0 / scale)
+            g_shards.append(g32)
+
+        pred = grads_finite
+        if want_finite:
+            from apex_tpu.amp.scaler import all_finite
+
+            finite = all_finite(list(g_shards))
+            if finite_sync is not None:
+                # the caller's vote MUST include the ZeRO axis: shards
+                # are dp-disjoint, so ranks can disagree (the gpt step
+                # builders append dp to sync_axes for ZeRO optimizers)
+                finite = finite_sync(finite)
+            else:
+                finite = jax.lax.pmin(finite.astype(jnp.int32),
+                                      ax).astype(jnp.bool_)
+            pred = finite
+
+        if clip_norm is not None:
+            from apex_tpu.optimizers.base import _clip_coef
+
+            leaf_sq = self._per_leaf_sumsq(plan, g_shards, rank, world)
+            leaf_sq = jax.lax.psum(leaf_sq, ax)  # assemble dp-disjoint shards
+            total_sq = (sumsq_reduce([leaf_sq[i] for i in range(plan.n_leaves)])
+                        if sumsq_reduce is not None else jnp.sum(leaf_sq))
+            # ONE clip expression (torch semantics) with the replicated
+            # engine — the two trajectories must not drift
+            coef = _clip_coef(jnp.sqrt(total_sq), clip_norm)
+            g_shards = [g * coef for g in g_shards]
+        return g_shards, pred, rank, world
+
+    def _per_leaf_sumsq(self, plan, shards, rank, world):
+        """Per-ORIGINAL-leaf Σx² of per-bucket 1/dp shards, via the
+        static segment map sliced to this rank's window (a dp shard
+        does not align to leaf boundaries) — LOCAL partial sums; psum
+        over dp (and model axes, per caller semantics) completes them."""
+        out = jnp.zeros((plan.n_leaves,), jnp.float32)
+        for bi, b in enumerate(plan.buckets):
+            ids = jnp.asarray(bucketing.seg_ids(plan, b))
+            shard = b.total // world
+            ids_loc = jax.lax.dynamic_slice_in_dim(ids, rank * shard, shard)
+            out = out + jax.ops.segment_sum(
+                jnp.square(shards[bi]), ids_loc,
+                num_segments=plan.n_leaves + 1)[:plan.n_leaves]
+        return out
+
+    def _owned_param_shards(self, plan, params, rank, world):
+        """The rank's bf16 param shard per bucket (remainder mode's
+        master reconstruction input): per-BUCKET bf16 concat + dynamic
+        slice — bf16 traffic only, no fp32 up-cast."""
+        leaves = jax.tree.leaves(params)
+        out = []
+        for b in plan.buckets:
+            bucket = self._pack_bucket(leaves, b, jnp.bfloat16)
+            shard = b.total // world
+            out.append(jax.lax.dynamic_slice_in_dim(bucket, rank * shard,
+                                                    shard))
+        return out
+
+    # ------------------------------------------------------------- emit
+    def _emit_params(self, plan, shard_out, params, pred):
+        """ZeRO param sync: one ``all_gather`` per bucket in
+        ``param_sync_dtype``, sliced back into the leaf tree through the
+        plan's offset table (static slices — never a whole-tree
+        concat/flatten).
+
+        ``shard_out`` is the UNCOMMITTED updated shard per bucket when
+        ``overlap_param_sync`` (the gather starts without waiting for
+        the finite vote; ``pred`` then selects per leaf against the old
+        params), else the committed shard (``pred`` None here)."""
+        ax = self.axis_name
+        leaves = jax.tree.leaves(params)
+        new_leaves: List[Optional[jnp.ndarray]] = [None] * plan.n_leaves
+        for bi, b in enumerate(plan.buckets):
+            full = jax.lax.all_gather(
+                shard_out[bi].astype(self._param_dtype(b)), ax, axis=0,
+                tiled=True)
+            for bl in b.leaves:
+                leaf = jax.lax.slice(
+                    full, (bl.offset,), (bl.offset + bl.size,)
+                ).reshape(bl.shape).astype(leaves[bl.leaf_id].dtype)
+                if pred is not None:
+                    leaf = jnp.where(jnp.asarray(pred), leaf,
+                                     leaves[bl.leaf_id])
+                new_leaves[bl.leaf_id] = leaf
+        return jax.tree.unflatten(plan.treedef, new_leaves)
+
+    @staticmethod
+    def _select(pred, new, old):
+        if pred is None:
+            return list(new)
+        p = jnp.asarray(pred)
+        return [jnp.where(p, n, o) for n, o in zip(new, old)]
+
+    def _bias_corrections(self, step):
+        return bias_corrections(step, self.bias_correction,
+                                self.beta1, self.beta2)
+
+    # ------------------------------------------------------- public API
+    def update(self, grads, state, params, grads_finite=None, lr=None,
+               clip_norm=None, sumsq_reduce=None):
+        """One ZeRO step inside shard_map.  ``grads`` are this rank's
+        LOCAL grads (the optimizer's reduce-scatter IS the dp gradient
+        sync); ``grads_finite`` (already agreed across every axis)
+        predicates the commit; ``clip_norm`` folds a global-l2 clip
+        (torch semantics) into the sharded grad read with
+        ``sumsq_reduce`` supplying the model-axes Σx² agreement."""
+        p, s, _ = self._zero_step(grads, state, params,
+                                  grads_finite=grads_finite, lr=lr,
+                                  clip_norm=clip_norm,
+                                  sumsq_reduce=sumsq_reduce,
+                                  want_finite=False)
+        return p, s
+
+    def update_scaled(self, grads, state, params, scale=None,
+                      clip_norm=None, finite_sync=None, lr=None,
+                      sumsq_reduce=None):
+        """The fused amp step on the sharded grad read: per-bucket
+        reduce-scatter, fp32 unscale of the 1/dp shard, the all-finite
+        vote (``finite_sync`` must agree it over the model axes AND
+        dp), optional global-l2 clip, predicated commit.  Returns
+        ``(new_params, new_state, all_finite)``."""
+        return self._zero_step(grads, state, params, scale=scale,
+                               clip_norm=clip_norm, finite_sync=finite_sync,
+                               lr=lr, sumsq_reduce=sumsq_reduce,
+                               want_finite=True)
+
+    def step(self, grads, state, params, **kw):
+        return self.update(grads, state, params, **kw)
+
+    def _zero_step(self, grads, state, params, grads_finite=None, lr=None,
+                   scale=None, clip_norm=None, finite_sync=None,
+                   sumsq_reduce=None, want_finite=False):
+        raise NotImplementedError  # pragma: no cover - abstract
+
+    # ----------------------------------------------------- state dicts
+    SHARD_FORMAT = "apex_tpu_zero2_v2"
+
+    @property
+    def _master_kind(self) -> str:
+        return "remainder_u16" if self.store_param_remainders else "fp32"
+
+    def _check_master_kind(self, d):
+        """A store_param_remainders mismatch between save and load would
+        value-convert master bit patterns silently — refuse instead."""
+        kind = d.get("master_kind")
+        if kind is None:  # pre-remainder checkpoints were always fp32
+            kind = "fp32"
+        if kind != self._master_kind:
+            raise ValueError(
+                f"checkpoint master_kind {kind!r} does not match this "
+                f"optimizer's ({self._master_kind!r}): set "
+                f"store_param_remainders={kind == 'remainder_u16'}")
+
+    def _bucket_meta(self):
+        plan = self._require_plan()
+        return [{"dtype": b.dtype, "size": b.size, "total": b.total}
+                for b in plan.buckets]
+
+    def _state_arrays(self, state) -> Dict[str, Sequence]:
+        """name -> per-bucket arrays, in the subclass's field order."""
+        return {f: getattr(state, f) for f in state._fields if f != "step"}
+
+    def state_dict(self, state):
+        """Whole-state dict (the reference's ``gather_on_root=True``
+        mode, distributed_fused_adam.py:2527).  For the per-rank
+        protocol use :meth:`sharded_state_dict`."""
+        d = {
+            "format": self.SHARD_FORMAT,
+            "step": int(state.step),
+            "master_kind": self._master_kind,
+            "buckets": self._bucket_meta(),
+        }
+        for name, slot in self._state_arrays(state).items():
+            d[name] = [np.asarray(a) for a in slot]
+        return d
+
+    #: the state NamedTuple class (subclasses set it)
+    _STATE_CLS = None
+
+    def load_state_dict(self, d):
+        fmt = d.get("format")
+        fmt = np.asarray(fmt).item() if isinstance(fmt, np.ndarray) else fmt
+        if fmt != self.SHARD_FORMAT:
+            # a pre-bucket (v1 flat-array) dict would otherwise iterate
+            # its flat slot into thousands of 0-d scalars and fail later
+            # with a misleading bucket-layout error
+            raise ValueError(
+                f"unrecognized state_dict format {fmt!r}: this optimizer "
+                f"reads {self.SHARD_FORMAT} (per-bucket arrays); "
+                "pre-bucket-plan (flat v1) checkpoints cannot be loaded")
+        self._check_master_kind(d)
+        fields = {"step": jnp.int32(d["step"])}
+        for f in [f for f in self._STATE_CLS._fields if f != "step"]:
+            fields[f] = tuple(jnp.asarray(a) for a in d[f])
+        return self._STATE_CLS(**fields)
+
+    def sharded_state_dict(self, state, rank: int, world_size: int):
+        """Per-rank shard of the state + the layout metadata needed to
+        reshard on load (reference ``state_dict(gather_on_root=False)``,
+        distributed_fused_adam.py:2527; redistribution :2959).  Each
+        bucket's piece is ``(model_mult, shard)`` — the model segments
+        kept separate so a dp=4 save reshard-loads at dp=2 without
+        scrambling the model-major layout."""
+        plan = self._require_plan()
+        if world_size != self._world:
+            raise ValueError(
+                f"state was built for dp={self._world}; sharded_state_dict "
+                f"slices that layout (got world_size={world_size})")
+        d = {
+            "format": self.SHARD_FORMAT,
+            "master_kind": self._master_kind,
+            "rank": int(rank),
+            "world_size": int(world_size),
+            "model_mult": self._model_mult,
+            "step": int(state.step),
+            "buckets": self._bucket_meta(),
+            "total_numel": int(sum(b.size for b in plan.buckets)),
+        }
+        for name, slot in self._state_arrays(state).items():
+            pieces = []
+            for arr, b in zip(slot, plan.buckets):
+                shard = b.total // world_size
+                a = np.asarray(arr).reshape(self._model_mult, b.total)
+                pieces.append(a[:, rank * shard:(rank + 1) * shard].copy())
+            d[name] = pieces
+        return d
+
+    @classmethod
+    def load_sharded_state_dicts(cls, shards, world_size: int,
+                                 store_param_remainders: Optional[bool] = None):
+        """Reassemble a full state from per-rank shard dicts and reshard
+        it for ``world_size`` ranks (which may differ from the saved
+        world — save at dp=4, load at dp=2): per bucket and per model
+        segment, concat the saved dp slices, trim to the payload, and
+        re-pad with the plan's own formula
+        (:func:`bucketing.padded_total`) for the new world."""
+        def _py(v):
+            """io round-trips scalars/strings as 0-d numpy arrays —
+            coerce metadata back to python before comparisons."""
+            v = np.asarray(v).item() if isinstance(v, np.ndarray) else v
+            return v
+
+        skip = set(cls._STATE_CLS._fields) | {"buckets"}
+        shards = [{k: _py(v) if k not in skip else v
+                   for k, v in d.items()} for d in shards]
+        for d in shards:
+            d["buckets"] = [{k: _py(v) for k, v in bm.items()}
+                            for bm in d["buckets"]]
+        shards = sorted(shards, key=lambda d: d["rank"])
+        if not shards:
+            raise ValueError("no shards given")
+        meta = shards[0]
+        if meta.get("format") != cls.SHARD_FORMAT:
+            raise ValueError(
+                f"unrecognized shard format {meta.get('format')!r} (pre-"
+                f"bucket-plan checkpoints cannot be resharded by this "
+                "version)")
+        saved_world = meta["world_size"]
+        if [d["rank"] for d in shards] != list(range(saved_world)):
+            raise ValueError(
+                f"incomplete shard set: got ranks {[d['rank'] for d in shards]}, "
+                f"saved world size is {saved_world}")
+        for d in shards:
+            for key in ("model_mult", "total_numel", "step", "world_size"):
+                if d[key] != meta[key]:
+                    raise ValueError(f"shard {d['rank']} disagrees on {key}")
+            if d.get("master_kind", "fp32") != meta.get("master_kind", "fp32"):
+                raise ValueError(f"shard {d['rank']} disagrees on master_kind")
+        if store_param_remainders is not None:
+            want = "remainder_u16" if store_param_remainders else "fp32"
+            got = meta.get("master_kind", "fp32")
+            if got != want:
+                raise ValueError(
+                    f"checkpoint master_kind {got!r} does not match "
+                    f"store_param_remainders={store_param_remainders}")
+
+        mm = meta["model_mult"]
+        buckets = meta["buckets"]
+        fields = {"step": jnp.int32(meta["step"])}
+        state_cls = cls._STATE_CLS
+        for name in [f for f in state_cls._fields if f != "step"]:
+            out = []
+            for bi, bm in enumerate(buckets):
+                # (model_mult, saved_total) from the saved dp slices
+                full = np.concatenate([d[name][bi] for d in shards], axis=1)
+                payload = full[:, :bm["size"]]
+                new_total = bucketing.padded_total(
+                    bm["size"], bm["dtype"], world_size)
+                padded = np.zeros((mm, new_total), payload.dtype)
+                padded[:, :bm["size"]] = payload
+                out.append(jnp.asarray(padded.reshape(-1)))
+            fields[name] = tuple(out)
+        return state_cls(**fields)

@@ -1,28 +1,36 @@
-"""DistributedFusedAdam — ZeRO-2 optimizer-state sharding over ``dp``.
+"""DistributedFusedAdam — ZeRO optimizer-state sharding over ``dp`` on
+the resident bucket plan.
 
 Reference: ``apex/contrib/optimizers/distributed_fused_adam.py:266``
-(3,078 LoC): params flattened into fixed-size buckets; optimizer state
-sharded over the process grid; reduce-scatter grad sync overlapped with
-backward; all-gather param sync optionally overlapped with forward
-(``ParameterFragment``/``StateBucket`` dataclasses :370-504, ``step``
-:2158).
+(3,078 LoC): params flattened into fixed-size buckets
+(``ParameterFragment``/``StateBucket`` :370-504), optimizer state
+sharded over the process grid, reduce-scatter grad sync overlapped with
+backward, all-gather param sync optionally overlapped with forward
+(``step`` :2158).
 
-TPU-native collapse of that machinery:
+This port runs on :mod:`apex_tpu.contrib.optimizers._zero_engine`:
 
-- the *bucketing* (fixed-size flat buffers, fragment maps) exists to
-  batch NCCL calls and kernel launches; XLA needs neither — one
-  ``psum_scatter`` on the concatenated grads and one ``all_gather`` on
-  the updated flat params, with overlap scheduled by the compiler;
-- the *sharding grid* (distributed_process_group × redundant_process_
-  group) is the ``dp`` mesh axis (a redundant axis would map to a
-  second mesh axis with ``psum`` — multi-slice DCN deployments);
-- optimizer state (m, v, fp32 master) lives ONLY for the local 1/dp
-  shard — the ZeRO-2 memory saving;
-- Adam math is exactly :class:`apex_tpu.optimizers.FusedAdam`'s
-  (AdamFunctor numerics), applied to the local shard, step predicated on
-  the synced finite flag.
+- optimizer state (m, v, fp32-master-or-remainder) lives permanently as
+  the local 1/dp shard of each dtype bucket — the ZeRO memory saving,
+  with no per-step tree flatten and no fp32 up-cast of bf16 traffic;
+- grads are reduce-scattered **per bucket** in ``grad_sync_dtype``
+  (storage dtype for half buckets by default) so XLA's latency-hiding
+  scheduler can overlap each bucket's collective with the remaining
+  backward; ``bucket_cap_mb`` splits oversized dtype buckets into
+  collective-sized chunks;
+- updated param shards are all-gathered per bucket in
+  ``param_sync_dtype``; ``overlap_param_sync`` gathers the pre-commit
+  update so the gather is not serialized behind the finite vote;
+- the Adam math on each shard is exactly
+  :func:`apex_tpu.optimizers.fused_adam.adam_math` — the per-leaf
+  :class:`~apex_tpu.optimizers.FusedAdam` is the numerics oracle and
+  the fp32 trajectories are bit-exact (``tests/
+  test_distributed_optimizers.py`` pins it).
 
-Use inside ``shard_map`` with params replicated over ``dp``.
+Use inside ``shard_map`` with params replicated over ``dp`` (the *sharding
+grid* of the reference — distributed_process_group × redundant_process_
+group — is the ``dp`` mesh axis; a redundant axis would map to a second
+mesh axis in multi-slice DCN deployments).
 """
 
 from typing import NamedTuple, Optional, Tuple
@@ -31,16 +39,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.contrib.optimizers._zero_engine import (
+    ZeroOptimizerBase,
+    local_leaf_info,
+)
+from apex_tpu.optimizers.base import predicate_step
+from apex_tpu.optimizers.fused_adam import adam_math
 from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedAdamState",
+           "local_total_and_axes"]
 
 
 class DistributedFusedAdamState(NamedTuple):
     step: jnp.ndarray
-    exp_avg: jnp.ndarray  # (local_shard,) fp32
-    exp_avg_sq: jnp.ndarray  # (local_shard,) fp32
+    exp_avg: Tuple[jnp.ndarray, ...]      # per-bucket fp32 dp shards
+    exp_avg_sq: Tuple[jnp.ndarray, ...]   # per-bucket fp32 dp shards
     # fp32 master of owned params — or, with store_param_remainders, the
-    # low 16 bits (uint16) the bf16 param is missing
-    master_shard: jnp.ndarray
+    # low 16 bits (uint16) the bf16 param is missing — per bucket
+    master_shard: Tuple[jnp.ndarray, ...]
 
 
 def _master_from_remainder(p_f32, rem_u16):
@@ -72,80 +89,22 @@ def _split_master(master_f32):
     return p_bf16, rem
 
 
-def _flatten(tree):
-    leaves = jax.tree.leaves(tree)
-    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
-    return flat
-
-
 def local_total_and_axes(params, param_specs, axis_sizes, zero_axis):
-    """(local_total_numel, model_axes, leaf_repl): per-device param
-    count when ``params`` are sharded over model-parallel mesh axes per
-    ``param_specs``, the sorted tuple of those axes, and — per leaf —
-    the replication factor a psum over ``model_axes`` over-counts it by
-    (1 for fully sharded leaves).  Raises if any param is sharded over
-    the ZeRO axis itself."""
-    total = 0
-    used_axes = []
-    leaves, treedef = jax.tree.flatten(params)
-    spec_leaves = treedef.flatten_up_to(param_specs)
-    leaf_axes = []
-    for leaf, spec in zip(leaves, spec_leaves):
-        n = int(np.prod(leaf.shape))
-        axes_here = set()
-        for dim, entry in enumerate(tuple(spec)):
-            dim_axes = tuple(
-                ax for ax in (entry if isinstance(entry, tuple) else (entry,))
-                if ax is not None
-            )
-            if not dim_axes:
-                continue
-            for ax in dim_axes:
-                if ax == zero_axis:
-                    raise ValueError(
-                        f"params must not be sharded over the ZeRO axis {ax!r}"
-                    )
-            shard = int(np.prod([axis_sizes[ax] for ax in dim_axes]))
-            # the check must be per-DIMENSION: a divisible total with an
-            # indivisible sharded dim (e.g. (13, 5) split 5-way on dim 0)
-            # still pads/misaligns the flat layout
-            if leaf.shape[dim] % shard != 0:
-                raise ValueError(
-                    f"param dim {dim} of shape {leaf.shape} is not divisible "
-                    f"by mesh axes {dim_axes!r} (total size {shard}); the "
-                    "flat ZeRO layout would silently misalign"
-                )
-            n //= shard
-            for ax in dim_axes:
-                axes_here.add(ax)
-                if ax not in used_axes:
-                    used_axes.append(ax)
-        leaf_axes.append(axes_here)
-        total += n
-    model_axes = tuple(sorted(used_axes))
-    # replication factor per leaf: a psum over model_axes counts a leaf
-    # replicated over an axis once PER rank of that axis — norm math
-    # must divide its contribution back out
-    repl = [
-        int(np.prod([axis_sizes[ax] for ax in model_axes if ax not in s] or [1]))
-        for s in leaf_axes
-    ]
+    """(local_total_numel, model_axes, leaf_repl) — the flat summary of
+    :func:`~apex_tpu.contrib.optimizers._zero_engine.local_leaf_info`,
+    kept for callers that only need sizes (DistributedFusedLAMB's old
+    API, tests)."""
+    shapes, model_axes, repl = local_leaf_info(
+        params, param_specs, axis_sizes, zero_axis)
+    total = sum(int(np.prod(s)) if s else 1 for s in shapes)
     return total, model_axes, repl
 
 
-def _unflatten_into(tree, flat):
-    leaves, treedef = jax.tree.flatten(tree)
-    out = []
-    off = 0
-    for l in leaves:
-        n = int(np.prod(l.shape))
-        out.append(flat[off : off + n].reshape(l.shape).astype(l.dtype))
-        off += n
-    return jax.tree.unflatten(treedef, out)
+class DistributedFusedAdam(ZeroOptimizerBase):
+    """ZeRO AdamW with the reference's constructor vocabulary, on the
+    resident sharded bucket engine."""
 
-
-class DistributedFusedAdam:
-    """ZeRO-2 AdamW with the reference's constructor vocabulary."""
+    _STATE_CLS = DistributedFusedAdamState
 
     def __init__(
         self,
@@ -157,7 +116,6 @@ class DistributedFusedAdam:
         weight_decay: float = 0.0,
         axis_name: str = DATA_AXIS,
         grad_average: bool = True,
-        # accepted-for-parity knobs (overlap is XLA's):
         overlap_grad_sync: bool = True,
         overlap_param_sync: bool = False,
         bucket_cap_mb: float = 100.0,
@@ -169,299 +127,108 @@ class DistributedFusedAdam:
         redundant_process_group=None,
         store_param_remainders: bool = False,
     ):
-        self.lr = lr
+        super().__init__(
+            lr, weight_decay, axis_name=axis_name, grad_average=grad_average,
+            overlap_grad_sync=overlap_grad_sync,
+            overlap_param_sync=overlap_param_sync,
+            bucket_cap_mb=bucket_cap_mb, grad_sync_dtype=grad_sync_dtype,
+            param_sync_dtype=param_sync_dtype,
+            store_param_remainders=store_param_remainders, dtype=dtype,
+            process_group=process_group,
+            distributed_process_group=distributed_process_group,
+            redundant_process_group=redundant_process_group,
+        )
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
         self.adam_w_mode = adam_w_mode
-        self.weight_decay = weight_decay
-        self.axis_name = axis_name
-        self.grad_average = grad_average
-        # halve master-weight memory for bf16 params: store only the 16
-        # mantissa bits the bf16 param is missing (reference
-        # ``store_param_remainders``); param sync also all-gathers bf16
-        # instead of fp32 (half the traffic)
-        self.store_param_remainders = store_param_remainders
 
-    # -------------------------------------------------------------- helpers
-    def _total_and_pad(self, params):
-        total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-        return total
-
+    # -------------------------------------------------------------- init
     def init(self, params, world_size: Optional[int] = None, param_specs=None,
              axis_sizes=None) -> DistributedFusedAdamState:
-        """Build the GLOBAL flat state: arrays of shape (padded_total,),
-        to be sharded over ``dp`` via :meth:`state_partition_spec` so
-        each rank holds its 1/dp shard (the ZeRO memory saving comes
-        from the sharding, stated explicitly rather than via per-device
-        local arrays).  The fp32 master is lazily sliced from params on
-        the first update (step==0).
+        """Build the GLOBAL per-bucket flat state, to be sharded via
+        :meth:`state_partition_spec` so each rank holds its 1/dp shard
+        of every bucket (the ZeRO memory saving stated explicitly
+        through the sharding).  The fp32 master is packed from the
+        params at init (resident — the step never re-flattens params);
+        with ``store_param_remainders`` the zeroed uint16 remainders
+        already reconstruct exactly the fp32 extension of the bf16
+        params.
 
-        **Composition with tensor parallelism**: when ``params`` are
+        **Composition with model parallelism**: when ``params`` are
         themselves sharded over model-parallel mesh axes, pass
-        ``param_specs`` (the PartitionSpec tree used for the params) and
-        ``axis_sizes`` (mapping axis name → mesh size).  The state is
-        then sized for the *local* param shard and additionally sharded
-        over those model axes — each (tp, dp) device holds the dp-shard
-        of the optimizer state for its tp-slice of the params.
-        """
-        if world_size is None:
-            raise ValueError("pass world_size= (the dp axis size)")
-        self._model_axes: Tuple[str, ...] = ()
-        model_mult = 1
-        if param_specs is not None:
-            if axis_sizes is None:
-                raise ValueError("param_specs requires axis_sizes")
-            total, self._model_axes, _ = local_total_and_axes(
-                params, param_specs, axis_sizes, self.axis_name
-            )
-            for ax in self._model_axes:
-                model_mult *= axis_sizes[ax]
-        else:
-            total = self._total_and_pad(params)
-        padded = ((total + world_size - 1) // world_size) * world_size
-        self._total = total
-        self._padded = padded
-        self._world = world_size
-        if self.store_param_remainders:
-            bad = [
-                l.dtype for l in jax.tree.leaves(params) if l.dtype != jnp.bfloat16
-            ]
-            if bad:
-                raise ValueError(
-                    f"store_param_remainders requires bf16 params (got {bad[:3]}): "
-                    "the master's high 16 bits must BE the param"
-                )
-        zeros = jnp.zeros((model_mult * padded,), jnp.float32)
-        master0 = (
-            jnp.zeros((model_mult * padded,), jnp.uint16)
-            if self.store_param_remainders
-            else zeros
-        )
+        ``param_specs`` (their PartitionSpec tree) and ``axis_sizes``
+        (axis name → mesh size).  The plan is then built over the LOCAL
+        leaf shards and the state additionally shards over those axes —
+        each (tp, dp) device holds the dp-shard of the optimizer state
+        for its tp-slice of the params."""
+        self._init_plan(params, world_size, param_specs, axis_sizes)
+        m = self._zero_slot()
+        v = self._zero_slot()
         return DistributedFusedAdamState(
-            step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=zeros, master_shard=master0
-        )
+            step=jnp.int32(0), exp_avg=m, exp_avg_sq=v,
+            master_shard=self._master_slot(params))
 
-    def state_partition_spec(self):
-        """The shard_map / pjit PartitionSpec tree for the state.  With
-        model-parallel composition (``init(param_specs=...)``) the flat
-        axis is sharded jointly over (model axes..., dp) — model-major,
-        matching the layout :meth:`init` builds."""
-        from jax.sharding import PartitionSpec as P
-
-        axes = getattr(self, "_model_axes", ())
-        flat = P((*axes, self.axis_name)) if axes else P(self.axis_name)
-        return DistributedFusedAdamState(
-            step=P(), exp_avg=flat, exp_avg_sq=flat, master_shard=flat,
-        )
-
-    def update(self, grads, state: DistributedFusedAdamState, params, grads_finite=None, lr=None):
-        """One ZeRO-2 step (inside shard_map, params/grads replicated or
-        dp-identical).  Returns (new_params, new_state)."""
+    # -------------------------------------------------------------- step
+    def _zero_step(self, grads, state: DistributedFusedAdamState, params,
+                   grads_finite=None, lr=None, scale=None, clip_norm=None,
+                   finite_sync=None, sumsq_reduce=None, want_finite=False):
         lr = self.lr if lr is None else lr
-        ax = self.axis_name
-        world = jax.lax.axis_size(ax)
-        rank = jax.lax.axis_index(ax)
-        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        wd = self.weight_decay
+        plan = self._plan_of_local(params)
+        self._check_master_precision(state.master_shard)
 
-        flat_g = _flatten(grads)
-        total = flat_g.shape[0]
-        padded = ((total + world - 1) // world * world) if total % world else total
-        if padded != total:
-            flat_g = jnp.pad(flat_g, (0, padded - total))
-        shard = padded // world
-
-        # ZeRO grad sync: reduce-scatter — each rank owns one shard
-        g_local = jax.lax.psum_scatter(flat_g, ax, scatter_dimension=0, tiled=True)
-        if self.grad_average:
-            g_local = g_local / world
-
-        flat_p = _flatten(params)
-        if padded != total:
-            flat_p = jnp.pad(flat_p, (0, padded - total))
-        p_owned = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard, shard)
-        if self.store_param_remainders:
-            # master ≡ (bf16 param bits | stored remainder); zero
-            # remainders (fresh state) reconstruct exactly the fp32
-            # extension of the params — no separate lazy-init needed
-            master = _master_from_remainder(p_owned, state.master_shard)
-        else:
-            # lazily materialize the fp32 master shard from params on step 0
-            master = jnp.where(state.step == 0, p_owned, state.master_shard)
-
-        step = state.step + (
-            jnp.asarray(grads_finite).astype(jnp.int32) if grads_finite is not None else 1
-        )
-        t = step.astype(jnp.float32)
-        if self.bias_correction:
-            bc1 = 1.0 - jnp.power(b1, t)
-            bc2 = 1.0 - jnp.power(b2, t)
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
-
-        g = g_local
-        if not self.adam_w_mode:
-            g = g + wd * master
-        m_new = b1 * state.exp_avg + (1.0 - b1) * g
-        v_new = b2 * state.exp_avg_sq + (1.0 - b2) * g * g
-        update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-        if self.adam_w_mode:
-            update = update + wd * master
-        master_new = master - lr * update
-
-        if grads_finite is not None:
-            pred = jnp.asarray(grads_finite)
-            m_new = jnp.where(pred, m_new, state.exp_avg)
-            v_new = jnp.where(pred, v_new, state.exp_avg_sq)
-            master_new = jnp.where(pred, master_new, master)
+        g_shards, pred, rank, world = self._prepare_grads(
+            plan, grads, scale, clip_norm, finite_sync, want_finite,
+            grads_finite, sumsq_reduce)
+        self._check_state_shards(plan, state.exp_avg, world, "exp_avg")
 
         if self.store_param_remainders:
-            # param = master's high bits (truncation); sync bf16 — half
-            # the all-gather traffic of the fp32 path
-            p_bf16, rem_new = _split_master(master_new)
-            flat_new = jax.lax.all_gather(p_bf16, ax, axis=0, tiled=True)
-            new_params = _unflatten_into(params, flat_new[:total])
+            # master ≡ (bf16 param bits | stored remainder); the bf16
+            # param shard is this rank's slice of the per-bucket bf16
+            # pack — bf16 traffic, no fp32 concat
+            p_owned = self._owned_param_shards(plan, params, rank, world)
+            master = [_master_from_remainder(p.astype(jnp.float32), rem)
+                      for p, rem in zip(p_owned, state.master_shard)]
+        else:
+            master = list(state.master_shard)
+
+        step = predicate_step(pred, state.step)
+        bc1, bc2 = self._bias_corrections(step)
+
+        new_p, new_m, new_v = [], [], []
+        for bi in range(len(plan.buckets)):
+            p_out, m_out, v_out = adam_math(
+                g_shards[bi], master[bi], state.exp_avg[bi],
+                state.exp_avg_sq[bi], wd, lr, bc1, bc2,
+                beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+                adam_w_mode=self.adam_w_mode)
+            new_p.append(p_out)
+            new_m.append(m_out)
+            new_v.append(v_out)
+
+        new_m = self._select(pred, new_m, state.exp_avg)
+        new_v = self._select(pred, new_v, state.exp_avg_sq)
+        master_committed = self._select(pred, new_p, master)
+
+        if self.store_param_remainders:
+            if self.overlap_param_sync and pred is not None:
+                # gather the PRE-commit bf16 halves — the all-gather
+                # need not wait for the finite vote's collectives; the
+                # commit happens per leaf against the old params
+                gather_src = [_split_master(p)[0] for p in new_p]
+                new_params = self._emit_params(plan, gather_src, params, pred)
+            else:
+                gather_src = [_split_master(p)[0] for p in master_committed]
+                new_params = self._emit_params(plan, gather_src, params, None)
+            rem_new = tuple(_split_master(p)[1] for p in master_committed)
             return new_params, DistributedFusedAdamState(
-                step=step, exp_avg=m_new, exp_avg_sq=v_new, master_shard=rem_new
-            )
+                step, tuple(new_m), tuple(new_v), rem_new), pred
 
-        # ZeRO param sync: all-gather the updated shards
-        flat_new = jax.lax.all_gather(master_new, ax, axis=0, tiled=True)
-        new_params = _unflatten_into(params, flat_new[:total])
-
+        if self.overlap_param_sync and pred is not None:
+            new_params = self._emit_params(plan, new_p, params, pred)
+        else:
+            new_params = self._emit_params(plan, master_committed, params,
+                                           None)
         return new_params, DistributedFusedAdamState(
-            step=step, exp_avg=m_new, exp_avg_sq=v_new, master_shard=master_new
-        )
-
-    # ----------------------------------------------------- state dict parity
-    SHARD_FORMAT = "apex_tpu_zero2_v1"
-
-    @property
-    def _master_kind(self) -> str:
-        return "remainder_u16" if self.store_param_remainders else "fp32"
-
-    def _check_master_kind(self, d):
-        """A store_param_remainders mismatch between save and load would
-        value-convert master bit patterns silently — refuse instead."""
-        kind = d.get("master_kind")
-        if kind is None:  # pre-remainder checkpoints were always fp32
-            kind = "fp32"
-        if kind != self._master_kind:
-            raise ValueError(
-                f"checkpoint master_kind {kind!r} does not match this "
-                f"optimizer's ({self._master_kind!r}): set "
-                f"store_param_remainders={kind == 'remainder_u16'}"
-            )
-
-    def state_dict(self, state: DistributedFusedAdamState):
-        """Whole-state dict (the reference's ``gather_on_root=True`` mode,
-        distributed_fused_adam.py:2527).  For the per-rank protocol use
-        :meth:`sharded_state_dict`."""
-        return {
-            "step": int(state.step),
-            "master_kind": self._master_kind,
-            "exp_avg": np.asarray(state.exp_avg),
-            "exp_avg_sq": np.asarray(state.exp_avg_sq),
-            "master_shard": np.asarray(state.master_shard),
-        }
-
-    def load_state_dict(self, d) -> DistributedFusedAdamState:
-        self._check_master_kind(d)
-        return DistributedFusedAdamState(
-            step=jnp.int32(d["step"]),
-            exp_avg=jnp.asarray(d["exp_avg"]),
-            exp_avg_sq=jnp.asarray(d["exp_avg_sq"]),
-            master_shard=jnp.asarray(d["master_shard"]),
-        )
-
-    def sharded_state_dict(self, state: DistributedFusedAdamState, rank: int,
-                           world_size: int, total_numel: Optional[int] = None):
-        """Per-rank shard of the state + the layout metadata needed to
-        reshard on load (reference ``state_dict(gather_on_root=False)``
-        saves each rank's fragments, distributed_fused_adam.py:2527;
-        ``load_state_dict`` redistributes them :2959).
-
-        ``total_numel`` is the UNPADDED parameter count; defaults to the
-        value recorded by :meth:`init`.  It is what lets a checkpoint
-        saved at dp=4 be re-padded for dp=2.
-        """
-        if total_numel is None:
-            total_numel = getattr(self, "_total", None)
-        if total_numel is None:
-            raise ValueError(
-                "pass total_numel= (or call init() first): resharding needs "
-                "the unpadded parameter count"
-            )
-        padded = int(state.exp_avg.shape[0])
-        if padded % world_size:
-            raise ValueError(f"state length {padded} not divisible by world {world_size}")
-        shard = padded // world_size
-        sl = slice(rank * shard, (rank + 1) * shard)
-        return {
-            "format": self.SHARD_FORMAT,
-            "master_kind": self._master_kind,
-            "rank": int(rank),
-            "world_size": int(world_size),
-            "padded_total": padded,
-            "shard_numel": shard,
-            "total_numel": int(total_numel),
-            "step": int(state.step),
-            "exp_avg": np.asarray(state.exp_avg[sl]),
-            "exp_avg_sq": np.asarray(state.exp_avg_sq[sl]),
-            "master_shard": np.asarray(state.master_shard[sl]),
-        }
-
-    @classmethod
-    def load_sharded_state_dicts(cls, shards, world_size: int,
-                                 store_param_remainders: Optional[bool] = None
-                                 ) -> DistributedFusedAdamState:
-        """Reassemble a full state from per-rank shard dicts and reshard
-        it for ``world_size`` ranks (which may differ from the saved
-        world size — save at dp=4, load at dp=2).
-
-        ``shards``: the complete set of shard dicts from one checkpoint,
-        any order.  Returns the global flat state padded for the NEW
-        world size; shard it with :meth:`state_partition_spec` as usual.
-        """
-        shards = sorted(shards, key=lambda d: d["rank"])
-        if not shards:
-            raise ValueError("no shards given")
-        meta = shards[0]
-        if meta.get("format") != cls.SHARD_FORMAT:
-            raise ValueError(f"unrecognized shard format {meta.get('format')!r}")
-        saved_world = meta["world_size"]
-        if [d["rank"] for d in shards] != list(range(saved_world)):
-            raise ValueError(
-                f"incomplete shard set: got ranks {[d['rank'] for d in shards]}, "
-                f"saved world size is {saved_world}"
-            )
-        for d in shards:
-            for key in ("padded_total", "total_numel", "step", "world_size"):
-                if d[key] != meta[key]:
-                    raise ValueError(f"shard {d['rank']} disagrees on {key}")
-            if d.get("master_kind", "fp32") != meta.get("master_kind", "fp32"):
-                raise ValueError(f"shard {d['rank']} disagrees on master_kind")
-        if store_param_remainders is not None:
-            want = "remainder_u16" if store_param_remainders else "fp32"
-            got = meta.get("master_kind", "fp32")
-            if got != want:
-                raise ValueError(
-                    f"checkpoint master_kind {got!r} does not match "
-                    f"store_param_remainders={store_param_remainders}"
-                )
-
-        total = meta["total_numel"]
-        new_padded = ((total + world_size - 1) // world_size) * world_size
-
-        def reassemble(key):
-            full = np.concatenate([d[key] for d in shards])[:total]
-            # dtype preserved: fp32 masters stay fp32, uint16 remainders
-            # (store_param_remainders) stay uint16
-            return jnp.asarray(np.pad(full, (0, new_padded - total)))
-
-        return DistributedFusedAdamState(
-            step=jnp.int32(meta["step"]),
-            exp_avg=reassemble("exp_avg"),
-            exp_avg_sq=reassemble("exp_avg_sq"),
-            master_shard=reassemble("master_shard"),
-        )
+            step, tuple(new_m), tuple(new_v), tuple(master_committed)), pred

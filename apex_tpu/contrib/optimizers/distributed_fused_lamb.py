@@ -1,39 +1,57 @@
-"""DistributedFusedLAMB — ZeRO-sharded LAMB (BERT-style large batch).
+"""DistributedFusedLAMB — ZeRO-sharded LAMB (BERT-style large batch) on
+the resident bucket engine.
 
 Reference: ``apex/contrib/optimizers/distributed_fused_lamb.py:1061``
 (ZeRO grid + two-stage LAMB with global grad norm and per-tensor trust
 ratios).
 
-LAMB's trust ratio is per-TENSOR, so unlike Adam the flat-shard trick
-can't ignore tensor boundaries.  TPU design: grads reduce-scatter over
-``dp`` per-tensor is wasteful for many small tensors; instead this
-implementation keeps the *moments* sharded (ZeRO-2 memory) by
-flattening, but computes stage-2 norms per tensor on the gathered
-update — the all_gather needed for param sync anyway supplies the
-update vector, so the extra cost is one pass of per-tensor reductions.
+LAMB's trust ratio is per-TENSOR, so unlike Adam the shard math cannot
+ignore tensor boundaries.  On the bucket plan the fix is cheap: the
+per-leaf ‖p‖²/‖u‖² sums are recovered from the dp shards through the
+plan's static segment map (one ``segment_sum`` per bucket) and completed
+by a psum over dp — with model-sharded params additionally psummed over
+the model axes with tp-REPLICATED leaves counted once (per-shard norms
+would silently change the numerics; the reference's DistributedFusedLAMB
+is pure-dp and never faces this).  The trust ratios then broadcast back
+onto each rank's shard as one static-repeats gather, so the all-gather
+stays a pure param sync exactly like Adam's — stage 2 adds zero
+collective traffic beyond the two batched norm psums.
+
+Stage-1/stage-2 per-element math is
+:func:`apex_tpu.optimizers.fused_lamb.lamb_stage1_math` /
+:func:`~apex_tpu.optimizers.fused_lamb.lamb_trust_ratio` — the per-leaf
+:class:`~apex_tpu.optimizers.FusedLAMB` is the numerics oracle.
 """
 
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from apex_tpu.contrib.optimizers.distributed_fused_adam import (
-    _flatten,
-    local_total_and_axes,
+from apex_tpu.contrib.optimizers._zero_engine import ZeroOptimizerBase
+from apex_tpu.optimizers import bucketing
+from apex_tpu.optimizers.base import predicate_step
+from apex_tpu.optimizers.fused_lamb import (
+    lamb_grad_clip,
+    lamb_stage1_math,
+    lamb_trust_ratio,
 )
 from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+__all__ = ["DistributedFusedLAMB", "DistributedFusedLAMBState"]
 
 
 class DistributedFusedLAMBState(NamedTuple):
     step: jnp.ndarray
-    exp_avg: jnp.ndarray
-    exp_avg_sq: jnp.ndarray
-    master_shard: jnp.ndarray
+    exp_avg: Tuple[jnp.ndarray, ...]
+    exp_avg_sq: Tuple[jnp.ndarray, ...]
+    master_shard: Tuple[jnp.ndarray, ...]
 
 
-class DistributedFusedLAMB:
+class DistributedFusedLAMB(ZeroOptimizerBase):
+
+    _STATE_CLS = DistributedFusedLAMBState
+
     def __init__(
         self,
         lr: float = 1e-3,
@@ -46,189 +64,120 @@ class DistributedFusedLAMB:
         grad_averaging: bool = True,
         use_nvlamb: bool = False,
         axis_name: str = DATA_AXIS,
+        overlap_grad_sync: bool = True,
+        overlap_param_sync: bool = False,
+        bucket_cap_mb: float = 100.0,
+        grad_sync_dtype=None,
+        param_sync_dtype=None,
         **parity_kwargs,
     ):
-        self.lr = lr
+        super().__init__(
+            lr, weight_decay, axis_name=axis_name,
+            grad_average=grad_averaging,
+            overlap_grad_sync=overlap_grad_sync,
+            overlap_param_sync=overlap_param_sync,
+            bucket_cap_mb=bucket_cap_mb, grad_sync_dtype=grad_sync_dtype,
+            param_sync_dtype=param_sync_dtype,
+        )
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
-        self.weight_decay = weight_decay
         self.max_grad_norm = max_grad_norm
         self.adam_w_mode = adam_w_mode
         self.grad_averaging = grad_averaging
         self.use_nvlamb = use_nvlamb
-        self.axis_name = axis_name
 
     def init(self, params, world_size: Optional[int] = None, param_specs=None,
              axis_sizes=None) -> DistributedFusedLAMBState:
-        """GLOBAL flat state (padded_total,) — shard over dp with
+        """GLOBAL per-bucket flat state — shard with
         :meth:`state_partition_spec` (see DistributedFusedAdam.init).
-
-        **Composition with tensor parallelism**: pass ``param_specs`` +
-        ``axis_sizes`` exactly as for DistributedFusedAdam.  LAMB's
-        stage-2 trust ratios need GLOBAL per-tensor norms, so with
-        model-sharded params the per-tensor ‖p‖/‖u‖ sums are psum'd over
-        the model axes before the ratio — per-shard norms would silently
-        change the numerics (the reference's DistributedFusedLAMB is
-        pure-dp and never faces this)."""
-        if world_size is None:
-            raise ValueError("pass world_size= (the dp axis size)")
-        self._model_axes = ()
-        self._leaf_repl = None
-        if param_specs is not None:
-            if axis_sizes is None:
-                raise ValueError("param_specs requires axis_sizes")
-            total, self._model_axes, self._leaf_repl = local_total_and_axes(
-                params, param_specs, axis_sizes, self.axis_name
-            )
-        else:
-            total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-        model_mult = 1
-        for ax in self._model_axes:
-            model_mult *= axis_sizes[ax]
-        padded = ((total + world_size - 1) // world_size) * world_size
-        zeros = jnp.zeros((model_mult * padded,), jnp.float32)
+        The fp32 master packs from the params at init (resident)."""
+        self._init_plan(params, world_size, param_specs, axis_sizes)
         return DistributedFusedLAMBState(
-            step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=zeros, master_shard=zeros
-        )
+            step=jnp.int32(0), exp_avg=self._zero_slot(),
+            exp_avg_sq=self._zero_slot(),
+            master_shard=self._master_slot(params))
 
-    def state_partition_spec(self):
-        from jax.sharding import PartitionSpec as P
-
-        axes = getattr(self, "_model_axes", ())
-        flat = P((*axes, self.axis_name)) if axes else P(self.axis_name)
-        return DistributedFusedLAMBState(
-            step=P(), exp_avg=flat, exp_avg_sq=flat, master_shard=flat,
-        )
-
-    def update(self, grads, state, params, grads_finite=None, lr=None):
-        lr = self.lr if lr is None else lr
-        ax = self.axis_name
-        world = jax.lax.axis_size(ax)
-        rank = jax.lax.axis_index(ax)
-        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
-        b3 = (1.0 - b1) if self.grad_averaging else 1.0
-
-        flat_g = _flatten(grads)
-        total = flat_g.shape[0]
-        padded = ((total + world - 1) // world * world) if total % world else total
-        if padded != total:
-            flat_g = jnp.pad(flat_g, (0, padded - total))
-        shard = padded // world
-
-        g_local = jax.lax.psum_scatter(flat_g, ax, scatter_dimension=0, tiled=True)
-        if self.grad_averaging:
-            g_local = g_local / world
-
-        # global grad norm on the dp-AVERAGED grad (fused_lamb.py:121-136).
-        # Per-leaf sums are recovered from the scattered shard via a
-        # static segment map (leaf boundaries in the flat layout), so
-        # the dp reduction stays a reduce-scatter; with model-sharded
-        # params the norm additionally psums over the model axes with
-        # tp-REPLICATED leaves counted once, not once per rank.
-        model_axes = getattr(self, "_model_axes", ())
-        leaves_g = jax.tree.leaves(grads)
-        L = len(leaves_g)
-        seg_ids = np.repeat(
-            np.arange(L), [int(np.prod(g.shape)) for g in leaves_g]
-        )
-        seg_ids = np.pad(seg_ids, (0, padded - total), constant_values=L)
-        seg_local = jax.lax.dynamic_slice_in_dim(
-            jnp.asarray(seg_ids), rank * shard, shard
-        )
-        leaf_sq_local = jax.ops.segment_sum(
-            jnp.square(g_local), seg_local, num_segments=L + 1
-        )[:L]
-        leaf_sq = jax.lax.psum(leaf_sq_local, ax)  # ||avg grad leaf||², per leaf
-        if model_axes:
+    def _global_leaf_sumsq(self, plan, shards, rank, world):
+        """GLOBAL per-leaf Σx² from per-bucket dp shards: segment sums,
+        psum over dp (shards are disjoint), then — with model-sharded
+        params — psum over the model axes dividing out each leaf's
+        replication factor so tp-replicated leaves count once, not once
+        per rank."""
+        leaf_sq = jax.lax.psum(
+            self._per_leaf_sumsq(plan, shards, rank, world), self.axis_name)
+        if self._model_axes:
             repl = jnp.asarray(self._leaf_repl, jnp.float32)
-            gn_sq = jax.lax.psum(jnp.sum(leaf_sq / repl), model_axes)
+            leaf_sq = jax.lax.psum(leaf_sq / repl, self._model_axes)
+        return leaf_sq
+
+    def _zero_step(self, grads, state: DistributedFusedLAMBState, params,
+                   grads_finite=None, lr=None, scale=None, clip_norm=None,
+                   finite_sync=None, sumsq_reduce=None, want_finite=False):
+        lr = self.lr if lr is None else lr
+        wd = self.weight_decay
+        plan = self._plan_of_local(params)
+        self._check_master_precision(state.master_shard)
+
+        g_shards, pred, rank, world = self._prepare_grads(
+            plan, grads, scale, clip_norm, finite_sync, want_finite,
+            grads_finite, sumsq_reduce)
+        self._check_state_shards(plan, state.exp_avg, world, "exp_avg")
+
+        # LAMB's own global grad-norm clip on the dp-AVERAGED grad
+        # (fused_lamb.py:121-136) — per-leaf sums recovered from the
+        # scattered shards, so the dp reduction stays a reduce-scatter
+        gn_sq = jnp.sum(self._global_leaf_sumsq(plan, g_shards, rank, world))
+        clip = lamb_grad_clip(jnp.sqrt(gn_sq), self.max_grad_norm)
+
+        master = list(state.master_shard)
+        step = predicate_step(pred, state.step)
+        bc1, bc2 = self._bias_corrections(step)
+
+        # stage 1: one fused pass per bucket shard
+        u_b, new_m, new_v = [], [], []
+        for bi in range(len(plan.buckets)):
+            u, m_out, v_out = lamb_stage1_math(
+                g_shards[bi] / clip, master[bi], state.exp_avg[bi],
+                state.exp_avg_sq[bi], wd, bc1, bc2,
+                beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+                adam_w_mode=self.adam_w_mode,
+                grad_averaging=self.grad_averaging)
+            u_b.append(u)
+            new_m.append(m_out)
+            new_v.append(v_out)
+
+        # stage 2: GLOBAL per-tensor trust ratios from the shards —
+        # both norm families in two batched psums, never 2·L scalar
+        # collectives
+        apply_ratio = self.use_nvlamb or wd != 0.0
+        if apply_ratio:
+            p_sq = self._global_leaf_sumsq(plan, master, rank, world)
+            u_sq = self._global_leaf_sumsq(plan, u_b, rank, world)
+            ratios = [
+                lamb_trust_ratio(lr, jnp.sqrt(p_sq[i]), jnp.sqrt(u_sq[i]),
+                                 apply_ratio=True)
+                for i in range(plan.n_leaves)
+            ]
         else:
-            gn_sq = jnp.sum(leaf_sq)
-        global_norm = jnp.sqrt(gn_sq)
-        clip = jnp.where(
-            global_norm > self.max_grad_norm, global_norm / self.max_grad_norm, jnp.float32(1.0)
-        )
+            ratios = [jnp.asarray(lr, jnp.float32)] * plan.n_leaves
 
-        flat_p = _flatten(params)
-        if padded != total:
-            flat_p = jnp.pad(flat_p, (0, padded - total))
-        p_owned = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard, shard)
-        master = jnp.where(state.step == 0, p_owned, state.master_shard)
+        new_p = []
+        for bi, b in enumerate(plan.buckets):
+            shard = b.total // world
+            ratio_b = bucketing.seg_broadcast(b, ratios)
+            ratio_shard = jax.lax.dynamic_slice_in_dim(
+                ratio_b, rank * shard, shard)
+            new_p.append(master[bi] - ratio_shard * u_b[bi])
 
-        step = state.step + (
-            jnp.asarray(grads_finite).astype(jnp.int32) if grads_finite is not None else 1
-        )
-        t = step.astype(jnp.float32)
-        if self.bias_correction:
-            bc1 = 1.0 - jnp.power(b1, t)
-            bc2 = 1.0 - jnp.power(b2, t)
+        new_m = self._select(pred, new_m, state.exp_avg)
+        new_v = self._select(pred, new_v, state.exp_avg_sq)
+        master_committed = self._select(pred, new_p, master)
+
+        if self.overlap_param_sync and pred is not None:
+            new_params = self._emit_params(plan, new_p, params, pred)
         else:
-            bc1 = bc2 = jnp.float32(1.0)
-
-        g = g_local / clip
-        if not self.adam_w_mode:
-            g = g + wd * master
-        m_new = b1 * state.exp_avg + b3 * g
-        v_new = b2 * state.exp_avg_sq + (1.0 - b2) * g * g
-        u_local = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-        if self.adam_w_mode:
-            u_local = u_local + wd * master
-
-        # gather update + params for per-tensor trust ratios (stage 2)
-        flat_u = jax.lax.all_gather(u_local, ax, axis=0, tiled=True)[:total]
-        flat_pm = jax.lax.all_gather(master, ax, axis=0, tiled=True)[:total]
-
-        leaves, treedef = jax.tree.flatten(params)
-        if self.use_nvlamb or wd != 0.0:
-            # all per-tensor ‖p‖²/‖u‖² in ONE batched psum over the
-            # model axes (not 2·L scalar collectives)
-            sums = []
-            off = 0
-            for p in leaves:
-                n = int(np.prod(p.shape))
-                sums.append(jnp.sum(jnp.square(flat_pm[off : off + n])))
-                sums.append(jnp.sum(jnp.square(flat_u[off : off + n])))
-                off += n
-            sums = jnp.stack(sums).reshape(len(leaves), 2)
-            if model_axes:  # GLOBAL per-tensor norms across tp shards;
-                # replicated leaves counted once, not once per rank
-                repl2 = jnp.asarray(self._leaf_repl, jnp.float32)[:, None]
-                sums = jax.lax.psum(sums, model_axes) / repl2
-            p_norms = jnp.sqrt(sums[:, 0])
-            u_norms = jnp.sqrt(sums[:, 1])
-        new_leaves = []
-        off = 0
-        for i, p in enumerate(leaves):
-            n = int(np.prod(p.shape))
-            u_t = flat_u[off : off + n]
-            p_t = flat_pm[off : off + n]
-            if self.use_nvlamb or wd != 0.0:
-                ratio = jnp.where(
-                    (p_norms[i] != 0.0) & (u_norms[i] != 0.0),
-                    lr * (p_norms[i] / u_norms[i]), lr,
-                )
-            else:
-                ratio = lr
-            new_leaves.append((p_t - ratio * u_t).reshape(p.shape).astype(p.dtype))
-            off += n
-        new_params = jax.tree.unflatten(treedef, new_leaves)
-
-        # refresh the owned master shard from the new params
-        flat_new = _flatten(new_params)
-        if padded != total:
-            flat_new = jnp.pad(flat_new, (0, padded - total))
-        master_new = jax.lax.dynamic_slice_in_dim(flat_new, rank * shard, shard)
-
-        if grads_finite is not None:
-            pred = jnp.asarray(grads_finite)
-            m_new = jnp.where(pred, m_new, state.exp_avg)
-            v_new = jnp.where(pred, v_new, state.exp_avg_sq)
-            master_new = jnp.where(pred, master_new, master)
-            new_params = jax.tree.map(
-                lambda n, o: jnp.where(pred, n, o.astype(n.dtype)), new_params, params
-            )
-
+            new_params = self._emit_params(plan, master_committed, params,
+                                           None)
         return new_params, DistributedFusedLAMBState(
-            step=step, exp_avg=m_new, exp_avg_sq=v_new, master_shard=master_new
-        )
+            step, tuple(new_m), tuple(new_v), tuple(master_committed)), pred

@@ -530,6 +530,25 @@ def clip_sumsq_reduce(specs):
     return reduce
 
 
+def _check_zero_axis(zero_opt, optimizer, dp_axis):
+    """A ZeRO optimizer's collectives run over ITS ``axis_name``; the
+    step builder's grad calculus (skip the dp pmean, add dp to the
+    finite-vote axes) is keyed on ``dp_axis``.  A mismatch would
+    silently double- or un-sync the grads, so fail at build time."""
+    if not zero_opt:
+        return
+    if isinstance(dp_axis, (tuple, list)):
+        raise NotImplementedError(
+            "ZeRO over a composite data axis (multi-slice dcn x dp) is "
+            "not wired: the optimizer reduce-scatters over ONE mesh axis")
+    opt_axis = getattr(optimizer, "axis_name", None)
+    if dp_axis is None or opt_axis != dp_axis:
+        raise ValueError(
+            f"ZeRO optimizer shards over axis {opt_axis!r} but the train "
+            f"step's dp axis is {dp_axis!r}; pass axis_name={dp_axis!r} "
+            "to the optimizer (or dp_axis= to the step builder)")
+
+
 def _clip_reduce_for(optimizer, clip_grad_norm, specs):
     """Shared clip wiring for both step builders: validate the
     optimizer can fold the clip into its fused grad pass, and build
@@ -557,8 +576,13 @@ def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
     .OptimizerBase`) the whole tail is ONE fused pass over the grad
     buckets — unscale, optional global-l2 clip, and the finite vote
     fold into the optimizer's own grad read (``update_scaled``) instead
-    of three separate tree sweeps; other optimizers (ZeRO) keep the
-    explicit sweep composition.
+    of three separate tree sweeps.  The ZeRO optimizers take the same
+    fused route: their ``update_scaled`` folds the unscale, the clip
+    (Σx² psummed over the dp shards and, via ``clip_sumsq``, the model
+    axes), and the vote into the per-bucket reduce-scattered grad read.
+    Optimizers without the capability (``supports_update_scaled``
+    False, e.g. contrib ``FusedAdamSWA``) keep the explicit sweep
+    composition.
 
     With a ``step_guard`` (:class:`apex_tpu.resilience.StepGuard`) the
     same agreed predicate also feeds the guard's device-side bad-step
@@ -734,12 +758,14 @@ def make_train_step(
         return rest
 
     # A ZeRO optimizer (state_partition_spec present) owns the dp grad
-    # sync via its reduce-scatter; grads then stay local over dp.
+    # sync via its per-bucket reduce-scatter; grads then stay local
+    # over dp and the collectives live inside the optimizer.
     zero_opt = hasattr(optimizer, "state_partition_spec")
     if zero_opt and config.moe:
         raise NotImplementedError(
             "ZeRO + MoE expert sharding both claim the dp axis; not wired"
         )
+    _check_zero_axis(zero_opt, optimizer, dp_axis)
 
     def sync_loss_and_grads(loss, grads):
         """cp behaves as a data axis for grads: each rank differentiated
@@ -1108,8 +1134,9 @@ def make_pp_train_step(
                     grads["layers"]["moe"] = moe_g
                 else:
                     grads = jax.tree.map(lambda g: jax.lax.pmean(g, dp_axis), grads)
-        # ZeRO: grads stay LOCAL — the optimizer's psum_scatter over dp
-        # IS the gradient sync (reduce-scatter fused with the update)
+        # ZeRO: grads stay LOCAL — the optimizer's per-bucket
+        # psum_scatter over dp IS the gradient sync (one reduce-scatter
+        # per dtype bucket in grad_sync_dtype, fused with the update)
         return loss, grads
 
     if chaos is not None and step_guard is None:
@@ -1206,6 +1233,7 @@ def make_pp_train_step(
         raise NotImplementedError(
             "ZeRO + MoE expert sharding both claim the dp axis; not wired"
         )
+    _check_zero_axis(zero_opt, optimizer, dp_axis)
     # stage-sharded (pp) and tp-sharded grads can overflow on one rank
     # only — every such axis must agree on the skip decision; ZeRO
     # (local dp grads) and MoE (dp-sharded expert grads) add dp

@@ -89,6 +89,16 @@ def f32(tree: Tree) -> Tree:
     return jax.tree.map(lambda x: x.astype(jnp.float32), tree)
 
 
+def bias_corrections(step, bias_correction: bool, beta1, beta2):
+    """Adam-family ``(1-β1^t, 1-β2^t)`` — module-level so the ZeRO
+    optimizers (which are not :class:`OptimizerBase` subclasses) share
+    the exact expression the per-leaf oracle evaluates."""
+    if not bias_correction:
+        return jnp.float32(1.0), jnp.float32(1.0)
+    t = step.astype(jnp.float32)
+    return (1.0 - jnp.power(beta1, t), 1.0 - jnp.power(beta2, t))
+
+
 class HyperLeaf(dict):
     """An override dict that is a pytree *leaf* (unregistered dict
     subclass), so a tree of them can ride through ``jax.tree.map``
@@ -348,11 +358,8 @@ class OptimizerBase:
         """Adam-family ``(1-β1^t, 1-β2^t)`` — reads the subclass's
         ``bias_correction``/``beta1``/``beta2`` attributes (NovoGrad
         overrides: its second correction is the sqrt form)."""
-        t = step.astype(jnp.float32)
-        if self.bias_correction:
-            return (1.0 - jnp.power(self.beta1, t),
-                    1.0 - jnp.power(self.beta2, t))
-        return jnp.float32(1.0), jnp.float32(1.0)
+        return bias_corrections(step, self.bias_correction,
+                                self.beta1, self.beta2)
 
     # --------------------------------------------------------- public API
     def init(self, params, bucketed: bool = False):  # pragma: no cover

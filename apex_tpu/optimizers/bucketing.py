@@ -27,6 +27,16 @@ Two ways to use a plan:
   concatenated *global* leaves does not slice into per-rank buckets of
   the leaf *shards*, so ``make_train_step``-style shard_map states stay
   per-leaf.
+
+The ZeRO optimizers (``contrib.optimizers``) build their plans with two
+extra knobs: ``shard_pad`` pads every bucket so it splits evenly into
+``dp`` tile-aligned shards (the layout a per-bucket ``psum_scatter``
+scatters cleanly), and ``cap_bytes`` (the reference's ``bucket_cap_mb``)
+splits an oversized dtype bucket into several collective-sized buckets
+at leaf granularity — each bucket then gets its own reduce-scatter /
+all-gather, which is what lets XLA's latency-hiding scheduler overlap
+one bucket's collective with another's math (and, inside a train step,
+with the remaining backward).
 """
 
 import dataclasses
@@ -43,7 +53,8 @@ Tree = Any
 
 __all__ = [
     "BucketLeaf", "BucketSpec", "BucketPlan", "Buckets", "plan_of",
-    "pack", "unpack", "per_leaf_reduce", "seg_values", "seg_broadcast",
+    "plan_of_shapes", "padded_total", "pack", "unpack", "per_leaf_reduce",
+    "seg_values", "seg_broadcast", "seg_ids",
 ]
 
 
@@ -96,8 +107,19 @@ def _tile(dtype_name: str) -> int:
     return sublane(jnp.dtype(dtype_name)) * LANES
 
 
+def padded_total(size: int, dtype_name: str, shard_pad: int = 1) -> int:
+    """The bucket length for ``size`` payload elements: rounded up to
+    the dtype tile × ``shard_pad``, so every 1/shard_pad shard is itself
+    tile-aligned.  The ONE padding formula — the plan builder and the
+    ZeRO checkpoint resharder (which re-pads a saved payload for a new
+    world size) must agree or a resumed state silently misaligns."""
+    unit = _tile(dtype_name) * max(1, int(shard_pad))
+    return ((size + unit - 1) // unit) * unit if size else 0
+
+
 @functools.lru_cache(maxsize=64)
-def _plan_from_key(treedef, shapes_dtypes) -> BucketPlan:
+def _plan_from_key(treedef, shapes_dtypes, cap_bytes=None,
+                   shard_pad=1) -> BucketPlan:
     by_dtype: dict = {}
     order: List[str] = []  # first-appearance bucket order, deterministic
     for i, (shape, dt) in enumerate(shapes_dtypes):
@@ -107,14 +129,33 @@ def _plan_from_key(treedef, shapes_dtypes) -> BucketPlan:
         by_dtype[dt].append((i, shape))
     buckets = []
     for dt in order:
-        leaves, off = [], 0
+        cap = None
+        if cap_bytes is not None:
+            # cap in elements of THIS dtype; at least one tile so a cap
+            # smaller than the alignment unit still makes progress
+            cap = max(int(cap_bytes) // jnp.dtype(dt).itemsize, _tile(dt))
+        groups: List[List] = [[]]
+        off = 0
         for i, shape in by_dtype[dt]:
-            leaves.append(BucketLeaf(leaf_id=i, shape=shape, offset=off))
-            off += int(np.prod(shape)) if shape else 1
-        tile = _tile(dt)
-        total = ((off + tile - 1) // tile) * tile if off else 0
-        buckets.append(BucketSpec(dtype=dt, leaves=tuple(leaves),
-                                  size=off, total=total))
+            n = int(np.prod(shape)) if shape else 1
+            # split at LEAF granularity (the reference splits params into
+            # fragments; a leaf spanning buckets would break the static
+            # per-leaf offset table every norm/unpack path slices by, so
+            # an over-cap leaf gets a bucket of its own instead)
+            if cap is not None and off and off + n > cap:
+                groups.append([])
+                off = 0
+            groups[-1].append((i, shape, off))
+            off += n
+        for group in groups:
+            if not group:
+                continue
+            leaves = tuple(BucketLeaf(leaf_id=i, shape=shape, offset=o)
+                           for i, shape, o in group)
+            size = sum(bl.size for bl in leaves)
+            buckets.append(BucketSpec(
+                dtype=dt, leaves=leaves, size=size,
+                total=padded_total(size, dt, shard_pad)))
     return BucketPlan(
         treedef=treedef,
         leaf_dtypes=tuple(dt for _, dt in shapes_dtypes),
@@ -122,12 +163,28 @@ def _plan_from_key(treedef, shapes_dtypes) -> BucketPlan:
     )
 
 
-def plan_of(tree: Tree) -> BucketPlan:
+def plan_of(tree: Tree, cap_bytes: Optional[int] = None,
+            shard_pad: int = 1) -> BucketPlan:
     """The bucket plan for ``tree``'s (treedef, shapes, dtypes) — cached,
-    so repeated traces of the same step reuse one plan object."""
+    so repeated traces of the same step reuse one plan object.
+
+    ``cap_bytes`` splits oversized dtype buckets at leaf granularity
+    (the reference's ``bucket_cap_mb``); ``shard_pad`` pads each bucket
+    to split evenly into that many tile-aligned shards (the ZeRO dp
+    shard count)."""
     leaves, treedef = jax.tree.flatten(tree)
     key = tuple((tuple(x.shape), jnp.dtype(x.dtype).name) for x in leaves)
-    return _plan_from_key(treedef, key)
+    return _plan_from_key(treedef, key, cap_bytes, shard_pad)
+
+
+def plan_of_shapes(treedef, shapes_dtypes: Sequence[Tuple[Tuple[int, ...], str]],
+                   cap_bytes: Optional[int] = None,
+                   shard_pad: int = 1) -> BucketPlan:
+    """:func:`plan_of` from ``(shape, dtype_name)`` pairs alone — the
+    ZeRO ``init`` path builds the plan for the LOCAL (model-sharded)
+    leaf shapes before any local array exists."""
+    return _plan_from_key(treedef, tuple(
+        (tuple(s), str(d)) for s, d in shapes_dtypes), cap_bytes, shard_pad)
 
 
 class Buckets:
@@ -223,6 +280,18 @@ def seg_values(bucket: BucketSpec, per_leaf: Sequence[float]):
     if bucket.pad:
         parts.append(np.zeros(bucket.pad, np.float32))
     return jnp.asarray(np.concatenate(parts))
+
+
+def seg_ids(plan: BucketPlan, bucket: BucketSpec) -> np.ndarray:
+    """Static leaf-id per element of one bucket (pad → ``n_leaves``
+    sentinel): the segment map a dp-scattered shard's per-leaf
+    reductions (``segment_sum``) read, since a 1/dp shard does not
+    align to leaf boundaries the way :func:`per_leaf_reduce`'s static
+    slices need."""
+    parts = [np.full(bl.size, bl.leaf_id, np.int32) for bl in bucket.leaves]
+    if bucket.pad:
+        parts.append(np.full(bucket.pad, plan.n_leaves, np.int32))
+    return np.concatenate(parts) if parts else np.zeros(0, np.int32)
 
 
 def seg_broadcast(bucket: BucketSpec, per_leaf: Sequence):

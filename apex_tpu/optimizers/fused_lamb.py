@@ -37,6 +37,38 @@ class LambState(NamedTuple):
     master: Optional[Any] = None
 
 
+def lamb_stage1_math(g, p32, m, v, wd_i, bc1, bc2, *, beta1, beta2, eps,
+                     adam_w_mode, grad_averaging):
+    """Stage-1 LAMB per element (LAMBStage1Functor) — module-level so
+    the ZeRO-sharded :class:`~apex_tpu.contrib.optimizers.
+    DistributedFusedLAMB` evaluates the identical expression tree on
+    its dp shards."""
+    b3 = (1.0 - beta1) if grad_averaging else 1.0
+    if not adam_w_mode:  # MOMENT_MODE_0: L2 on scaled grad
+        g = g + wd_i * p32
+    m_new = beta1 * m + b3 * g
+    v_new = beta2 * v + (1.0 - beta2) * (g * g)
+    u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if adam_w_mode:  # MOMENT_MODE_1: decoupled
+        u = u + wd_i * p32
+    return u, m_new, v_new
+
+
+def lamb_trust_ratio(lr_i, p_norm, u_norm, *, apply_ratio):
+    """Stage-2 per-tensor ratio (multi_tensor_lamb.cu:255-262)."""
+    if apply_ratio:
+        return jnp.where((p_norm != 0.0) & (u_norm != 0.0),
+                         lr_i * (p_norm / u_norm), lr_i)
+    return jnp.asarray(lr_i, jnp.float32)
+
+
+def lamb_grad_clip(global_grad_norm, max_grad_norm):
+    """fused_lamb.py:121-136: the divide-every-grad-by factor when the
+    global norm exceeds the max."""
+    return jnp.where(global_grad_norm > max_grad_norm,
+                     global_grad_norm / max_grad_norm, jnp.float32(1.0))
+
+
 class FusedLAMB(base.OptimizerBase):
 
     #: group-override keys beyond the base lr/lr_scale/weight_decay set
@@ -95,32 +127,20 @@ class FusedLAMB(base.OptimizerBase):
     def _grad_clip(self, global_grad_norm):
         """fused_lamb.py:121-136: divide every grad by
         ``gn/max_grad_norm`` when the global norm exceeds the max."""
-        return jnp.where(
-            global_grad_norm > self.max_grad_norm,
-            global_grad_norm / self.max_grad_norm,
-            jnp.float32(1.0),
-        )
+        return lamb_grad_clip(global_grad_norm, self.max_grad_norm)
 
     def _stage1_math(self, g, p32, m, v, wd_i, bc1, bc2):
         """Shared stage-1 expression tree (per-leaf == bucket)."""
-        b1, b2, eps = self.beta1, self.beta2, self.eps
-        b3 = (1.0 - b1) if self.grad_averaging else 1.0
-        if not self.adam_w_mode:  # MOMENT_MODE_0: L2 on scaled grad
-            g = g + wd_i * p32
-        m_new = b1 * m + b3 * g
-        v_new = b2 * v + (1.0 - b2) * (g * g)
-        u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-        if self.adam_w_mode:  # MOMENT_MODE_1: decoupled
-            u = u + wd_i * p32
-        return u, m_new, v_new
+        return lamb_stage1_math(
+            g, p32, m, v, wd_i, bc1, bc2, beta1=self.beta1,
+            beta2=self.beta2, eps=self.eps, adam_w_mode=self.adam_w_mode,
+            grad_averaging=self.grad_averaging)
 
     def _trust_ratio(self, h, wd_i, lr_i, p_norm, u_norm):
         """Stage-2 per-tensor ratio (multi_tensor_lamb.cu:255-262)."""
-        if h.get("use_trust_ratio", True) and (self.use_nvlamb or wd_i != 0.0):
-            return jnp.where(
-                (p_norm != 0.0) & (u_norm != 0.0),
-                lr_i * (p_norm / u_norm), lr_i)
-        return jnp.asarray(lr_i, jnp.float32)
+        apply = h.get("use_trust_ratio", True) and (
+            self.use_nvlamb or wd_i != 0.0)
+        return lamb_trust_ratio(lr_i, p_norm, u_norm, apply_ratio=apply)
 
     # ------------------------------------------------------- per-leaf path
     def _leaf_update(self, grads, state: LambState, params,

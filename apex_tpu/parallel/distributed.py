@@ -21,6 +21,15 @@ numerics knobs:
 ``message_size``/``num_allreduce_streams``/``delay_allreduce`` from the
 reference configure the overlap engine and have no TPU meaning; the
 ``DistributedDataParallel`` wrapper accepts and ignores them.
+
+This module is the REPLICATED-state grad sync (every rank applies the
+same update).  When optimizer state is ZeRO-sharded over dp, the sync
+is owned by the optimizer instead: ``contrib.optimizers.
+DistributedFusedAdam``/``DistributedFusedLAMB`` reduce-scatter each
+dtype bucket in ``grad_sync_dtype`` (half the allreduce's wire bytes,
+and each rank only reads the 1/dp shard it updates), so steps built
+with a ZeRO optimizer must NOT also psum their grads — the gpt step
+builders skip the dp pmean automatically.
 """
 
 

@@ -40,6 +40,19 @@ error deferred to ``jit`` *compile* time surfaces to the caller of the
 compiled step; catch it there, feed it to :func:`trip_from_exception`,
 and rebuild the step — the new trace consults the registry and lowers
 the fallback.  ``examples/gpt/pretrain_gpt.py`` wires this.
+
+Collective-bearing engines NEVER register here.  The multi-tensor
+bucket engine routes through ``"multi_tensor_engine"`` only because its
+fallback (the per-leaf path) lowers the SAME collective-free program
+shape; the ZeRO bucket engine
+(:mod:`apex_tpu.contrib.optimizers._zero_engine`) has per-bucket
+reduce-scatters and all-gathers INSIDE the optimizer, so a per-process
+degrade-once would lower divergent SPMD programs across the pod —
+mismatched collective counts deadlock every host device-side with no
+error (the same invariant :func:`registry_engaged` enforces by
+disengaging under ``jax.process_count() > 1``).  ZeRO therefore runs
+its engine directly and fails fast; ``--auto-resume`` is the recovery
+path.
 """
 
 import dataclasses

@@ -26,8 +26,12 @@ for:
 - ``flash_attn``: Pallas flash attention forward, absolute TFLOP/s
   (causal matmul FLOPs only: 2·2·S²·D/2 per batch·head) and % of the
   measured bf16 matmul roofline, per (D, S) shape.
-- ``zero2_vs_fused``: DistributedFusedAdam (ZeRO-2) step vs replicated
+- ``zero2_vs_fused``: DistributedFusedAdam (ZeRO) step vs replicated
   FusedAdam at 25.6M and GPT-345M param counts, dp=1 degenerate.
+- ``zero_gpt124``: GPT-124M over the dp mesh through the real
+  ``make_train_step`` seam — replicated FusedAdam vs bucketed
+  DistributedFusedAdam (fp32-master and ``store_param_remainders``),
+  tokens/sec + per-device live bytes of params+optimizer state.
 - ``fused_ln``: FusedLayerNorm fwd+bwd vs the jnp composite at
   8192×4096 bf16 (BASELINE config 2's second half).
 
@@ -306,6 +310,23 @@ def bench_fused_adam(params=None):
     leaf_ms = timed_steps_ms(leaf_step, (params, leaf_opt.init(params)),
                              K=200)
 
+    # the BENCH_r05 before/after, measured in THIS run: the pre-fix
+    # emit packed params into a bucket and unpacked them back (two
+    # whole-model HBM passes optax never pays — the 0.679x root cause);
+    # the packfree emit (default) slices each leaf's update out of the
+    # core bucket instead.  _pack_params_emit restores the old path so
+    # the drift evidence carries a live A/B, not a remembered number.
+    packed_opt = FusedAdam(lr=1e-3, weight_decay=0.01)
+    packed_opt._pack_params_emit = True
+
+    def packed_step(c):
+        p, s = c
+        p, s = packed_opt.update(grads, s, p)
+        return (p, s)
+
+    packed_ms = timed_steps_ms(
+        packed_step, (params, packed_opt.init(params, bucketed=True)), K=200)
+
     # unjitted per-op baseline (the eager execution model).  3 timed
     # steps = ~3000 op dispatches over the tunnel — enough to average
     # dispatch cost without dominating the whole bench's wall time.
@@ -324,7 +345,7 @@ def bench_fused_adam(params=None):
         return round(100 * (max(reps) - min(reps)) / min(reps), 1)
 
     return {
-        "engine": "bucketed-resident",
+        "engine": "bucketed-resident-packfree",
         "fused_ms": round(fused_ms, 3),
         "jitted_optax_ms": round(optax_ms, 3),
         "per_leaf_ms": round(leaf_ms, 3),
@@ -335,12 +356,21 @@ def bench_fused_adam(params=None):
         # the 0.679x verdict: per-PAIR ratios from the interleaved reps.
         # Stable ratios + big per-rep spread = the gap was measurement
         # drift; the audited number is the paired ratio, not the two
-        # best-of windows compared across time.
+        # best-of windows compared across time.  r05_dispute is the
+        # live before/after of the root-cause fix: the pre-fix
+        # pack-params emit timed in the same run.
         "drift": {
             "paired_rep_speedup": [round(o / f, 3) for f, o
                                    in zip(fused_reps, optax_reps)],
             "rep_spread_pct": {"fused": spread_pct(fused_reps),
                                "jitted_optax": spread_pct(optax_reps)},
+            "r05_dispute": {
+                "pre_fix_packed_emit_ms": round(packed_ms, 3),
+                "packfree_speedup_vs_pre_fix": round(packed_ms / fused_ms, 3),
+                "root_cause": "param bucket pack+unpack (2 whole-model "
+                              "HBM passes); fixed by per-leaf emit off "
+                              "the core bucket",
+            },
         },
     }
 
@@ -582,7 +612,8 @@ def _bench_bert_at_batch(layers, hidden, heads, seq, batch, vocab, iters):
 
 
 def bench_zero2(iters=30, param_sets=None):
-    """DistributedFusedAdam (ZeRO-2, flat-shard psum_scatter/all_gather)
+    """DistributedFusedAdam (ZeRO, per-bucket psum_scatter/all_gather on
+    the resident sharded bucket plan)
     step time vs replicated FusedAdam at two real param counts
     (VERDICT r4: the ZeRO design claimed overlap with zero measured
     evidence).  One chip ⇒ dp=1, the degenerate case: it prices the
@@ -643,6 +674,109 @@ def bench_zero2(iters=30, param_sets=None):
             "fused_state_mb": round(fused_bytes / 2**20, 1),
             "zero2_state_mb_dp1": round(zero_bytes / 2**20, 1),
         }
+    return out
+
+
+def _per_device_bytes(tree, spec_tree, mesh):
+    """Per-device live bytes of ``tree`` under ``spec_tree`` on
+    ``mesh``: each leaf's bytes divided by the product of the mesh axes
+    its PartitionSpec names (replicated leaves count in full on every
+    device — that is the point of measuring them)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    specs = treedef.flatten_up_to(spec_tree)
+    total = 0
+    for leaf, spec in zip(leaves, specs):
+        div = 1
+        for entry in tuple(spec) if spec is not None else ():
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                if ax is not None:
+                    div *= mesh.shape[ax]
+        total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize // div
+    return total
+
+
+def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
+                      seq=1024, batch_per_rank=1, vocab=50304):
+    """The MULTICHIP ZeRO section: GPT-124M over a dp mesh — replicated
+    ``FusedAdam`` (fp32 master) vs bucketed ``DistributedFusedAdam`` in
+    its fp32-master and ``store_param_remainders`` modes, through the
+    REAL ``make_train_step`` seam (per-bucket reduce-scatter grad sync
+    fused into the update).  Reports tokens/sec and per-device live
+    bytes of params + optimizer state — the ZeRO claim is exactly that
+    the state bytes shrink 1/dp while tokens/sec holds or improves from
+    the overlappable per-bucket collectives.  dp defaults to
+    min(8, visible devices): 8 on a pod slice, the degenerate 1 on a
+    single chip (which still banks the engine's single-chip overhead
+    and the memory split)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.models.gpt import (
+        GPTConfig, gpt_loss, init_params, make_train_step, param_specs,
+    )
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.fused_adam import AdamState
+
+    devs = jax.devices()
+    dp = min(8, len(devs)) if dp is None else dp
+    mesh = Mesh(np.array(devs[:dp]).reshape(dp, 1), ("dp", "tp"))
+    cfg = GPTConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_seq_len=seq,
+        compute_dtype=jnp.bfloat16, use_flash_attention=True,
+        checkpoint_layers=True,
+    )
+    # bf16 params everywhere so the three modes move the same model and
+    # store_param_remainders (bf16-only by contract) applies
+    params0 = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                           init_params(cfg, jax.random.PRNGKey(0)))
+    pspecs = param_specs(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, vocab, size=(dp * batch_per_rank, seq)))
+    targets = jnp.roll(tokens, -1, axis=1)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params0))
+
+    def time_mode(optimizer, state, sspec):
+        step = make_train_step(cfg, optimizer, mesh, donate_state=True,
+                               opt_state_spec=sspec)
+        params = jax.tree.map(lambda x: x.copy(), params0)
+        live = _per_device_bytes(params, pspecs, mesh) + \
+            _per_device_bytes(state, sspec, mesh)
+        params, state, loss = step(params, state, tokens, targets)
+        block(loss)
+        n = 1 if _SMOKE else iters
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, state, loss = step(params, state, tokens, targets)
+        block(loss)
+        dt = (time.perf_counter() - t0) / n
+        return {
+            "tokens_per_sec": round(tokens.size / dt, 0),
+            "ms_per_step": round(dt * 1e3, 2),
+            "live_bytes_per_device_mb": round(live / 2 ** 20, 1),
+        }
+
+    out = {"dp": dp, "params_m": round(n_params / 1e6, 1),
+           "batch": int(tokens.shape[0])}
+
+    fused = FusedAdam(lr=3e-4, weight_decay=0.1, master_weights=True)
+    fstate = fused.init(params0)
+    fsspec = AdamState(step=P(), exp_avg=pspecs, exp_avg_sq=pspecs,
+                       master=pspecs)
+    _progress("zero_gpt124: replicated FusedAdam...")
+    out["fused_replicated"] = time_mode(fused, fstate, fsspec)
+
+    for label, kw in (("zero_fp32_master", {}),
+                      ("zero_param_remainders",
+                       {"store_param_remainders": True})):
+        zopt = DistributedFusedAdam(lr=3e-4, weight_decay=0.1,
+                                    axis_name="dp", **kw)
+        zstate = zopt.init(params0, world_size=dp)
+        _progress(f"zero_gpt124: {label}...")
+        out[label] = time_mode(zopt, zstate, zopt.state_partition_spec())
+        out[label]["state_bytes_vs_replicated"] = round(
+            out[label]["live_bytes_per_device_mb"]
+            / out["fused_replicated"]["live_bytes_per_device_mb"], 3)
     return out
 
 
@@ -906,6 +1040,9 @@ def _smoke_main() -> int:
             interpret=True),
         "zero2": lambda: bench_zero2(
             iters=1, param_sets=(("smoke", _smoke_params),)),
+        "zero_gpt124": lambda: bench_zero_gpt124(
+            iters=1, dp=1, layers=2, hidden=64, heads=2, seq=64,
+            batch_per_rank=2, vocab=512),
     }
     report, failures = {}, []
     for name, fn in sections.items():
@@ -1042,7 +1179,8 @@ def _banked_fallback(err: str) -> dict:
         out["matmul_roofline_tflops"] = round(float(roof), 1)
     for name in ("fused_adam", "fused_ln", "gpt124_s1024", "gpt124_s4096",
                  "gpt345_s1024", "gpt124_s1024_fce", "resnet50_b64",
-                 "bert_base_lamb", "flash_attn", "zero2_vs_fused"):
+                 "bert_base_lamb", "flash_attn", "zero2_vs_fused",
+                 "zero_gpt124"):
         if name in sections:
             out[name if name != "fused_adam" else "adam"] = sections[name]
     return out
@@ -1103,7 +1241,7 @@ def main():
     known = {"matmul_roofline", "fused_adam", "fused_ln", "gpt124_s1024",
              "gpt124_s4096", "gpt345_s1024", "gpt124_s1024_fce",
              "resnet50_b64", "bert_base_lamb", "flash_attn",
-             "zero2_vs_fused"}
+             "zero2_vs_fused", "zero_gpt124"}
     only = set(cli.only.split(",")) if cli.only else None
     if only is not None and not only <= known:
         # a typo'd section name must fail loudly BEFORE the multi-minute
@@ -1202,6 +1340,10 @@ def main():
     # over the tunnel — 300s left no headroom
     zero2 = (_try("zero2_vs_fused", bench_zero2, section_budget=600.0)
              if want("zero2_vs_fused") else skipped)
+    # three GPT-124M train-step compiles (replicated + two ZeRO modes):
+    # the same headroom class as the gpt sections
+    zero_gpt = (_try("zero_gpt124", bench_zero_gpt124, section_budget=900.0)
+                if want("zero_gpt124") else skipped)
 
     headline = adam.get("speedup_vs_eager") if isinstance(adam, dict) else None
     if headline is None and only is not None and "fused_adam" not in only:
@@ -1225,6 +1367,7 @@ def main():
         "bert_base_lamb": bert,
         "flash_attn": flash,
         "zero2_vs_fused": zero2,
+        "zero_gpt124": zero_gpt,
     }
     if not _DEVICE_WEDGED:
         try:

@@ -423,8 +423,11 @@ class TestShardedCheckpoint:
         shards = load_sharded_checkpoint(d)
         state2 = DistributedFusedAdam.load_sharded_state_dicts(shards, world_size=2)
         assert int(state2.step) == 1
+        # per-bucket payloads identical across the reshard (totals
+        # differ: the dp=2 plan re-pads each bucket for 2 shards)
         np.testing.assert_allclose(
-            np.asarray(state2.exp_avg[:30]), np.asarray(state.exp_avg[:30]), rtol=1e-7
+            np.asarray(state2.exp_avg[0][:30]),
+            np.asarray(state.exp_avg[0][:30]), rtol=1e-7
         )
 
 

@@ -775,6 +775,151 @@ class TestEngine:
         assert [f.path for f in got] == ["a.py", "b.py"]
 
 
+# ------------------------------------- cross-module trace reachability
+class TestCrossModuleReachability:
+    """The traced-function index was per-module, so a helper whose only
+    traced caller lives in ANOTHER module escaped APX101 — the exact
+    ROADMAP case: ``fused_ce_pallas._default_dot_dtype``'s env read
+    reached from ``fused_ce._fwd``.  ``analyze_paths`` now links the
+    indexes through import-resolved calls; single-file
+    ``analyze_file`` stays per-module (no imports to resolve)."""
+
+    HELPER = textwrap.dedent("""
+        import os
+
+        def helper():
+            return os.environ.get("APEX_TPU_X", "auto")
+        """)
+
+    def _scan(self, tmp_path):
+        return analyze_paths([str(tmp_path)], DEFAULT_RULES,
+                             axis_registry=set(AXES),
+                             rel_to=str(tmp_path))
+
+    def test_from_import_reached_from_jit(self, tmp_path):
+        (tmp_path / "helper_mod.py").write_text(self.HELPER)
+        (tmp_path / "main.py").write_text(textwrap.dedent("""
+            import jax
+            from helper_mod import helper
+
+            @jax.jit
+            def f(x):
+                if helper() == "on":
+                    return x * 2
+                return x
+            """))
+        got = self._scan(tmp_path)
+        assert [(f.rule, f.path, f.symbol) for f in got] == \
+            [("APX101", "helper_mod.py", "helper")]
+        assert "cross-module" in got[0].message or "main" in got[0].message
+
+    def test_function_local_import_and_alias(self, tmp_path):
+        """The fused_ce shape: the import lives INSIDE the traced
+        closure; and the `import m as alias` dotted-call spelling."""
+        (tmp_path / "helper_mod.py").write_text(self.HELPER)
+        (tmp_path / "main.py").write_text(textwrap.dedent("""
+            import jax
+            import helper_mod as hm
+
+            @jax.jit
+            def f(x):
+                from helper_mod import helper
+                return x if helper() else x * hm.helper()
+            """))
+        got = self._scan(tmp_path)
+        assert [(f.rule, f.path) for f in got] == \
+            [("APX101", "helper_mod.py")]
+
+    def test_package_relative_import(self, tmp_path):
+        """Packages resolve: `from .kernels import helper` inside
+        pkg/api.py marks pkg/kernels.py's helper traced."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "kernels.py").write_text(self.HELPER)
+        (pkg / "api.py").write_text(textwrap.dedent("""
+            import jax
+            from .kernels import helper
+
+            @jax.jit
+            def f(x):
+                return x * helper()
+            """))
+        got = self._scan(tmp_path)
+        assert [(f.rule, f.path, f.symbol) for f in got] == \
+            [("APX101", str(Path("pkg") / "kernels.py"), "helper")]
+
+    def test_package_init_relative_import(self, tmp_path):
+        """Relative imports in a package __init__.py resolve against
+        the package ITSELF (python semantics) — review finding: the
+        parent-of-module rule resolved one level too shallow and the
+        seed was silently dropped."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "kernels.py").write_text(self.HELPER)
+        (pkg / "__init__.py").write_text(textwrap.dedent("""
+            import jax
+            from .kernels import helper
+
+            @jax.jit
+            def f(x):
+                return x * helper()
+            """))
+        got = self._scan(tmp_path)
+        assert [(f.rule, f.path, f.symbol) for f in got] == \
+            [("APX101", str(Path("pkg") / "kernels.py"), "helper")]
+
+    def test_colliding_module_names_never_mislink(self, tmp_path):
+        """Two bare roots both holding utils.py: the dotted name is
+        ambiguous, so NO cross-module seed may land through it (a wrong
+        -file APX101 is worse than a missed link)."""
+        for d in ("libA", "libB"):
+            (tmp_path / d).mkdir()
+            (tmp_path / d / "utils.py").write_text(self.HELPER)
+        (tmp_path / "libB" / "main.py").write_text(textwrap.dedent("""
+            import jax
+            from utils import helper
+
+            @jax.jit
+            def f(x):
+                return x * helper()
+            """))
+        got = analyze_paths(
+            [str(tmp_path / "libA"), str(tmp_path / "libB")],
+            DEFAULT_RULES, axis_registry=set(AXES), rel_to=str(tmp_path))
+        assert got == []
+
+    def test_untraced_cross_module_call_not_flagged(self, tmp_path):
+        """A helper reached only from plain (untraced) code stays
+        clean — reachability, not mere import, is the trigger."""
+        (tmp_path / "helper_mod.py").write_text(self.HELPER)
+        (tmp_path / "main.py").write_text(textwrap.dedent("""
+            from helper_mod import helper
+
+            def plain():
+                return helper()
+            """))
+        assert self._scan(tmp_path) == []
+
+    def test_local_binding_shadows_import(self, tmp_path):
+        """A module-local def with the imported name wins resolution —
+        the other module must not be marked through the shadowed
+        name."""
+        (tmp_path / "helper_mod.py").write_text(self.HELPER)
+        (tmp_path / "main.py").write_text(textwrap.dedent("""
+            import jax
+            from helper_mod import helper
+
+            def helper():
+                return 1
+
+            @jax.jit
+            def f(x):
+                return x * helper()
+            """))
+        assert self._scan(tmp_path) == []
+
+
 # ------------------------------------------------------------- baseline
 class TestBaseline:
     def _write(self, tmp_path, entries):
