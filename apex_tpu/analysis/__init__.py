@@ -12,9 +12,18 @@ machine-checked invariants):
   no-op on CPU, garbage or a deleted-array error on TPU.
 - **APX201/202** collective-axis consistency against the
   ``parallel_state.py`` mesh registry (``rules_collectives``).
+- **APX203/204** axis-scope dataflow (``dataflow`` + ``rules_collectives``):
+  a registered-axis collective reachable only from ``jit``/``pjit``
+  (no axis bound), or under a ``shard_map`` nest that binds only OTHER
+  axes.
 - **APX301/302** Mosaic dtype-dependent tiling contracts for Pallas
   block shapes (``rules_tiling``) — the ``_ceil_block(..., 8)``-on-bf16
   class.
+- **APX303** scratch/accumulator dtype narrower than the dot's
+  ``preferred_element_type`` (``rules_precision`` + the ``dataflow``
+  dtype lattice) — fp32 accumulation silently re-rounded to bf16.
+- **APX304** provable per-``pallas_call`` VMEM footprint over budget
+  (``rules_tiling``, warning).
 - **APX401/402** indexing/precision hygiene: unclamped vocab gathers
   and fp32 constants in bf16 paths (``rules_precision``) — the
   ``gpt.py:447`` class.
@@ -23,47 +32,69 @@ CLI: ``python -m apex_tpu.analysis [paths] [--baseline FILE]`` — see
 ``docs/static_analysis.md`` for rule details, the baseline format, and
 how to add a rule.  This package imports NO jax: it must run in
 containers where jax is broken and over trees that do not import.
+(The jax-importing lowered-artifact tier lives in
+``apex_tpu.analysis.lowered`` and is deliberately NOT imported here —
+``import apex_tpu.analysis.lowered`` is an explicit, test-suite-side
+opt-in.)
 """
 
 from apex_tpu.analysis.baseline import (
     BaselineEntry, BaselineError, apply_baseline, load_baseline,
+    write_baseline,
 )
 from apex_tpu.analysis.core import (
     Finding, ModuleContext, Rule, analyze_file, analyze_paths,
     discover_axis_registry,
 )
 from apex_tpu.analysis.rules_collectives import (
+    CollectiveAxisOutsideShardMapNest, CollectiveAxisUnboundUnderJit,
     CollectiveOutsideSpmdContext, UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_precision import (
-    Fp32ConstantInBf16Path, UnclampedTakeAlongAxis,
+    Fp32ConstantInBf16Path, ScratchAccumDtypeMismatch,
+    UnclampedTakeAlongAxis,
 )
 from apex_tpu.analysis.rules_tiling import (
     BlockShapeTilingViolation, BlockSpecIndexMapArity,
-    HardCodedSublaneAlignment,
+    HardCodedSublaneAlignment, VmemFootprintOverBudget,
 )
 from apex_tpu.analysis.rules_trace import (
     ProcessGlobalEnvMutation, TraceTimeHostStateRead,
 )
 
-#: Every shipped rule, instantiated — the CLI's and the test suite's
-#: single source of truth for "what does a full run check".
-DEFAULT_RULES = (
-    TraceTimeHostStateRead(),
-    ProcessGlobalEnvMutation(),
-    DonatedBufferReuse(),
-    UnknownCollectiveAxis(),
-    CollectiveOutsideSpmdContext(),
-    BlockShapeTilingViolation(),
-    BlockSpecIndexMapArity(),
-    HardCodedSublaneAlignment(),
-    UnclampedTakeAlongAxis(),
-    Fp32ConstantInBf16Path(),
-)
+
+def default_rules(vmem_budget_bytes=None):
+    """Every shipped rule, instantiated — the one place that knows the
+    full set.  ``vmem_budget_bytes`` overrides APX304's 16 MiB default
+    (the CLI's ``--vmem-budget-mib``)."""
+    vmem = VmemFootprintOverBudget() if vmem_budget_bytes is None \
+        else VmemFootprintOverBudget(budget_bytes=vmem_budget_bytes)
+    return (
+        TraceTimeHostStateRead(),
+        ProcessGlobalEnvMutation(),
+        DonatedBufferReuse(),
+        UnknownCollectiveAxis(),
+        CollectiveOutsideSpmdContext(),
+        CollectiveAxisUnboundUnderJit(),
+        CollectiveAxisOutsideShardMapNest(),
+        BlockShapeTilingViolation(),
+        BlockSpecIndexMapArity(),
+        HardCodedSublaneAlignment(),
+        vmem,
+        ScratchAccumDtypeMismatch(),
+        UnclampedTakeAlongAxis(),
+        Fp32ConstantInBf16Path(),
+    )
+
+
+#: The default instantiation — the CLI's and the test suite's single
+#: source of truth for "what does a full run check".
+DEFAULT_RULES = default_rules()
 
 __all__ = [
     "BaselineEntry", "BaselineError", "DEFAULT_RULES", "Finding",
     "ModuleContext", "Rule", "analyze_file", "analyze_paths",
-    "apply_baseline", "discover_axis_registry", "load_baseline",
+    "apply_baseline", "default_rules", "discover_axis_registry",
+    "load_baseline", "write_baseline",
 ]

@@ -14,8 +14,8 @@ import os
 import sys
 
 from apex_tpu.analysis import (
-    DEFAULT_RULES, BaselineError, analyze_paths, apply_baseline,
-    discover_axis_registry, load_baseline,
+    BaselineError, analyze_paths, apply_baseline, default_rules,
+    discover_axis_registry, load_baseline, sarif, write_baseline,
 )
 
 DEFAULT_PATHS = ("apex_tpu", "bench.py", "examples")
@@ -58,12 +58,28 @@ def main(argv=None) -> int:
                          f"any scanned path)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore any baseline: report everything")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline file for the CURRENT "
+                         "findings: kept entries verbatim, stale "
+                         "entries dropped, new findings added with a "
+                         "justification of 'TODO' that the loader "
+                         "REJECTS — the refresh is mechanical, the "
+                         "review is not skippable")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--axes", default=None,
                     help="comma-separated collective-axis registry "
                          "override (default: *_AXIS constants parsed "
                          "from any scanned parallel_state.py)")
+    ap.add_argument("--vmem-budget-mib", type=float, default=None,
+                    help="APX304 per-pallas_call VMEM budget in MiB "
+                         "(default 16)")
     args = ap.parse_args(argv)
+    if args.update_baseline and args.no_baseline:
+        # --no-baseline loads nothing, so the rewrite would drop every
+        # reviewed justification and emit TODOs for the whole tree
+        ap.error("--update-baseline with --no-baseline would discard "
+                 "every existing justification; drop one of the flags")
 
     paths = args.paths or [p for p in DEFAULT_PATHS if os.path.exists(p)]
     if not paths:
@@ -74,20 +90,39 @@ def main(argv=None) -> int:
 
     registry = (set(a for a in args.axes.split(",") if a)
                 if args.axes is not None else discover_axis_registry(paths))
-    findings = analyze_paths(paths, DEFAULT_RULES, registry)
+    rules = default_rules(
+        vmem_budget_bytes=None if args.vmem_budget_mib is None
+        else int(args.vmem_budget_mib * 2 ** 20))
+    findings = analyze_paths(paths, rules, registry)
 
     entries = []
+    baseline_path = args.baseline or _find_default_baseline(paths)
     if not args.no_baseline:
-        baseline_path = args.baseline or _find_default_baseline(paths)
-        if baseline_path:
+        bootstrapping = (args.update_baseline and baseline_path
+                         and not os.path.isfile(baseline_path))
+        if baseline_path and not bootstrapping:
             try:
-                entries = load_baseline(baseline_path)
+                entries = load_baseline(
+                    baseline_path, allow_todo=args.update_baseline)
             except BaselineError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
     kept, suppressed, stale = apply_baseline(findings, entries)
 
-    if args.format == "json":
+    if args.update_baseline:
+        target = baseline_path or DEFAULT_BASELINE
+        n_kept, n_dropped, n_added = write_baseline(
+            target, findings, entries)
+        print(f"{target}: kept {n_kept} entr(ies), dropped {n_dropped} "
+              f"stale, added {n_added} with justification \"TODO\""
+              + (" — fill every TODO in before the next run will load "
+                 "this file" if n_added else ""),
+              file=sys.stderr)
+        return 0
+
+    if args.format == "sarif":
+        print(json.dumps(sarif.render(kept, suppressed, rules), indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "findings": [f.to_json() for f in kept],
             "suppressed": [f.to_json() for f in suppressed],

@@ -42,7 +42,11 @@ class BaselineError(ValueError):
     pass
 
 
-def load_baseline(path: str) -> List[BaselineEntry]:
+def load_baseline(path: str,
+                  allow_todo: bool = False) -> List[BaselineEntry]:
+    """``allow_todo`` is for the --update-baseline path ONLY: the
+    placeholder entries it is about to regenerate must not block the
+    regeneration itself.  Every normal load rejects them."""
     try:
         with open(path, encoding="utf-8") as f:
             data = json.load(f)
@@ -56,17 +60,68 @@ def load_baseline(path: str) -> List[BaselineEntry]:
         if missing:
             raise BaselineError(
                 f"baseline entry #{i} missing {sorted(missing)}")
-        if not str(raw["justification"]).strip():
+        just = str(raw["justification"]).strip()
+        if not just:
             raise BaselineError(
                 f"baseline entry #{i} ({raw['rule']} {raw['path']}): "
                 f"empty justification — every suppression must explain "
                 f"WHY the finding is acceptable")
+        if not allow_todo and (just.upper() == "TODO"
+                               or just.upper().startswith("TODO:")):
+            raise BaselineError(
+                f"baseline entry #{i} ({raw['rule']} {raw['path']}): "
+                f"justification is the '{just}' placeholder "
+                f"--update-baseline writes — replace it with the actual "
+                f"reason this finding is acceptable AS IS (a TODO "
+                f"suppression is a rubber stamp)")
         entries.append(BaselineEntry(
             rule=raw["rule"], path=raw["path"],
             symbol=raw.get("symbol", "*"),
             contains=raw.get("contains", ""),
             justification=raw["justification"]))
     return entries
+
+
+#: How much of a finding's message --update-baseline pins in the
+#: ``contains`` matcher: enough to distinguish same-symbol findings,
+#: short enough to survive wording tweaks elsewhere in the message.
+_CONTAINS_CHARS = 60
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   entries: Sequence[BaselineEntry]) -> Tuple[int, int, int]:
+    """Regenerate the baseline for the CURRENT findings: entries that
+    still suppress something are kept verbatim (their justifications
+    are reviewed text — never regenerate those), stale entries are
+    dropped, and every unsuppressed finding gains a new entry whose
+    justification is the literal placeholder ``"TODO"`` — which
+    :func:`load_baseline` REJECTS, so the refreshed file fails loudly
+    until a human replaces each placeholder with a real reason.
+    Returns (kept, dropped, added)."""
+    kept_f, suppressed, stale = apply_baseline(findings, entries)
+    survivors = [e for e in entries if e not in stale]
+    added = [
+        BaselineEntry(
+            rule=f.rule, path=f.path.replace("\\", "/"), symbol=f.symbol,
+            contains=f.message[:_CONTAINS_CHARS], justification="TODO")
+        for f in kept_f
+    ]
+    payload = {
+        "_comment": (
+            "Suppressions for `python -m apex_tpu.analysis` (see "
+            "docs/static_analysis.md). Every entry MUST carry a "
+            "justification explaining why the finding is acceptable AS "
+            "IS — the loader rejects entries without one, and rejects "
+            "the 'TODO' placeholder --update-baseline writes. Match is "
+            "rule + path suffix + enclosing symbol + message substring "
+            "(never line numbers). Remove entries when the code they "
+            "cover is fixed; the CLI reports stale entries."),
+        "entries": [dataclasses.asdict(e) for e in survivors + added],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return len(survivors), len(stale), len(added)
 
 
 def apply_baseline(

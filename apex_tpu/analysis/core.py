@@ -585,6 +585,11 @@ def analyze_paths(paths: Iterable[str], rules: Iterable[Rule],
                 ctxs[loaded.module_name] = loaded
             ordered.append(loaded)
     _link_cross_module(ctxs)
+    # the axis-scope dataflow runs its own cross-module fixpoint so the
+    # collective rules see shard_map wrappers that live in other files
+    # (imported here, not at module top: dataflow imports core)
+    from apex_tpu.analysis import dataflow
+    dataflow.link_axis_scopes(ctxs)
     for ctx in ordered:
         for rule in rules:
             findings.extend(rule.check(ctx))

@@ -1,0 +1,650 @@
+"""Symbolic dataflow for the analyzer: axis-binding scopes + a dtype
+lattice.  Pure-stdlib ``ast`` like the rest of the package — no jax.
+
+Two facts the pattern-matching rules cannot compute:
+
+- **Axis-binding scopes** (:class:`Scope`, :func:`scopes_at`): for each
+  function, the set of trace contexts it is reachable from, each
+  carrying the mesh axis names *provably bound* there.  ``shard_map``
+  and ``pmap`` bind axes (collectives legal); ``jit``/``pjit``
+  auto-sharding binds none — a ``lax.psum("dp")`` reachable only
+  through ``jit`` fails at trace time, but only on the code path that
+  traces it, which for TPU-gated code is the chip.  Scopes propagate
+  through the module-local call graph exactly like the traced index,
+  and :func:`link_axis_scopes` runs the same import-resolved
+  cross-module fixpoint, so a helper whose only shard_map wrapper
+  lives in another file still gets its axes.
+
+- **Dtype lattice** (:func:`dtype_literal`, :func:`dtype_env`,
+  :func:`itemsize`): dtype names resolved through local assignments
+  (``dot_dtype = jnp.bfloat16`` … ``jnp.zeros(s, dot_dtype)``), so the
+  precision rules can compare a Pallas scratch dtype against the
+  ``preferred_element_type`` of the dot that accumulates into it, and
+  the tiling rules can price VMEM blocks whose dims thread through
+  ``bn = 256``-style aliases.
+
+Approximations (all fail QUIET, never loud): a binding whose axes
+cannot be read statically (dynamic mesh, spec variables) is recorded
+as ``unknown`` and silences the collective rules for that path; a
+function with no computed scope at all is host code as far as this
+pass can see, and the rules fall back to nothing (APX202's module
+heuristic covers the literal-collective-with-invisible-caller case).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from apex_tpu.analysis.core import (
+    TRACE_ENTRYPOINTS, ModuleContext, _is_partial, dotted_name, last_name,
+)
+
+# ----------------------------------------------------------------- scopes
+#: Entry points that establish a fresh *non-binding* trace root: under
+#: jit/pjit auto-sharding no mesh axis name is bound, whatever the
+#: in_shardings say — collectives need shard_map/pmap.
+_JIT_ROOTS = {"jit", "pjit"}
+
+#: Entry points that bind mesh axes over their function argument.
+_BINDING_ROOTS = {"shard_map", "pmap", "xmap"}
+
+#: Mesh constructors whose axis-name argument names every bindable axis.
+_MESH_CTORS = {"Mesh", "AbstractMesh", "make_mesh"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Scope:
+    """One trace context a function is reachable from.
+
+    ``axes``: axis names provably bound on this path.  ``unknown``:
+    additional axes *may* be bound (a dynamic mesh / non-literal
+    axis_name somewhere in the nest) — rules must stay quiet.
+    ``shard_map``: a shard_map/pmap/xmap participates in the nest (the
+    APX203-vs-204 discriminator)."""
+
+    axes: FrozenSet[str] = frozenset()
+    unknown: bool = False
+    shard_map: bool = False
+
+    def binds(self, axis: str) -> bool:
+        return axis in self.axes or self.unknown
+
+
+def _str_constants(node: ast.AST) -> List[str]:
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.append(sub.value)
+    return out
+
+
+def _mesh_axes(node: Optional[ast.AST],
+               aliases: Dict[str, ast.AST]) -> Optional[FrozenSet[str]]:
+    """The full axis-name set of a ``Mesh(devs, ("dp", "tp"))`` /
+    ``make_mesh(shape, axis_names)`` expression (resolved through one
+    local-alias hop), or None when it cannot be read statically.  A
+    resolvable mesh is the only way to know EVERY axis shard_map binds
+    — in_specs only name the partitioned subset."""
+    if isinstance(node, ast.Name):
+        node = aliases.get(node.id)
+    if not (isinstance(node, ast.Call)
+            and last_name(node.func) in _MESH_CTORS):
+        return None
+    names = None
+    for kw in node.keywords:
+        if kw.arg == "axis_names":
+            names = kw.value
+    if names is None and len(node.args) > 1:
+        names = node.args[1]
+    if names is None:
+        return None
+    if isinstance(names, ast.Constant) and isinstance(names.value, str):
+        return frozenset({names.value})
+    if isinstance(names, (ast.Tuple, ast.List)):
+        if all(isinstance(e, ast.Constant) and isinstance(e.value, str)
+               for e in names.elts):
+            return frozenset(e.value for e in names.elts)
+    return None
+
+
+def _spec_axes(nodes: Iterable[ast.AST]) -> FrozenSet[str]:
+    """Axis names mentioned in ``P(...)``/``PartitionSpec(...)`` calls
+    under the given spec expressions — a LOWER bound on what the mesh
+    binds (replicated axes never appear in specs)."""
+    axes: Set[str] = set()
+    for node in nodes:
+        if node is None:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and last_name(sub.func) in ("P", "PartitionSpec"):
+                for arg in sub.args:
+                    axes.update(_str_constants(arg))
+    return frozenset(axes)
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _binding_axes(entry: str, call: ast.Call,
+                  aliases: Dict[str, ast.AST]
+                  ) -> Tuple[FrozenSet[str], bool]:
+    """(axes, unknown) bound by one shard_map/pmap/xmap call site."""
+    if entry == "shard_map":
+        mesh = _kwarg(call, "mesh")
+        if mesh is None and len(call.args) > 1:
+            mesh = call.args[1]
+        axes = _mesh_axes(mesh, aliases)
+        if axes is not None:
+            return axes, False
+        specs = [_kwarg(call, "in_specs"), _kwarg(call, "out_specs")]
+        specs += call.args[2:4]
+        return _spec_axes(specs), True
+    if entry == "pmap":
+        name = _kwarg(call, "axis_name")
+        if name is None and len(call.args) > 1:
+            name = call.args[1]
+        if name is None:
+            # unnamed mapped axis: spmd context, but no NAME is bound
+            return frozenset(), False
+        if isinstance(name, ast.Constant) and isinstance(name.value, str):
+            return frozenset({name.value}), False
+        return frozenset(), True
+    return frozenset(), True  # xmap: axes out of static reach
+
+
+def _vmap_axis(call: ast.Call) -> Optional[Tuple[FrozenSet[str], bool]]:
+    """vmap with a literal ``axis_name`` binds that axis (collectives
+    over a vmapped named axis are legal); without one it is pure
+    vectorization — neutral, handled by the caller."""
+    name = _kwarg(call, "axis_name")
+    if name is None:
+        return None
+    if isinstance(name, ast.Constant) and isinstance(name.value, str):
+        return frozenset({name.value}), False
+    return frozenset(), True
+
+
+class AxisScopeIndex:
+    """Per-module axis-binding scopes, built like the traced index:
+    decorator + call-site seeds, then a call-graph fixpoint.  Lambdas
+    are tracked by identity (no qualname)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.scopes: Dict[str, Set[Scope]] = {}
+        self.lambda_scopes: Dict[ast.Lambda, Set[Scope]] = {}
+        # name -> function-name aliases (x = f / x = partial(f, ...))
+        self._fn_aliases: Dict[str, str] = {}
+        # name -> value-node aliases for mesh resolution; lexically
+        # LAST assignment wins (the APX105 house rule: ast.walk order
+        # is breadth-first, not source order)
+        self._value_aliases: Dict[str, ast.AST] = {}
+        assigns = [
+            node for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ]
+        for node in sorted(assigns, key=lambda n: (n.lineno, n.col_offset)):
+            tgt = node.targets[0].id
+            self._value_aliases[tgt] = node.value
+            if isinstance(node.value, ast.Name):
+                self._fn_aliases[tgt] = node.value.id
+            elif isinstance(node.value, ast.Call) \
+                    and _is_partial(node.value) and node.value.args \
+                    and isinstance(node.value.args[0], ast.Name):
+                self._fn_aliases[tgt] = node.value.args[0].id
+        self._entry_sites: List[Tuple[ast.Call, str]] = [
+            (node, last_name(node.func))
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Call)
+            and last_name(node.func) in TRACE_ENTRYPOINTS
+        ]
+        self._seed_decorators()
+        self._fixpoint()
+
+    # ------------------------------------------------------------- sizes
+    def size(self) -> int:
+        return (sum(len(s) for s in self.scopes.values())
+                + sum(len(s) for s in self.lambda_scopes.values()))
+
+    # ------------------------------------------------------------ seeding
+    def _add(self, qualname: str, scopes: Set[Scope]) -> bool:
+        cur = self.scopes.setdefault(qualname, set())
+        before = len(cur)
+        cur |= scopes
+        return len(cur) != before
+
+    def _add_lambda(self, lam: ast.Lambda, scopes: Set[Scope]) -> bool:
+        cur = self.lambda_scopes.setdefault(lam, set())
+        before = len(cur)
+        cur |= scopes
+        return len(cur) != before
+
+    def _extend(self, entry: str, call: ast.Call,
+                base: Optional[Set[Scope]]) -> Set[Scope]:
+        """Scopes the function-valued arguments of this entry call run
+        under: the caller's scopes, extended by whatever the entry
+        binds.  An empty base is host code — jit establishes a fresh
+        non-binding root there, a binding entry a fresh spmd root, and
+        a neutral combinator (scan/pallas_call/grad/...) an UNKNOWN
+        context (its caller is outside this pass's reach)."""
+        if entry in _JIT_ROOTS:
+            return set(base) if base else {Scope()}
+        binding = None
+        smap = False
+        if entry in _BINDING_ROOTS:
+            binding = _binding_axes(entry, call, self._value_aliases)
+            smap = True
+        elif entry == "vmap":
+            binding = _vmap_axis(call)
+        if binding is not None:
+            axes, unk = binding
+            srcs = base or {Scope()}
+            return {Scope(s.axes | axes, s.unknown or unk,
+                          s.shard_map or smap) for s in srcs}
+        return set(base) if base else {Scope(unknown=True)}
+
+    def _base(self, node: ast.AST) -> Optional[Set[Scope]]:
+        fn = self.ctx.enclosing_function(node)
+        while fn is not None:
+            if isinstance(fn, ast.Lambda):
+                ss = self.lambda_scopes.get(fn)
+            else:
+                ss = self.scopes.get(self.ctx.enclosing_qualname(fn))
+            if ss:
+                return ss
+            fn = self.ctx.enclosing_function(fn)
+        return None
+
+    def _seed_decorators(self) -> None:
+        for qn, info in self.ctx.functions.items():
+            for dec in getattr(info.node, "decorator_list", []):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = last_name(target)
+                inner_call = dec if isinstance(dec, ast.Call) else None
+                if name == "partial" and inner_call is not None \
+                        and inner_call.args:
+                    name = last_name(inner_call.args[0])
+                if name in _JIT_ROOTS:
+                    self._add(qn, {Scope()})
+                elif name in _BINDING_ROOTS:
+                    axes, unk = _binding_axes(
+                        name, inner_call or ast.Call(
+                            func=ast.Name(id=name), args=[], keywords=[]),
+                        self._value_aliases)
+                    self._add(qn, {Scope(axes, unk, True)})
+                # neutral decorators (checkpoint/custom_vjp/...) add no
+                # scope: the body runs wherever the caller traces it,
+                # which plain-call propagation already models
+
+    def _seed_value(self, value: ast.AST, scopes: Set[Scope],
+                    scope: str) -> bool:
+        """Plant ``scopes`` on the function a call argument refers to
+        (Name / partial(f, ..) / lambda / attribute), module-locally.
+        Cross-module targets are handled by :meth:`exports`."""
+        if isinstance(value, ast.Lambda):
+            return self._add_lambda(value, scopes)
+        if isinstance(value, ast.Call) and _is_partial(value) and value.args:
+            return self._seed_value(value.args[0], scopes, scope)
+        name = None
+        if isinstance(value, ast.Name):
+            name = self._fn_aliases.get(value.id, value.id)
+        elif isinstance(value, ast.Attribute):
+            name = last_name(value)
+        if name is None:
+            return False
+        resolved = self.ctx.resolve_function(name, scope)
+        if resolved is not None:
+            return self._add(resolved, scopes)
+        return False
+
+    # ----------------------------------------------------------- fixpoint
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for call, entry in self._entry_sites:
+                ext = self._extend(entry, call, self._base(call))
+                scope = self.ctx.enclosing_qualname(call)
+                scope = "" if scope == "<module>" else scope
+                for arg in list(call.args) + [kw.value
+                                              for kw in call.keywords]:
+                    if self._seed_value(arg, ext, scope):
+                        changed = True
+            if self._propagate():
+                changed = True
+
+    def _propagate(self) -> bool:
+        """Callees and nested defs inherit their caller's scopes — the
+        scope analog of ``ModuleContext._propagate``."""
+        changed = False
+        prog = True
+        while prog:
+            prog = False
+            for lam, ss in list(self.lambda_scopes.items()):
+                scope = self.ctx.enclosing_qualname(lam)
+                scope = "" if scope == "<module>" else scope
+                prog |= self._propagate_body(lam.body, scope, ss)
+            for qn in list(self.scopes):
+                ss = self.scopes[qn]
+                if not ss:
+                    continue
+                info = self.ctx.functions.get(qn)
+                if info is None:
+                    continue
+                for other_qn in self.ctx.functions:
+                    if other_qn.startswith(qn + "."):
+                        if self._add(other_qn, ss):
+                            prog = True
+                prog |= self._propagate_body(info.node, qn, ss)
+            changed |= prog
+        return changed
+
+    def _propagate_body(self, body: ast.AST, scope: str,
+                        ss: Set[Scope]) -> bool:
+        changed = False
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = last_name(sub.func)
+            if callee is None or callee in TRACE_ENTRYPOINTS:
+                continue  # entry sites get the EXTENDED scopes instead
+            resolved = self.ctx.resolve_function(
+                self._fn_aliases.get(callee, callee), scope)
+            if resolved is not None and self._add(resolved, ss):
+                changed = True
+        return changed
+
+    # ------------------------------------------------------- cross-module
+    def _import_target(self, name: str,
+                       scope: str) -> Optional[Tuple[str, str]]:
+        """(module, attr) a bare name resolves to through this module's
+        imports — None when a module-local binding shadows it."""
+        if self.ctx.resolve_function(
+                self._fn_aliases.get(name, name), scope) is not None:
+            return None
+        tgt = self.ctx.from_imports.get(name)
+        if tgt is None:
+            return None
+        mod, attr = tgt
+        return (mod, attr) if mod else (attr, "")
+
+    def _export_value(self, value: ast.AST, scopes: Set[Scope], scope: str,
+                      out: List[Tuple[str, str, FrozenSet[Scope]]]) -> None:
+        if isinstance(value, ast.Call) and _is_partial(value) and value.args:
+            self._export_value(value.args[0], scopes, scope, out)
+            return
+        if isinstance(value, ast.Name):
+            tgt = self._import_target(value.id, scope)
+            if tgt is not None:
+                out.append((*tgt, frozenset(scopes)))
+        elif isinstance(value, ast.Attribute):
+            d = dotted_name(value)
+            if d is None:
+                return
+            head, attr = d.split(".")[:-1], d.split(".")[-1]
+            if head and head[0] in self.ctx.import_aliases:
+                mod = ".".join(
+                    [self.ctx.import_aliases[head[0]]] + head[1:])
+                out.append((mod, attr, frozenset(scopes)))
+
+    def exports(self) -> List[Tuple[str, str, FrozenSet[Scope]]]:
+        """(module, func, scopes) seeds this module plants into OTHER
+        modules: plain calls inside scoped code, and entry-call
+        arguments resolving through imports (``jit(other.f)``)."""
+        out: List[Tuple[str, str, FrozenSet[Scope]]] = []
+        for qn, ss in self.scopes.items():
+            info = self.ctx.functions.get(qn)
+            if info is None or not ss:
+                continue
+            self._export_calls(info.node, qn, ss, out)
+        for lam, ss in self.lambda_scopes.items():
+            scope = self.ctx.enclosing_qualname(lam)
+            scope = "" if scope == "<module>" else scope
+            self._export_calls(lam, scope, ss, out)
+        for call, entry in self._entry_sites:
+            ext = self._extend(entry, call, self._base(call))
+            scope = self.ctx.enclosing_qualname(call)
+            scope = "" if scope == "<module>" else scope
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                self._export_value(arg, ext, scope, out)
+        return out
+
+    def _export_calls(self, body: ast.AST, scope: str, ss: Set[Scope],
+                      out: List[Tuple[str, str, FrozenSet[Scope]]]) -> None:
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted_name(sub.func)
+            if d is None or last_name(sub.func) in TRACE_ENTRYPOINTS:
+                continue
+            parts = d.split(".")
+            if len(parts) == 1:
+                tgt = self._import_target(parts[0], scope)
+                if tgt is not None:
+                    out.append((*tgt, frozenset(ss)))
+                continue
+            head, attr = parts[:-1], parts[-1]
+            if head[0] in self.ctx.import_aliases:
+                mod = ".".join([self.ctx.import_aliases[head[0]]] + head[1:])
+            elif head[0] in self.ctx.from_imports:
+                m0, a0 = self.ctx.from_imports[head[0]]
+                mod = ".".join([f"{m0}.{a0}" if m0 else a0] + head[1:])
+            else:
+                mod = ".".join(head)
+            out.append((mod, attr, frozenset(ss)))
+
+    def mark_external(self, qualname: str, scopes: Set[Scope]) -> bool:
+        """Seed a function's scopes from ANOTHER module and re-run the
+        local fixpoint; True if anything new was recorded."""
+        if qualname not in self.ctx.functions:
+            return False
+        if not self._add(qualname, set(scopes)):
+            return False
+        self._fixpoint()
+        return True
+
+    # -------------------------------------------------------------- query
+    def scopes_for(self, node: ast.AST) -> Optional[Set[Scope]]:
+        """The scope set of the innermost scoped function (or lambda)
+        lexically enclosing ``node`` — None when no enclosing function
+        has any computed scope (host code, or callers out of reach)."""
+        fn = self.ctx.enclosing_function(node)
+        while fn is not None:
+            if isinstance(fn, ast.Lambda):
+                ss = self.lambda_scopes.get(fn)
+            else:
+                ss = self.scopes.get(self.ctx.enclosing_qualname(fn))
+            if ss:
+                return ss
+            fn = self.ctx.enclosing_function(fn)
+        return None
+
+
+def scope_index(ctx: ModuleContext) -> AxisScopeIndex:
+    """The (cached) axis-scope index of one module.  For multi-file
+    runs, :func:`link_axis_scopes` must run first so cross-module
+    wrappers are linked in; single-file analysis sees local scopes
+    only (same contract as the traced index)."""
+    idx = getattr(ctx, "_axis_scope_index", None)
+    if idx is None:
+        idx = AxisScopeIndex(ctx)
+        ctx._axis_scope_index = idx
+    return idx
+
+
+def scopes_at(ctx: ModuleContext, node: ast.AST) -> Optional[Set[Scope]]:
+    return scope_index(ctx).scopes_for(node)
+
+
+def link_axis_scopes(ctxs: Dict[str, Optional[ModuleContext]]) -> None:
+    """Global scope fixpoint across modules, mirroring
+    ``core._link_cross_module``: ambiguous module names (None entries)
+    are never linked through; each module's export list is recomputed
+    only when its scope count grew."""
+    live = [c for c in ctxs.values() if c is not None]
+    for c in live:
+        scope_index(c)
+    memo: Dict[int, Tuple[int, list]] = {}
+    changed = True
+    while changed:
+        changed = False
+        for c in live:
+            idx = scope_index(c)
+            n = idx.size()
+            if memo.get(id(c), (-1,))[0] != n:
+                memo[id(c)] = (n, idx.exports())
+            for mod, attr, ss in memo[id(c)][1]:
+                target = ctxs.get(mod)
+                if target is None or target is c:
+                    continue
+                if scope_index(target).mark_external(attr, set(ss)):
+                    changed = True
+
+
+# ------------------------------------------------------------ dtype lattice
+#: dtype name -> bytes per element.  The lattice is {UNKNOWN} ∪ these
+#: names; anything unresolvable is UNKNOWN (None) and silences rules.
+_ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1, "float8_e4m3": 1,
+    "float8_e4m3fnuz": 1, "float8_e5m2fnuz": 1,
+}
+
+
+def itemsize(dtype_name: Optional[str]) -> Optional[int]:
+    return _ITEMSIZE.get(dtype_name) if dtype_name else None
+
+
+def dtype_literal(node: Optional[ast.AST],
+                  env: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """The dtype name an expression denotes, or None (UNKNOWN): a
+    string literal, ``jnp.float32``-style attribute, ``jnp.dtype(X)``
+    wrapper, or a Name resolved through ``env`` (the local-assignment
+    lattice from :func:`dtype_env`)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _ITEMSIZE else None
+    if isinstance(node, ast.Name):
+        return (env or {}).get(node.id)
+    if isinstance(node, ast.Attribute):
+        name = last_name(node)
+        return name if name in _ITEMSIZE else None
+    if isinstance(node, ast.Call) and last_name(node.func) == "dtype" \
+            and node.args:
+        return dtype_literal(node.args[0], env)
+    return None
+
+
+def _scope_dtype_env(assigns: List[ast.Assign],
+                     base: Dict[str, str]) -> Dict[str, str]:
+    """One ordered pass over a scope's assignments: chains resolve in
+    source order (``a = jnp.bfloat16; b = a``), and a name assigned
+    two DIFFERENT resolvable dtypes — or re-assigned something
+    unresolvable — is POISONED to UNKNOWN rather than last-wins (the
+    two assignments may sit on different branches; claiming either is
+    a wrong finding waiting to happen).  No fixpoint: a single pass
+    terminates by construction."""
+    env = dict(base)
+    poisoned: set = set()
+    for node in sorted(assigns, key=lambda n: (n.lineno, n.col_offset)):
+        name = node.targets[0].id
+        if name in poisoned:
+            continue
+        d = dtype_literal(node.value, env)
+        if d is None:
+            if name in env:  # a dtype name re-bound to who-knows-what
+                del env[name]
+                poisoned.add(name)
+            continue
+        if name in env and env[name] != d:
+            del env[name]
+            poisoned.add(name)
+        else:
+            env[name] = d
+    return env
+
+
+def _dtype_assigns(scope: ast.AST) -> List[ast.Assign]:
+    return [n for n in ast.walk(scope)
+            if isinstance(n, ast.Assign) and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)]
+
+
+def dtype_env(ctx: ModuleContext,
+              fn: Optional[ast.AST] = None) -> Dict[str, str]:
+    """name -> dtype for simple single-target assignments: module
+    TOP-LEVEL constants first (one function's dtype locals must never
+    leak into another's resolution), then — overriding — everything
+    under ``fn``.  The module layer is cached on the ctx: every rule on
+    every pallas_call may ask."""
+    mod_env = getattr(ctx, "_dtype_env_module", None)
+    if mod_env is None:
+        top = [n for n in _dtype_assigns(ctx.tree)
+               if ctx.enclosing_function(n) is None]
+        mod_env = _scope_dtype_env(top, {})
+        ctx._dtype_env_module = mod_env
+    if fn is None:
+        return dict(mod_env)
+    return _scope_dtype_env(_dtype_assigns(fn), mod_env)
+
+
+def scratch_entries(call: ast.Call) -> List[Tuple[ast.AST, Optional[ast.AST],
+                                                  Optional[ast.AST]]]:
+    """``(entry_node, shape_node, dtype_node)`` per scratch buffer of a
+    ``pallas_call``, in declaration order.  Handles the plain list and
+    the repo's ``[pltpu.VMEM(shape, dtype)] * 3`` spelling; entries
+    that are not ``VMEM``/``SMEM``/``ANY`` calls (e.g. ``pltpu.SemaphoreType``)
+    yield ``(node, None, None)`` — counted (they consume a kernel
+    parameter) but unpriceable."""
+    arg = _kwarg(call, "scratch_shapes")
+    if arg is None:
+        return []
+    repeat = 1
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mult):
+        lst, n = arg.left, arg.right
+        if isinstance(lst, (ast.List, ast.Tuple)) \
+                and isinstance(n, ast.Constant) and isinstance(n.value, int):
+            arg, repeat = lst, n.value
+    if not isinstance(arg, (ast.List, ast.Tuple)):
+        return []
+    out = []
+    for el in arg.elts:
+        if isinstance(el, ast.Call) \
+                and last_name(el.func) in ("VMEM", "SMEM", "ANY"):
+            shape = el.args[0] if el.args else _kwarg(el, "shape")
+            dtype = el.args[1] if len(el.args) > 1 else _kwarg(el, "dtype")
+            out.append((el, shape, dtype))
+        else:
+            out.append((el, None, None))
+    return out * repeat
+
+
+def literal_dims(shape_node: Optional[ast.AST],
+                 aliases: Dict[str, ast.AST]) -> Optional[List[int]]:
+    """A shape tuple as concrete ints, resolving Name dims through one
+    local-assignment hop (``bn = 256``); None when any dim stays
+    dynamic — rules must treat the whole shape as unknowable."""
+    if not isinstance(shape_node, (ast.Tuple, ast.List)):
+        return None
+    out: List[int] = []
+    for el in shape_node.elts:
+        if isinstance(el, ast.Name):
+            el = aliases.get(el.id, el)
+        if isinstance(el, ast.Constant) and isinstance(el.value, int) \
+                and not isinstance(el.value, bool):
+            out.append(el.value)
+        else:
+            return None
+    return out

@@ -1,0 +1,196 @@
+"""Lowered-artifact invariant checkers — the analyzer's SECOND tier.
+
+The AST tier (the rest of this package) proves properties of source
+code; this module proves properties of what the compiler was actually
+ASKED to do, by pattern-matching ``jax.jit(...).lower(...)`` artifacts.
+The two tiers are complementary: no AST rule can see that a bucketed
+optimizer's grad sync lowered to one reduce-scatter per bucket, and no
+HLO grep survives a refactor that renames the function it was pinned
+to — these checkers live in tests, next to the step builders they pin.
+
+**This module imports jax** and is deliberately NOT imported by
+``apex_tpu.analysis.__init__`` or the CLI: the no-jax contract of the
+AST tier (runs in broken containers, over trees that do not import)
+stays intact.  Import it explicitly — ``from apex_tpu.analysis import
+lowered`` — from test code.
+
+Checkers accept a ``jax.stages.Lowered``, anything with ``as_text()``,
+or a plain StableHLO/MHLO text dump.  They assert on the LOWERING, not
+the compiled module, wherever possible: the CPU backend's compile
+rewrites TPU-irrelevant details (e.g. upcasting bf16 collectives), so
+the lowering is what faithfully records the program's intent.  The one
+exception is :func:`assert_donation_covers` with ``compiled=True``,
+which reads the compiled module's ``input_output_alias`` header — the
+aliasing table only materializes at compile time.
+
+Born from PR 4's inline string-grep asserts in
+``tests/test_distributed_optimizers.py`` (per-bucket reduce-scatters,
+no whole-tree concat, donation aliasing), refactored here so
+``tests/test_lowered_invariants.py`` can pin the same invariants on
+the real GPT train steps.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+import jax
+
+__all__ = [
+    "hlo_text", "count_collectives", "operand_dtypes",
+    "assert_collective_dtype", "assert_no_whole_tree_concat",
+    "assert_donation_covers", "donated_buffer_count",
+]
+
+#: collective ops that carry a reduction REGION in StableHLO — their
+#: type signature follows the closing ``})`` of the region, so the
+#: dtype regex must skip it (re.S); region-less ops type right after
+#: their attribute dict on the same line.
+_REGION_OPS = {"reduce_scatter", "all_reduce", "reduce"}
+
+
+def hlo_text(artifact) -> str:
+    """The StableHLO/MHLO text of a lowering artifact: a str passes
+    through, anything with ``as_text()`` (``Lowered``, ``Compiled``)
+    is rendered."""
+    if isinstance(artifact, str):
+        return artifact
+    if hasattr(artifact, "as_text"):
+        return artifact.as_text()
+    raise TypeError(
+        f"expected StableHLO text or an object with as_text() "
+        f"(jax.stages.Lowered / Compiled), got {type(artifact).__name__}")
+
+
+def _op_occurrences(txt: str, kind: str) -> List[str]:
+    # MLIR prints ops in generic quoted form ("stablehlo.reduce_scatter")
+    # inside shard_map bodies and pretty unquoted form (stablehlo.
+    # concatenate) elsewhere — match the dotted name either way
+    return re.findall(
+        r'(?:stablehlo|mhlo)\.' + re.escape(kind) + r'\b', txt)
+
+
+def count_collectives(artifact, kind: str, *,
+                      minimum: Optional[int] = None,
+                      maximum: Optional[int] = None) -> int:
+    """Occurrences of one collective (``reduce_scatter``,
+    ``all_gather``, ``all_reduce``, ``all_to_all``,
+    ``collective_permute``, ...) in the lowering.  With ``minimum``/
+    ``maximum`` given, asserts the count is inside the bounds — the
+    per-bucket contract reads ``count_collectives(txt,
+    "reduce_scatter", minimum=n_buckets, maximum=n_buckets)``."""
+    txt = hlo_text(artifact)
+    n = len(_op_occurrences(txt, kind))
+    if minimum is not None:
+        assert n >= minimum, (
+            f"expected >= {minimum} {kind} collective(s) in the "
+            f"lowering, found {n} — the per-bucket plan did not lower "
+            f"to per-bucket collectives")
+    if maximum is not None:
+        assert n <= maximum, (
+            f"expected <= {maximum} {kind} collective(s) in the "
+            f"lowering, found {n} — something introduced extra "
+            f"collectives (a whole-tree sync path?)")
+    return n
+
+
+def operand_dtypes(artifact, kind: str) -> List[str]:
+    """Element dtype of each ``kind`` collective's first operand, in
+    program order (``["bf16", "f32"]`` for a two-dtype bucket plan).
+    Ops with reduction regions type after the region's ``})``;
+    region-less ops type directly."""
+    txt = hlo_text(artifact)
+    if kind in _REGION_OPS:
+        pat = (r'"?(?:stablehlo|mhlo)\.' + re.escape(kind)
+               + r'\b.*?\}\)\s*:\s*\(tensor<[0-9x]*x?(\w+)>')
+        return re.findall(pat, txt, re.S)
+    # the literal "( " before tensor<> is load-bearing: it anchors the
+    # match to the op's TYPE SIGNATURE, skipping `dense<...> :
+    # tensor<NxMxi64>` replica_groups attributes inside the attr dict
+    pat = (r'"?(?:stablehlo|mhlo)\.' + re.escape(kind)
+           + r'\b.*?:\s*\(tensor<[0-9x]*x?(\w+)>')
+    return re.findall(pat, txt)
+
+
+def assert_collective_dtype(artifact, kind: str, dtype: str,
+                            mode: str = "any") -> None:
+    """Assert the wire dtype of ``kind`` collectives: ``mode="any"`` —
+    at least one runs in ``dtype`` (the bf16 bucket syncs in bf16);
+    ``mode="all"`` — every one does (grad_sync_dtype=fp32 forces the
+    whole plan up); ``mode="none"`` — none does."""
+    dts = operand_dtypes(artifact, kind)
+    if mode == "any":
+        assert dtype in dts, (
+            f"no {kind} with {dtype} operands in the lowering "
+            f"(found {dts or 'none'}) — the {dtype} bucket is not "
+            f"syncing on its own wire type")
+    elif mode == "all":
+        assert dts and all(d == dtype for d in dts), (
+            f"expected every {kind} in {dtype}, found {dts or 'none'}")
+    elif mode == "none":
+        assert dtype not in dts, (
+            f"found a {kind} with {dtype} operands ({dts}) — "
+            f"expected none")
+    else:
+        raise ValueError(f"mode must be any/all/none, got {mode!r}")
+
+
+def assert_no_whole_tree_concat(artifact, total_elements: int,
+                                dtype: str = "f32") -> None:
+    """No concatenate producing the FULL flat tree (``total_elements``
+    x ``dtype``) anywhere in the lowering — the signature of the
+    pre-bucket ``_flatten`` stub (one whole-model HBM round trip per
+    step) that the bucket plan exists to avoid."""
+    txt = hlo_text(artifact)
+    m = re.search(
+        r'"?(?:stablehlo|mhlo)\.concatenate"?.*->\s*tensor<'
+        + str(int(total_elements)) + r'x' + re.escape(dtype) + r'>', txt)
+    assert m is None, (
+        f"the lowering concatenates the whole tree to one "
+        f"tensor<{total_elements}x{dtype}> — a full-model flatten is "
+        f"back in the step (the pre-bucket _flatten shape)")
+
+
+def donated_buffer_count(artifact) -> int:
+    """Buffers the LOWERING declares donatable: ``jax.buffer_donor``
+    (shard_map inputs) plus ``tf.aliasing_output`` (plain-jit donated
+    args pre-aliased to outputs)."""
+    txt = hlo_text(artifact)
+    return txt.count("jax.buffer_donor") + txt.count("tf.aliasing_output")
+
+
+def _expected_leaves(donated_trees: Sequence, extra: int) -> int:
+    return extra + sum(
+        len(jax.tree_util.tree_leaves(t)) for t in donated_trees)
+
+
+def assert_donation_covers(lowered, *donated_trees, extra: int = 0,
+                           compiled: bool = True) -> None:
+    """Every leaf of ``donated_trees`` (plus ``extra`` buffers) must be
+    donated through the step: the lowering declares at least that many
+    donatable buffers, and — with ``compiled=True`` — the compiled
+    module's ``input_output_alias`` table actually aliases them to
+    outputs.  Donation that LOWERS but does not ALIAS is the silent
+    failure mode (XLA drops donations it cannot use, keeping the ~3x
+    param-bytes peak the donation was written to avoid), so prefer the
+    compiled check whenever the test budget allows; ``compiled=False``
+    skips the XLA compile and pins only the declaration."""
+    n = _expected_leaves(donated_trees, extra)
+    assert n > 0, "no donated leaves to check — pass the donated trees"
+    declared = donated_buffer_count(lowered)
+    assert declared >= n, (
+        f"{declared} buffer(s) declared donatable in the lowering but "
+        f"the donated trees hold {n} leaves — donate_argnums is not "
+        f"covering the state (dropped arg? tuple index drift?)")
+    if not compiled:
+        return
+    hdr = lowered.compile().as_text().splitlines()[0]
+    assert "input_output_alias=" in hdr, (
+        f"compiled module has no input_output_alias table at all — "
+        f"every donation was dropped: {hdr}")
+    aliased = hdr.count("may-alias") + hdr.count("must-alias")
+    assert aliased >= n, (
+        f"only {aliased} aliased buffer(s) in input_output_alias for "
+        f"{n} donated leaves — XLA dropped donations (dtype/layout "
+        f"mismatch between the donated input and every output?)")

@@ -7,13 +7,27 @@ configurations never execute it at all.  The registry of legal names
 comes from ``transformer/parallel_state.py``'s ``*_AXIS`` constants
 (discovered by the engine), so the linter tracks the mesh definition
 instead of a hand-maintained list.
+
+Three tiers of precision, each yielding EXACTLY ONE finding per
+hazard:
+
+- APX201 (registry): the axis name is not on the mesh at all.
+- APX203/204 (dataflow): the name is registered, and the axis-scope
+  pass (``dataflow.scopes_at``) PROVES how the collective's function is
+  reached — only through ``jit``/``pjit`` with the axis unbound
+  (APX203), or through a ``shard_map`` nest none of whose axes match
+  (APX204).
+- APX202 (heuristic): no scope information at all — the collective's
+  callers are outside static reach, and the module shows no spmd
+  machinery either; the old invisible-caller-contract warning.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple
 
+from apex_tpu.analysis import dataflow
 from apex_tpu.analysis.core import Finding, ModuleContext, Rule, last_name
 
 # collective -> positional index of its axis-name argument
@@ -81,12 +95,17 @@ class UnknownCollectiveAxis(Rule):
 
 class CollectiveOutsideSpmdContext(Rule):
     """APX202: hard-coded collective axis in a module with no visible
-    shard_map/pmap/mesh machinery.
+    shard_map/pmap/mesh machinery — and no dataflow verdict either.
 
     A ``psum("dp")`` whose module never touches shard_map depends on a
     caller somewhere else binding "dp" — an invisible contract that
     breaks unexecuted (tp=1 CI never runs it).  Threading ``axis_name``
     as a parameter makes the contract explicit and silences this rule.
+
+    Where the axis-scope pass has ANY scope information for the
+    enclosing function, this heuristic yields to APX203/204 (which
+    either prove the axis bound — no finding at all — or prove it
+    unbound, a harder error): one hazard, one finding.
     """
 
     rule_id = "APX202"
@@ -99,6 +118,8 @@ class CollectiveOutsideSpmdContext(Rule):
         if ctx.mentions(*_SPMD_MARKERS):
             return
         for call, name, pos in _collective_calls(ctx):
+            if dataflow.scopes_at(ctx, call):
+                continue  # the dataflow tier owns this call site
             for node, literal in _axis_literals(call, pos):
                 if literal in ctx.axis_registry:
                     yield self.finding(
@@ -107,3 +128,94 @@ class CollectiveOutsideSpmdContext(Rule):
                         f"shard_map/pmap/mesh in sight: nothing here "
                         f"binds {literal!r}, so correctness rests on an "
                         f"undocumented caller contract")
+
+
+def _scope_verdict(ctx: ModuleContext, call: ast.Call,
+                   axis: str) -> Optional[str]:
+    """'jit' (APX203) / 'mismatch' (APX204) / None (bound, unknowable,
+    or no scope info).  Union semantics: one reaching context that
+    binds (or MAY bind — ``unknown``) the axis acquits the call site;
+    the rules only speak when every known path fails."""
+    scopes = dataflow.scopes_at(ctx, call)
+    if not scopes:
+        return None
+    if any(s.binds(axis) for s in scopes):
+        return None
+    return "mismatch" if any(s.shard_map for s in scopes) else "jit"
+
+
+def _bound_axes(scopes) -> str:
+    axes = sorted(set().union(*(s.axes for s in scopes)))
+    return ", ".join(axes) if axes else "(none)"
+
+
+class CollectiveAxisUnboundUnderJit(Rule):
+    """APX203: a registered-axis collective reachable ONLY from
+    ``jit``/``pjit``-traced entry points, where no shard_map binds the
+    axis.
+
+    ``jit`` auto-sharding binds no axis names — ``lax.psum(x, "dp")``
+    under plain jit is an unbound-axis error at trace time.  But for
+    TPU-gated code the first trace happens on the chip, and the tp=1
+    CI mesh may never execute the branch at all: the error is real,
+    deferred, and this rule moves it to CI.  Subsumes APX202 wherever
+    the dataflow pass can actually see the callers.
+    """
+
+    rule_id = "APX203"
+    severity = "error"
+    fix_hint = ("wrap the traced entry point in shard_map (binding the "
+                "axis) instead of bare jit/pjit, or drop the collective "
+                "— under jit auto-sharding XLA inserts the data "
+                "movement itself")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call, name, pos in _collective_calls(ctx):
+            for node, literal in _axis_literals(call, pos):
+                if literal not in ctx.axis_registry:
+                    continue  # APX201's finding
+                if _scope_verdict(ctx, call, literal) == "jit":
+                    yield self.finding(
+                        ctx, node,
+                        f"lax.{name}({literal!r}) is reachable only "
+                        f"from jit/pjit-traced entry points: jit "
+                        f"auto-sharding binds no axis names, so "
+                        f"{literal!r} is unbound and the first real "
+                        f"trace dies with an unbound-axis error — on "
+                        f"the chip, after CPU CI passed")
+
+
+class CollectiveAxisOutsideShardMapNest(Rule):
+    """APX204: the collective's axis differs from every axis bound by
+    the enclosing ``shard_map`` nest.
+
+    The one-character-typo class APX201 cannot catch: ``"dp"`` and
+    ``"tp"`` are both on the mesh, but the shard_map this function runs
+    under binds only one of them.  The axis-scope pass knows the nest's
+    full axis set only when the mesh itself is statically resolvable
+    (``Mesh(devs, ("dp", "tp"))`` through a local alias); dynamic
+    meshes mark the scope ``unknown`` and stay quiet.
+    """
+
+    rule_id = "APX204"
+    severity = "error"
+    fix_hint = ("use one of the axes the enclosing shard_map binds, or "
+                "add the intended axis to the shard_map's mesh; if the "
+                "function is meant to be generic, thread axis_name as "
+                "a parameter")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call, name, pos in _collective_calls(ctx):
+            for node, literal in _axis_literals(call, pos):
+                if literal not in ctx.axis_registry:
+                    continue  # APX201's finding
+                if _scope_verdict(ctx, call, literal) == "mismatch":
+                    scopes = dataflow.scopes_at(ctx, call)
+                    yield self.finding(
+                        ctx, node,
+                        f"lax.{name}({literal!r}) runs under a "
+                        f"shard_map nest that binds only "
+                        f"{{{_bound_axes(scopes)}}}: {literal!r} is "
+                        f"never bound on any reaching path, so the "
+                        f"collective fails at trace time — on the "
+                        f"chip, for TPU-gated kernels")

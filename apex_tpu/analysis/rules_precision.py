@@ -10,15 +10,26 @@
   operand.  Binary-op promotion silently upcasts the whole bf16 tensor
   to fp32 — doubling its HBM traffic in a compute path someone already
   paid to keep in bf16.
+- APX303: a scratch buffer or local accumulator whose dtype is
+  NARROWER than the ``preferred_element_type`` of the dot accumulated
+  into it.  The MXU computes the requested fp32 partials, then every
+  store re-rounds them to bf16 — the accumulation quality the
+  ``preferred_element_type`` was written to buy is silently thrown
+  away, and the loss only shows on long reduction chains on real data.
+  Dtypes resolve through the local-assignment lattice
+  (``dataflow.dtype_env``), and scratch refs are matched to their
+  ``pallas_call``'s ``scratch_shapes`` declarations positionally (the
+  trailing kernel parameters, by the Pallas calling convention).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Set
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from apex_tpu.analysis import dataflow
 from apex_tpu.analysis.core import (
-    Finding, ModuleContext, Rule, dotted_name, last_name,
+    Finding, ModuleContext, Rule, _is_partial, dotted_name, last_name,
 )
 
 _F32_FACTORIES = {"array", "asarray", "full", "ones", "zeros", "arange",
@@ -88,6 +99,223 @@ class UnclampedTakeAlongAxis(Rule):
                 "clamped/filled depending on gather mode — corrupt "
                 "targets produce plausible-looking wrong losses instead "
                 "of failing")
+
+
+_DOT_NAMES = {"dot", "dot_general"}
+_ACC_FACTORIES = {"zeros", "ones", "full", "empty"}
+
+
+def _dots_with_preferred(expr: ast.AST,
+                         env: Dict[str, str]) -> List[Tuple[ast.Call, str]]:
+    """(dot_call, preferred_dtype_name) for every dot/dot_general under
+    ``expr`` that declares a resolvable ``preferred_element_type``."""
+    out = []
+    for sub in ast.walk(expr):
+        if not (isinstance(sub, ast.Call)
+                and last_name(sub.func) in _DOT_NAMES):
+            continue
+        pref = None
+        for kw in sub.keywords:
+            if kw.arg == "preferred_element_type":
+                pref = dataflow.dtype_literal(kw.value, env)
+        if pref is not None:
+            out.append((sub, pref))
+    return out
+
+
+def _subscript_base(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Subscript) and isinstance(node.value, ast.Name):
+        return node.value.id
+    return None
+
+
+class ScratchAccumDtypeMismatch(Rule):
+    """APX303: scratch/accumulator dtype narrower than the declared
+    accumulation dtype of the dot stored into it."""
+
+    rule_id = "APX303"
+    severity = "error"
+    fix_hint = ("declare the scratch/accumulator in the dot's "
+                "preferred_element_type (fp32 for bf16 MXU dots) and "
+                "cast once at the final store, or drop "
+                "preferred_element_type if narrow accumulation is "
+                "really intended")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call in self._pallas_calls(ctx):
+            yield from self._check_scratch(ctx, call)
+        for info in ctx.functions.values():
+            yield from self._check_local_accumulators(ctx, info.node)
+
+    # ------------------------------------------------- scratch-ref side
+    @staticmethod
+    def _pallas_calls(ctx: ModuleContext) -> Iterator[ast.Call]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and last_name(node.func) == "pallas_call":
+                yield node
+
+    def _check_scratch(self, ctx: ModuleContext,
+                       call: ast.Call) -> Iterator[Finding]:
+        scratch = dataflow.scratch_entries(call)
+        if not scratch:
+            return
+        kernel = self._resolve_kernel(ctx, call)
+        if kernel is None:
+            return
+        args = kernel.args
+        if args.vararg is not None:
+            return  # dynamic parameter list: refs unmappable
+        params = [a.arg for a in
+                  list(getattr(args, "posonlyargs", [])) + list(args.args)]
+        if len(params) < len(scratch):
+            return
+        # scratch dtype expressions evaluate at the CALL site, the
+        # preferred_element_type ones inside the kernel — each side
+        # resolves against its own function's env
+        launcher = ctx.enclosing_function(call)
+        call_env = dataflow.dtype_env(ctx, launcher)
+        env = dataflow.dtype_env(ctx, kernel)
+        ref_dtypes: Dict[str, Tuple[str, ast.AST]] = {}
+        for name, (entry, _shape, dtype_node) in zip(
+                params[len(params) - len(scratch):], scratch):
+            d = dataflow.dtype_literal(dtype_node, call_env)
+            if d is not None:
+                ref_dtypes[name] = (d, entry)
+        if not ref_dtypes:
+            return
+        for stmt in ast.walk(kernel):
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AugAssign):
+                targets, value = [stmt.target], stmt.value
+            else:
+                continue
+            hit = next((ref_dtypes[b] for t in targets
+                        if (b := _subscript_base(t)) in ref_dtypes), None)
+            if hit is None:
+                continue
+            scratch_dtype, _entry = hit
+            yield from self._judge(ctx, value, scratch_dtype, env,
+                                   what=f"scratch ref (declared "
+                                        f"{scratch_dtype} in "
+                                        f"scratch_shapes)")
+
+    def _resolve_kernel(self, ctx: ModuleContext,
+                        call: ast.Call) -> Optional[ast.AST]:
+        """The kernel FunctionDef a pallas_call launches: a direct
+        Name, an inline ``partial(f, ...)``, or a local alias to
+        either."""
+        if not call.args:
+            return None
+        node = call.args[0]
+        scope = ctx.enclosing_qualname(call)
+        scope = "" if scope == "<module>" else scope
+        for _hop in range(2):
+            if isinstance(node, ast.Call) and _is_partial(node) and node.args:
+                node = node.args[0]
+            if isinstance(node, ast.Name):
+                qn = ctx.resolve_function(node.id, scope)
+                if qn is not None:
+                    return ctx.functions[qn].node
+                # one local-alias hop: kernel = partial(_fwd_kernel, ...)
+                aliased = self._alias_value(ctx, call, node.id)
+                if aliased is None or aliased is node:
+                    return None
+                node = aliased
+            else:
+                return None
+        return None
+
+    @staticmethod
+    def _alias_value(ctx: ModuleContext, call: ast.Call,
+                     name: str) -> Optional[ast.AST]:
+        """The value ``name`` was last assigned in the pallas_call's
+        OWN enclosing function (two launchers both naming their
+        partial ``kernel`` must not cross-resolve), module level as
+        the fallback."""
+        scopes = []
+        fn = ctx.enclosing_function(call)
+        if fn is not None:
+            scopes.append(fn)
+        scopes.append(ctx.tree)
+        for scope in scopes:
+            hit = None
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == name \
+                        and (scope is not ctx.tree
+                             or ctx.enclosing_function(node) is None):
+                    if hit is None or (node.lineno, node.col_offset) > \
+                            (hit.lineno, hit.col_offset):
+                        hit = node
+            if hit is not None:
+                return hit.value
+        return None
+
+    # --------------------------------------------- local-accumulator side
+    def _check_local_accumulators(self, ctx: ModuleContext,
+                                  fn: ast.AST) -> Iterator[Finding]:
+        env = dataflow.dtype_env(ctx, fn)
+        acc_dtypes: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            if ctx.enclosing_function(node) is not fn:
+                continue  # a nested def's local — judged under ITS entry
+            v = node.value
+            if isinstance(v, ast.Call) \
+                    and last_name(v.func) in _ACC_FACTORIES:
+                dtype_node = None
+                for kw in v.keywords:
+                    if kw.arg == "dtype":
+                        dtype_node = kw.value
+                if dtype_node is None and len(v.args) > 1:
+                    dtype_node = v.args[-1]
+                d = dataflow.dtype_literal(dtype_node, env)
+                if d is not None:
+                    acc_dtypes[node.targets[0].id] = d
+        if not acc_dtypes:
+            return
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, (ast.Assign, ast.AugAssign)) \
+                    and ctx.enclosing_function(stmt) is not fn:
+                continue
+            if isinstance(stmt, ast.AugAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and stmt.target.id in acc_dtypes:
+                name, value = stmt.target.id, stmt.value
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id in acc_dtypes \
+                    and any(isinstance(s, ast.Name)
+                            and s.id == stmt.targets[0].id
+                            for s in ast.walk(stmt.value)):
+                name, value = stmt.targets[0].id, stmt.value
+            else:
+                continue
+            yield from self._judge(
+                ctx, value, acc_dtypes[name], env,
+                what=f"accumulator `{name}` (initialized {acc_dtypes[name]})")
+
+    def _judge(self, ctx: ModuleContext, value: ast.AST, store_dtype: str,
+               env: Dict[str, str], what: str) -> Iterator[Finding]:
+        store_size = dataflow.itemsize(store_dtype)
+        if store_size is None:
+            return
+        for dot, pref in _dots_with_preferred(value, env):
+            pref_size = dataflow.itemsize(pref)
+            if pref_size is not None and store_size < pref_size:
+                yield self.finding(
+                    ctx, dot,
+                    f"{store_dtype} {what} accumulates a dot with "
+                    f"preferred_element_type={pref}: every store "
+                    f"re-rounds the {pref} partials to {store_dtype}, "
+                    f"silently discarding the accumulation precision "
+                    f"the preferred_element_type was written to buy")
 
 
 class Fp32ConstantInBf16Path(Rule):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator, List, Optional
 
+from apex_tpu.analysis import dataflow
 from apex_tpu.analysis.core import Finding, ModuleContext, Rule, last_name
 
 _LANES = 128
@@ -228,6 +229,99 @@ class BlockShapeTilingViolation(Rule):
                     f"BlockSpec sublane dim {sublane} is not a multiple "
                     f"of {_MIN_SUBLANE} (fp32's tile; bf16 needs 16, "
                     f"int8/fp8 32): Mosaic rejects the layout on-chip")
+
+
+class VmemFootprintOverBudget(Rule):
+    """APX304: the provable VMEM footprint of one ``pallas_call`` —
+    Σ block-shape bytes across its BlockSpecs plus its scratch shapes —
+    exceeds the budget.
+
+    VMEM is ~16 MiB/core and Mosaic reports an overrun only when the
+    kernel actually compiles for the chip; interpret-mode CPU tests
+    allocate host RAM and sail through.  The estimate is a LOWER bound:
+    dims resolve through local int assignments (``bn = 256``) via the
+    dataflow lattice, dynamic dims price at 0, BlockSpec elements price
+    at 4 bytes (dtype is the array's, invisible here) and scratch at
+    its declared dtype — and Mosaic double-buffers grid-revisited
+    blocks, so the true requirement is larger still.  A warning, not an
+    error: the budget is configurable (``VmemFootprintOverBudget(
+    budget_bytes=...)``, CLI ``--vmem-budget-mib``) for targets with
+    different VMEM.
+    """
+
+    rule_id = "APX304"
+    severity = "warning"
+    fix_hint = ("shrink the block shapes (the grid revisits tiles; "
+                "smaller blocks trade VMEM for grid steps) or move "
+                "rarely-touched scratch to pltpu.ANY/HBM; budgets "
+                "other than 16 MiB: --vmem-budget-mib")
+
+    DEFAULT_BUDGET = 16 * 2 ** 20
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET):
+        self.budget_bytes = int(budget_bytes)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        arity = BlockSpecIndexMapArity  # shares the scope/alias helpers
+        for scope in arity._scopes(ctx.tree):
+            aliases = arity._local_aliases(scope)
+            for node in arity._walk_scope(scope):
+                if not (isinstance(node, ast.Call)
+                        and last_name(node.func) == "pallas_call"):
+                    continue
+                total, priced, skipped = self._footprint(ctx, node, aliases)
+                if priced and total > self.budget_bytes:
+                    about = "" if not skipped else \
+                        f" (+{skipped} buffer(s) with dynamic dims, " \
+                        f"unpriced — the true footprint is larger)"
+                    yield self.finding(
+                        ctx, node,
+                        f"pallas_call VMEM footprint ≥ "
+                        f"{total / 2**20:.1f} MiB across {priced} "
+                        f"block/scratch buffer(s){about}, over the "
+                        f"{self.budget_bytes / 2**20:.0f} MiB budget: "
+                        f"Mosaic rejects the allocation only when the "
+                        f"kernel first compiles on the chip")
+
+    def _footprint(self, ctx: ModuleContext, call: ast.Call, aliases):
+        """(bytes, priced_buffer_count, skipped_buffer_count): literal
+        contributions only — a lower bound."""
+        total = 0
+        priced = skipped = 0
+        for spec in BlockSpecIndexMapArity._blockspecs(call, aliases):
+            dims = dataflow.literal_dims(_shape_node(spec), aliases)
+            if dims is None:
+                skipped += 1
+                continue
+            total += _prod(dims) * 4
+            priced += 1
+        scratch = dataflow.scratch_entries(call)
+        env = dataflow.dtype_env(
+            ctx, ctx.enclosing_function(call)) if scratch else {}
+        for _entry, shape, dtype_node in scratch:
+            dims = dataflow.literal_dims(shape, aliases)
+            if dims is None:
+                skipped += 1
+                continue
+            size = dataflow.itemsize(
+                dataflow.dtype_literal(dtype_node, env))
+            total += _prod(dims) * (size or 4)
+            priced += 1
+        return total, priced, skipped
+
+
+def _prod(dims: List[int]) -> int:
+    out = 1
+    for d in dims:
+        out *= d
+    return out
+
+
+def _shape_node(spec: ast.Call) -> Optional[ast.AST]:
+    for kw in spec.keywords:
+        if kw.arg == "block_shape":
+            return kw.value
+    return spec.args[0] if spec.args else None
 
 
 class HardCodedSublaneAlignment(Rule):

@@ -1,0 +1,99 @@
+"""SARIF 2.1.0 output for editor/CI integrations.
+
+One ``run`` per invocation; every rule that produced a finding gets a
+``reportingDescriptor`` (id + help text from its class docstring and
+fix hint), every finding a ``result`` with a physical location.  The
+shape follows the OASIS SARIF 2.1.0 schema closely enough for GitHub
+code scanning and the VS Code SARIF viewer; suppressed (baselined)
+findings are emitted with ``suppressions`` so consumers can tell
+"clean" from "suppressed" — the same distinction the text format's
+stderr summary draws.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence
+
+from apex_tpu.analysis.core import Finding, Rule
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+#: analyzer severity -> SARIF result level
+_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _rule_descriptor(rule_id: str, rules: Sequence[Rule]) -> dict:
+    for rule in rules:
+        if rule.rule_id == rule_id:
+            doc = (rule.__doc__ or "").strip().splitlines()
+            short = doc[0].strip() if doc else rule_id
+            return {
+                "id": rule_id,
+                "shortDescription": {"text": short},
+                "help": {"text": rule.fix_hint or short},
+                "defaultConfiguration": {
+                    "level": _LEVELS.get(rule.severity, "warning")},
+            }
+    return {"id": rule_id, "shortDescription": {"text": rule_id}}
+
+
+def _result(f: Finding, rule_index: Dict[str, int],
+            suppressed: bool) -> dict:
+    out = {
+        "ruleId": f.rule,
+        "ruleIndex": rule_index[f.rule],
+        "level": _LEVELS.get(f.severity, "warning"),
+        "message": {"text": f"{f.message}\nfix: {f.fix_hint}"},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {
+                    "uri": f.path.replace("\\", "/"),
+                    "uriBaseId": "SRCROOT",
+                },
+                "region": {
+                    # SARIF columns are 1-based; ast's are 0-based
+                    "startLine": max(f.line, 1),
+                    "startColumn": f.col + 1,
+                },
+            },
+            "logicalLocations": [{
+                "fullyQualifiedName": f.symbol,
+                "kind": "function",
+            }],
+        }],
+    }
+    if suppressed:
+        out["suppressions"] = [{
+            "kind": "external",
+            "justification": "analysis_baseline.json entry",
+        }]
+    return out
+
+
+def render(kept: Iterable[Finding], suppressed: Iterable[Finding],
+           rules: Sequence[Rule]) -> dict:
+    """The SARIF log object (plain dict — callers json.dump it)."""
+    kept, suppressed = list(kept), list(suppressed)
+    rule_ids = sorted({f.rule for f in kept + suppressed})
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    results: List[dict] = [
+        _result(f, rule_index, suppressed=False) for f in kept
+    ] + [
+        _result(f, rule_index, suppressed=True) for f in suppressed
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "apex_tpu.analysis",
+                "informationUri": "docs/static_analysis.md",
+                "rules": [_rule_descriptor(rid, rules)
+                          for rid in rule_ids],
+            }},
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+        }],
+    }

@@ -1,0 +1,183 @@
+"""Lowered-IR invariants on the REAL train steps, via
+``apex_tpu.analysis.lowered`` (the analyzer's jax-importing second
+tier).
+
+PR 4 proved these invariants one-off with inline HLO greps pinned to
+the ZeRO optimizer's ``update`` in isolation; this band pins the same
+contracts on ``gpt.make_train_step`` itself — the seam every refactor
+actually goes through — so a step-builder change that silently drops
+the per-bucket reduce-scatter plan, reintroduces a whole-tree flatten,
+or loses donation coverage fails HERE, in CI, not as a perf regression
+three benchmark rounds later.
+
+Everything asserts on the .lower() artifact (trace only, no XLA
+compile) except the compiled input_output_alias check, which is the
+one fact that only materializes at compile time and rides the slow
+tier.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.analysis import lowered as lw
+from apex_tpu.contrib.optimizers import DistributedFusedAdam
+from apex_tpu.models.gpt import (
+    GPTConfig, init_params, make_train_step, param_specs,
+)
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.fused_adam import AdamState
+
+DP = 8
+
+CFG = GPTConfig(
+    vocab_size=64,
+    hidden_size=32,
+    num_layers=2,
+    num_attention_heads=4,
+    max_seq_len=16,
+    compute_dtype=jnp.float32,
+    checkpoint_layers=False,
+)
+
+#: splits the tiny fp32 tree into several buckets (clamps at one dtype
+#: tile), so "per-bucket" is distinguishable from "whole-tree"
+TINY_CAP_MB = 4096 / 2 ** 20
+
+
+def _mesh(devices8):
+    return Mesh(np.array(devices8).reshape(DP, 1), ("dp", "tp"))
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(DP, 16)))
+    return tokens, jnp.roll(tokens, -1, axis=1)
+
+
+def _zero_lowering(devices8, **opt_kw):
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    opt = DistributedFusedAdam(lr=1e-2, axis_name="dp",
+                               bucket_cap_mb=TINY_CAP_MB, **opt_kw)
+    state = opt.init(params, world_size=DP)
+    step = make_train_step(CFG, opt, _mesh(devices8), donate_state=True)
+    tokens, targets = _data()
+    return step.lower(params, state, tokens, targets), opt, params, state
+
+
+class TestZeroTrainStep:
+    """The bucket plan's collective structure, read off the full
+    ``make_train_step`` lowering with a cap that forces >= 2 buckets."""
+
+    def test_grad_sync_is_one_reduce_scatter_per_bucket(self, devices8):
+        low, opt, _params, _state = _zero_lowering(devices8)
+        n_buckets = len(opt._plan.buckets)
+        assert n_buckets >= 2, "cap should split the fp32 bucket"
+        txt = low.as_text()
+        # exactly one grad reduce-scatter per bucket — a refactor that
+        # reroutes grads through pmean (replicated sync) or fuses the
+        # buckets back into one collective changes this count
+        lw.count_collectives(txt, "reduce_scatter",
+                             minimum=n_buckets, maximum=n_buckets)
+        lw.assert_collective_dtype(txt, "reduce_scatter", "f32",
+                                   mode="all")
+        # params come back per bucket too
+        lw.count_collectives(txt, "all_gather", minimum=n_buckets)
+
+    def test_no_whole_tree_concat(self, devices8):
+        """With >= 2 buckets nothing may concatenate the FULL flat
+        param tree — the pre-bucket ``_flatten`` signature (one extra
+        whole-model HBM round trip per step)."""
+        low, _opt, params, _state = _zero_lowering(devices8)
+        total = sum(int(np.prod(p.shape))
+                    for p in jax.tree_util.tree_leaves(params))
+        lw.assert_no_whole_tree_concat(low.as_text(), total)
+
+    def test_step_donates_params_and_shard_state(self, devices8):
+        """``donate_state=True`` must cover every param leaf AND every
+        resident ZeRO shard (m/v/master per bucket + step) at the
+        lowering level — a dropped donation re-inflates the step's peak
+        by the state bytes ZeRO exists to shard away."""
+        low, _opt, params, state = _zero_lowering(devices8)
+        lw.assert_donation_covers(low, params, state, compiled=False)
+
+    @pytest.mark.slow
+    def test_step_donation_survives_compilation(self, devices8):
+        """The compiled module's input_output_alias table actually
+        aliases the donated buffers (XLA silently DROPS donations it
+        cannot use — the declaration alone proves nothing)."""
+        low, _opt, params, state = _zero_lowering(devices8)
+        lw.assert_donation_covers(low, params, state, compiled=True)
+
+
+class TestReplicatedTrainStep:
+    """The replicated FusedAdam step: dp grad sync stays an all-reduce
+    (pmean), never a reduce-scatter, and donation covers params +
+    optimizer state."""
+
+    def _lowering(self, devices8):
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        pspecs = param_specs(CFG)
+        sspec = AdamState(step=P(), exp_avg=pspecs, exp_avg_sq=pspecs,
+                          master=None)
+        step = make_train_step(CFG, opt, _mesh(devices8),
+                               donate_state=True, opt_state_spec=sspec)
+        tokens, targets = _data()
+        return step.lower(params, state, tokens, targets), params, state
+
+    def test_grad_sync_is_all_reduce_not_scatter(self, devices8):
+        low, _params, _state = self._lowering(devices8)
+        txt = low.as_text()
+        lw.count_collectives(txt, "reduce_scatter", maximum=0)
+        lw.count_collectives(txt, "all_reduce", minimum=1)
+
+    def test_step_donates_params_and_state(self, devices8):
+        low, params, state = self._lowering(devices8)
+        lw.assert_donation_covers(low, params, state, compiled=False)
+
+
+class TestCheckerSelfConsistency:
+    """The checkers themselves, against hand-built artifacts — the
+    helpers guard real invariants, so their own failure modes (regex
+    drift against a jax upgrade's StableHLO spelling) must be loud."""
+
+    def test_counts_and_dtypes_on_a_real_psum_lowering(self, devices8):
+        mesh = Mesh(np.array(devices8), ("dp",))
+        f = jax.jit(jax.shard_map(
+            lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+            in_specs=P("dp"), out_specs=P(), check_vma=False))
+        txt = f.lower(jnp.ones((8, 4), jnp.bfloat16)).as_text()
+        assert lw.count_collectives(txt, "all_reduce", minimum=1) >= 1
+        lw.assert_collective_dtype(txt, "all_reduce", "bf16")
+        with pytest.raises(AssertionError):
+            lw.count_collectives(txt, "all_reduce", maximum=0)
+        with pytest.raises(AssertionError):
+            lw.assert_collective_dtype(txt, "all_reduce", "f32",
+                                       mode="all")
+
+    def test_whole_tree_concat_detects_a_real_flatten(self):
+        f = jax.jit(lambda a, b: jnp.concatenate(
+            [a.ravel(), b.ravel()]))
+        txt = f.lower(jnp.ones((13, 5)), jnp.ones((31,))).as_text()
+        with pytest.raises(AssertionError, match="whole tree"):
+            lw.assert_no_whole_tree_concat(txt, 13 * 5 + 31)
+        lw.assert_no_whole_tree_concat(txt, 10_000)  # other sizes fine
+
+    def test_donation_checker_flags_uncovered_state(self):
+        tree = {"a": jnp.ones((4,)), "b": jnp.ones((2, 2))}
+        donated = jax.jit(lambda t: jax.tree.map(lambda x: x + 1, t),
+                          donate_argnums=(0,)).lower(tree)
+        lw.assert_donation_covers(donated, tree, compiled=False)
+        undonated = jax.jit(
+            lambda t: jax.tree.map(lambda x: x + 1, t)).lower(tree)
+        with pytest.raises(AssertionError, match="donatable"):
+            lw.assert_donation_covers(undonated, tree, compiled=False)
+
+    def test_text_passthrough_and_type_errors(self):
+        assert lw.hlo_text("module {}") == "module {}"
+        with pytest.raises(TypeError):
+            lw.hlo_text(42)

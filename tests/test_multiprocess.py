@@ -24,7 +24,21 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.slow
+# CPU-only containers cannot run these AT ALL: jax.distributed worker
+# fleets need a backend with real cross-process transport, and every
+# worker dies with "Multiprocess computations aren't implemented on the
+# CPU backend".  Skip LOUDLY (with that reason) instead of letting the
+# fleet fail after a 600 s timeout — the suite stays honest about what
+# this environment can and cannot verify.
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        jax.default_backend() == "cpu",
+        reason="Multiprocess computations aren't implemented on the "
+               "CPU backend (jax.distributed needs real cross-process "
+               "transport; the 8-virtual-device single-process suite "
+               "covers the mesh logic)"),
+]
 
 REPO = Path(__file__).resolve().parent.parent
 

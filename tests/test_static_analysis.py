@@ -25,20 +25,25 @@ from apex_tpu.analysis import (
     apply_baseline,
     discover_axis_registry,
     load_baseline,
+    write_baseline,
 )
 from apex_tpu.analysis.rules_collectives import (
+    CollectiveAxisOutsideShardMapNest,
+    CollectiveAxisUnboundUnderJit,
     CollectiveOutsideSpmdContext,
     UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_precision import (
     Fp32ConstantInBf16Path,
+    ScratchAccumDtypeMismatch,
     UnclampedTakeAlongAxis,
 )
 from apex_tpu.analysis.rules_tiling import (
     BlockShapeTilingViolation,
     BlockSpecIndexMapArity,
     HardCodedSublaneAlignment,
+    VmemFootprintOverBudget,
 )
 from apex_tpu.analysis.rules_trace import (
     ProcessGlobalEnvMutation,
@@ -477,6 +482,515 @@ class TestCollectiveOutsideSpmdContext:
                                      in_specs=P("dp"), out_specs=P())(x)
             """, tmp_path, [CollectiveOutsideSpmdContext()])
         assert got == []
+
+
+# ------------------------------ APX203 collective unbound under jit/pjit
+class TestCollectiveAxisUnboundUnderJit:
+    def test_positive_helper_reached_only_from_jit(self, tmp_path):
+        """jit binds no axis names: the psum dies with an unbound-axis
+        error on the first real trace — which for TPU-gated code is the
+        chip, not the CPU suite."""
+        got = run("""
+            import jax
+
+            def allreduce(x):
+                return jax.lax.psum(x, "dp")
+
+            @jax.jit
+            def f(x):
+                return allreduce(x)
+            """, tmp_path, [CollectiveAxisUnboundUnderJit()])
+        assert rule_ids(got) == ["APX203"]
+        assert got[0].symbol == "allreduce"
+        assert "jit auto-sharding binds no axis names" in got[0].message
+
+    def test_positive_inside_jitted_lambda(self, tmp_path):
+        got = run("""
+            import jax
+
+            g = jax.jit(lambda x: jax.lax.pmean(x, "tp"))
+            """, tmp_path, [CollectiveAxisUnboundUnderJit()])
+        assert rule_ids(got) == ["APX203"]
+
+    def test_one_hazard_one_finding_with_apx202(self, tmp_path):
+        """Reconciliation: where the dataflow pass HAS a verdict, the
+        APX202 module heuristic yields — the full rule set reports
+        exactly one finding for the jit-only psum."""
+        got = run("""
+            import jax
+
+            def allreduce(x):
+                return jax.lax.psum(x, "dp")
+
+            @jax.jit
+            def f(x):
+                return allreduce(x)
+            """, tmp_path, DEFAULT_RULES)
+        assert rule_ids(got) == ["APX203"]
+
+    def test_negative_shard_map_binds_the_axis(self, tmp_path):
+        """The same helper additionally reachable through a shard_map
+        whose (statically resolvable) mesh carries the axis: one
+        binding path acquits the call site."""
+        got = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def allreduce(x):
+                return jax.lax.psum(x, "dp")
+
+            @jax.jit
+            def f(x):
+                return allreduce(x)
+
+            def train(x):
+                mesh = Mesh(np.array(jax.devices()), ("dp",))
+                return jax.shard_map(allreduce, mesh=mesh,
+                                     in_specs=P("dp"), out_specs=P())(x)
+            """, tmp_path, [CollectiveAxisUnboundUnderJit(),
+                            CollectiveAxisOutsideShardMapNest()])
+        assert got == []
+
+    def test_negative_dynamic_axis_name_never_flags(self, tmp_path):
+        """Threading the axis as an argument is the RECOMMENDED fix —
+        a dynamic axis name must stay silent even on a jit-only path
+        (the caller may pass an axis its own shard_map binds)."""
+        got = run("""
+            import jax
+
+            def generic(x, axis_name):
+                return jax.lax.pmean(x, axis_name)
+
+            @jax.jit
+            def f(x):
+                return generic(x, "dp")
+            """, tmp_path, [CollectiveAxisUnboundUnderJit(),
+                            CollectiveAxisOutsideShardMapNest(),
+                            CollectiveOutsideSpmdContext()])
+        assert got == []
+
+    def test_negative_unregistered_axis_is_apx201_territory(self, tmp_path):
+        got = run("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return jax.lax.psum(x, "tq")
+            """, tmp_path, [CollectiveAxisUnboundUnderJit(),
+                            UnknownCollectiveAxis()])
+        assert rule_ids(got) == ["APX201"]
+
+    def test_cross_module_jit_wrapper_feeds_apx203(self, tmp_path):
+        """The collective lives in one file, its ONLY traced entry
+        point (a jit wrapper) in another: the linked scope pass still
+        proves the axis unbound — per-module analysis could not."""
+        (tmp_path / "collective_mod.py").write_text(textwrap.dedent("""
+            import jax
+
+            def allreduce(x):
+                return jax.lax.psum(x, "dp")
+            """))
+        (tmp_path / "main.py").write_text(textwrap.dedent("""
+            import jax
+            from collective_mod import allreduce
+
+            @jax.jit
+            def step(x):
+                return allreduce(x)
+            """))
+        got = analyze_paths([str(tmp_path)], DEFAULT_RULES,
+                            axis_registry=set(AXES), rel_to=str(tmp_path))
+        assert [(f.rule, f.path, f.symbol) for f in got] == \
+            [("APX203", "collective_mod.py", "allreduce")]
+
+
+# --------------------------- APX204 collective outside the shard_map nest
+class TestCollectiveAxisOutsideShardMapNest:
+    def test_positive_nest_binds_only_other_axes(self, tmp_path):
+        """Both axes are on the registry (APX201 is blind), but the
+        shard_map's resolvable mesh binds only "tp" — the dp collective
+        can never bind on this path."""
+        got = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def loss(x):
+                return jax.lax.pmean(x, "dp")
+
+            def train(x):
+                mesh = Mesh(np.array(jax.devices()), ("tp",))
+                return jax.shard_map(loss, mesh=mesh, in_specs=P("tp"),
+                                     out_specs=P())(x)
+            """, tmp_path, [CollectiveAxisOutsideShardMapNest()])
+        assert rule_ids(got) == ["APX204"]
+        assert "binds only {tp}" in got[0].message
+
+    def test_negative_shadowed_axis_nest_unions(self, tmp_path):
+        """The nest case that MUST stay silent: the inner shard_map
+        binds only "tp", but the outer one already bound "dp" — axes
+        accumulate through the nest, so the dp collective inside the
+        inner function is legal."""
+        got = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def inner(x):
+                return jax.lax.psum(x, "dp")
+
+            def mid(x):
+                tp_mesh = Mesh(np.array(jax.devices()), ("tp",))
+                return jax.shard_map(inner, mesh=tp_mesh,
+                                     in_specs=P("tp"), out_specs=P())(x)
+
+            def train(x):
+                dp_mesh = Mesh(np.array(jax.devices()), ("dp",))
+                return jax.shard_map(mid, mesh=dp_mesh,
+                                     in_specs=P("dp"), out_specs=P())(x)
+            """, tmp_path, DEFAULT_RULES)
+        assert got == []
+
+    def test_negative_dynamic_mesh_is_unknowable(self, tmp_path):
+        """A mesh passed in as a parameter may bind ANY axes — the
+        scope records unknown and the rule stays quiet (specs are only
+        a lower bound: replicated axes never appear in them)."""
+        got = run("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def loss(x):
+                return jax.lax.pmean(x, "dp")
+
+            def train(mesh, x):
+                return jax.shard_map(loss, mesh=mesh, in_specs=P("tp"),
+                                     out_specs=P())(x)
+            """, tmp_path, DEFAULT_RULES)
+        assert got == []
+
+    def test_positive_pmap_binds_one_name(self, tmp_path):
+        got = run("""
+            import jax
+
+            def loss(x):
+                return jax.lax.pmean(x, "dp")
+
+            def train(x):
+                return jax.pmap(loss, axis_name="tp")(x)
+            """, tmp_path, [CollectiveAxisOutsideShardMapNest()])
+        assert rule_ids(got) == ["APX204"]
+
+    def test_negative_lambda_under_binding_shard_map(self, tmp_path):
+        got = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def train(x):
+                mesh = Mesh(np.array(jax.devices()), ("dp",))
+                return jax.shard_map(
+                    lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                    in_specs=P("dp"), out_specs=P())(x)
+            """, tmp_path, DEFAULT_RULES)
+        assert got == []
+
+
+# ------------------------------- APX303 scratch/accumulator dtype vs dot
+class TestScratchAccumDtypeMismatch:
+    def test_positive_bf16_scratch_fp32_preferred(self, tmp_path):
+        """The hazard class: preferred_element_type asks the MXU for
+        fp32 partials, the bf16 scratch re-rounds every accumulation
+        step — the precision was paid for and silently discarded."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def _kernel(x_ref, o_ref, acc_ref):
+                acc_ref[:] += jax.lax.dot_general(
+                    x_ref[:], x_ref[:], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            def launch(x, bn, H):
+                return pl.pallas_call(
+                    _kernel, grid=(4,),
+                    in_specs=[pl.BlockSpec((bn, H), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((bn, H), lambda i: (i, 0)),
+                    scratch_shapes=[pltpu.VMEM((bn, H), jnp.bfloat16)],
+                )(x)
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert rule_ids(got) == ["APX303"]
+        assert got[0].symbol == "_kernel"
+        assert "preferred_element_type=float32" in got[0].message
+
+    def test_positive_dtype_through_lattice_and_repeat_list(self, tmp_path):
+        """The dtype rides a local assignment (``acc_dtype = jnp.
+        bfloat16``) and the scratch list uses the ``[...] * 2`` repeat
+        spelling — both resolved by the dataflow lattice."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            acc_dtype = jnp.bfloat16
+
+            def _kernel(x_ref, o_ref, a_ref, b_ref):
+                b_ref[:] = b_ref[:] + jax.lax.dot_general(
+                    x_ref[:], x_ref[:], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            def launch(x, bn, H):
+                return pl.pallas_call(
+                    _kernel, grid=(4,),
+                    in_specs=[pl.BlockSpec((bn, H), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((bn, H), lambda i: (i, 0)),
+                    scratch_shapes=[pltpu.VMEM((bn, H), acc_dtype)] * 2,
+                )(x)
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert rule_ids(got) == ["APX303"]
+
+    def test_positive_local_accumulator(self, tmp_path):
+        """The non-Pallas spelling: a bf16 ``jnp.zeros`` accumulator
+        fed by fp32-preferred dots in a scan-style loop."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def chunked_matmul(a, b):
+                acc = jnp.zeros((128, 128), dtype=jnp.bfloat16)
+                for i in range(4):
+                    acc += jax.lax.dot_general(
+                        a[i], b[i], (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                return acc
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert rule_ids(got) == ["APX303"]
+        assert "accumulator `acc`" in got[0].message
+
+    def test_negative_fp32_scratch_fp32_preferred(self, tmp_path):
+        """The repo's own fused-CE shape: fp32 scratch, fp32 preferred
+        — the contract this rule exists to protect."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def _kernel(x_ref, o_ref, acc_ref):
+                acc_ref[:] += jax.lax.dot_general(
+                    x_ref[:], x_ref[:], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            def launch(x, bn, H):
+                return pl.pallas_call(
+                    _kernel, grid=(4,),
+                    in_specs=[pl.BlockSpec((bn, H), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((bn, H), lambda i: (i, 0)),
+                    scratch_shapes=[pltpu.VMEM((bn, H), jnp.float32)],
+                )(x)
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert got == []
+
+    def test_negative_deliberate_narrow_accumulation(self, tmp_path):
+        """bf16 scratch with bf16 preferred is self-consistent: the
+        author CHOSE narrow accumulation, nothing is discarded."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def f(a, b):
+                acc = jnp.zeros((128, 128), dtype=jnp.bfloat16)
+                acc += jax.lax.dot_general(
+                    a, b, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.bfloat16)
+                return acc
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert got == []
+
+    def test_negative_unresolvable_dtype_stays_quiet(self, tmp_path):
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def f(a, b, out_dtype):
+                acc = jnp.zeros((128, 128), dtype=out_dtype)
+                acc += jax.lax.dot_general(
+                    a, b, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return acc
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert got == []
+
+    def test_conflicting_dtype_names_terminate_and_poison(self, tmp_path):
+        """Review finding: two functions reusing one dtype name with
+        different values made the old dtype_env fixpoint flip forever
+        (the analyzer HUNG on any module reusing the name ``dtype``).
+        Now the module layer reads only top-level statements and a
+        conflicting name poisons to UNKNOWN — terminates, stays
+        quiet."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def a():
+                dt = jnp.bfloat16
+                return dt
+
+            def b():
+                dt = jnp.float32
+                return dt
+
+            def f(x, y):
+                acc = jnp.zeros((128, 128), dtype=jnp.bfloat16)
+                acc += jax.lax.dot_general(
+                    x, y, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return acc
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert rule_ids(got) == ["APX303"]  # f still judged; no hang
+
+    def test_dtype_locals_do_not_leak_across_functions(self, tmp_path):
+        """Review finding: one function's ``dt = jnp.bfloat16`` must
+        not resolve another function's unrelated ``dt`` (a parameter
+        there) — the module layer is top-level-only now."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def other():
+                dt = jnp.bfloat16
+                return dt
+
+            def f(x, y, dt):
+                acc = jnp.zeros((128, 128), dtype=dt)
+                acc += jax.lax.dot_general(
+                    x, y, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return acc
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert got == []
+
+    def test_branch_conflicting_accumulator_dtype_stays_quiet(self, tmp_path):
+        """A name carrying fp32 on one branch and bf16 on the other
+        must poison, not last-win into a wrong finding."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def f(x, y, wide):
+                dt = jnp.float32
+                if not wide:
+                    dt = jnp.bfloat16
+                acc = jnp.zeros((128, 128), dtype=dt)
+                acc += jax.lax.dot_general(
+                    x, y, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return acc
+            """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert got == []
+
+
+# ----------------------------------------- APX304 VMEM footprint budget
+class TestVmemFootprintOverBudget:
+    def test_positive_literal_blocks_over_budget(self, tmp_path):
+        """2048x1024 fp32 blocks x 3 ≈ 24 MiB — fine in interpret
+        mode, a Mosaic allocation failure on the chip."""
+        got = run("""
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def launch(x):
+                return pl.pallas_call(
+                    _body, grid=(4,),
+                    in_specs=[pl.BlockSpec((2048, 1024),
+                                           lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((2048, 1024),
+                                           lambda i: (i, 0)),
+                    scratch_shapes=[pltpu.VMEM((2048, 1024),
+                                               jnp.float32)],
+                )(x)
+            """, tmp_path, [VmemFootprintOverBudget()])
+        assert rule_ids(got) == ["APX304"]
+        assert got[0].severity == "warning"
+        assert "24.0 MiB" in got[0].message
+
+    def test_positive_dims_through_local_aliases(self, tmp_path):
+        """``bn = 2048`` resolves through the assignment lattice —
+        the spelling real kernels use."""
+        got = run("""
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def launch(x):
+                bn = 2048
+                hidden = 1024
+                spec = pl.BlockSpec((bn, hidden), lambda i: (i, 0))
+                return pl.pallas_call(
+                    _body, grid=(4,),
+                    in_specs=[spec],
+                    out_specs=pl.BlockSpec((bn, hidden),
+                                           lambda i: (i, 0)),
+                    scratch_shapes=[pltpu.VMEM((bn, hidden),
+                                               jnp.float32)],
+                )(x)
+            """, tmp_path, [VmemFootprintOverBudget()])
+        assert rule_ids(got) == ["APX304"]
+
+    def test_negative_dynamic_dims_unpriceable(self, tmp_path):
+        """Runtime-sized blocks (the repo's ``_ceil_block`` pattern)
+        cannot be priced — the rule only speaks on provable sums."""
+        got = run("""
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def launch(x, block_n):
+                bn = _ceil_block(x.shape[0], block_n, 8)
+                return pl.pallas_call(
+                    _body, grid=(4,),
+                    in_specs=[pl.BlockSpec((bn, 4096), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((bn, 4096), lambda i: (i, 0)),
+                    scratch_shapes=[pltpu.VMEM((bn, 4096), jnp.float32)],
+                )(x)
+            """, tmp_path, [VmemFootprintOverBudget()])
+        assert got == []
+
+    def test_negative_small_blocks_under_budget(self, tmp_path):
+        got = run("""
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def launch(x):
+                return pl.pallas_call(
+                    _body, grid=(4,),
+                    in_specs=[pl.BlockSpec((256, 512), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((256, 512), lambda i: (i, 0)),
+                    scratch_shapes=[pltpu.VMEM((256, 128), jnp.float32)],
+                )(x)
+            """, tmp_path, [VmemFootprintOverBudget()])
+        assert got == []
+
+    def test_budget_is_configurable(self, tmp_path):
+        """The same small kernel flags under a 128 KiB budget — the
+        constructor knob the CLI's --vmem-budget-mib drives."""
+        got = run("""
+            from jax.experimental import pallas as pl
+
+            def launch(x):
+                return pl.pallas_call(
+                    _body, grid=(4,),
+                    in_specs=[pl.BlockSpec((256, 512), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((256, 512), lambda i: (i, 0)),
+                )(x)
+            """, tmp_path,
+            [VmemFootprintOverBudget(budget_bytes=128 * 1024)])
+        assert rule_ids(got) == ["APX304"]
 
 
 # ----------------------------------------------- APX301 BlockSpec tiling
@@ -953,6 +1467,189 @@ class TestBaseline:
     def test_missing_fields_rejected(self, tmp_path):
         with pytest.raises(BaselineError, match="missing"):
             load_baseline(self._write(tmp_path, [{"rule": "APX102"}]))
+
+    @pytest.mark.parametrize("placeholder", ["TODO", "todo", "TODO: later"])
+    def test_todo_placeholder_rejected(self, tmp_path, placeholder):
+        """--update-baseline's placeholder must never LOAD — a refresh
+        is mechanical, signing off on it is not."""
+        with pytest.raises(BaselineError, match="placeholder"):
+            load_baseline(self._write(tmp_path, [
+                {"rule": "APX102", "path": "x.py",
+                 "justification": placeholder}]))
+
+    def test_todo_allowed_only_for_the_update_path(self, tmp_path):
+        entries = load_baseline(self._write(tmp_path, [
+            {"rule": "APX102", "path": "x.py", "justification": "TODO"}]),
+            allow_todo=True)
+        assert len(entries) == 1
+
+    def test_write_baseline_keeps_drops_adds(self, tmp_path):
+        """Regeneration semantics: matched entries survive VERBATIM
+        (their justifications are reviewed text), stale entries drop,
+        new findings land with the rejected TODO placeholder."""
+        findings = run("""
+            import os
+
+            def f():
+                os.environ["X"] = "1"
+
+            def g():
+                os.environ.pop("Y", None)
+            """, tmp_path, [ProcessGlobalEnvMutation()])
+        entries = load_baseline(self._write(tmp_path, [
+            {"rule": "APX102", "path": "fixture.py", "symbol": "f",
+             "contains": "assignment", "justification": "reviewed: test"},
+            {"rule": "APX102", "path": "gone.py",
+             "justification": "stale on purpose"},
+        ]))
+        out = tmp_path / "new_baseline.json"
+        kept, dropped, added = write_baseline(str(out), findings, entries)
+        assert (kept, dropped, added) == (1, 1, 1)
+        data = json.loads(out.read_text())
+        justs = [e["justification"] for e in data["entries"]]
+        assert justs == ["reviewed: test", "TODO"]
+        assert data["entries"][1]["symbol"] == "g"
+        # the regenerated file round-trips ONLY through the update path
+        with pytest.raises(BaselineError, match="placeholder"):
+            load_baseline(str(out))
+        reloaded = load_baseline(str(out), allow_todo=True)
+        k2, s2, _ = apply_baseline(findings, reloaded)
+        assert k2 == [] and len(s2) == 2  # every finding now matched
+
+
+# ----------------------------------------- CLI: --update-baseline, SARIF
+class TestCliUpdateBaselineAndSarif:
+    FIXTURE = textwrap.dedent("""
+        import os
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x if os.environ.get("FLAG") else -x
+        """)
+
+    def _run_cli(self, args, cwd):
+        import os as _os
+
+        env = dict(_os.environ, PYTHONPATH=str(REPO))
+        return subprocess.run(
+            [sys.executable, "-m", "apex_tpu.analysis", *args],
+            cwd=str(cwd), env=env, capture_output=True, text=True,
+            timeout=600)
+
+    def test_update_baseline_is_mechanical_but_loud(self, tmp_path):
+        """The full loop: findings -> --update-baseline exits 0 and
+        writes TODO entries -> a normal run REFUSES the file (exit 2)
+        -> filling the justification in makes the run clean (exit 0)."""
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        r = self._run_cli(["mod.py"], tmp_path)
+        assert r.returncode == 1  # the APX101 finding, unsuppressed
+
+        r = self._run_cli(["mod.py", "--update-baseline"], tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "added 1" in r.stderr
+        baseline = tmp_path / "analysis_baseline.json"
+        data = json.loads(baseline.read_text())
+        assert data["entries"][0]["justification"] == "TODO"
+        assert data["entries"][0]["rule"] == "APX101"
+
+        r = self._run_cli(["mod.py"], tmp_path)
+        assert r.returncode == 2, r.stdout + r.stderr
+        assert "placeholder" in r.stderr
+
+        data["entries"][0]["justification"] = "reviewed: test fixture"
+        baseline.write_text(json.dumps(data))
+        r = self._run_cli(["mod.py"], tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "1 baselined" in r.stderr
+
+    def test_sarif_schema_shape(self, tmp_path):
+        """--format sarif emits a SARIF 2.1.0 log whose runs/tool/
+        driver/rules/results shape CI consumers (GitHub code scanning,
+        the VS Code viewer) require; baselined findings carry
+        ``suppressions`` instead of disappearing."""
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        (tmp_path / "analysis_baseline.json").write_text(json.dumps({
+            "entries": [{"rule": "APX101", "path": "mod.py",
+                         "justification": "reviewed: test fixture"}]}))
+        r = self._run_cli(["mod.py", "--format", "sarif"], tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        log = json.loads(r.stdout)
+        assert log["version"] == "2.1.0"
+        assert log["$schema"].endswith("sarif-schema-2.1.0.json")
+        (run_obj,) = log["runs"]
+        driver = run_obj["tool"]["driver"]
+        assert driver["name"] == "apex_tpu.analysis"
+        rule_d = {d["id"]: d for d in driver["rules"]}
+        assert "APX101" in rule_d
+        assert rule_d["APX101"]["defaultConfiguration"]["level"] == "error"
+        (result,) = run_obj["results"]
+        assert result["ruleId"] == "APX101"
+        assert result["level"] == "error"
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "mod.py"
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+        assert result["suppressions"][0]["kind"] == "external"
+
+    def test_update_baseline_bootstraps_an_explicit_path(self, tmp_path):
+        """Review finding: --baseline pointing at a not-yet-existing
+        file must BOOTSTRAP it under --update-baseline, not die with
+        'cannot read baseline' before write_baseline runs."""
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        target = tmp_path / "fresh" "_baseline.json"
+        r = self._run_cli(
+            ["mod.py", "--baseline", str(target), "--update-baseline"],
+            tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert json.loads(target.read_text())["entries"]
+        # a normal run against a MISSING explicit baseline still errors
+        r = self._run_cli(
+            ["mod.py", "--baseline", str(tmp_path / "nope.json")],
+            tmp_path)
+        assert r.returncode == 2
+
+    def test_update_baseline_rejects_no_baseline(self, tmp_path):
+        """Review finding: the combination would rewrite the file from
+        an EMPTY entry list, silently discarding every reviewed
+        justification — refuse it."""
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        (tmp_path / "analysis_baseline.json").write_text(json.dumps({
+            "entries": [{"rule": "APX101", "path": "mod.py",
+                         "justification": "reviewed: keep me"}]}))
+        r = self._run_cli(
+            ["mod.py", "--update-baseline", "--no-baseline"], tmp_path)
+        assert r.returncode == 2
+        assert "discard" in r.stderr
+        kept = json.loads(
+            (tmp_path / "analysis_baseline.json").read_text())
+        assert kept["entries"][0]["justification"] == "reviewed: keep me"
+
+    def test_sarif_unsuppressed_finding_has_no_suppressions(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        r = self._run_cli(
+            ["mod.py", "--format", "sarif", "--no-baseline"], tmp_path)
+        assert r.returncode == 1  # findings still drive the exit code
+        log = json.loads(r.stdout)
+        (result,) = log["runs"][0]["results"]
+        assert "suppressions" not in result
+
+    def test_vmem_budget_flag_reaches_apx304(self, tmp_path):
+        (tmp_path / "mod.py").write_text(textwrap.dedent("""
+            from jax.experimental import pallas as pl
+
+            def launch(x):
+                return pl.pallas_call(
+                    _body, grid=(4,),
+                    in_specs=[pl.BlockSpec((256, 512), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((256, 512), lambda i: (i, 0)),
+                )(x)
+            """))
+        assert self._run_cli(["mod.py"], tmp_path).returncode == 0
+        r = self._run_cli(
+            ["mod.py", "--vmem-budget-mib", "0.125"], tmp_path)
+        assert r.returncode == 1
+        assert "APX304" in r.stdout
 
 
 # ------------------------------------------------- the repo-wide rider
