@@ -24,6 +24,11 @@ machine-checked invariants):
   dtype lattice) — fp32 accumulation silently re-rounded to bf16.
 - **APX304** provable per-``pallas_call`` VMEM footprint over budget
   (``rules_tiling``, warning).
+- **APX305** quantized-sync state dtype (``rules_precision`` + the
+  dtype lattice): in int8/fp8 wire-cast code, a ``scale`` buffer
+  narrower than fp32 or a ``residual`` buffer at wire width — the
+  compressed-grad-sync contract of
+  ``contrib.optimizers._quantized_sync``.
 - **APX401/402** indexing/precision hygiene: unclamped vocab gathers
   and fp32 constants in bf16 paths (``rules_precision``) — the
   ``gpt.py:447`` class.
@@ -52,8 +57,8 @@ from apex_tpu.analysis.rules_collectives import (
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_precision import (
-    Fp32ConstantInBf16Path, ScratchAccumDtypeMismatch,
-    UnclampedTakeAlongAxis,
+    Fp32ConstantInBf16Path, QuantizedSyncStateDtype,
+    ScratchAccumDtypeMismatch, UnclampedTakeAlongAxis,
 )
 from apex_tpu.analysis.rules_tiling import (
     BlockShapeTilingViolation, BlockSpecIndexMapArity,
@@ -83,6 +88,7 @@ def default_rules(vmem_budget_bytes=None):
         HardCodedSublaneAlignment(),
         vmem,
         ScratchAccumDtypeMismatch(),
+        QuantizedSyncStateDtype(),
         UnclampedTakeAlongAxis(),
         Fp32ConstantInBf16Path(),
     )

@@ -20,6 +20,15 @@
   (``dataflow.dtype_env``), and scratch refs are matched to their
   ``pallas_call``'s ``scratch_shapes`` declarations positionally (the
   trailing kernel parameters, by the Pallas calling convention).
+- APX305: quantized-sync state narrower than its contract.  Inside a
+  function that casts to a quantized WIRE dtype (int8/fp8 — the
+  compressed grad-sync idiom), a ``scale``-named buffer provably
+  narrower than fp32, or a ``residual``-named buffer provably AT the
+  wire width.  A half-precision scale re-quantizes the quantizer
+  (every dequantize multiplies by a rounded scale, a bias error
+  feedback cannot see), and a wire-width residual throws away exactly
+  the error-feedback information it exists to carry — the residual
+  must live in the bucket's storage dtype (a >= 2-byte float).
 """
 
 from __future__ import annotations
@@ -316,6 +325,100 @@ class ScratchAccumDtypeMismatch(Rule):
                     f"re-rounds the {pref} partials to {store_dtype}, "
                     f"silently discarding the accumulation precision "
                     f"the preferred_element_type was written to buy")
+
+
+#: quantized wire dtypes — the presence of a cast to one of these is
+#: what marks a function as quantized-sync code (the scoping guard:
+#: the repo is full of ``loss_scale``-style names that have nothing to
+#: do with wire quantization and must stay out of APX305's reach)
+_WIRE_DTYPES = {"int8", "uint8", "float8_e4m3fn", "float8_e5m2",
+                "float8_e4m3", "float8_e4m3fnuz", "float8_e5m2fnuz"}
+
+
+def _cast_dtype(value: ast.AST, env: Dict[str, str]) -> Optional[str]:
+    """The dtype an assignment's value provably creates: an
+    ``x.astype(DT)`` cast, or a ``zeros/full/...`` factory with a
+    ``dtype=`` (positional trailing arg included)."""
+    if not isinstance(value, ast.Call):
+        return None
+    if isinstance(value.func, ast.Attribute) and value.func.attr == "astype" \
+            and value.args:
+        return dataflow.dtype_literal(value.args[0], env)
+    if last_name(value.func) in _ACC_FACTORIES | _F32_FACTORIES:
+        dtype_node = None
+        for kw in value.keywords:
+            if kw.arg == "dtype":
+                dtype_node = kw.value
+        if dtype_node is None and len(value.args) > 1:
+            dtype_node = value.args[-1]
+        return dataflow.dtype_literal(dtype_node, env)
+    return None
+
+
+class QuantizedSyncStateDtype(Rule):
+    """APX305: error-feedback residual or scale buffer narrower than
+    its quantized-sync contract (scales fp32; residuals storage-width,
+    never the wire dtype)."""
+
+    rule_id = "APX305"
+    severity = "error"
+    fix_hint = ("keep quantization scales in float32 (the dequantize "
+                "multiplies by them — a rounded scale biases every "
+                "block) and store error-feedback residuals in the "
+                "bucket's storage dtype (bfloat16/float16/float32), "
+                "never the int8/fp8 wire dtype")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for info in ctx.functions.values():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            yield from self._check_fn(ctx, info.node)
+
+    def _assigns(self, ctx: ModuleContext, fn: ast.AST):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and ctx.enclosing_function(node) is fn:
+                yield node
+
+    def _check_fn(self, ctx: ModuleContext, fn: ast.AST) -> Iterator[Finding]:
+        env = dataflow.dtype_env(ctx, fn)
+        # the scoping marker: this function DIRECTLY casts something to
+        # a wire dtype (assignment or not — `return q.astype(jnp.int8)`
+        # counts; a cast inside a nested def marks only the nested def,
+        # which is checked on its own — the outer function's scale
+        # names must not be judged by its helper's wire)
+        if not any(
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype" and node.args
+            and ctx.enclosing_function(node) is fn
+            and dataflow.dtype_literal(node.args[0], env) in _WIRE_DTYPES
+            for node in ast.walk(fn)
+        ):
+            return
+        for node in self._assigns(ctx, fn):
+            name = node.targets[0].id.lower()
+            d = _cast_dtype(node.value, env)
+            if d is None:
+                continue
+            size = dataflow.itemsize(d)
+            if "scale" in name and size is not None and size < 4:
+                yield self.finding(
+                    ctx, node,
+                    f"quantization scale `{node.targets[0].id}` created "
+                    f"as {d} in a quantized-sync function: scales must "
+                    "stay float32 — every dequantize multiplies by them, "
+                    "so a rounded scale injects a per-block bias the "
+                    "error-feedback residual cannot observe")
+            elif "resid" in name and d in _WIRE_DTYPES:
+                yield self.finding(
+                    ctx, node,
+                    f"error-feedback residual `{node.targets[0].id}` "
+                    f"created as the wire dtype {d}: the residual exists "
+                    "to carry the part of the gradient the wire could "
+                    "NOT represent — storing it at wire width re-rounds "
+                    "it away; use the bucket's storage dtype")
 
 
 class Fp32ConstantInBf16Path(Rule):

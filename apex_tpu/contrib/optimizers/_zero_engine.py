@@ -24,7 +24,13 @@ TPU shape of that machinery (this module):
   bf16 bucket's gradient crosses the wire in bf16, half the traffic of
   the old monolithic fp32 concat), so XLA's latency-hiding scheduler
   can overlap each bucket's collective with the remaining backward and
-  with other buckets' math;
+  with other buckets' math; ``grad_sync_dtype`` of ``int8`` /
+  ``float8_e4m3fn`` / ``float8_e5m2`` engages the QUANTIZED wire
+  (:mod:`apex_tpu.contrib.optimizers._quantized_sync`): shared
+  per-block fp32 scales from an amax psum, the narrow payload
+  reduce-scattered in the wire dtype, and the per-rank quantization
+  error carried as a resident error-feedback residual bucket (stored
+  in the bucket's storage dtype, donated through jit like m/v);
 - **param sync is one ``all_gather`` per bucket in
   ``param_sync_dtype``**; with ``overlap_param_sync`` the gather runs
   on the pre-commit update (before the cross-rank finite vote
@@ -46,36 +52,51 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.contrib.optimizers import _quantized_sync as qs
 from apex_tpu.optimizers import bucketing
 from apex_tpu.optimizers.base import bias_corrections
 from apex_tpu.transformer.parallel_state import DATA_AXIS
 
 Tree = Any
 
-#: Sync dtypes the engine knows how to reduce/gather in.  fp8 (and any
-#: integer) sync would need the reference's scaled-quantization support
-#: (``distributed_fused_adam.py`` fp8 buffers + per-bucket amax) that
-#: this port does not have — constructor-time rejection beats the old
-#: accept-and-silently-drop behavior.
+#: Wide sync dtypes: the wire carries the values themselves.
 _SUPPORTED_SYNC = ("float32", "bfloat16", "float16")
+
+#: Quantized wire dtypes (grad sync ONLY): shared per-block fp32
+#: scales + error-feedback residuals (``_quantized_sync``).  int8 is
+#: the only legal integer — wider ints have no scaled-sum story and
+#: narrower ones no wire support.
+_QUANTIZED_GRAD_SYNC = ("int8", "float8_e4m3fn", "float8_e5m2")
 
 
 def resolve_sync_dtype(value, knob: str):
     """Validate a ``grad_sync_dtype``/``param_sync_dtype`` knob; None
     means the per-bucket default (the bucket's storage dtype for half
-    buckets, fp32 otherwise)."""
+    buckets, fp32 otherwise).  ``grad_sync_dtype`` additionally accepts
+    the quantized wire dtypes ``int8``/``float8_e4m3fn``/
+    ``float8_e5m2``; ``param_sync_dtype`` never does."""
     if value is None:
         return None
     dt = jnp.dtype(value)
-    if dt.name not in _SUPPORTED_SYNC:
+    if dt.name in _SUPPORTED_SYNC:
+        return dt
+    if dt.name in _QUANTIZED_GRAD_SYNC:
+        if knob == "grad_sync_dtype":
+            return dt
         raise ValueError(
-            f"{knob}={dt.name!r} is not supported: fp8/integer sync needs "
-            "the reference's scaled-quantization machinery (per-bucket "
-            "amax + stochastic rounding) this port does not implement; "
-            f"pass one of {_SUPPORTED_SYNC} or None (per-bucket default: "
-            "the bucket's storage dtype for bf16/fp16 buckets, float32 "
-            "otherwise)")
-    return dt
+            f"{knob}={dt.name!r}: quantized sync is gradient-only — a "
+            "param all-gather has no error-feedback channel (a gather "
+            "is not a sum: each step's quantization error would land in "
+            "the params with no residual to carry it to the next step); "
+            f"pass one of {_SUPPORTED_SYNC} or None")
+    raise ValueError(
+        f"{knob}={dt.name!r} is not supported: pass one of "
+        f"{_SUPPORTED_SYNC}, None (per-bucket default: the bucket's "
+        "storage dtype for bf16/fp16 buckets, float32 otherwise), or — "
+        f"for grad_sync_dtype only — a quantized wire dtype "
+        f"{_QUANTIZED_GRAD_SYNC} (int8 is the only supported integer; "
+        "per-block fp32 scales + error-feedback residuals ride the "
+        "bucket plan)")
 
 
 def _spec_dim_axes(entry) -> Tuple[str, ...]:
@@ -228,6 +249,12 @@ class ZeroOptimizerBase:
         return dt if dt in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)) \
             else jnp.dtype(jnp.float32)
 
+    @property
+    def _quantized(self) -> bool:
+        """True when grad sync runs the quantized wire (int8/fp8) —
+        the optimizer then carries error-feedback residual buckets."""
+        return qs.is_quantized(self.grad_sync_dtype)
+
     def _param_dtype(self, bucket) -> jnp.dtype:
         if self.param_sync_dtype is not None:
             return self.param_sync_dtype
@@ -274,6 +301,26 @@ class ZeroOptimizerBase:
         array per bucket, to be sharded over (model axes…, dp)."""
         return tuple(jnp.zeros((self._model_mult * b.total,), dtype)
                      for b in self._plan.buckets)
+
+    def _residual_slot(self) -> Tuple[jnp.ndarray, ...]:
+        """The error-feedback residuals for quantized grad sync — or
+        the empty tuple on wide wires (the residual field stays in the
+        state NamedTuple with zero leaves, so specs/donation/pytree
+        plumbing need no special case).
+
+        Residuals are PER-RANK FULL-BUCKET (each rank quantizes the
+        whole local gradient it contributes, so its error covers every
+        element — the 1-bit-Adam/EF-SGD shape), stored in the bucket's
+        STORAGE dtype: globally (model_mult · dp · total,) sharded over
+        (model axes…, dp), i.e. each rank resides its own (total,)
+        error vector — bucket-sized like one grad copy, not
+        state-sized."""
+        if not self._quantized:
+            return ()
+        return tuple(
+            jnp.zeros((self._model_mult * self._world * b.total,),
+                      jnp.dtype(b.dtype))
+            for b in self._plan.buckets)
 
     def _master_slot(self, params) -> Tuple[jnp.ndarray, ...]:
         """The resident master: fp32 pack of every mesh rank's local
@@ -323,13 +370,18 @@ class ZeroOptimizerBase:
     def state_partition_spec(self):
         """The shard_map / pjit PartitionSpec tree for the state: each
         bucket's flat array sharded jointly over (model axes…, dp) —
-        model-major, matching the layout ``init`` builds."""
+        model-major, matching the layout ``init`` builds.  The residual
+        field shares the flat spec (its global arrays are dp-times
+        longer, each rank residing its full-bucket error vector) and is
+        the empty tuple on wide wires."""
         from jax.sharding import PartitionSpec as P
 
         flat = self._flat_spec()
         fields = {"step": P()}
         for f in [f for f in self._STATE_CLS._fields if f != "step"]:
             fields[f] = flat
+        if "residual" in self._STATE_CLS._fields and not self._quantized:
+            fields["residual"] = ()
         return self._STATE_CLS(**fields)
 
     # ---------------------------------------------------------- prepare
@@ -373,24 +425,67 @@ class ZeroOptimizerBase:
         """One bucket's concat in ``dtype`` (the grad read / the bf16
         param read of remainder mode) — per-BUCKET and in the sync
         dtype, never a whole-tree fp32 flatten."""
-        parts = [jnp.ravel(leaves[bl.leaf_id]).astype(dtype)
-                 for bl in bucket.leaves]
-        arr = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        if scale is not None:
-            arr = arr * jnp.asarray(scale, dtype)
-        if bucket.pad:
-            arr = jnp.pad(arr, (0, bucket.pad))
-        return arr
+        return bucketing.pack_bucket(bucket, leaves, dtype, scale=scale)
+
+    def _check_residual_state(self, plan, residuals) -> None:
+        """The error-feedback residuals must exist exactly when the
+        wire is quantized — a compressed checkpoint restored into an
+        uncompressed optimizer (or vice versa) fails HERE, at trace
+        time with the knob named, mirroring the remainder-master
+        check."""
+        n = len(residuals) if residuals is not None else 0
+        if not self._quantized:
+            if n:
+                raise ValueError(
+                    "optimizer state carries error-feedback residual "
+                    "buckets but this optimizer's grad_sync_dtype="
+                    f"{getattr(self.grad_sync_dtype, 'name', None)!r} is "
+                    "not quantized: a compressed (int8/fp8) checkpoint "
+                    "cannot be value-converted silently — construct the "
+                    "optimizer with the matching grad_sync_dtype")
+            return
+        if n != len(plan.buckets):
+            raise ValueError(
+                f"grad_sync_dtype={self.grad_sync_dtype.name!r} needs one "
+                f"error-feedback residual per bucket ({len(plan.buckets)}), "
+                f"state has {n}: this state was saved by an uncompressed "
+                "run (or a different bucket layout) — resume with the "
+                "matching grad_sync_dtype or reshard with "
+                "load_sharded_state_dicts")
+        for arr, b in zip(residuals, plan.buckets):
+            if arr.shape[0] != b.total:
+                raise ValueError(
+                    f"residual bucket holds {arr.shape[0]} elements; each "
+                    f"rank resides its FULL local bucket ({b.total}) — "
+                    "state saved at another world size must be resharded "
+                    "with load_sharded_state_dicts")
+            if arr.dtype != jnp.dtype(b.dtype):
+                raise ValueError(
+                    f"residual bucket dtype {arr.dtype} must match the "
+                    f"bucket storage dtype {b.dtype} (the APX305 "
+                    "contract: a narrower residual re-quantizes the "
+                    "feedback)")
 
     def _prepare_grads(self, plan, grads, scale, clip_norm, finite_sync,
-                       want_finite, grads_finite, sumsq_reduce):
+                       want_finite, grads_finite, sumsq_reduce,
+                       residuals=None):
         """The sharded grad read: per-bucket reduce-scatter in
         ``grad_sync_dtype`` (grad-average pre-division folded in — the
         reference's predivide, overflow-safe for large worlds), fp32
         unscale on the 1/dp shard, the all-finite vote, and the
         global-l2 clip with per-leaf Σx² recovered from the shards via
-        the plan's static segment map.  Returns
-        ``(g32_shards, pred, rank, world)``."""
+        the plan's static segment map.
+
+        With a quantized wire the same single read additionally folds
+        the error-feedback residual add (``h = g/scale + residual``),
+        the shared-scale quantization, and the residual refresh — the
+        wire carries int8/fp8 plus the small fp32 scale psum, and the
+        UNSCALED error lives in the residual so loss-scale changes
+        between steps cannot change its units.  Returns
+        ``(g32_shards, new_residuals, pred, rank, world)`` —
+        ``new_residuals`` is ``()`` on wide wires, UNCOMMITTED (the
+        caller predicates it on the finite vote: a skipped step leaves
+        residuals untouched)."""
         ax = self.axis_name
         world = jax.lax.axis_size(ax)
         rank = jax.lax.axis_index(ax)
@@ -398,9 +493,33 @@ class ZeroOptimizerBase:
         if len(leaves) != plan.n_leaves:
             raise ValueError(f"grad tree has {len(leaves)} leaves; plan "
                              f"expects {plan.n_leaves}")
+        self._check_residual_state(plan, residuals)
         g_shards = []
-        for b in plan.buckets:
+        new_residuals = []
+        pre_wire = []  # fp32 pre-quantization buckets, for the vote
+        for bi, b in enumerate(plan.buckets):
             sdt = self._grad_dtype(b)
+            spec = qs.qspec_of(sdt)
+            if spec is not None:
+                # quantized wire: unscale BEFORE quantizing (the
+                # residual must be in loss-scale-free units — a scaler
+                # backoff between steps must not re-weight carried
+                # error), add the residual, quantize against the
+                # shared per-block scales, reduce-scatter int8/fp8
+                h = self._pack_bucket(
+                    leaves, b, jnp.float32,
+                    scale=(1.0 / scale) if scale is not None else None)
+                h = h + residuals[bi].astype(jnp.float32)
+                g_sum, res_new = qs.quantized_reduce_scatter(
+                    h, ax, spec, rank, world)
+                g32 = g_sum / world if self.grad_average else g_sum
+                new_residuals.append(res_new.astype(jnp.dtype(b.dtype)))
+                # a non-finite grad quantizes to garbage the wire may
+                # MASK (nan -> int8 is finite): vote on the
+                # pre-quantization values, not just the shards
+                pre_wire.append(h)
+                g_shards.append(g32)
+                continue
             # fp16 sync pre-divides (the reference's predivide: the
             # world-sized sum would overflow fp16's range); fp32/bf16
             # sync post-divides in fp32 — same association the
@@ -428,7 +547,7 @@ class ZeroOptimizerBase:
         if want_finite:
             from apex_tpu.amp.scaler import all_finite
 
-            finite = all_finite(list(g_shards))
+            finite = all_finite(list(g_shards) + pre_wire)
             if finite_sync is not None:
                 # the caller's vote MUST include the ZeRO axis: shards
                 # are dp-disjoint, so ranks can disagree (the gpt step
@@ -450,7 +569,16 @@ class ZeroOptimizerBase:
             # engine — the two trajectories must not drift
             coef = _clip_coef(jnp.sqrt(total_sq), clip_norm)
             g_shards = [g * coef for g in g_shards]
-        return g_shards, pred, rank, world
+        return g_shards, tuple(new_residuals), pred, rank, world
+
+    def _commit_residuals(self, new_residuals, old_residuals, pred):
+        """The residual commit, predicated like every other state slot:
+        a skipped (non-finite) step leaves the carried error untouched
+        — a nan must never poison the feedback channel."""
+        if not self._quantized:
+            return ()
+        return tuple(self._select(pred, list(new_residuals),
+                                  list(old_residuals)))
 
     def _per_leaf_sumsq(self, plan, shards, rank, world):
         """Per-ORIGINAL-leaf Σx² of per-bucket 1/dp shards, via the
@@ -557,11 +685,39 @@ class ZeroOptimizerBase:
         raise NotImplementedError  # pragma: no cover - abstract
 
     # ----------------------------------------------------- state dicts
-    SHARD_FORMAT = "apex_tpu_zero2_v2"
+    #: v3 adds the error-feedback residual buckets (full local bucket
+    #: per rank, storage dtype) + ``residual_kind`` metadata.  v2
+    #: (pre-quantization) checkpoints still load — into uncompressed
+    #: optimizers only.
+    SHARD_FORMAT = "apex_tpu_zero2_v3"
+    _READ_FORMATS = ("apex_tpu_zero2_v2", "apex_tpu_zero2_v3")
 
     @property
     def _master_kind(self) -> str:
         return "remainder_u16" if self.store_param_remainders else "fp32"
+
+    @property
+    def _residual_kind(self) -> str:
+        """``"ef"`` when the quantized wire carries error-feedback
+        residual state, ``"none"`` otherwise — the save/restore
+        compatibility key (mirrors ``master_kind``)."""
+        return "ef" if self._quantized else "none"
+
+    def _check_residual_kind(self, d) -> None:
+        kind = d.get("residual_kind")
+        if kind is None:  # v2 checkpoints never carried residuals
+            kind = "none"
+        if kind != self._residual_kind:
+            have = ("a compressed (error-feedback) checkpoint"
+                    if kind == "ef" else "an uncompressed checkpoint")
+            raise ValueError(
+                f"checkpoint residual_kind {kind!r} does not match this "
+                f"optimizer's ({self._residual_kind!r}): {have} cannot "
+                "restore into an optimizer whose grad_sync_dtype="
+                f"{getattr(self.grad_sync_dtype, 'name', None)!r} — "
+                "construct the optimizer with the matching "
+                "grad_sync_dtype (quantized <-> not is a state-layout "
+                "change, like store_param_remainders)")
 
     def _check_master_kind(self, d):
         """A store_param_remainders mismatch between save and load would
@@ -580,6 +736,29 @@ class ZeroOptimizerBase:
         return [{"dtype": b.dtype, "size": b.size, "total": b.total}
                 for b in plan.buckets]
 
+    def wire_bytes_per_step(self) -> Dict[str, int]:
+        """Static per-step wire accounting off the bucket plan — what
+        the ``zero_gpt124`` bench reports per sync mode:
+
+        - ``grad_payload``: Σ bucket totals × the grad wire itemsize
+          (1 B for int8/fp8);
+        - ``grad_scales``: the quantized wires' fp32 per-block scale
+          psum (0 on wide wires) — counted so the reported cut is
+          honest (int8 ≈ 2x vs bf16, ≈ 4x vs fp32, minus ~0.4% scales);
+        - ``grad_sync`` = payload + scales; ``param_sync``: the
+          all-gather payload in ``param_sync_dtype``; ``total``."""
+        plan = self._require_plan()
+        grad = scales = param = 0
+        for b in plan.buckets:
+            p_bytes, s_bytes = qs.grad_sync_bytes(b.total,
+                                                  self._grad_dtype(b))
+            grad += p_bytes
+            scales += s_bytes
+            param += b.total * self._param_dtype(b).itemsize
+        return {"grad_payload": grad, "grad_scales": scales,
+                "grad_sync": grad + scales, "param_sync": param,
+                "total": grad + scales + param}
+
     def _state_arrays(self, state) -> Dict[str, Sequence]:
         """name -> per-bucket arrays, in the subclass's field order."""
         return {f: getattr(state, f) for f in state._fields if f != "step"}
@@ -592,6 +771,7 @@ class ZeroOptimizerBase:
             "format": self.SHARD_FORMAT,
             "step": int(state.step),
             "master_kind": self._master_kind,
+            "residual_kind": self._residual_kind,
             "buckets": self._bucket_meta(),
         }
         for name, slot in self._state_arrays(state).items():
@@ -604,18 +784,24 @@ class ZeroOptimizerBase:
     def load_state_dict(self, d):
         fmt = d.get("format")
         fmt = np.asarray(fmt).item() if isinstance(fmt, np.ndarray) else fmt
-        if fmt != self.SHARD_FORMAT:
+        if fmt not in self._READ_FORMATS:
             # a pre-bucket (v1 flat-array) dict would otherwise iterate
             # its flat slot into thousands of 0-d scalars and fail later
             # with a misleading bucket-layout error
             raise ValueError(
                 f"unrecognized state_dict format {fmt!r}: this optimizer "
-                f"reads {self.SHARD_FORMAT} (per-bucket arrays); "
+                f"reads {self._READ_FORMATS} (per-bucket arrays); "
                 "pre-bucket-plan (flat v1) checkpoints cannot be loaded")
         self._check_master_kind(d)
+        self._check_residual_kind(d)
         fields = {"step": jnp.int32(d["step"])}
         for f in [f for f in self._STATE_CLS._fields if f != "step"]:
-            fields[f] = tuple(jnp.asarray(a) for a in d[f])
+            # ONLY residual may be absent (v2 dicts predate it; empty
+            # on wide wires) — a missing m/v/master slot is corruption
+            # and must stay a loud KeyError here, not a misleading
+            # bucket-layout error at first trace
+            src = d.get(f, ()) if f == "residual" else d[f]
+            fields[f] = tuple(jnp.asarray(a) for a in src)
         return self._STATE_CLS(**fields)
 
     def sharded_state_dict(self, state, rank: int, world_size: int):
@@ -633,6 +819,7 @@ class ZeroOptimizerBase:
         d = {
             "format": self.SHARD_FORMAT,
             "master_kind": self._master_kind,
+            "residual_kind": self._residual_kind,
             "rank": int(rank),
             "world_size": int(world_size),
             "model_mult": self._model_mult,
@@ -643,21 +830,48 @@ class ZeroOptimizerBase:
         for name, slot in self._state_arrays(state).items():
             pieces = []
             for arr, b in zip(slot, plan.buckets):
+                if name == "residual":
+                    # each rank resides its FULL local bucket: the
+                    # global layout is (model_mult, world, total) and
+                    # rank r's piece is the (model_mult, total) block
+                    a = np.asarray(arr).reshape(
+                        self._model_mult, world_size, b.total)
+                    pieces.append(a[:, rank, :].copy())
+                    continue
                 shard = b.total // world_size
                 a = np.asarray(arr).reshape(self._model_mult, b.total)
                 pieces.append(a[:, rank * shard:(rank + 1) * shard].copy())
             d[name] = pieces
         return d
 
+    #: sentinel: "caller did not say" (None is a meaningful value — an
+    #: uncompressed optimizer)
+    _UNSPECIFIED = object()
+
     @classmethod
     def load_sharded_state_dicts(cls, shards, world_size: int,
-                                 store_param_remainders: Optional[bool] = None):
+                                 store_param_remainders: Optional[bool] = None,
+                                 grad_sync_dtype=_UNSPECIFIED):
         """Reassemble a full state from per-rank shard dicts and reshard
         it for ``world_size`` ranks (which may differ from the saved
         world — save at dp=4, load at dp=2): per bucket and per model
         segment, concat the saved dp slices, trim to the payload, and
         re-pad with the plan's own formula
-        (:func:`bucketing.padded_total`) for the new world."""
+        (:func:`bucketing.padded_total`) for the new world.
+
+        Error-feedback residuals (quantized grad sync, format v3)
+        reshard with the SAME pad formula: at the saved world size each
+        rank's full-bucket residual round-trips bitwise; at a different
+        world size the per-rank errors are summed into the new rank
+        0's residual (zeros elsewhere) — what the optimizer trajectory
+        sees is ``Σ_r (g_r + residual_r)``, so the sum-collapse
+        preserves the carried error exactly while the per-rank
+        attribution (which no longer exists) is dropped.
+
+        Pass ``grad_sync_dtype=`` to assert the target optimizer's wire
+        up front (mirrors ``store_param_remainders``): a compressed
+        checkpoint refuses to reshard for an uncompressed optimizer and
+        vice versa."""
         def _py(v):
             """io round-trips scalars/strings as 0-d numpy arrays —
             coerce metadata back to python before comparisons."""
@@ -674,7 +888,7 @@ class ZeroOptimizerBase:
         if not shards:
             raise ValueError("no shards given")
         meta = shards[0]
-        if meta.get("format") != cls.SHARD_FORMAT:
+        if meta.get("format") not in cls._READ_FORMATS:
             raise ValueError(
                 f"unrecognized shard format {meta.get('format')!r} (pre-"
                 f"bucket-plan checkpoints cannot be resharded by this "
@@ -688,8 +902,11 @@ class ZeroOptimizerBase:
             for key in ("model_mult", "total_numel", "step", "world_size"):
                 if d[key] != meta[key]:
                     raise ValueError(f"shard {d['rank']} disagrees on {key}")
-            if d.get("master_kind", "fp32") != meta.get("master_kind", "fp32"):
-                raise ValueError(f"shard {d['rank']} disagrees on master_kind")
+            for kind_key, default in (("master_kind", "fp32"),
+                                      ("residual_kind", "none")):
+                if d.get(kind_key, default) != meta.get(kind_key, default):
+                    raise ValueError(
+                        f"shard {d['rank']} disagrees on {kind_key}")
         if store_param_remainders is not None:
             want = "remainder_u16" if store_param_remainders else "fp32"
             got = meta.get("master_kind", "fp32")
@@ -697,12 +914,26 @@ class ZeroOptimizerBase:
                 raise ValueError(
                     f"checkpoint master_kind {got!r} does not match "
                     f"store_param_remainders={store_param_remainders}")
+        res_kind = meta.get("residual_kind", "none")
+        if grad_sync_dtype is not cls._UNSPECIFIED:
+            resolved = resolve_sync_dtype(grad_sync_dtype, "grad_sync_dtype")
+            want_kind = "ef" if qs.is_quantized(resolved) else "none"
+            if res_kind != want_kind:
+                raise ValueError(
+                    f"checkpoint residual_kind {res_kind!r} does not match "
+                    f"grad_sync_dtype={getattr(resolved, 'name', None)!r}: "
+                    "compressed (error-feedback) and uncompressed states "
+                    "cannot be value-converted silently")
 
         mm = meta["model_mult"]
         buckets = meta["buckets"]
         fields = {"step": jnp.int32(meta["step"])}
         state_cls = cls._STATE_CLS
         for name in [f for f in state_cls._fields if f != "step"]:
+            if name == "residual":
+                fields[name] = cls._reshard_residuals(
+                    shards, meta, world_size) if res_kind == "ef" else ()
+                continue
             out = []
             for bi, bm in enumerate(buckets):
                 # (model_mult, saved_total) from the saved dp slices
@@ -715,3 +946,28 @@ class ZeroOptimizerBase:
                 out.append(jnp.asarray(padded.reshape(-1)))
             fields[name] = tuple(out)
         return state_cls(**fields)
+
+    @classmethod
+    def _reshard_residuals(cls, shards, meta, world_size: int):
+        """Residual buckets for the new world (see
+        :meth:`load_sharded_state_dicts`): bitwise per-rank restore at
+        the saved world, trajectory-sum-preserving collapse onto the
+        new rank 0 otherwise.  Pads with the ONE
+        :func:`bucketing.padded_total` formula."""
+        mm = meta["model_mult"]
+        saved_world = meta["world_size"]
+        out = []
+        for bi, bm in enumerate(meta["buckets"]):
+            pieces = [np.asarray(d["residual"][bi]) for d in shards]
+            new_total = bucketing.padded_total(
+                bm["size"], bm["dtype"], world_size)
+            new = np.zeros((mm, world_size, new_total), pieces[0].dtype)
+            if world_size == saved_world:
+                for r, piece in enumerate(pieces):
+                    new[:, r, :bm["size"]] = piece[:, :bm["size"]]
+            else:
+                summed = sum(p[:, :bm["size"]].astype(np.float32)
+                             for p in pieces)
+                new[:, 0, :bm["size"]] = summed.astype(pieces[0].dtype)
+            out.append(jnp.asarray(new.reshape(-1)))
+        return tuple(out)

@@ -58,6 +58,10 @@ class DistributedFusedAdamState(NamedTuple):
     # fp32 master of owned params — or, with store_param_remainders, the
     # low 16 bits (uint16) the bf16 param is missing — per bucket
     master_shard: Tuple[jnp.ndarray, ...]
+    # quantized grad sync only: per-bucket error-feedback residuals in
+    # the bucket storage dtype, each rank residing its FULL local
+    # bucket's quantization error; () on wide wires
+    residual: Tuple[jnp.ndarray, ...] = ()
 
 
 def _master_from_remainder(p_f32, rem_u16):
@@ -167,7 +171,8 @@ class DistributedFusedAdam(ZeroOptimizerBase):
         v = self._zero_slot()
         return DistributedFusedAdamState(
             step=jnp.int32(0), exp_avg=m, exp_avg_sq=v,
-            master_shard=self._master_slot(params))
+            master_shard=self._master_slot(params),
+            residual=self._residual_slot())
 
     # -------------------------------------------------------------- step
     def _zero_step(self, grads, state: DistributedFusedAdamState, params,
@@ -178,9 +183,9 @@ class DistributedFusedAdam(ZeroOptimizerBase):
         plan = self._plan_of_local(params)
         self._check_master_precision(state.master_shard)
 
-        g_shards, pred, rank, world = self._prepare_grads(
+        g_shards, res_new, pred, rank, world = self._prepare_grads(
             plan, grads, scale, clip_norm, finite_sync, want_finite,
-            grads_finite, sumsq_reduce)
+            grads_finite, sumsq_reduce, residuals=state.residual)
         self._check_state_shards(plan, state.exp_avg, world, "exp_avg")
 
         if self.store_param_remainders:
@@ -210,6 +215,7 @@ class DistributedFusedAdam(ZeroOptimizerBase):
         new_m = self._select(pred, new_m, state.exp_avg)
         new_v = self._select(pred, new_v, state.exp_avg_sq)
         master_committed = self._select(pred, new_p, master)
+        res_committed = self._commit_residuals(res_new, state.residual, pred)
 
         if self.store_param_remainders:
             if self.overlap_param_sync and pred is not None:
@@ -223,7 +229,8 @@ class DistributedFusedAdam(ZeroOptimizerBase):
                 new_params = self._emit_params(plan, gather_src, params, None)
             rem_new = tuple(_split_master(p)[1] for p in master_committed)
             return new_params, DistributedFusedAdamState(
-                step, tuple(new_m), tuple(new_v), rem_new), pred
+                step, tuple(new_m), tuple(new_v), rem_new,
+                res_committed), pred
 
         if self.overlap_param_sync and pred is not None:
             new_params = self._emit_params(plan, new_p, params, pred)
@@ -231,4 +238,5 @@ class DistributedFusedAdam(ZeroOptimizerBase):
             new_params = self._emit_params(plan, master_committed, params,
                                            None)
         return new_params, DistributedFusedAdamState(
-            step, tuple(new_m), tuple(new_v), tuple(master_committed)), pred
+            step, tuple(new_m), tuple(new_v), tuple(master_committed),
+            res_committed), pred

@@ -46,6 +46,9 @@ class DistributedFusedLAMBState(NamedTuple):
     exp_avg: Tuple[jnp.ndarray, ...]
     exp_avg_sq: Tuple[jnp.ndarray, ...]
     master_shard: Tuple[jnp.ndarray, ...]
+    # quantized grad sync only: per-bucket error-feedback residuals
+    # (see DistributedFusedAdamState.residual); () on wide wires
+    residual: Tuple[jnp.ndarray, ...] = ()
 
 
 class DistributedFusedLAMB(ZeroOptimizerBase):
@@ -96,7 +99,8 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
         return DistributedFusedLAMBState(
             step=jnp.int32(0), exp_avg=self._zero_slot(),
             exp_avg_sq=self._zero_slot(),
-            master_shard=self._master_slot(params))
+            master_shard=self._master_slot(params),
+            residual=self._residual_slot())
 
     def _global_leaf_sumsq(self, plan, shards, rank, world):
         """GLOBAL per-leaf Σx² from per-bucket dp shards: segment sums,
@@ -119,14 +123,16 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
         plan = self._plan_of_local(params)
         self._check_master_precision(state.master_shard)
 
-        g_shards, pred, rank, world = self._prepare_grads(
+        g_shards, res_new, pred, rank, world = self._prepare_grads(
             plan, grads, scale, clip_norm, finite_sync, want_finite,
-            grads_finite, sumsq_reduce)
+            grads_finite, sumsq_reduce, residuals=state.residual)
         self._check_state_shards(plan, state.exp_avg, world, "exp_avg")
 
         # LAMB's own global grad-norm clip on the dp-AVERAGED grad
         # (fused_lamb.py:121-136) — per-leaf sums recovered from the
-        # scattered shards, so the dp reduction stays a reduce-scatter
+        # scattered shards (DEQUANTIZED fp32 on a compressed wire: the
+        # trust-ratio segment sums never see the int8/fp8 payload), so
+        # the dp reduction stays a reduce-scatter
         gn_sq = jnp.sum(self._global_leaf_sumsq(plan, g_shards, rank, world))
         clip = lamb_grad_clip(jnp.sqrt(gn_sq), self.max_grad_norm)
 
@@ -173,6 +179,7 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
         new_m = self._select(pred, new_m, state.exp_avg)
         new_v = self._select(pred, new_v, state.exp_avg_sq)
         master_committed = self._select(pred, new_p, master)
+        res_committed = self._commit_residuals(res_new, state.residual, pred)
 
         if self.overlap_param_sync and pred is not None:
             new_params = self._emit_params(plan, new_p, params, pred)
@@ -180,4 +187,5 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
             new_params = self._emit_params(plan, master_committed, params,
                                            None)
         return new_params, DistributedFusedLAMBState(
-            step, tuple(new_m), tuple(new_v), tuple(master_committed)), pred
+            step, tuple(new_m), tuple(new_v), tuple(master_committed),
+            res_committed), pred

@@ -666,8 +666,20 @@ def make_train_step(
     step_guard=None,
     chaos=None,
     clip_grad_norm=None,
+    grad_sync_dtype=None,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
+
+    ``grad_sync_dtype``: quantize the REPLICATED data-parallel
+    gradient sync (``int8``/``float8_e4m3fn``/``float8_e5m2``): the dp
+    pmean becomes a reduce-scatter + all-gather pair on the wire dtype
+    with shared per-block fp32 scales
+    (:func:`apex_tpu.contrib.optimizers._quantized_sync
+    .quantized_pmean`).  STATELESS — the replicated step has no
+    optimizer-state channel, so there is no error-feedback residual
+    here; for compressed sync with feedback use a ZeRO optimizer with
+    its own ``grad_sync_dtype`` (which owns the dp sync and must not
+    also be quantized here — pass the knob to exactly one of the two).
 
     ``clip_grad_norm``: global-l2 gradient clipping (torch
     ``clip_grad_norm_`` semantics) folded into the optimizer's fused
@@ -736,12 +748,44 @@ def make_train_step(
             )
     specs = param_specs(config, ep_axis=ep_axis)
 
+    qspec = None
+    if grad_sync_dtype is not None:
+        from apex_tpu.contrib.optimizers import _quantized_sync
+
+        qspec = _quantized_sync.qspec_of(grad_sync_dtype)
+        if qspec is None:
+            raise ValueError(
+                f"grad_sync_dtype={jnp.dtype(grad_sync_dtype).name!r}: the "
+                "step builder's knob quantizes the replicated dp sync and "
+                "accepts int8/float8_e4m3fn/float8_e5m2 only (wide sync "
+                "dtypes belong to the ZeRO optimizer's own knob)")
+        if hasattr(optimizer, "state_partition_spec"):
+            raise ValueError(
+                "a ZeRO optimizer owns the dp grad sync: pass "
+                "grad_sync_dtype to its constructor (where it gains the "
+                "error-feedback residual), not to make_train_step")
+        if config.moe:
+            raise NotImplementedError(
+                "quantized dp sync + MoE is not wired: expert grads are "
+                "dp-sharded sums, not pmean'd")
+        if dp_axis is None:
+            raise ValueError("grad_sync_dtype quantizes the dp sync; "
+                             "this step has dp_axis=None")
+
     def pmean_grads(grads, ax, skip_experts):
         """pmean over a data axis.  Expert grads are dp-SHARDED, not
         replicated: the all_to_all transpose already delivered every
         rank's cotangents (a sum over dp), so the mean-loss gradient is
         that sum divided by dp — never pmean'd (which would mix grads of
         *different* experts)."""
+        if qspec is not None and ax == dp_axis:
+            from apex_tpu.contrib.optimizers import _quantized_sync
+
+            # quantized all-reduce: reduce-scatter + all-gather, both
+            # on the wire dtype (the same scale machinery as ZeRO's
+            # compressed sync, minus the residual — no state channel)
+            return _quantized_sync.quantized_pmean(
+                grads, ax, qspec, world=mesh.shape[dp_axis])
         if not (skip_experts and config.moe):
             return jax.tree.map(lambda g: jax.lax.pmean(g, ax), grads)
         from apex_tpu.transformer.expert_parallel import EXPERT_PARAM_KEYS

@@ -53,8 +53,8 @@ Tree = Any
 
 __all__ = [
     "BucketLeaf", "BucketSpec", "BucketPlan", "Buckets", "plan_of",
-    "plan_of_shapes", "padded_total", "pack", "unpack", "per_leaf_reduce",
-    "seg_values", "seg_broadcast", "seg_ids",
+    "plan_of_shapes", "padded_total", "pack", "pack_bucket", "unpack",
+    "per_leaf_reduce", "seg_values", "seg_broadcast", "seg_ids",
 ]
 
 
@@ -216,6 +216,23 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def pack_bucket(bucket: BucketSpec, leaves: Sequence, dtype=jnp.float32,
+                scale=None) -> jnp.ndarray:
+    """ONE bucket's flat concat from the tree_flatten ``leaves``, cast
+    to ``dtype``, optional scalar multiply fused in, zero-padded tail —
+    the per-bucket unit both :func:`pack` and the ZeRO/quantized sync
+    paths read grads through (per-bucket and in the sync dtype, never a
+    whole-tree flatten)."""
+    parts = [jnp.ravel(leaves[bl.leaf_id]).astype(dtype)
+             for bl in bucket.leaves]
+    arr = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    if scale is not None:
+        arr = arr * jnp.asarray(scale, dtype)
+    if bucket.pad:
+        arr = jnp.pad(arr, (0, bucket.pad))
+    return arr
+
+
 def pack(plan: BucketPlan, tree: Tree, dtype=jnp.float32,
          scale=None) -> List[jnp.ndarray]:
     """Flatten ``tree`` into ``plan``'s buckets, cast to the math dtype,
@@ -226,17 +243,8 @@ def pack(plan: BucketPlan, tree: Tree, dtype=jnp.float32,
     if len(leaves) != plan.n_leaves:
         raise ValueError(
             f"tree has {len(leaves)} leaves; plan expects {plan.n_leaves}")
-    out = []
-    for b in plan.buckets:
-        parts = [jnp.ravel(leaves[bl.leaf_id]).astype(dtype)
-                 for bl in b.leaves]
-        arr = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        if scale is not None:
-            arr = arr * scale
-        if b.pad:
-            arr = jnp.pad(arr, (0, b.pad))
-        out.append(arr)
-    return out
+    return [pack_bucket(b, leaves, dtype, scale=scale)
+            for b in plan.buckets]
 
 
 def unpack(plan: BucketPlan, arrays: Sequence, dtype=None) -> Tree:

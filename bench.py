@@ -699,22 +699,26 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
                       seq=1024, batch_per_rank=1, vocab=50304):
     """The MULTICHIP ZeRO section: GPT-124M over a dp mesh — replicated
     ``FusedAdam`` (fp32 master) vs bucketed ``DistributedFusedAdam`` in
-    its fp32-master and ``store_param_remainders`` modes, through the
-    REAL ``make_train_step`` seam (per-bucket reduce-scatter grad sync
-    fused into the update).  Reports tokens/sec and per-device live
-    bytes of params + optimizer state — the ZeRO claim is exactly that
-    the state bytes shrink 1/dp while tokens/sec holds or improves from
-    the overlappable per-bucket collectives.  dp defaults to
-    min(8, visible devices): 8 on a pod slice, the degenerate 1 on a
-    single chip (which still banks the engine's single-chip overhead
-    and the memory split)."""
+    its fp32-master and ``store_param_remainders`` modes plus the
+    QUANTIZED grad-sync wires (int8 / float8_e4m3fn with per-block
+    scales + error-feedback residuals), through the REAL
+    ``make_train_step`` seam (per-bucket reduce-scatter grad sync fused
+    into the update).  Reports tokens/sec, per-device live bytes of
+    params + optimizer state, and — per sync mode —
+    ``wire_bytes_per_step`` computed statically from the bucket plan
+    (grad payload + fp32 scale vectors; the compressed-sync headline is
+    the ``wire_cut_vs_default`` ratio: ≈2x for int8 vs the bf16
+    default, ≈4x vs an fp32 wire).  dp defaults to min(8, visible
+    devices): 8 on a pod slice, the degenerate 1 on a single chip
+    (which still banks the engine's single-chip overhead and the
+    memory split)."""
     from jax.sharding import Mesh, PartitionSpec as P
 
     from apex_tpu.contrib.optimizers import DistributedFusedAdam
     from apex_tpu.models.gpt import (
         GPTConfig, gpt_loss, init_params, make_train_step, param_specs,
     )
-    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers import FusedAdam, bucketing
     from apex_tpu.optimizers.fused_adam import AdamState
 
     devs = jax.devices()
@@ -765,10 +769,17 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
                        master=pspecs)
     _progress("zero_gpt124: replicated FusedAdam...")
     out["fused_replicated"] = time_mode(fused, fstate, fsspec)
+    # replicated wire: the dp pmean moves every bf16 grad leaf
+    rplan = bucketing.plan_of(params0)
+    out["fused_replicated"]["wire_bytes_per_step"] = sum(
+        b.total * jnp.dtype(b.dtype).itemsize for b in rplan.buckets)
 
     for label, kw in (("zero_fp32_master", {}),
                       ("zero_param_remainders",
-                       {"store_param_remainders": True})):
+                       {"store_param_remainders": True}),
+                      ("zero_int8_sync", {"grad_sync_dtype": "int8"}),
+                      ("zero_fp8_e4m3_sync",
+                       {"grad_sync_dtype": "float8_e4m3fn"})):
         zopt = DistributedFusedAdam(lr=3e-4, weight_decay=0.1,
                                     axis_name="dp", **kw)
         zstate = zopt.init(params0, world_size=dp)
@@ -777,6 +788,16 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
         out[label]["state_bytes_vs_replicated"] = round(
             out[label]["live_bytes_per_device_mb"]
             / out["fused_replicated"]["live_bytes_per_device_mb"], 3)
+        wb = zopt.wire_bytes_per_step()
+        out[label]["wire_bytes_per_step"] = wb["grad_sync"]
+        out[label]["wire_bytes_param_sync"] = wb["param_sync"]
+    # the compressed-sync headline: grad-sync wire bytes vs the
+    # default-wire ZeRO mode (bf16 buckets sync bf16)
+    default_wire = out["zero_fp32_master"]["wire_bytes_per_step"]
+    for label in ("zero_fp32_master", "zero_param_remainders",
+                  "zero_int8_sync", "zero_fp8_e4m3_sync"):
+        out[label]["wire_cut_vs_default"] = round(
+            default_wire / out[label]["wire_bytes_per_step"], 1)
     return out
 
 

@@ -15,6 +15,8 @@ Runs out of the box on the virtual CPU mesh (synthetic data):
     python examples/gpt/pretrain_gpt.py --tp 2 --pp 2 --steps 4
     ... --tp 2 --fp16                  # fp16 + dynamic loss scaling
     ... --tp 2 --zero                  # ZeRO-2 state sharding over dp
+    ... --tp 2 --zero --grad-sync-dtype int8   # quantized grad sync
+    #   (int8/fp8 wire + error-feedback residuals in the sharded state)
     ... --checkpoint /tmp/gpt_ck --steps 4   # then: --resume /tmp/gpt_ck
     ... --checkpoint /tmp/gpt_ck --auto-resume   # preemption-safe: SIGTERM
     #   saves+flushes and exits; rerunning the same line resumes from the
@@ -54,6 +56,14 @@ def parse_args():
                    help="grouped-query attention: kv-head groups (1 = MQA)")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-2: shard optimizer state over dp")
+    p.add_argument("--grad-sync-dtype", default=None,
+                   choices=["int8", "float8_e4m3fn", "float8_e5m2"],
+                   help="quantized ZeRO gradient sync (needs --zero): the "
+                        "per-bucket reduce-scatter carries int8/fp8 "
+                        "payloads with per-block fp32 scales, and the "
+                        "quantization error rides the optimizer state as "
+                        "an error-feedback residual (checkpointed; resume "
+                        "must pass the same flag)")
     p.add_argument("--sequence-parallel", action="store_true")
     p.add_argument("--remat-policy", default="full", choices=["full", "dots"],
                    help="layer remat: 'full' saves only layer inputs, "
@@ -144,9 +154,14 @@ def main():
             }
         return specs
 
+    if args.grad_sync_dtype and not args.zero:
+        raise SystemExit("--grad-sync-dtype needs --zero: the quantized "
+                         "wire's error-feedback residual lives in the "
+                         "ZeRO optimizer's sharded state")
     if args.zero:
         optimizer = DistributedFusedAdam(lr=args.lr, weight_decay=0.01,
-                                         axis_name="dp")
+                                         axis_name="dp",
+                                         grad_sync_dtype=args.grad_sync_dtype)
         # the specs handed to init must include every model axis the
         # params shard over
         zspecs = train_param_specs()

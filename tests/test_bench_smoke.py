@@ -38,3 +38,33 @@ def test_bench_smoke_all_sections_build():
               if not v.get("ok")}
     assert proc.returncode == 0 and not broken, (
         f"bench sections no longer build: {json.dumps(broken, indent=2)}")
+
+
+def test_zero_wire_bytes_accounting_ratios():
+    """The ``zero_gpt124`` section's ``wire_bytes_per_step`` field,
+    validated at the accounting level (pure plan arithmetic, no step
+    compile): the quantized wires cut the grad-sync bytes ~2x vs the
+    bf16 default and ~4x vs an fp32 wire, WITH the fp32 per-block
+    scale vectors counted against them."""
+    import jax.numpy as jnp
+
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    params = {"w": jnp.zeros((512, 256), jnp.bfloat16),
+              "b": jnp.zeros((8192,), jnp.bfloat16)}
+
+    def wire(**kw):
+        opt = DistributedFusedAdam(lr=1e-3, **kw)
+        opt.init(params, world_size=4)
+        return opt.wire_bytes_per_step()
+
+    bf16 = wire()                                  # default: storage dtype
+    i8 = wire(grad_sync_dtype="int8")
+    f8 = wire(grad_sync_dtype=jnp.float8_e5m2)
+    f32 = wire(grad_sync_dtype=jnp.float32)
+    assert i8["grad_scales"] > 0 and bf16["grad_scales"] == 0
+    assert round(bf16["grad_sync"] / i8["grad_sync"], 1) >= 2.0
+    assert round(f32["grad_sync"] / i8["grad_sync"], 1) >= 4.0
+    assert f8["grad_sync"] == i8["grad_sync"]      # both 1-byte wires
+    # param gather is never quantized (no error-feedback channel)
+    assert i8["param_sync"] == bf16["param_sync"]

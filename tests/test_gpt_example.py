@@ -108,6 +108,33 @@ def test_auto_resume_all_torn_fails_loudly(tmp_path):
     assert "torn/corrupt" in _run(args, expect_fail=True)
 
 
+def test_zero_quantized_auto_resume(tmp_path):
+    """--zero --grad-sync-dtype int8: the compressed wire trains end to
+    end, the error-feedback residuals checkpoint with the sharded state
+    (format v3), the same command line resumes — and resuming WITHOUT
+    the flag fails loudly at the residual field instead of silently
+    dropping the carried error."""
+    ck = tmp_path / "ck"
+    args = ["--tp", "2", "--zero", "--grad-sync-dtype", "int8",
+            "--steps", "4", "--save-every", "2",
+            "--checkpoint", str(ck), "--auto-resume"]
+    out = _run(args)
+    assert "resumed" not in out
+    losses = [float(l.split("loss=")[1].split()[0])
+              for l in out.splitlines() if l.startswith("step ")]
+    assert len(losses) == 4 and all(np.isfinite(losses))
+    out2 = _run(["--tp", "2", "--zero", "--grad-sync-dtype", "int8",
+                 "--steps", "2", "--checkpoint", str(ck), "--auto-resume"])
+    assert "resumed at step 4" in out2
+    err = _run(["--tp", "2", "--zero", "--steps", "1",
+                "--checkpoint", str(ck), "--auto-resume"], expect_fail=True)
+    assert "residual" in err
+    # and without --zero the flag itself is refused with the reason
+    err2 = _run(["--tp", "2", "--grad-sync-dtype", "int8", "--steps", "1"],
+                expect_fail=True)
+    assert "--zero" in err2
+
+
 def test_fp16_resume_from_fp32_checkpoint_fails_loudly(tmp_path):
     """Resuming --fp16 from a checkpoint saved without a loss scaler
     (e.g. a dir mixing runs with different precision flags) names the

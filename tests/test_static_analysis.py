@@ -35,6 +35,7 @@ from apex_tpu.analysis.rules_collectives import (
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_precision import (
+    QuantizedSyncStateDtype,
     Fp32ConstantInBf16Path,
     ScratchAccumDtypeMismatch,
     UnclampedTakeAlongAxis,
@@ -890,6 +891,102 @@ class TestScratchAccumDtypeMismatch:
                     preferred_element_type=jnp.float32)
                 return acc
             """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert got == []
+
+
+# ---------------------------------- APX305 quantized-sync state dtypes
+class TestQuantizedSyncStateDtype:
+    """Scale/residual buffers of the compressed grad-sync idiom —
+    scoped to functions that cast to a quantized WIRE dtype, so the
+    repo's many ``loss_scale``-style names stay out of reach."""
+
+    def test_positive_narrow_scales(self, tmp_path):
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def quantized_sync(h, amax_sum):
+                scales = (amax_sum / 127.0).astype(jnp.bfloat16)
+                q = (h / scales).astype(jnp.int8)
+                return jax.lax.psum_scatter(q, "dp", scatter_dimension=0,
+                                            tiled=True)
+            """, tmp_path, [QuantizedSyncStateDtype()])
+        assert rule_ids(got) == ["APX305"]
+        assert "scale" in got[0].message and "float32" in got[0].message
+
+    def test_positive_wire_width_residual_via_lattice(self, tmp_path):
+        """The residual narrowed to the WIRE dtype (through a dtype
+        alias) — the error-feedback information re-rounded away."""
+        got = run("""
+            import jax.numpy as jnp
+
+            wire = jnp.float8_e4m3fn
+
+            def quantize_with_feedback(h, scales):
+                q = (h / scales).astype(wire)
+                residual = (h - q.astype(jnp.float32) * scales).astype(wire)
+                return q, residual
+            """, tmp_path, [QuantizedSyncStateDtype()])
+        assert rule_ids(got) == ["APX305"]
+        assert "residual" in got[0].message
+
+    def test_negative_contract_shapes(self, tmp_path):
+        """fp32 scales + storage-dtype residual (the
+        ``_quantized_sync`` contract itself) are clean."""
+        got = run("""
+            import jax.numpy as jnp
+
+            def quantize_with_feedback(h, scales):
+                scales = scales.astype(jnp.float32)
+                q = (h / scales).astype(jnp.int8)
+                residual = (h - q.astype(jnp.float32) * scales).astype(
+                    jnp.bfloat16)
+                return q, residual
+            """, tmp_path, [QuantizedSyncStateDtype()])
+        assert got == []
+
+    def test_negative_wire_cast_in_nested_def_does_not_mark_outer(
+            self, tmp_path):
+        """The marker is per-function: a nested helper's int8 cast must
+        not put the OUTER function's ``loss_scale``-style names in
+        APX305's reach."""
+        got = run("""
+            import jax.numpy as jnp
+
+            def train_step(grads, scaler_state):
+                new_scale = scaler_state.loss_scale.astype(jnp.bfloat16)
+
+                def _quantize(x):
+                    return x.astype(jnp.int8)
+
+                return _quantize(grads), new_scale
+            """, tmp_path, [QuantizedSyncStateDtype()])
+        assert got == []
+
+    def test_negative_loss_scale_outside_quantized_code(self, tmp_path):
+        """A half-precision ``loss_scale`` in ordinary amp code — no
+        wire cast in the function, so APX305 must stay quiet."""
+        got = run("""
+            import jax.numpy as jnp
+
+            def scale_loss(loss, scaler_state):
+                loss_scale = scaler_state.loss_scale.astype(jnp.float16)
+                return loss * loss_scale
+            """, tmp_path, [QuantizedSyncStateDtype()])
+        assert got == []
+
+    def test_negative_unresolvable_dtype_stays_quiet(self, tmp_path):
+        """A residual cast to a dynamically-chosen dtype (the engine's
+        ``.astype(jnp.dtype(b.dtype))``) is UNKNOWN — no finding."""
+        got = run("""
+            import jax.numpy as jnp
+
+            def quantize(h, scales, storage_dtype):
+                q = (h / scales).astype(jnp.int8)
+                residual = (h - q.astype(jnp.float32) * scales).astype(
+                    storage_dtype)
+                return q, residual
+            """, tmp_path, [QuantizedSyncStateDtype()])
         assert got == []
 
 
