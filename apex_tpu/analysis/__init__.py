@@ -10,6 +10,10 @@ machine-checked invariants):
 - **APX103** donated-buffer reuse: a ``donate_argnums`` argument read
   after the donating call without a rebind (``rules_donation``) — a
   no-op on CPU, garbage or a deleted-array error on TPU.
+- **APX104** non-atomic checkpoint write (``rules_io``): a direct
+  ``open(..., "wb")`` on a checkpoint path bypassing the
+  ``io.native.atomic_output`` tmp+fsync+rename helper — the
+  torn-write class ``io.validate_checkpoint`` exists to detect.
 - **APX201/202** collective-axis consistency against the
   ``parallel_state.py`` mesh registry (``rules_collectives``).
 - **APX203/204** axis-scope dataflow (``dataflow`` + ``rules_collectives``):
@@ -56,6 +60,7 @@ from apex_tpu.analysis.rules_collectives import (
     CollectiveOutsideSpmdContext, UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
+from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_precision import (
     Fp32ConstantInBf16Path, QuantizedSyncStateDtype,
     ScratchAccumDtypeMismatch, UnclampedTakeAlongAxis,
@@ -79,6 +84,7 @@ def default_rules(vmem_budget_bytes=None):
         TraceTimeHostStateRead(),
         ProcessGlobalEnvMutation(),
         DonatedBufferReuse(),
+        NonAtomicCheckpointWrite(),
         UnknownCollectiveAxis(),
         CollectiveOutsideSpmdContext(),
         CollectiveAxisUnboundUnderJit(),

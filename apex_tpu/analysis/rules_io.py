@@ -1,0 +1,97 @@
+"""Checkpoint-I/O hygiene rules (the torn-write class).
+
+A checkpoint file published with a plain ``open(path, "wb")`` is torn
+the moment a preemption, OOM-kill, or dying filesystem interrupts the
+writer: a truncated file sits under the FINAL name, and the resume side
+can only detect it after the fact (``io.validate_checkpoint``) — or
+worse, load garbage.  The tree has exactly one sanctioned publish
+primitive, :func:`apex_tpu.io.native.atomic_output` (write to
+``<path>.tmp``, fsync, rename, dir-fsync), and every checkpoint write
+must route through it or a wrapper of it.
+
+- APX104: a write-mode binary ``open()`` whose path (or enclosing
+  function) is checkpoint-shaped, outside the atomic helper and not
+  staged through a ``.tmp`` name.  Only statically certain cases are
+  flagged: a literal mode string, a builtin-``open`` call (attribute
+  spellings like ``gzip.open`` are other formats' business).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from apex_tpu.analysis.core import Finding, ModuleContext, Rule
+
+__all__ = ["NonAtomicCheckpointWrite"]
+
+#: Path-or-function spellings that mark a write as checkpoint-bound.
+_CKPT_MARKERS = ("ckpt", "checkpoint", "shard_", ".apex")
+
+#: Functions allowed to open checkpoint bytes directly: the designated
+#: atomic helper itself (io/native.py) and explicit wrappers named for
+#: the contract.
+_ATOMIC_FN_PREFIXES = ("atomic_output", "_atomic")
+
+
+def _write_binary_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string if this ``open`` call writes binary
+    (``wb``/``ab``/``xb``/``w+b``...); None otherwise/unknown."""
+    mode_node: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if not (isinstance(mode_node, ast.Constant)
+            and isinstance(mode_node.value, str)):
+        return None
+    mode = mode_node.value
+    if "b" in mode and any(c in mode for c in "wax"):
+        return mode
+    return None
+
+
+class NonAtomicCheckpointWrite(Rule):
+    """APX104: direct binary write to a checkpoint path — bypasses the
+    atomic write/rename helper, so an interrupted writer publishes a
+    torn file under the final name."""
+
+    rule_id = "APX104"
+    severity = "error"
+    fix_hint = ("publish through apex_tpu.io.native.atomic_output (tmp "
+                "+ fsync + rename + dir-fsync) or a wrapper of it "
+                "(io.save_checkpoint); a direct open(path, 'wb') leaves "
+                "a truncated file under the final name when the writer "
+                "dies mid-save — the torn-write class "
+                "io.validate_checkpoint exists to detect after the fact")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if not (isinstance(call.func, ast.Name)
+                    and call.func.id == "open"):
+                continue
+            mode = _write_binary_mode(call)
+            if mode is None or not call.args:
+                continue
+            path_src = (ast.get_source_segment(ctx.source, call.args[0])
+                        or "").lower()
+            qual = ctx.enclosing_qualname(call).lower()
+            fn_name = qual.rsplit(".", 1)[-1]
+            checkpointish = (
+                any(m in path_src for m in _CKPT_MARKERS)
+                or any(m in fn_name for m in _CKPT_MARKERS))
+            if not checkpointish:
+                continue
+            if any(fn_name.startswith(p) for p in _ATOMIC_FN_PREFIXES):
+                continue  # the designated helper / an explicit wrapper
+            if ".tmp" in path_src:
+                continue  # staged write: the rename-publish idiom
+            yield self.finding(
+                ctx, call,
+                f"checkpoint path opened for direct binary write "
+                f"(mode {mode!r}): a writer killed mid-save leaves a "
+                f"TORN file under the final name — publish via "
+                f"io.native.atomic_output instead")

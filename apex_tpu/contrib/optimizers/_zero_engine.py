@@ -360,6 +360,17 @@ class ZeroOptimizerBase:
         flat = P((*axes, self.axis_name)) if axes else P(self.axis_name)
         return tuple(flat for _ in self._require_plan().buckets)
 
+    @property
+    def world_size(self) -> Optional[int]:
+        """The dp world this optimizer's plan/state were built for
+        (None before ``init``).  The elastic controller
+        (:mod:`apex_tpu.resilience.elastic`) compares this against the
+        LIVE world before resharding a checkpoint — a mismatch at
+        restore time means ``init`` ran for the wrong mesh and the
+        bucket plan would disagree with the resharded state at first
+        trace."""
+        return getattr(self, "_world", None)
+
     def _require_plan(self) -> bucketing.BucketPlan:
         plan = getattr(self, "_plan", None)
         if plan is None:

@@ -11,6 +11,7 @@ from apex_tpu.io.checkpoint import (
     load_distributed_checkpoint,
     load_sharded_checkpoint,
     make_global_array_tree,
+    read_index,
     save_checkpoint,
     save_distributed_checkpoint,
     save_sharded_checkpoint,
@@ -32,6 +33,7 @@ __all__ = [
     "make_global_array_tree",
     "latest_checkpoint",
     "latest_distributed_step",
+    "read_index",
     "validate_checkpoint",
     "checkpoint_step",
     "PrefetchIterator",

@@ -17,8 +17,9 @@ the dependency-free fast path the reference's users had with
 import json
 import os
 import struct
+import time
 from pathlib import Path
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -26,6 +27,63 @@ import numpy as np
 from apex_tpu.io import native
 
 _MAGIC = b"APEXTPU1"
+
+#: Bounded retry budget for one checkpoint read/write against transient
+#: filesystem errors (NFS hiccups, GCS fuse EIO, the chaos harness's
+#: injected ``ChaosIOError``).  Deterministic failures repeat
+#: identically, so the budget is small; delays are jittered so a pod's
+#: worth of ranks retrying the same dying fileserver don't re-land in
+#: lockstep.
+_IO_RETRIES = 3
+_IO_BACKOFF_BASE = 0.05
+_IO_BACKOFF_CAP = 2.0
+
+#: OSError subclasses that are DETERMINISTIC, not transient: a missing
+#: file, a permission wall, or a path that is a directory repeats
+#: identically — retrying only adds sleeps and three spurious
+#: "transient" warnings in front of the real error.
+_IO_NO_RETRY = (FileNotFoundError, PermissionError, IsADirectoryError,
+                NotADirectoryError)
+
+
+def _chaos_io(site: str) -> None:
+    """Chaos seam: the fault-injection hook for slow/failing checkpoint
+    I/O (:func:`apex_tpu.resilience.chaos.check_io`).  Sits INSIDE the
+    retried operation so each retry re-consults the armed plan — an
+    injected-failure budget burns down across attempts exactly like a
+    recovering filesystem."""
+    from apex_tpu.resilience.chaos import check_io
+
+    check_io(site)
+
+
+def _with_io_retries(fn, op: str, path, retries=None):
+    """Run one checkpoint I/O operation with bounded, jittered,
+    structured-logged retry-with-backoff on transient ``OSError``s
+    (NFS hiccups, EIO).  Never retried: deterministic OSErrors
+    (``_IO_NO_RETRY`` — a typo'd path repeats identically) and
+    ``ValueError`` (short reads / bad headers — corrupt bytes don't
+    heal).  The final attempt's error propagates unwrapped."""
+    import logging
+    import random
+
+    from apex_tpu.utils.logging import get_logger, log_structured
+
+    n = _IO_RETRIES if retries is None else int(retries)
+    for attempt in range(n + 1):
+        try:
+            return fn()
+        except OSError as e:
+            if isinstance(e, _IO_NO_RETRY) or attempt >= n:
+                raise
+            delay = min(_IO_BACKOFF_CAP, _IO_BACKOFF_BASE * (2 ** attempt))
+            delay *= random.uniform(0.5, 1.5)
+            log_structured(
+                get_logger("apex_tpu.io"), logging.WARNING,
+                "checkpoint.io_retry", op=op, path=str(path),
+                attempt=attempt + 1, retries=n, delay_s=round(delay, 4),
+                error=f"{type(e).__name__}: {e}")
+            time.sleep(delay)
 
 
 def _dtype_str(dt) -> str:
@@ -48,7 +106,12 @@ def _resolve_dtype(s) -> np.dtype:
 
 
 def save_checkpoint(path, tree: Any) -> None:
-    """Serialize a pytree of arrays (+ scalars/None) to ``path``."""
+    """Serialize a pytree of arrays (+ scalars/None) to ``path``.
+
+    The publish is ATOMIC and durable (:func:`apex_tpu.io.native
+    .atomic_output`: tmp + fsync + rename + dir-fsync) and retried with
+    backoff on transient FS errors — a crash mid-save never leaves a
+    truncated file under the final name."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arrays = []
     meta = []
@@ -67,12 +130,17 @@ def save_checkpoint(path, tree: Any) -> None:
     treedef_bytes = pickle.dumps(treedef)
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
-    with open(p, "wb") as f:
-        f.write(_MAGIC)
-        f.write(struct.pack("<QQ", len(header), len(treedef_bytes)))
-        f.write(header)
-        f.write(treedef_bytes)
-        f.write(blob.tobytes())
+
+    def write():
+        _chaos_io("ckpt.write")
+        with native.atomic_output(p) as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<QQ", len(header), len(treedef_bytes)))
+            f.write(header)
+            f.write(treedef_bytes)
+            f.write(blob.tobytes())
+
+    _with_io_retries(write, "write", p)
 
 
 def _read_header(f, path):
@@ -92,12 +160,28 @@ def _read_header(f, path):
 
 
 def load_checkpoint(path) -> Any:
-    """Load a pytree saved by :func:`save_checkpoint` (numpy leaves)."""
-    with open(path, "rb") as f:
-        header, treedef = _read_header(f, path)
-        blob = np.frombuffer(f.read(), np.uint8)
+    """Load a pytree saved by :func:`save_checkpoint` (numpy leaves).
+    Transient FS errors are retried with backoff (the chaos harness's
+    slow/failing-I/O seam rides the same path)."""
+    def read():
+        _chaos_io("ckpt.read")
+        with open(path, "rb") as f:
+            header, treedef = _read_header(f, path)
+            blob = np.frombuffer(f.read(), np.uint8)
+        return header, treedef, blob
+
+    header, treedef, blob = _with_io_retries(read, "read", path)
     shapes = [tuple(m["shape"]) for m in header["leaves"]]
     dtypes = [_resolve_dtype(m["dtype"]) for m in header["leaves"]]
+    need = sum(int(np.prod(s, dtype=np.int64)) * d.itemsize
+               for s, d in zip(shapes, dtypes))
+    if blob.size != need:
+        # the torn-blob check validate_checkpoint does by stat, applied
+        # at load: the native unflatten is an unchecked memcpy and must
+        # never read past (or zero-fill) a truncated buffer silently
+        raise ValueError(
+            f"{path} is torn: header promises a {need}-byte blob, file "
+            f"holds {blob.size} (interrupted write?)")
     leaves = native.unflatten(blob, shapes, dtypes)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
@@ -131,8 +215,12 @@ class _LazyLeaf:
         return np.frombuffer(buf, self.dtype).reshape(self.shape)
 
     def load(self) -> np.ndarray:
-        with open(self.path, "rb") as f:
-            return self.read_from(f)
+        def read():
+            _chaos_io("ckpt.read")
+            with open(self.path, "rb") as f:
+                return self.read_from(f)
+
+        return _with_io_retries(read, "read", self.path)
 
     def __array__(self, dtype=None, copy=None):
         a = self.load()
@@ -145,9 +233,13 @@ def open_checkpoint_lazy(path) -> Any:
     bytes are read on demand via ``np.asarray(leaf)``.  This is how a
     pod-scale restore avoids materializing every rank's full shard file
     on every host (see :func:`load_distributed_checkpoint`)."""
-    with open(path, "rb") as f:
-        header, treedef = _read_header(f, path)
-        base = f.tell()
+    def read():
+        _chaos_io("ckpt.read")
+        with open(path, "rb") as f:
+            h, t = _read_header(f, path)
+            return h, t, f.tell()
+
+    header, treedef, base = _with_io_retries(read, "read", path)
     leaves = []
     off = base
     for m in header["leaves"]:
@@ -273,14 +365,25 @@ def latest_distributed_step(dir_path) -> int:
     """Newest fully-published ``step_*`` directory under ``dir_path`` —
     the pod-scale sibling of :func:`latest_checkpoint`.
 
-    A complete directory holds an ``index.json`` and at least its
-    ``world_size`` many ``shard_*.ckpt`` files (per-step dirs mean an
-    interrupted save can only leave an INCOMPLETE newest dir, never a
-    torn mix of steps).  Returns the step number; returns ``-1`` when
-    no ``step_*`` dirs exist at all (a legitimate fresh start); raises
+    A complete directory holds an ``index.json`` and EVERY one of its
+    ``world_size`` named ``shard_<r>-of-<world>.ckpt`` files (per-step
+    dirs mean an interrupted save can only leave an INCOMPLETE newest
+    dir, never a torn mix of steps).  The check is by exact per-rank
+    NAME, not file count: rank 0 publishes ``index.json`` before the
+    shard data lands, so a crash in that window leaves an indexed dir
+    with missing ranks — and under elastic restarts the same step
+    number can be re-saved at a DIFFERENT world size into one dir,
+    where stale other-world ``shard_*`` files would satisfy a mere
+    count.  Incomplete dirs are skipped with a structured warning.
+    Returns the step number; returns ``-1`` when no ``step_*`` dirs
+    exist at all (a legitimate fresh start); raises
     :class:`AllCheckpointsTornError` when dirs EXIST but none is
     complete — prior progress would be silently discarded by starting
     fresh, so even an auto-resuming caller must fail loudly."""
+    import logging
+
+    from apex_tpu.utils.logging import get_logger, log_structured
+
     d = Path(dir_path)
     dirs = sorted(d.glob("step_*"), reverse=True) if d.is_dir() else []
     for sd in dirs:
@@ -288,13 +391,22 @@ def latest_distributed_step(dir_path) -> int:
         if not idx.exists():
             continue
         try:
+            # the read rides the retry/chaos seam like every shard read
+            # (a transient EIO must not skip the newest COMPLETE dir);
             # int() inside the try: a parseable index.json whose
             # world_size is null/garbage is just as torn as no index
-            world = int(json.loads(idx.read_text())["world_size"])
+            world = int(json.loads(_read_index_text(idx))["world_size"])
         except (OSError, ValueError, KeyError, TypeError):
             continue
-        if len(list(sd.glob("shard_*.ckpt"))) >= world:
+        missing = [r for r in range(world)
+                   if not (sd / _shard_name(r, world)).exists()]
+        if not missing:
             return checkpoint_step(sd)
+        log_structured(
+            get_logger("apex_tpu.io"), logging.WARNING,
+            "checkpoint.incomplete_step_dir_skipped", path=str(sd),
+            world_size=world, missing_ranks=missing[:8],
+            missing=len(missing))
     if dirs:
         raise AllCheckpointsTornError(
             f"no complete checkpoint under {dir_path}: {len(dirs)} "
@@ -304,30 +416,11 @@ def latest_distributed_step(dir_path) -> int:
 
 
 def _atomic_write(path: str, tree: Any) -> None:
-    """tmp + fsync + rename + dir-fsync around :func:`save_checkpoint`:
-    a crash mid-save never leaves a truncated file under the final
-    name, and the published bytes are durable."""
-    tmp = str(path) + ".tmp"
-    try:
-        save_checkpoint(tmp, tree)
-        fd = os.open(tmp, os.O_RDONLY)
-        try:
-            os.fsync(fd)  # data durable before the rename publishes it
-        finally:
-            os.close(fd)
-        os.replace(tmp, path)
-        dfd = os.open(os.path.dirname(str(path)) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dfd)  # the rename itself durable
-        finally:
-            os.close(dfd)
-    except BaseException:
-        try:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    """Alias kept for the async checkpointer and older call sites:
+    :func:`save_checkpoint` itself now publishes atomically + durably
+    through :func:`apex_tpu.io.native.atomic_output` (tmp + fsync +
+    rename + dir-fsync) with bounded retry on transient FS errors."""
+    save_checkpoint(path, tree)
 
 
 # ------------------------------------------------------- sharded checkpoints
@@ -335,51 +428,66 @@ def _shard_name(rank: int, world: int) -> str:
     return f"shard_{rank:05d}-of-{world:05d}.ckpt"
 
 
-def _write_index(dir_path, world_size: int) -> None:
-    """Durably publish the sharded-checkpoint index (tmp + fsync +
-    rename + dir-fsync — a crash or power loss mid-write must not leave
-    a truncated or missing index.json while the shard data survives)."""
+def _read_index_text(path) -> str:
+    """One index.json read through the same retry/chaos seam as the
+    shard reads — the index is as load-bearing as any shard."""
+    def read():
+        _chaos_io("ckpt.read")
+        return Path(path).read_text()
+
+    return _with_io_retries(read, "read", path)
+
+
+def read_index(dir_path) -> dict:
+    """Parse + format-check a sharded checkpoint dir's ``index.json``
+    (world size plus any ``index_extra`` metadata the saver recorded —
+    the elastic controller's saved-world-layout record).  Transient FS
+    errors retry like any shard read."""
+    index = json.loads(_read_index_text(Path(dir_path) / "index.json"))
+    if index.get("format") != "apex_tpu_sharded_v1":
+        raise ValueError(f"{dir_path} is not a sharded apex_tpu checkpoint")
+    return index
+
+
+def _write_index(dir_path, world_size: int, extra: Optional[dict] = None) -> None:
+    """Durably publish the sharded-checkpoint index through
+    :func:`apex_tpu.io.native.atomic_output` (a crash or power loss
+    mid-write must not leave a truncated or missing index.json while
+    the shard data survives), with bounded retry.  ``extra`` merges
+    additional metadata keys into the index (the elastic controller
+    records the saved world layout here); ``format``/``world_size``
+    stay authoritative."""
     d = Path(dir_path)
     d.mkdir(parents=True, exist_ok=True)
-    tmp = d / "index.json.tmp"
-    try:
-        with open(tmp, "w") as f:
-            f.write(
-                json.dumps(
-                    {"format": "apex_tpu_sharded_v1", "world_size": world_size}
-                )
-            )
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, d / "index.json")
-        dfd = os.open(d, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except BaseException:
-        try:
-            if tmp.exists():
-                tmp.unlink()
-        except OSError:
-            pass
-        raise
+    payload = dict(extra or {})
+    payload.update(
+        {"format": "apex_tpu_sharded_v1", "world_size": world_size})
+
+    def write():
+        _chaos_io("ckpt.write")
+        with native.atomic_output(d / "index.json") as f:
+            f.write(json.dumps(payload).encode())
+
+    _with_io_retries(write, "write", d / "index.json")
 
 
-def save_sharded_checkpoint(dir_path, tree: Any, rank: int, world_size: int) -> str:
+def save_sharded_checkpoint(dir_path, tree: Any, rank: int, world_size: int,
+                            index_extra: Optional[dict] = None) -> str:
     """Save this rank's piece of a distributed checkpoint (the per-rank
     protocol of reference ``DistributedFusedAdam.state_dict``, :2527).
 
     ``tree`` is whatever this rank owns — e.g. the dict from
     :meth:`DistributedFusedAdam.sharded_state_dict`, a tp-local param
     shard, or any pytree.  One file per rank, plus an index file written
-    by rank 0 recording the world size.  Reassembly/resharding semantics
-    belong to the consumer (``load_sharded_state_dicts`` for ZeRO).
+    by rank 0 recording the world size (``index_extra`` merges
+    additional metadata into it — see :mod:`apex_tpu.resilience
+    .elastic`).  Reassembly/resharding semantics belong to the consumer
+    (``load_sharded_state_dicts`` for ZeRO).
     """
     d = Path(dir_path)
     d.mkdir(parents=True, exist_ok=True)
     if rank == 0:
-        _write_index(d, world_size)
+        _write_index(d, world_size, extra=index_extra)
     path = d / _shard_name(rank, world_size)
     _atomic_write(str(path), tree)
     return str(path)
@@ -587,9 +695,7 @@ def load_sharded_checkpoint(dir_path, rank=None, lazy: bool = False) -> Any:
     (headers read now, bytes on demand) so callers that need only a
     fraction of each shard never pull whole files into RAM."""
     d = Path(dir_path)
-    index = json.loads((d / "index.json").read_text())
-    if index.get("format") != "apex_tpu_sharded_v1":
-        raise ValueError(f"{dir_path} is not a sharded apex_tpu checkpoint")
+    index = read_index(d)
     world = index["world_size"]
     reader = open_checkpoint_lazy if lazy else load_checkpoint
     if rank is not None:

@@ -828,6 +828,31 @@ def make_train_step(
     if chaos is not None and step_guard is None:
         raise ValueError("chaos NaN injection needs step_guard (the "
                          "injection step counter lives in GuardState)")
+
+    wedge_axis = dp_axis if dp_axis is not None else tp_axis
+
+    def chaos_wedge(loss, guard_step):
+        """Chaos "wedge one rank's collective site": on the planned
+        (rank, step) an ``io_callback`` stalls exactly that rank right
+        before the loss/grad sync, so its PEERS block device-side in
+        the collective waiting for it — the truthful presentation of a
+        wedged all-reduce, which only the host-side step watchdog
+        (:class:`apex_tpu.resilience.StepWatchdog`) can notice.  The
+        callback's token is folded into the loss to order it before
+        the sync; off-plan (rank, step) pairs return immediately."""
+        if chaos is None or not getattr(chaos, "wedges_collective", False):
+            return loss
+        from jax.experimental import io_callback
+
+        def host(s, r):
+            chaos.collective_wedge_callback(s, r)
+            return np.float32(0.0)
+
+        rank = jax.lax.axis_index(wedge_axis)
+        tok = io_callback(host, jax.ShapeDtypeStruct((), jnp.float32),
+                          guard_step, rank)
+        return loss + tok
+
     # the clip's global norm must agree across ranks: sharded leaves'
     # Σx² psum over exactly their spec axes, replicated leaves don't
     clip_reduce = _clip_reduce_for(optimizer, clip_grad_norm, specs)
@@ -861,6 +886,7 @@ def make_train_step(
             return l * fault if fault is not None else l
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss = chaos_wedge(loss, guard_state.step)
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state, new_guard = _apply_guarded_update(
             grads, optimizer, opt_state, params, sync_axes,
@@ -896,6 +922,7 @@ def make_train_step(
 
         scaled_loss, grads = jax.value_and_grad(scaled_loss_fn)(params)
         loss = scaled_loss / scaler_state.loss_scale
+        loss = chaos_wedge(loss, guard_state.step)
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state, new_scaler_state, new_guard = \
             _apply_scaled_update(

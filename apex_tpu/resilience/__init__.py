@@ -15,18 +15,35 @@ first-class pillar of a pre-training stack):
 - :mod:`~apex_tpu.resilience.preemption` — SIGTERM/deadline hook that
   flushes the async checkpoint queue; pairs with
   :func:`apex_tpu.io.latest_checkpoint` torn-file-safe discovery.
+- :mod:`~apex_tpu.resilience.elastic` — cross-world elastic resume
+  (a dp=4 checkpoint reshards for dp=2 through the bucket plan's own
+  pad formula), a step watchdog that drains and exits on wedged
+  collectives, and the run controller composing both.
 - :mod:`~apex_tpu.resilience.chaos` — deterministic fault injection
-  (NaN grads, kernel-launch failures, preemptions, wedges) so all of
-  the above is testable on the virtual 8-device CPU mesh today.
+  (NaN grads, kernel-launch failures, preemptions, wedges, per-rank
+  host kills, slow/failing checkpoint I/O) so all of the above is
+  testable on the virtual 8-device CPU mesh today.
 
 See ``docs/resilience.md`` for the fault model and usage.
 """
 
 from apex_tpu.resilience.chaos import (
+    ChaosHostKilled,
+    ChaosIOError,
     ChaosKernelFailure,
     ChaosMonkey,
     ChaosPlan,
     active_monkey,
+)
+from apex_tpu.resilience.elastic import (
+    EXIT_KILLED,
+    EXIT_WEDGED,
+    ElasticRestore,
+    ElasticRunController,
+    StepWatchdog,
+    restart_backoff,
+    restore_elastic_checkpoint,
+    save_elastic_checkpoint,
 )
 from apex_tpu.resilience.fallback import (
     KernelFallbackRegistry,
@@ -47,17 +64,27 @@ from apex_tpu.resilience.step_guard import (
 
 __all__ = [
     "BadStepBudgetExceeded",
+    "ChaosHostKilled",
+    "ChaosIOError",
     "ChaosKernelFailure",
     "ChaosMonkey",
     "ChaosPlan",
+    "EXIT_KILLED",
+    "EXIT_WEDGED",
+    "ElasticRestore",
+    "ElasticRunController",
     "GuardState",
     "KernelFallbackRegistry",
     "PreemptionHandler",
     "StepGuard",
+    "StepWatchdog",
     "active_monkey",
     "get_registry",
     "load_rng_tracker_state_dict",
     "registry_engaged",
+    "restart_backoff",
+    "restore_elastic_checkpoint",
     "rng_tracker_state_dict",
+    "save_elastic_checkpoint",
     "trip_from_exception",
 ]

@@ -46,8 +46,8 @@ import logging
 from apex_tpu.utils.logging import get_logger, log_structured
 
 __all__ = [
-    "ChaosKernelFailure", "ChaosPlan", "ChaosMonkey", "active_monkey",
-    "check_kernel",
+    "ChaosHostKilled", "ChaosIOError", "ChaosKernelFailure", "ChaosPlan",
+    "ChaosMonkey", "active_monkey", "check_io", "check_kernel",
 ]
 
 _logger = get_logger("apex_tpu.resilience")
@@ -55,6 +55,30 @@ _logger = get_logger("apex_tpu.resilience")
 
 class ChaosKernelFailure(RuntimeError):
     """Injected stand-in for a Mosaic lowering / kernel-launch error."""
+
+
+class ChaosHostKilled(SystemExit):
+    """Injected stand-in for one host of N dying hard (spot reclaim
+    past the grace window, kernel panic): no save, no drain, no exit
+    handler — the pod-scale fault the elastic controller must resume
+    from at a SMALLER world.  A ``SystemExit`` subclass so an unwitting
+    ``except Exception`` recovery path cannot swallow the death; the
+    carried code is :data:`~apex_tpu.resilience.elastic.EXIT_KILLED`."""
+
+    def __init__(self, rank: int, step: int, code: int):
+        super().__init__(code)
+        self.rank = int(rank)
+        self.step = int(step)
+
+    def __str__(self):
+        return (f"injected hard kill of host rank {self.rank} at step "
+                f"{self.step} (exit {self.code})")
+
+
+class ChaosIOError(OSError):
+    """Injected transient filesystem error on a checkpoint I/O site —
+    an ``OSError`` subclass so it takes exactly the retry-with-backoff
+    path real NFS/GCS hiccups take (``io.checkpoint._with_io_retries``)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +90,27 @@ class ChaosPlan:
     count means "every call until the registry trips").
     ``preempt_at_step``: loop step at which a simulated SIGTERM lands.
     ``wedge_seconds``: site name -> seconds to sleep when reached.
+
+    Pod-scale faults (all deterministic, all CPU-testable):
+
+    ``kill_at``: host rank -> loop step at which that host dies HARD
+    (:meth:`ChaosMonkey.maybe_kill` raises :class:`ChaosHostKilled` —
+    no save, no drain; the elastic-resume scenario "preempt one host
+    of N").  Per-rank, so a matrix test can kill host 2 of 4 and
+    resume the survivors at world 3.
+    ``wedge_step_at``: loop step whose dispatch wedges for
+    ``wedge_step_seconds`` (a hung whole-step: dead tunnel, compile
+    hang) — the step-watchdog fault.
+    ``wedge_collective_rank``/``wedge_collective_at_step``: ONE mesh
+    rank sleeps ``wedge_collective_seconds`` INSIDE the compiled step,
+    immediately before the gradient sync — its peers block device-side
+    in the collective waiting for it, which is exactly how a real
+    wedged all-reduce presents (see ``models/gpt.py`` ``chaos=``).
+    ``io_failures``: I/O site name (``"ckpt.write"``/``"ckpt.read"``)
+    -> how many operations raise :class:`ChaosIOError` before the
+    "filesystem" recovers; ``io_delay_seconds``: site -> seconds each
+    operation stalls first (slow disk).  Both ride
+    :func:`check_io` inside ``io.checkpoint``'s retry loop.
     """
 
     nan_grad_steps: FrozenSet[int] = frozenset()
@@ -74,18 +119,43 @@ class ChaosPlan:
     preempt_at_step: Optional[int] = None
     wedge_seconds: Mapping[str, float] = dataclasses.field(
         default_factory=dict)
+    kill_at: Mapping[int, int] = dataclasses.field(default_factory=dict)
+    wedge_step_at: Optional[int] = None
+    wedge_step_seconds: float = 0.0
+    wedge_collective_rank: Optional[int] = None
+    wedge_collective_at_step: Optional[int] = None
+    wedge_collective_seconds: float = 0.0
+    io_failures: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    io_delay_seconds: Mapping[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @staticmethod
     def make(nan_grad_steps: Iterable[int] = (),
              kernel_failures: Optional[Mapping[str, int]] = None,
              preempt_at_step: Optional[int] = None,
-             wedge_seconds: Optional[Mapping[str, float]] = None
+             wedge_seconds: Optional[Mapping[str, float]] = None,
+             kill_at: Optional[Mapping[int, int]] = None,
+             wedge_step_at: Optional[int] = None,
+             wedge_step_seconds: float = 0.0,
+             wedge_collective_rank: Optional[int] = None,
+             wedge_collective_at_step: Optional[int] = None,
+             wedge_collective_seconds: float = 0.0,
+             io_failures: Optional[Mapping[str, int]] = None,
+             io_delay_seconds: Optional[Mapping[str, float]] = None
              ) -> "ChaosPlan":
         return ChaosPlan(
             nan_grad_steps=frozenset(int(s) for s in nan_grad_steps),
             kernel_failures=dict(kernel_failures or {}),
             preempt_at_step=preempt_at_step,
             wedge_seconds=dict(wedge_seconds or {}),
+            kill_at={int(r): int(s) for r, s in (kill_at or {}).items()},
+            wedge_step_at=wedge_step_at,
+            wedge_step_seconds=float(wedge_step_seconds),
+            wedge_collective_rank=wedge_collective_rank,
+            wedge_collective_at_step=wedge_collective_at_step,
+            wedge_collective_seconds=float(wedge_collective_seconds),
+            io_failures=dict(io_failures or {}),
+            io_delay_seconds=dict(io_delay_seconds or {}),
         )
 
 
@@ -96,7 +166,12 @@ class ChaosMonkey:
         self.plan = plan
         self._lock = threading.Lock()
         self._kernel_budget: Dict[str, int] = dict(plan.kernel_failures)
+        self._io_budget: Dict[str, int] = dict(plan.io_failures)
         self.injected: Dict[str, int] = {}  # fault kind -> times fired
+
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
 
     # ------------------------------------------------------- NaN grads
     def grad_fault(self, step):
@@ -154,6 +229,83 @@ class ChaosMonkey:
             time.sleep(secs)
         return secs
 
+    # ------------------------------------------------ pod-scale faults
+    def maybe_kill(self, step, rank: int = 0) -> None:
+        """Deliver the planned HARD death of host ``rank`` at ``step``:
+        raises :class:`ChaosHostKilled` (a ``SystemExit``) with the
+        elastic runtime's documented kill exit code — no save, no
+        drain, mirroring a spot VM vanishing past its grace window.
+        The elastic matrix tests catch it to play the supervisor; the
+        example lets it exit the process."""
+        planned = self.plan.kill_at.get(int(rank))
+        if planned is None or int(step) != int(planned):
+            return
+        self._count(f"kill:{int(rank)}")
+        from apex_tpu.resilience.elastic import EXIT_KILLED
+
+        log_structured(_logger, logging.WARNING, "chaos.host_killed",
+                       rank=int(rank), step=int(step))
+        raise ChaosHostKilled(int(rank), int(step), EXIT_KILLED)
+
+    def maybe_wedge_step(self, step) -> float:
+        """Host-side whole-step wedge: sleep the planned seconds before
+        dispatching ``step`` (a dead tunnel / hung compile presents as
+        the dispatch never returning).  Returns the seconds slept —
+        the step watchdog should fire mid-sleep."""
+        if self.plan.wedge_step_at is None \
+                or int(step) != int(self.plan.wedge_step_at):
+            return 0.0
+        secs = float(self.plan.wedge_step_seconds)
+        if secs > 0.0:
+            self._count("wedge_step")
+            log_structured(_logger, logging.INFO, "chaos.wedge_step",
+                           step=int(step), seconds=secs)
+            time.sleep(secs)
+        return secs
+
+    def collective_wedge_callback(self, step, rank) -> None:
+        """In-step host callback (see ``models/gpt.py``): sleep on
+        exactly the planned (rank, step) so that rank arrives LATE at
+        the next collective while its peers block device-side waiting —
+        the truthful shape of a wedged all-reduce.  ``step``/``rank``
+        arrive as 0-d arrays from ``jax.experimental.io_callback``."""
+        if int(step) != int(self.plan.wedge_collective_at_step) \
+                or int(rank) != int(self.plan.wedge_collective_rank):
+            return
+        secs = float(self.plan.wedge_collective_seconds)
+        self._count("wedge_collective")
+        log_structured(_logger, logging.INFO, "chaos.wedge_collective",
+                       step=int(step), rank=int(rank), seconds=secs)
+        time.sleep(secs)
+
+    @property
+    def wedges_collective(self) -> bool:
+        return (self.plan.wedge_collective_at_step is not None
+                and self.plan.wedge_collective_rank is not None
+                and self.plan.wedge_collective_seconds > 0.0)
+
+    # ------------------------------------------------------ I/O faults
+    def io_fault(self, site: str) -> None:
+        """Checkpoint-I/O seam: stall the planned delay, then raise
+        :class:`ChaosIOError` while the site's failure budget lasts —
+        each retry of ``io.checkpoint._with_io_retries`` burns one
+        budget unit, so a budget smaller than the retry cap means "the
+        filesystem recovers mid-retry" and larger means "stays down"."""
+        delay = float(self.plan.io_delay_seconds.get(site, 0.0))
+        if delay > 0.0:
+            self._count(f"io_delay:{site}")
+            time.sleep(delay)
+        with self._lock:
+            left = self._io_budget.get(site, 0)
+            if left <= 0:
+                return
+            self._io_budget[site] = left - 1
+        self._count(f"io_fail:{site}")
+        log_structured(_logger, logging.INFO, "chaos.io_failure",
+                       site=site, remaining=left - 1)
+        raise ChaosIOError(f"injected transient I/O failure at {site!r} "
+                           f"({left - 1} more planned)")
+
     # ---------------------------------------------------- activation
     @contextlib.contextmanager
     def active(self):
@@ -179,3 +331,11 @@ def check_kernel(name: str) -> None:
     m = _ACTIVE
     if m is not None:
         m.fail_kernel(name)
+
+
+def check_io(site: str) -> None:
+    """Checkpoint-I/O hook (``io.checkpoint`` calls this inside its
+    retry loop): stall/raise the injected fault when armed."""
+    m = _ACTIVE
+    if m is not None:
+        m.io_fault(site)

@@ -65,6 +65,9 @@ class PreemptionHandler:
         self._signals = tuple(signals)
         self._prev = {}
         self._installed = False
+        self._drain_lock = threading.Lock()
+        self._draining = False
+        self._drain_done = threading.Event()
         self._deadline = (
             time.monotonic() + float(deadline_sec)
             if deadline_sec is not None else None)
@@ -134,12 +137,54 @@ class PreemptionHandler:
     def drain(self, checkpointer) -> None:
         """Flush every queued async save to disk (and surface write
         errors) — the step that turns "a save was accepted" into "the
-        bytes are durable" before the grace window closes."""
-        t0 = time.monotonic()
-        checkpointer.wait_until_finished()
-        log_structured(_logger, logging.WARNING, "preemption.drained",
-                       reason=self.reason,
-                       flush_seconds=round(time.monotonic() - t0, 3))
+        bytes are durable" before the grace window closes.
+
+        NOT re-entrant by design, and guarded against it: a second
+        SIGTERM landing mid-drain (schedulers often resend), or the
+        step watchdog firing from its own thread while the loop is
+        already draining, must not re-enter ``wait_until_finished`` —
+        worst case two callers race ``close()``-adjacent state.  A
+        re-entrant call logs ``preemption.drain_reentered`` and then
+        WAITS for the in-flight drain to finish (never flushing twice):
+        returning early instead would let the watchdog report
+        "drained" and ``os._exit`` while the first flush is still
+        writing, losing the final accepted save.  Callers that need a
+        bound on that wait wrap drain in their own timeout (the
+        watchdog's ``_drain_bounded`` helper thread)."""
+        with self._drain_lock:
+            if self._draining:
+                log_structured(_logger, logging.WARNING,
+                               "preemption.drain_reentered",
+                               reason=self.reason)
+                done = self._drain_done
+            else:
+                self._draining = True
+                done = None
+        if done is not None:
+            done.wait()  # the in-flight drain's completion IS this one's
+            err = getattr(done, "error", None)
+            if err is not None:
+                # the flush this caller piggybacked on FAILED: returning
+                # normally would let a watchdog report "drained" and
+                # exit over an unflushed save — surface it here too
+                raise RuntimeError(
+                    f"in-flight drain failed: {type(err).__name__}: {err}"
+                ) from err
+            return
+        try:
+            t0 = time.monotonic()
+            checkpointer.wait_until_finished()
+            log_structured(_logger, logging.WARNING, "preemption.drained",
+                           reason=self.reason,
+                           flush_seconds=round(time.monotonic() - t0, 3))
+        except BaseException as e:
+            self._drain_done.error = e  # visible to piggybacked waiters
+            raise
+        finally:
+            with self._drain_lock:
+                self._draining = False
+                self._drain_done.set()
+                self._drain_done = threading.Event()  # re-arm
 
 
 # ----------------------------------------------------- RNG tracker I/O

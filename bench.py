@@ -801,6 +801,102 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
     return out
 
 
+def bench_elastic_resume(steps=3, dp_from=None, dp_to=1, layers=2,
+                         hidden=64, heads=2, seq=64, batch=4, vocab=512):
+    """Elastic-resume smoke (resilience.elastic): train a tiny GPT with
+    the ZeRO optimizer at ``dp_from``, publish an elastic ``step_*``
+    dir, restore RESHARDED at ``dp_to`` (the shrink scenario: save at
+    dp=2, resume at dp=1), and take one more step.  Asserts the
+    continuation — BITWISE state round-trip at the same world, a banded
+    loss continuation across worlds — so the section is a correctness
+    smoke first and a save/restore wall-time record second (the full
+    scenario matrix rides tests/test_elastic.py).  ``dp_from`` defaults
+    to min(2, visible devices): 2→1 wherever two devices exist, the
+    degenerate 1→1 (bitwise branch) on a single chip."""
+    import shutil
+    import tempfile
+
+    from jax.sharding import Mesh
+
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.models.gpt import (
+        GPTConfig, init_params, make_train_step, param_specs,
+    )
+    from apex_tpu.resilience import (
+        restore_elastic_checkpoint, save_elastic_checkpoint,
+    )
+
+    devs = jax.devices()
+    dp_from = min(2, len(devs)) if dp_from is None else int(dp_from)
+    dp_to = min(int(dp_to), len(devs))
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_attention_heads=heads, max_seq_len=seq,
+                    compute_dtype=jnp.float32)
+    params0 = init_params(cfg, jax.random.PRNGKey(0))
+    specs = param_specs(cfg)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, vocab, size=(steps + 1, batch, seq + 1))
+
+    def make(world):
+        mesh = Mesh(np.array(devs[:world]).reshape(world, 1), ("dp", "tp"))
+        opt = DistributedFusedAdam(lr=1e-3, weight_decay=0.01,
+                                   axis_name="dp")
+        state = opt.init(params0, world_size=world, param_specs=specs,
+                         axis_sizes={"tp": 1})
+        return opt, state, make_train_step(cfg, opt, mesh)
+
+    _progress(f"elastic_resume: dp={dp_from} -> dp={dp_to}...")
+    opt_a, state, step_a = make(dp_from)
+    params, losses = params0, []
+    for i in range(steps):
+        params, state, loss = step_a(
+            params, state, jnp.asarray(data[i, :, :-1]),
+            jnp.asarray(data[i, :, 1:]))
+        losses.append(float(loss))  # float() is itself a sync barrier
+
+    tmp = tempfile.mkdtemp(prefix="apex_tpu_elastic_bench_")
+    try:
+        t0 = time.perf_counter()
+        save_elastic_checkpoint(tmp, steps, params=params, opt_state=state,
+                                optimizer=opt_a, world_size=dp_from,
+                                mesh_axes={"tp": 1})
+        save_s = time.perf_counter() - t0
+        opt_b, _, step_b = make(dp_to)
+        t0 = time.perf_counter()
+        r = restore_elastic_checkpoint(tmp, optimizer=opt_b,
+                                       world_size=dp_to,
+                                       mesh_axes={"tp": 1})
+        restore_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    assert r is not None and r.step == steps
+    # params are dp-replicated: bitwise round-trip at ANY world
+    for a, b in zip(jax.tree.leaves(r.params), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    if dp_to == dp_from:
+        for a, b in zip(jax.tree.leaves(r.opt_state),
+                        jax.tree.leaves(state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        continuation = "bitwise"
+    else:
+        continuation = "banded"
+    _, _, loss2 = step_b(r.params, r.opt_state,
+                         jnp.asarray(data[steps, :, :-1]),
+                         jnp.asarray(data[steps, :, 1:]))
+    l2 = float(loss2)
+    # banded continuation: a reshard bug (scrambled shards, dropped
+    # masters) snaps the loss back toward ln(vocab) instantly; a
+    # correct resume stays within a few percent of the trajectory
+    band = abs(l2 - losses[-1]) / max(abs(losses[-1]), 1e-6)
+    assert np.isfinite(l2) and band < 0.10, \
+        f"resumed loss {l2} vs pre-save {losses[-1]} ({band:.3f} rel)"
+    return {"dp_from": dp_from, "dp_to": dp_to,
+            "resharded": dp_to != dp_from, "continuation": continuation,
+            "loss_pre": round(losses[-1], 4), "loss_resumed": round(l2, 4),
+            "band_rel": round(band, 4), "save_ms": round(save_s * 1e3, 1),
+            "restore_ms": round(restore_s * 1e3, 1)}
+
+
 def _progress(msg):
     import sys
     import time as _t
@@ -1025,7 +1121,7 @@ def _smoke_params(seed=0):
     }
 
 
-def _smoke_main() -> int:
+def _smoke_main(only=None) -> int:
     """``--smoke``: trace + compile + single-execute a SMALL config of
     every bench section on the host platform (CPU in tier-1).  No
     timing — the output is a does-each-section-still-build map, so
@@ -1064,7 +1160,20 @@ def _smoke_main() -> int:
         "zero_gpt124": lambda: bench_zero_gpt124(
             iters=1, dp=1, layers=2, hidden=64, heads=2, seq=64,
             batch_per_rank=2, vocab=512),
+        # dp_from=min(2, devices): the reshard (2->1) path wherever the
+        # host platform exposes 2 devices, the bitwise 1->1 branch
+        # otherwise (tests/test_bench_smoke.py runs this section alone
+        # under a 2-device XLA_FLAGS to pin the reshard branch)
+        "elastic_resume": lambda: bench_elastic_resume(),
     }
+    if only:
+        unknown = set(only) - set(sections)
+        if unknown:
+            print(json.dumps({"smoke": False,
+                              "error": f"unknown --smoke-only sections "
+                                       f"{sorted(unknown)}"}), flush=True)
+            return 1
+        sections = {k: v for k, v in sections.items() if k in only}
     report, failures = {}, []
     for name, fn in sections.items():
         t0 = time.perf_counter()
@@ -1251,18 +1360,24 @@ def main():
         help="trace+compile+single-run a small config of EVERY section "
              "on the host platform, no timing — the tier-1 bitrot check "
              "(exits nonzero listing broken sections)")
+    ap.add_argument(
+        "--smoke-only", default=None,
+        help="with --smoke: comma-separated smoke section names to run "
+             "alone (tests/test_bench_smoke.py isolates elastic_resume "
+             "under a 2-device host platform this way)")
     cli = ap.parse_args()
     global _RESNET_VARIANT
     _RESNET_VARIANT = cli.resnet_variant
     if cli.smoke:
-        raise SystemExit(_smoke_main())
+        raise SystemExit(_smoke_main(
+            only=set(cli.smoke_only.split(",")) if cli.smoke_only else None))
     if cli.child_section:
         _child_section_main(cli.child_section)
         return
     known = {"matmul_roofline", "fused_adam", "fused_ln", "gpt124_s1024",
              "gpt124_s4096", "gpt345_s1024", "gpt124_s1024_fce",
              "resnet50_b64", "bert_base_lamb", "flash_attn",
-             "zero2_vs_fused", "zero_gpt124"}
+             "zero2_vs_fused", "zero_gpt124", "elastic_resume"}
     only = set(cli.only.split(",")) if cli.only else None
     if only is not None and not only <= known:
         # a typo'd section name must fail loudly BEFORE the multi-minute
@@ -1365,6 +1480,11 @@ def main():
     # the same headroom class as the gpt sections
     zero_gpt = (_try("zero_gpt124", bench_zero_gpt124, section_budget=900.0)
                 if want("zero_gpt124") else skipped)
+    # correctness smoke at bench scale: ZeRO elastic save -> reshard ->
+    # resume continuation (tiny model; one spare compile budget)
+    elastic = (_try("elastic_resume", bench_elastic_resume,
+                    section_budget=300.0)
+               if want("elastic_resume") else skipped)
 
     headline = adam.get("speedup_vs_eager") if isinstance(adam, dict) else None
     if headline is None and only is not None and "fused_adam" not in only:
@@ -1389,6 +1509,7 @@ def main():
         "flash_attn": flash,
         "zero2_vs_fused": zero2,
         "zero_gpt124": zero_gpt,
+        "elastic_resume": elastic,
     }
     if not _DEVICE_WEDGED:
         try:

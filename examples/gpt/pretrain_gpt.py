@@ -21,6 +21,13 @@ Runs out of the box on the virtual CPU mesh (synthetic data):
     ... --checkpoint /tmp/gpt_ck --auto-resume   # preemption-safe: SIGTERM
     #   saves+flushes and exits; rerunning the same line resumes from the
     #   newest valid checkpoint (torn files skipped) — apex_tpu.resilience
+    ... --tp 2 --zero --checkpoint /tmp/gpt_ck --auto-resume   # ELASTIC:
+    #   --zero checkpoints are per-dp-rank step_* dirs; the same command
+    #   at a DIFFERENT device count (dp=4 -> dp=2) reshards the full
+    #   sharded state on resume (resilience.elastic)
+    ... --watchdog-secs 60   # wedged-step watchdog: drain + exit 75
+    #   (EX_TEMPFAIL) for supervisor restart-with-backoff
+    ... --chaos-kill-at-step 3   # pod chaos: die hard (exit 137, no save)
 """
 
 import argparse
@@ -84,6 +91,25 @@ def parse_args():
                    choices=["uint16", "int32"],
                    help="token id dtype of --data")
     p.add_argument("--resume", default=None, help="checkpoint dir to resume")
+    p.add_argument("--watchdog-secs", type=float, default=None,
+                   help="step watchdog: a step exceeding this many "
+                        "seconds (wedged collective, hung compile) is "
+                        "declared dead — the async checkpoint queue is "
+                        "drained and the process exits with the distinct "
+                        "code 75 (EX_TEMPFAIL) so a supervisor restarts "
+                        "with backoff; the run then resumes elastically")
+    p.add_argument("--watchdog-compile-grace", type=float, default=600.0,
+                   help="the FIRST step's watchdog allowance (jit "
+                        "compile makes it legitimately slow)")
+    p.add_argument("--chaos-kill-at-step", type=int, default=None,
+                   help="chaos: die HARD (exit 137, no save, no drain) "
+                        "at this loop step — the kill-one-host fault; "
+                        "rerunning the same command resumes elastically")
+    p.add_argument("--chaos-wedge-step", type=int, default=None,
+                   help="chaos: wedge this loop step's dispatch for "
+                        "--chaos-wedge-secs (pair with --watchdog-secs "
+                        "to demonstrate the drain-and-exit path)")
+    p.add_argument("--chaos-wedge-secs", type=float, default=120.0)
     p.add_argument("--auto-resume", action="store_true",
                    help="preemption-safe mode (needs --checkpoint): resume "
                         "from the newest VALID checkpoint in the dir if one "
@@ -158,6 +184,10 @@ def main():
         raise SystemExit("--grad-sync-dtype needs --zero: the quantized "
                          "wire's error-feedback residual lives in the "
                          "ZeRO optimizer's sharded state")
+    # the model layout an elastic checkpoint must match (only dp is
+    # elastic: tp/pp reshape is a state-layout change)
+    mesh_meta = {"tp": args.tp, "pp": args.pp}
+
     if args.zero:
         optimizer = DistributedFusedAdam(lr=args.lr, weight_decay=0.01,
                                          axis_name="dp",
@@ -241,6 +271,17 @@ def main():
         return {"params": pspecs, "state": sspec, "step": P(),
                 "scaler": scaler_spec}
 
+    ckpt = io.AsyncCheckpointer() if args.checkpoint else None
+    # ONE run controller for every mode: it owns the per-step protocol
+    # (watchdog heartbeat + chaos delivery — wired further down once
+    # those are built) and, for --zero single-process runs, the elastic
+    # checkpointing (save + bounded-disk prune, restore-or-fresh with
+    # cross-world resharding).  Multiproc keeps the per-process
+    # distributed save path below.
+    run_ctl = resilience.ElasticRunController(
+        args.checkpoint, optimizer, world_size=dp, mesh_axes=mesh_meta,
+        checkpointer=ckpt, keep=args.keep)
+
     # --resume points at a dir and fails loudly if nothing valid is
     # there; --auto-resume resumes from --checkpoint when it holds a
     # valid checkpoint and silently starts fresh otherwise (first
@@ -286,6 +327,39 @@ def main():
                     Path(resume_dir) / f"step_{chosen:08d}",
                     ckpt_tree(params, state, 0, scaler_state),
                     mesh=mesh, spec_tree=ckpt_specs())
+        elif args.zero:
+            # ELASTIC resume (apex_tpu.resilience.elastic): --zero runs
+            # checkpoint as per-dp-rank step_* dirs whose index.json
+            # records the saved world layout.  A dp=4 checkpoint resumes
+            # at dp=2 (or dp=8) in this same command line: the sharded
+            # optimizer state — m/v, masters/remainders, error-feedback
+            # residuals — reshards through the bucket plan's one
+            # padded_total formula; params/scaler ride rank 0's shard.
+            # AllCheckpointsTornError (dirs exist, none complete) stays
+            # loud even under --auto-resume.
+            restored = resilience.restore_elastic_checkpoint(
+                resume_dir, optimizer=optimizer, world_size=dp,
+                mesh_axes=mesh_meta)
+            if restored is None and args.resume:
+                raise FileNotFoundError(
+                    f"no elastic checkpoint under {resume_dir}")
+            if restored is not None:
+                params = restored.params
+                state = restored.opt_state
+                start_step = restored.step
+                if scaler is not None:
+                    if restored.scaler is None:
+                        raise ValueError(
+                            f"checkpoint in {resume_dir} has no "
+                            "loss-scaler state (saved by a run without "
+                            "--fp16); resume without --fp16 or point at "
+                            "a matching run's dir")
+                    scaler_state = scaler.load_state_dict(restored.scaler)
+                msg = f"resumed at step {start_step}"
+                if restored.resharded:
+                    msg += (f" (elastic reshard: dp={restored.saved_world}"
+                            f" -> dp={dp})")
+                print(msg, flush=True)
         else:
             # torn-file-safe discovery: a file the preempted writer was
             # killed inside (bad header, short blob) is skipped with a
@@ -300,6 +374,14 @@ def main():
             except FileNotFoundError:
                 if args.resume:
                     raise  # explicit --resume with nothing valid: loud
+                if any(Path(resume_dir).glob("step_*/index.json")):
+                    # the dir holds ELASTIC step dirs (a --zero run's
+                    # layout): silently starting fresh would discard
+                    # that progress — name the flag mismatch instead
+                    raise ValueError(
+                        f"{resume_dir} holds elastic step_* checkpoints "
+                        "(saved by a --zero run); resume with --zero or "
+                        "point at a matching run's dir")
                 path = None  # --auto-resume first launch: fresh start
             if path is not None:
                 ck = io.load_checkpoint(path)
@@ -323,7 +405,6 @@ def main():
             scaler_state = scaler.load_state_dict(ck["scaler"])
         print(f"resumed at step {start_step}")
 
-    ckpt = io.AsyncCheckpointer() if args.checkpoint else None
     mb_size = args.global_batch  # sampler yields global batches here
 
     def epoch_cycling_batches(consumed):
@@ -367,6 +448,30 @@ def main():
     pre = resilience.PreemptionHandler().install() if args.auto_resume \
         else None
 
+    # chaos faults armed from the CLI (the one-command reproduction of
+    # the pod-scale scenarios: kill-one-host, wedged step)
+    chaos_monkey = None
+    if args.chaos_kill_at_step is not None or args.chaos_wedge_step is not None:
+        chaos_monkey = resilience.ChaosMonkey(resilience.ChaosPlan.make(
+            kill_at=({0: args.chaos_kill_at_step}
+                     if args.chaos_kill_at_step is not None else None),
+            wedge_step_at=args.chaos_wedge_step,
+            wedge_step_seconds=args.chaos_wedge_secs,
+        ))
+
+    # step watchdog: a wedged step (hung collective, dead tunnel) gets
+    # one structured log, a bounded drain of the async queue, and the
+    # distinct exit 75 so a supervisor restarts with backoff
+    watchdog = None
+    if args.watchdog_secs is not None:
+        watchdog = resilience.StepWatchdog(
+            args.watchdog_secs, checkpointer=ckpt, preemption=pre,
+            first_deadline_sec=args.watchdog_compile_grace)
+        watchdog.start()
+    # the controller's on_step drives both from here on
+    run_ctl.watchdog = watchdog
+    run_ctl.chaos = chaos_monkey
+
     def preempt_agreed():
         """Every process must take the same break-or-continue decision:
         one host seeing SIGTERM while another enters the next step would
@@ -401,6 +506,14 @@ def main():
                 old = sorted(Path(args.checkpoint).glob("step_*"))
                 for d in old[:-max(args.keep, 3)]:
                     shutil.rmtree(d, ignore_errors=True)
+        elif args.zero:
+            # elastic per-dp-rank step dir via the run controller:
+            # index first (an interrupted save leaves an incomplete dir
+            # the resume side skips as torn), shard snapshots on the
+            # async queue, bounded disk via the controller's prune;
+            # resume at a DIFFERENT dp reshards
+            run_ctl.save(step_no, tree["params"], tree["state"],
+                         scaler_state=tree["scaler"])
         else:
             # step-named files (atomic publish) so a preempted restart
             # picks the newest VALID one; same bounded-disk pruning
@@ -469,6 +582,11 @@ def main():
     done = 0
     for i in range(start_step, start_step + args.steps):
         done = i - start_step + 1
+        # heartbeat + chaos delivery (wedge: the watchdog fires
+        # mid-sleep; kill: hard exit 137, no drain); the first
+        # iteration's allowance covers the jit compile
+        run_ctl.on_step(i, deadline=(args.watchdog_compile_grace
+                                     if i == start_step else None))
         batch = next(prefetch)
         tokens = jnp.asarray(batch[:, :-1])
         targets = jnp.asarray(batch[:, 1:])
@@ -492,6 +610,9 @@ def main():
                   f"step {i}; rerun the same command to resume",
                   flush=True)
             break
+    if watchdog is not None:
+        watchdog.stop()  # the loop is done; the queue flush below may
+        # legitimately outlast a step deadline
     if ckpt:
         ckpt.close()
         print(f"checkpoint: {args.checkpoint}")

@@ -152,7 +152,11 @@ def main():
             "amp": amp_state.state_dict(scaler_state) if amp_state.scaler else None,
         }
         Path(args.checkpoint).parent.mkdir(parents=True, exist_ok=True)
-        with open(args.checkpoint, "wb") as f:
+        # atomic publish (APX104): a run killed mid-save must not leave
+        # a torn pickle under the final name
+        from apex_tpu.io import native
+
+        with native.atomic_output(args.checkpoint) as f:
             pickle.dump(ck, f)
         print(f"checkpoint saved to {args.checkpoint}")
 

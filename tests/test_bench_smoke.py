@@ -40,6 +40,36 @@ def test_bench_smoke_all_sections_build():
         f"bench sections no longer build: {json.dumps(broken, indent=2)}")
 
 
+def test_elastic_resume_smoke_resharded():
+    """The ``elastic_resume`` bench section under a TWO-device host
+    platform, isolated via ``--smoke-only``: save at dp=2, restore
+    resharded at dp=1 — the section itself asserts the banded loss
+    continuation (and the bitwise branch at equal worlds), so ``ok``
+    means the reshard path held."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--smoke", "--smoke-only", "elastic_resume"],
+        capture_output=True, text=True, timeout=400, env=env,
+    )
+    report = None
+    for line in reversed((proc.stdout or "").splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "smoke" in rec:
+            report = rec
+            break
+    assert report is not None, (
+        f"no smoke JSON; rc={proc.returncode}\n"
+        f"stderr tail: {(proc.stderr or '')[-2000:]}")
+    assert proc.returncode == 0 and \
+        report["sections"]["elastic_resume"].get("ok"), report
+    assert list(report["sections"]) == ["elastic_resume"]
+
+
 def test_zero_wire_bytes_accounting_ratios():
     """The ``zero_gpt124`` section's ``wire_bytes_per_step`` field,
     validated at the accounting level (pure plan arithmetic, no step

@@ -135,6 +135,85 @@ def test_zero_quantized_auto_resume(tmp_path):
     assert "--zero" in err2
 
 
+def _devs(n):
+    return {"XLA_FLAGS": f"--xla_force_host_platform_device_count={n}"}
+
+
+def test_elastic_zero_resume_across_world_sizes(tmp_path):
+    """The one-command elastic contract: `--zero --auto-resume` saved at
+    dp=4 resumes at dp=2 (shrink) and then back at dp=4 (grow), the
+    full sharded state resharding through the bucket plan's pad
+    formula; losses stay finite and the step counter continues."""
+    ck = tmp_path / "ck"
+    base = ["--tp", "2", "--zero", "--save-every", "2",
+            "--checkpoint", str(ck), "--auto-resume"]
+    out = _run([*base, "--steps", "4"], extra_env=_devs(8))   # dp=4
+    assert "resumed" not in out
+    assert (ck / "step_00000004" / "index.json").exists()
+    out2 = _run([*base, "--steps", "2"], extra_env=_devs(4))  # dp=2
+    assert "resumed at step 4 (elastic reshard: dp=4 -> dp=2)" in out2
+    assert "step 5:" in out2
+    out3 = _run([*base, "--steps", "2"], extra_env=_devs(8))  # dp=4 again
+    assert "resumed at step 6 (elastic reshard: dp=2 -> dp=4)" in out3
+    losses = [float(l.split("loss=")[1].split()[0])
+              for l in out3.splitlines() if l.startswith("step ")]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+    # and a zero checkpoint refuses to silently restart when --zero is
+    # dropped from the resume command
+    err = _run(["--tp", "2", "--steps", "1", "--checkpoint", str(ck),
+                "--auto-resume"], extra_env=_devs(8), expect_fail=True)
+    assert "--zero" in err
+
+
+def test_chaos_kill_one_host_then_elastic_resume(tmp_path):
+    """Pod chaos at process level: the run dies HARD at step 3 (exit
+    137 — no save, no drain), then the same command at a smaller world
+    resumes elastically from the last COMPLETE step dir."""
+    import subprocess as sp
+
+    ck = tmp_path / "ck"
+    r = sp.run(
+        [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
+         "--tp", "2", "--zero", "--steps", "6", "--save-every", "2",
+         "--checkpoint", str(ck), "--auto-resume",
+         "--chaos-kill-at-step", "3"],
+        capture_output=True, text=True, timeout=600, env=_env(_devs(8)),
+    )
+    assert r.returncode == 137, f"rc={r.returncode}\n{r.stderr[-1500:]}"
+    assert "chaos.host_killed" in r.stderr
+    out = _run(["--tp", "2", "--zero", "--steps", "2", "--save-every", "2",
+                "--checkpoint", str(ck), "--auto-resume"],
+               extra_env=_devs(4))
+    assert "resumed at step 2 (elastic reshard: dp=4 -> dp=2)" in out
+    assert "step 3:" in out
+
+
+def test_watchdog_drains_and_exits_75_on_wedged_step(tmp_path):
+    """Wedged-step watchdog at process level: step 2's dispatch hangs
+    (chaos), the watchdog logs, drains the async queue, and exits with
+    the documented 75 — leaving the accepted saves durable so the same
+    command resumes."""
+    import subprocess as sp
+
+    ck = tmp_path / "ck"
+    r = sp.run(
+        [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
+         "--tp", "2", "--zero", "--steps", "6", "--save-every", "2",
+         "--checkpoint", str(ck), "--auto-resume",
+         "--watchdog-secs", "3", "--chaos-wedge-step", "3",
+         "--chaos-wedge-secs", "300"],
+        capture_output=True, text=True, timeout=600, env=_env(_devs(4)),
+    )
+    assert r.returncode == 75, f"rc={r.returncode}\n{r.stderr[-1500:]}"
+    assert "watchdog.step_wedged" in r.stderr
+    assert '"drain": "drained"' in r.stderr
+    assert (ck / "step_00000002" / "index.json").exists()
+    out = _run(["--tp", "2", "--zero", "--steps", "1",
+                "--checkpoint", str(ck), "--auto-resume"],
+               extra_env=_devs(4))
+    assert "resumed at step 2" in out
+
+
 def test_fp16_resume_from_fp32_checkpoint_fails_loudly(tmp_path):
     """Resuming --fp16 from a checkpoint saved without a loss scaler
     (e.g. a dir mixing runs with different precision flags) names the
@@ -196,6 +275,68 @@ def test_sigterm_preempts_saves_and_resumes(tmp_path):
     assert proc.returncode == 0, err[-2000:]
     assert "preempted (signal SIGTERM)" in out
     assert list(ck.glob("step_*.ckpt")), "no durable checkpoint"
+    out2 = _run(["--tp", "2", "--steps", "1", "--checkpoint", str(ck),
+                 "--auto-resume"])
+    assert "resumed at step" in out2
+
+
+def test_second_sigterm_during_drain_still_exits_clean(tmp_path):
+    """SIGTERM arriving DURING the save+drain window (schedulers resend
+    the reclaim notice): the handler only sets the flag — drain is
+    re-entrancy-guarded — so the process still exits 0 with a VALID
+    (non-torn) newest checkpoint and the same command resumes."""
+    import select
+    import signal
+    import time
+
+    ck = tmp_path / "ck"
+    args = ["--tp", "2", "--steps", "200", "--checkpoint", str(ck),
+            "--auto-resume", "--save-every", "1000"]
+    err_path = tmp_path / "stderr.log"
+    with open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
+             *args],
+            stdout=subprocess.PIPE, stderr=err_f, text=True, env=_env(),
+        )
+        try:
+            deadline = time.monotonic() + 300
+            saw_step = False
+            lines = []
+            while time.monotonic() < deadline:
+                ready, _, _ = select.select(
+                    [proc.stdout], [], [],
+                    max(0.0, deadline - time.monotonic()))
+                if not ready:
+                    break
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                lines.append(line)
+                if line.startswith("step 1:"):
+                    # the reclaim notice, then an immediate resend: it
+                    # lands while the loop is still stepping/saving/
+                    # draining (any later and it can hit interpreter
+                    # teardown, where restored default handlers would
+                    # kill the child -15 — the exact-mid-drain timing
+                    # is pinned by the in-process unit test)
+                    proc.send_signal(signal.SIGTERM)
+                    proc.send_signal(signal.SIGTERM)
+                    saw_step = True
+                    break
+            if not saw_step:
+                pytest.fail("never saw step 1:\n" + "".join(lines))
+            out, _ = proc.communicate(timeout=120)
+        finally:
+            proc.kill()
+    err = err_path.read_text()
+    assert proc.returncode == 0, err[-2000:]
+    assert out.count("preempted (") == 1
+    from apex_tpu.io import latest_checkpoint, validate_checkpoint
+
+    newest = latest_checkpoint(ck)  # torn files would be skipped: require
+    validate_checkpoint(newest)     # the NEWEST to be the valid one
+    assert sorted(ck.glob("step_*.ckpt"))[-1] == Path(newest)
     out2 = _run(["--tp", "2", "--steps", "1", "--checkpoint", str(ck),
                  "--auto-resume"])
     assert "resumed at step" in out2

@@ -235,11 +235,13 @@ class TestDistributedStepDiscovery:
     def _publish(self, d, step, world=2, shards=None):
         import json
 
+        from apex_tpu.io.checkpoint import _shard_name
+
         sd = d / f"step_{step:08d}"
         sd.mkdir(parents=True)
         (sd / "index.json").write_text(json.dumps({"world_size": world}))
         for i in range(world if shards is None else shards):
-            (sd / f"shard_{i}.ckpt").write_bytes(b"x")
+            (sd / _shard_name(i, world)).write_bytes(b"x")
         return sd
 
     def test_newest_complete_dir_wins(self, tmp_path):
@@ -265,6 +267,36 @@ class TestDistributedStepDiscovery:
         (sd / "index.json").write_text("{garbage")  # unparseable index
         with pytest.raises(AllCheckpointsTornError,
                            match="none is fully published"):
+            latest_distributed_step(tmp_path)
+
+    def test_indexed_dir_with_deleted_shard_skipped(self, tmp_path):
+        """The crash-between-index-and-shards window (rank 0 publishes
+        index.json FIRST): an indexed dir missing any rank's shard is
+        torn and must be skipped, not resumed with missing ranks."""
+        from apex_tpu.io import latest_distributed_step
+        from apex_tpu.io.checkpoint import _shard_name
+
+        self._publish(tmp_path, 4)
+        sd = self._publish(tmp_path, 8)
+        (sd / _shard_name(1, 2)).unlink()       # rank 1's shard gone
+        assert latest_distributed_step(tmp_path) == 4
+
+    def test_stale_other_world_shards_do_not_fake_completeness(
+            self, tmp_path):
+        """Elastic restarts can re-save one step number at a DIFFERENT
+        world size into the same dir: stale shard files from the old
+        world must not satisfy the new index by mere COUNT — every
+        rank's exactly-named shard is required."""
+        from apex_tpu.io import (AllCheckpointsTornError,
+                                 latest_distributed_step)
+        from apex_tpu.io.checkpoint import _shard_name
+
+        sd = self._publish(tmp_path, 8, world=2, shards=1)  # rank 1 missing
+        # leftovers of an interrupted dp=4 save of the same step: three
+        # more shard files — five total, >= world_size 2
+        for r in range(3):
+            (sd / _shard_name(r, 4)).write_bytes(b"stale")
+        with pytest.raises(AllCheckpointsTornError):
             latest_distributed_step(tmp_path)
 
 
@@ -322,9 +354,9 @@ class TestShardedCheckpoint:
         d = tmp_path / "ck"
 
         def boom(path, tree):
-            with open(path, "wb") as f:
+            with native.atomic_output(path) as f:
                 f.write(b"partial")  # bytes hit the tmp file...
-            raise OSError("disk died mid-write")
+                raise OSError("disk died mid-write")
 
         monkeypatch.setattr(ck, "save_checkpoint", boom)
         with pytest.raises(OSError, match="disk died"):
@@ -549,3 +581,174 @@ class TestAsyncCheckpointer:
             for piece in view_payload["['x']"] for rv in raw_views
         ]
         assert any(aliases)
+
+
+class TestAtomicOutput:
+    """io.native.atomic_output — THE publish primitive (APX104's
+    designated helper): clean exits land durable bytes under the final
+    name, failures leave nothing at all."""
+
+    def test_publishes_on_clean_exit(self, tmp_path):
+        p = tmp_path / "blob.ckpt"
+        with native.atomic_output(p) as f:
+            f.write(b"hello")
+        assert p.read_bytes() == b"hello"
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_failure_publishes_nothing(self, tmp_path):
+        p = tmp_path / "blob.ckpt"
+        with pytest.raises(RuntimeError):
+            with native.atomic_output(p) as f:
+                f.write(b"parti")
+                raise RuntimeError("writer died")
+        assert not p.exists()
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_overwrite_is_all_or_nothing(self, tmp_path):
+        """A failed re-save must leave the PREVIOUS published bytes
+        intact — the whole point of staging through .tmp."""
+        p = tmp_path / "blob.ckpt"
+        with native.atomic_output(p) as f:
+            f.write(b"v1")
+        with pytest.raises(RuntimeError):
+            with native.atomic_output(p) as f:
+                f.write(b"v2-partial")
+                raise RuntimeError("boom")
+        assert p.read_bytes() == b"v1"
+
+    def test_save_checkpoint_is_atomic_by_itself(self, tmp_path,
+                                                 monkeypatch):
+        """save_checkpoint routes through atomic_output: a mid-write
+        crash (simulated at the native flatten seam) publishes nothing
+        and leaves no .tmp."""
+        from apex_tpu.io import checkpoint as ck
+
+        def boom(arrays, threads=native.DEFAULT_THREADS):
+            raise RuntimeError("flatten died")
+
+        monkeypatch.setattr(ck.native, "flatten", boom)
+        with pytest.raises(RuntimeError, match="flatten died"):
+            save_checkpoint(tmp_path / "x.ckpt", {"a": np.ones(4)})
+        assert not (tmp_path / "x.ckpt").exists()
+        assert not list(tmp_path.glob("*.tmp"))
+
+
+class TestCheckpointIORetry:
+    """Bounded retry-with-backoff around shard read/write — tested
+    through the chaos slow/failing-I/O seam (ChaosPlan.io_failures
+    rides io.checkpoint._with_io_retries)."""
+
+    TREE = {"w": np.arange(6.0), "n": np.int64(2)}
+
+    def _monkey(self, **kw):
+        from apex_tpu.resilience import ChaosMonkey, ChaosPlan
+
+        return ChaosMonkey(ChaosPlan.make(**kw))
+
+    def test_transient_write_failures_retried_to_success(self, tmp_path):
+        import logging
+
+        from apex_tpu.utils.logging import get_logger
+
+        messages = []
+        handler = logging.Handler()
+        handler.emit = lambda rec: messages.append(rec.getMessage())
+        logger = get_logger("apex_tpu.io")
+        logger.addHandler(handler)
+        try:
+            m = self._monkey(io_failures={"ckpt.write": 2})
+            with m.active():
+                save_checkpoint(tmp_path / "a.ckpt", self.TREE)
+        finally:
+            logger.removeHandler(handler)
+        assert m.injected["io_fail:ckpt.write"] == 2
+        back = load_checkpoint(tmp_path / "a.ckpt")
+        np.testing.assert_array_equal(back["w"], self.TREE["w"])
+        # the retries are structured-logged with attempt + jittered delay
+        retries = [msg for msg in messages if "checkpoint.io_retry" in msg]
+        assert len(retries) == 2
+        assert "ChaosIOError" in retries[0]
+        assert '"attempt": 1' in retries[0] and '"delay_s"' in retries[0]
+
+    def test_transient_read_failures_retried_to_success(self, tmp_path):
+        save_checkpoint(tmp_path / "a.ckpt", self.TREE)
+        m = self._monkey(io_failures={"ckpt.read": 3})
+        with m.active():
+            back = load_checkpoint(tmp_path / "a.ckpt")
+        assert m.injected["io_fail:ckpt.read"] == 3
+        np.testing.assert_array_equal(back["w"], self.TREE["w"])
+
+    def test_persistent_failure_exhausts_budget_and_raises(self, tmp_path):
+        from apex_tpu.resilience import ChaosIOError
+
+        m = self._monkey(io_failures={"ckpt.write": 100})
+        with m.active(), pytest.raises(ChaosIOError):
+            save_checkpoint(tmp_path / "a.ckpt", self.TREE)
+        # 1 initial + 3 retries, then the final error propagates
+        assert m.injected["io_fail:ckpt.write"] == 4
+        assert not (tmp_path / "a.ckpt").exists()
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_lazy_leaf_reads_retry_too(self, tmp_path):
+        from apex_tpu.io.checkpoint import open_checkpoint_lazy
+
+        save_checkpoint(tmp_path / "a.ckpt", self.TREE)
+        m = self._monkey(io_failures={"ckpt.read": 1})
+        with m.active():
+            lazy = open_checkpoint_lazy(tmp_path / "a.ckpt")
+        m2 = self._monkey(io_failures={"ckpt.read": 2})
+        with m2.active():
+            w = np.asarray(lazy["w"])
+        np.testing.assert_array_equal(w, self.TREE["w"])
+        assert m2.injected["io_fail:ckpt.read"] == 2
+
+    def test_slow_io_delay_injection(self, tmp_path):
+        import time as _t
+
+        m = self._monkey(io_delay_seconds={"ckpt.write": 0.15})
+        t0 = _t.monotonic()
+        with m.active():
+            save_checkpoint(tmp_path / "a.ckpt", self.TREE)
+        assert _t.monotonic() - t0 >= 0.15
+        assert m.injected["io_delay:ckpt.write"] == 1
+
+    def test_index_reads_ride_the_retry_seam(self, tmp_path):
+        """index.json is as load-bearing as any shard: a transient EIO
+        must not skip the newest COMPLETE step dir (or fail an elastic
+        restore) while the shard reads would have retried."""
+        from apex_tpu.io import (latest_distributed_step, read_index,
+                                 save_sharded_checkpoint)
+
+        save_sharded_checkpoint(tmp_path / "step_00000003",
+                                {"a": np.ones(2)}, 0, 1)
+        m = self._monkey(io_failures={"ckpt.read": 2})
+        with m.active():
+            assert latest_distributed_step(tmp_path) == 3
+        assert m.injected["io_fail:ckpt.read"] == 2
+        m2 = self._monkey(io_failures={"ckpt.read": 1})
+        with m2.active():
+            assert read_index(tmp_path / "step_00000003")["world_size"] == 1
+        assert m2.injected["io_fail:ckpt.read"] == 1
+
+    def test_deterministic_oserrors_are_not_retried(self, tmp_path):
+        """A typo'd path (FileNotFoundError) repeats identically —
+        retrying would add ~0.35s of sleeps and three spurious
+        'transient' warnings in front of the real error."""
+        import time as _t
+
+        t0 = _t.monotonic()
+        with pytest.raises(FileNotFoundError):
+            load_checkpoint(tmp_path / "never_saved.ckpt")
+        assert _t.monotonic() - t0 < 0.05  # no backoff sleeps happened
+
+    def test_corrupt_bytes_are_not_retried(self, tmp_path):
+        """ValueError (torn header/blob) is NOT a transient error:
+        corrupt bytes don't heal, so validation failures surface on
+        the first attempt."""
+        p = tmp_path / "a.ckpt"
+        save_checkpoint(p, self.TREE)
+        p.write_bytes(p.read_bytes()[:-8])
+        m = self._monkey()   # counts nothing: no injection armed
+        with m.active(), pytest.raises(ValueError):
+            load_checkpoint(p)
+        assert not m.injected

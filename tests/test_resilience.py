@@ -351,6 +351,117 @@ class TestPreemptionHandler:
         finally:
             ck.close()
 
+    def test_drain_is_not_reentrant_but_waits_for_completion(self):
+        """A second drain landing while one is in flight (watchdog
+        thread firing mid-preemption-drain, schedulers resending
+        SIGTERM) never re-enters the flush — but it WAITS for the
+        in-flight one: returning early would let a watchdog report
+        'drained' and exit while the first flush is still writing."""
+        import threading
+        import time
+
+        entered = []
+        release = threading.Event()
+        started = threading.Event()
+
+        class SlowCkpt:
+            def wait_until_finished(self):
+                entered.append(1)
+                started.set()
+                release.wait(5.0)
+
+        pre = PreemptionHandler()
+        ck = SlowCkpt()
+        t = threading.Thread(target=pre.drain, args=(ck,))
+        t.start()
+        assert started.wait(5.0)
+        t0 = time.monotonic()
+        reentrant_done = threading.Event()
+
+        def second():
+            pre.drain(ck)  # must block until the first flush lands
+            reentrant_done.set()
+
+        threading.Thread(target=second).start()
+        time.sleep(0.2)
+        assert not reentrant_done.is_set()  # still waiting on flush #1
+        release.set()
+        t.join(5.0)
+        assert reentrant_done.wait(5.0)
+        assert time.monotonic() - t0 >= 0.2
+        assert len(entered) == 1            # ONE flush served both
+        # after the in-flight drain completes, a NEW drain runs again
+        pre.drain(ck)
+        assert len(entered) == 2
+
+    def test_reentrant_drain_sees_inflight_failure(self):
+        """A caller that piggybacks on an in-flight drain must NOT
+        report success when that flush failed — a watchdog would log
+        'drained' and exit over an unflushed save."""
+        import threading
+
+        release = threading.Event()
+        started = threading.Event()
+
+        class FailingCkpt:
+            def wait_until_finished(self):
+                started.set()
+                release.wait(5.0)
+                raise RuntimeError("disk full mid-flush")
+
+        pre = PreemptionHandler()
+        ck = FailingCkpt()
+        first_err = []
+
+        def first():
+            try:
+                pre.drain(ck)
+            except RuntimeError as e:
+                first_err.append(e)
+
+        t = threading.Thread(target=first)
+        t.start()
+        assert started.wait(5.0)
+        waiter_err = []
+
+        def second():
+            try:
+                pre.drain(ck)
+            except RuntimeError as e:
+                waiter_err.append(e)
+
+        t2 = threading.Thread(target=second)
+        t2.start()
+        release.set()
+        t.join(5.0)
+        t2.join(5.0)
+        assert first_err and "disk full" in str(first_err[0])
+        assert waiter_err and "in-flight drain failed" in str(waiter_err[0])
+
+    def test_sigterm_during_drain_only_sets_flag(self):
+        """SIGTERM arriving DURING the drain: the handler sets the flag
+        and chains — it never calls drain itself, so the in-flight
+        flush completes exactly once and the process can still exit 0
+        (the process-level twin lives in test_gpt_example.py)."""
+        import threading
+
+        entered = []
+        release = threading.Event()
+
+        class SlowCkpt:
+            def wait_until_finished(self):
+                entered.append(1)
+                # SIGTERM lands while the main thread is INSIDE drain
+                os.kill(os.getpid(), signal.SIGTERM)
+                release.wait(2.0)
+
+        with PreemptionHandler() as pre:
+            pre.simulate("first notice")
+            release.set()
+            pre.drain(SlowCkpt())
+            assert pre.preempted  # the mid-drain signal registered
+        assert len(entered) == 1
+
     def test_rng_tracker_roundtrip_continues_streams(self):
         """A resume that reset the fork counter would replay dropout
         masks; the snapshot must continue the stream exactly."""

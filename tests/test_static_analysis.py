@@ -34,6 +34,7 @@ from apex_tpu.analysis.rules_collectives import (
     UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
+from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_precision import (
     QuantizedSyncStateDtype,
     Fp32ConstantInBf16Path,
@@ -418,6 +419,98 @@ class TestDonatedBufferReuse:
                 out = step(*step_args)
                 return out, params
             """, tmp_path, [DonatedBufferReuse()])
+        assert got == []
+
+
+# ------------------------------------------ APX104 non-atomic ckpt write
+class TestNonAtomicCheckpointWrite:
+    def test_positive_direct_wb_on_checkpoint_path(self, tmp_path):
+        """The torn-write shape: a checkpoint-named path opened for a
+        direct binary write — an interrupted writer publishes a
+        truncated file under the final name."""
+        got = run("""
+            def save(ckpt_path, blob):
+                with open(ckpt_path, "wb") as f:
+                    f.write(blob)
+            """, tmp_path, [NonAtomicCheckpointWrite()])
+        assert rule_ids(got) == ["APX104"]
+        assert "atomic_output" in got[0].fix_hint
+
+    def test_positive_checkpointish_function_name(self, tmp_path):
+        """The function name marks the write even when the path
+        expression itself is opaque."""
+        got = run("""
+            def write_checkpoint(path, blob):
+                f = open(path, mode="wb")
+                f.write(blob)
+                f.close()
+            """, tmp_path, [NonAtomicCheckpointWrite()])
+        assert rule_ids(got) == ["APX104"]
+
+    def test_positive_append_and_exclusive_binary_modes(self, tmp_path):
+        got = run("""
+            def save(ckpt_path, blob):
+                with open(ckpt_path, "ab") as f:
+                    f.write(blob)
+                with open(ckpt_path, "xb") as f:
+                    f.write(blob)
+            """, tmp_path, [NonAtomicCheckpointWrite()])
+        assert rule_ids(got) == ["APX104", "APX104"]
+
+    def test_negative_tmp_staged_write(self, tmp_path):
+        """Writing to <path>.tmp then renaming IS the atomic idiom —
+        the staging write must stay silent."""
+        got = run("""
+            import os
+
+            def save(ckpt_path, blob):
+                with open(str(ckpt_path) + ".tmp", "wb") as f:
+                    f.write(blob)
+                os.replace(str(ckpt_path) + ".tmp", ckpt_path)
+            """, tmp_path, [NonAtomicCheckpointWrite()])
+        assert got == []
+
+    def test_negative_atomic_helper_itself(self, tmp_path):
+        """The designated helper (atomic_output / _atomic_* wrappers)
+        owns the one sanctioned open."""
+        got = run("""
+            import contextlib, os
+
+            @contextlib.contextmanager
+            def atomic_output(path):
+                f = open(str(path) + ".stage", "wb")
+                yield f
+                f.close()
+                os.replace(str(path) + ".stage", path)
+
+            def _atomic_write_checkpoint(path, blob):
+                f = open(path, "wb")
+                f.write(blob)
+            """, tmp_path, [NonAtomicCheckpointWrite()])
+        assert got == []
+
+    def test_negative_non_checkpoint_writes_and_reads(self, tmp_path):
+        """Binary writes to non-checkpoint paths, text-mode writes, and
+        checkpoint READS are out of scope."""
+        got = run("""
+            def dump_log(log_path, text):
+                with open(log_path, "wb") as f:      # not a ckpt path
+                    f.write(text)
+                with open("sections.jsonl", "a") as f:  # text append
+                    f.write("{}")
+
+            def load_checkpoint(ckpt_path):
+                with open(ckpt_path, "rb") as f:     # read: fine
+                    return f.read()
+            """, tmp_path, [NonAtomicCheckpointWrite()])
+        assert got == []
+
+    def test_negative_computed_mode_trusted(self, tmp_path):
+        got = run("""
+            def save(ckpt_path, blob, mode):
+                with open(ckpt_path, mode) as f:
+                    f.write(blob)
+            """, tmp_path, [NonAtomicCheckpointWrite()])
         assert got == []
 
 
