@@ -36,6 +36,12 @@ machine-checked invariants):
 - **APX401/402** indexing/precision hygiene: unclamped vocab gathers
   and fp32 constants in bf16 paths (``rules_precision``) — the
   ``gpt.py:447`` class.
+- **APX107/306** decode-path hygiene (``rules_precision``): a
+  page-table ``take``/subscript gather with no clamp (the APX401
+  family extended to the serving path's mutable page indirection),
+  and a KV-cache buffer provably narrower than the
+  ``preferred_element_type`` of a dot it feeds with no explicit widen
+  at the read (the ``inference.kv_cache`` storage-dtype contract).
 
 CLI: ``python -m apex_tpu.analysis [paths] [--baseline FILE]`` — see
 ``docs/static_analysis.md`` for rule details, the baseline format, and
@@ -62,7 +68,8 @@ from apex_tpu.analysis.rules_collectives import (
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_precision import (
-    Fp32ConstantInBf16Path, QuantizedSyncStateDtype,
+    Fp32ConstantInBf16Path, KvCacheReadDtypeMismatch,
+    PageTableGatherUnclamped, QuantizedSyncStateDtype,
     ScratchAccumDtypeMismatch, UnclampedTakeAlongAxis,
 )
 from apex_tpu.analysis.rules_tiling import (
@@ -95,7 +102,9 @@ def default_rules(vmem_budget_bytes=None):
         vmem,
         ScratchAccumDtypeMismatch(),
         QuantizedSyncStateDtype(),
+        KvCacheReadDtypeMismatch(),
         UnclampedTakeAlongAxis(),
+        PageTableGatherUnclamped(),
         Fp32ConstantInBf16Path(),
     )
 

@@ -39,8 +39,9 @@ import jax
 
 __all__ = [
     "hlo_text", "count_collectives", "operand_dtypes",
-    "assert_collective_dtype", "assert_no_whole_tree_concat",
-    "assert_donation_covers", "donated_buffer_count",
+    "assert_collective_dtype", "assert_no_host_transfer",
+    "assert_no_whole_tree_concat", "assert_donation_covers",
+    "donated_buffer_count", "host_transfer_sites",
 ]
 
 #: collective ops that carry a reduction REGION in StableHLO — their
@@ -150,6 +151,51 @@ def assert_no_whole_tree_concat(artifact, total_elements: int,
         f"the lowering concatenates the whole tree to one "
         f"tensor<{total_elements}x{dtype}> — a full-model flatten is "
         f"back in the step (the pre-bucket _flatten shape)")
+
+
+#: StableHLO ops that move data across the device/host boundary
+_HOST_TRANSFER_OPS = ("infeed", "outfeed", "send", "recv")
+
+#: custom_call targets that round-trip through the host: Python
+#: callbacks (io_callback / pure_callback / debug.print lower to
+#: these) and explicit host-memory placement
+_HOST_CALL_MARKERS = ("callback", "host")
+
+
+def host_transfer_sites(artifact) -> List[str]:
+    """Every host-transfer site in the lowering, as matched snippets:
+    infeed/outfeed/send/recv ops plus ``custom_call`` targets naming a
+    Python callback or host placement.  Empty list = the program runs
+    entirely on device."""
+    txt = hlo_text(artifact)
+    sites = []
+    for op in _HOST_TRANSFER_OPS:
+        sites.extend(_op_occurrences(txt, op))
+    # custom_call targets appear as `@target(` in pretty form and as
+    # call_target_name = "target" in generic form
+    targets = re.findall(
+        r'custom_call\s*@([\w.\-]+)\(', txt)
+    targets += re.findall(r'call_target_name\s*=\s*"([^"]+)"', txt)
+    for t in targets:
+        low = t.lower()
+        if any(m in low for m in _HOST_CALL_MARKERS):
+            sites.append(f"custom_call @{t}")
+    return sites
+
+
+def assert_no_host_transfer(artifact) -> None:
+    """The lowering must contain ZERO host transfers — no infeed/
+    outfeed/send/recv, no Python-callback or host-placement custom
+    calls.  The decode-step contract (ROADMAP: "decode step pinned to
+    zero host transfers"): one stray ``debug.print``, ``io_callback``,
+    or host-pinned buffer inserts a device->host sync into a loop that
+    runs tens of times per generated token."""
+    sites = host_transfer_sites(artifact)
+    assert not sites, (
+        f"the lowering contains {len(sites)} host-transfer site(s): "
+        f"{sites[:5]} — a compiled hot-loop step must run entirely on "
+        f"device (drop the callback/debug print, or move the host work "
+        f"between steps)")
 
 
 def donated_buffer_count(artifact) -> int:

@@ -20,6 +20,23 @@
   (``dataflow.dtype_env``), and scratch refs are matched to their
   ``pallas_call``'s ``scratch_shapes`` declarations positionally (the
   trailing kernel parameters, by the Pallas calling convention).
+- APX107: page-table gather without a clamp/mask — the APX401
+  unclamped-gather family extended to the decode path.  A page table
+  maps logical sequence positions onto pool pages; its entries are
+  host-maintained mutable state (admission/eviction rewrites them
+  every step), so a stale or corrupt entry is a WHEN, not an if.  An
+  unclamped ``take``/subscript gather through one wraps negative ids
+  and clamps-or-fills past-end ids depending on gather mode — reading
+  (or worse, scattering into) a LIVE sequence's page instead of the
+  reserved garbage page.
+- APX306: KV-cache storage read into a wider attention accumulator
+  without an explicit widen.  The cache pool is deliberately stored
+  narrow (bf16 by default — half the HBM); a dot that declares
+  ``preferred_element_type=f32`` but feeds the narrow cache buffer in
+  directly leaves the widening decision to the backend — Mosaic and
+  XLA agree today, but the decode kernels' contract is the EXPLICIT
+  ``.astype`` at the read seam, where the intent is visible and the
+  interpret-mode tests exercise the same arithmetic as the chip.
 - APX305: quantized-sync state narrower than its contract.  Inside a
   function that casts to a quantized WIRE dtype (int8/fp8 — the
   compressed grad-sync idiom), a ``scale``-named buffer provably
@@ -108,6 +125,104 @@ class UnclampedTakeAlongAxis(Rule):
                 "clamped/filled depending on gather mode — corrupt "
                 "targets produce plausible-looking wrong losses instead "
                 "of failing")
+
+
+#: identifier substrings that mark a page-table value (the decode
+#: path's host-maintained page indirection) — the APX107 scope guard
+_PAGE_TABLE_NAMES = ("page_table", "block_table")
+
+
+def _mentions_page_table(node: ast.AST) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) \
+                and any(m in sub.id.lower() for m in _PAGE_TABLE_NAMES):
+            return sub.id
+    return None
+
+
+class PageTableGatherUnclamped(Rule):
+    """APX107: a ``take`` or subscript gather through a page table
+    whose indices (or whose table values, when the table itself IS the
+    index) are never clamped/masked."""
+
+    rule_id = "APX107"
+    severity = "error"
+    fix_hint = ("clamp page-table reads into the pool "
+                "(jnp.clip(table, 0, num_pages - 1)) and route masked "
+                "writes to the reserved garbage page — a stale table "
+                "entry must read/write garbage, never wrap into a live "
+                "sequence's page")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_take(ctx, node)
+            elif isinstance(node, ast.Subscript):
+                yield from self._check_subscript(ctx, node)
+
+    def _clipped(self, ctx: ModuleContext, node: ast.AST) -> Set[str]:
+        fn = ctx.enclosing_function(node)
+        return _clipped_names(fn) if fn is not None else set()
+
+    def _check_take(self, ctx: ModuleContext,
+                    node: ast.Call) -> Iterator[Finding]:
+        if last_name(node.func) != "take":
+            return
+        if any(kw.arg == "mode" for kw in node.keywords):
+            return  # explicit out-of-bounds semantic chosen
+        indices = node.args[1] if len(node.args) > 1 else None
+        for kw in node.keywords:
+            if kw.arg == "indices":
+                indices = kw.value
+        if indices is None or not node.args:
+            return
+        src = node.args[0]
+        table = (src.id if isinstance(src, ast.Name)
+                 and any(m in src.id.lower() for m in _PAGE_TABLE_NAMES)
+                 else _mentions_page_table(indices))
+        if table is None:
+            return
+        if _contains_clip(indices, self._clipped(ctx, node)):
+            return
+        yield self.finding(
+            ctx, node,
+            f"unclamped take through page table `{table}`: a stale or "
+            "corrupt table entry (or position index) WRAPS under jit "
+            "instead of hitting the reserved garbage page — reading, or "
+            "scattering into, a live sequence's page")
+
+    @staticmethod
+    def _at_mode_chosen(ctx: ModuleContext, node: ast.Subscript) -> bool:
+        """``pool.at[table].set(x, mode="drop")`` — the explicit
+        out-of-bounds semantic lives on the ``.set``/``.get`` call
+        ENCLOSING the subscript, not on the subscript itself."""
+        attr = ctx.parent(node)
+        if not isinstance(attr, ast.Attribute):
+            return False
+        call = ctx.parent(attr)
+        return isinstance(call, ast.Call) \
+            and any(kw.arg == "mode" for kw in call.keywords)
+
+    def _check_subscript(self, ctx: ModuleContext,
+                         node: ast.Subscript) -> Iterator[Finding]:
+        # pool[page_table] / pool.at[page_table, slot] — the table's
+        # VALUES are the gather/scatter indices
+        table = _mentions_page_table(node.slice)
+        if table is None:
+            return
+        if _contains_clip(node.slice, self._clipped(ctx, node)):
+            return
+        if self._at_mode_chosen(ctx, node):
+            return  # explicit out-of-bounds semantic chosen
+        yield self.finding(
+            ctx, node,
+            f"page table `{table}` used as a gather/scatter index "
+            "without a clamp or an explicit mode=: out-of-range page "
+            "ids get backend-chosen out-of-bounds semantics (a gather "
+            "WRAPS negative ids into the pool — a LIVE sequence's "
+            "page; scatter behavior differs again) — the "
+            "silent-corruption class the reserved garbage page exists "
+            "to absorb")
 
 
 _DOT_NAMES = {"dot", "dot_general"}
@@ -419,6 +534,98 @@ class QuantizedSyncStateDtype(Rule):
                     "to carry the part of the gradient the wire could "
                     "NOT represent — storing it at wire width re-rounds "
                     "it away; use the bucket's storage dtype")
+
+
+#: identifier substrings that mark a KV-cache buffer (the decode
+#: path's paged pools) — the APX306 scope guard
+_KV_CACHE_NAMES = ("kv", "cache", "pool")
+
+
+class KvCacheReadDtypeMismatch(Rule):
+    """APX306: a KV-cache-named buffer provably NARROWER than the
+    ``preferred_element_type`` of a dot it feeds, with no explicit
+    widen at the read."""
+
+    rule_id = "APX306"
+    severity = "error"
+    fix_hint = ("widen the cache read explicitly at the seam "
+                "(k = k_pool[...].astype(jnp.float32), or .astype the "
+                "dot operand) — the narrow storage dtype is a deliberate "
+                "HBM trade, and the widen point must be visible where "
+                "the accumulator contract is declared")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for info in ctx.functions.values():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            yield from self._check_fn(ctx, info.node)
+
+    def _check_fn(self, ctx: ModuleContext, fn: ast.AST) -> Iterator[Finding]:
+        env = dataflow.dtype_env(ctx, fn)
+        caches: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and ctx.enclosing_function(node) is fn):
+                continue
+            name = node.targets[0].id
+            if not any(m in name.lower() for m in _KV_CACHE_NAMES):
+                continue
+            d = _cast_dtype(node.value, env)
+            if d is not None:
+                caches[name] = d
+        if not caches:
+            return
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call)
+                    and last_name(call.func) in _DOT_NAMES):
+                continue
+            pref = None
+            for kw in call.keywords:
+                if kw.arg == "preferred_element_type":
+                    pref = dataflow.dtype_literal(kw.value, env)
+            pref_size = dataflow.itemsize(pref)
+            if pref_size is None:
+                continue
+            for arg in call.args[:2]:
+                hit = self._narrow_cache_operand(arg, caches, env, pref_size)
+                if hit is not None:
+                    name, d = hit
+                    yield self.finding(
+                        ctx, call,
+                        f"KV-cache buffer `{name}` is stored as {d} but "
+                        f"feeds a dot with preferred_element_type={pref} "
+                        f"without an explicit widen at the read: the "
+                        f"narrow->wide conversion point is invisible, and "
+                        f"a backend that honors the operand dtype over "
+                        f"the accumulator request loses the precision "
+                        f"the cache's attention contract promises")
+
+    @staticmethod
+    def _narrow_cache_operand(arg: ast.AST, caches: Dict[str, str],
+                              env: Dict[str, str],
+                              pref_size: int) -> Optional[Tuple[str, str]]:
+        """(cache_name, dtype) when ``arg`` reads a tracked narrow
+        cache without widening; None otherwise.  An ``astype`` wrapper
+        resolving to >= the preferred width is the explicit widen."""
+        if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Attribute) \
+                and arg.func.attr == "astype" and arg.args:
+            d = dataflow.dtype_literal(arg.args[0], env)
+            size = dataflow.itemsize(d)
+            if size is None or size >= pref_size:
+                # an explicit cast sits at the read: either it provably
+                # widens, or its dtype is unresolvable (a parameter, a
+                # config attribute) — the intent is SPELLED, and the
+                # quiet-when-unprovable convention applies.  Only a
+                # provably-NARROW explicit cast still flags.
+                return None
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and sub.id in caches:
+                d = caches[sub.id]
+                size = dataflow.itemsize(d)
+                if size is not None and size < pref_size:
+                    return (sub.id, d)
+        return None
 
 
 class Fp32ConstantInBf16Path(Rule):

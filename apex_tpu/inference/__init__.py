@@ -1,0 +1,39 @@
+"""apex_tpu.inference — the serving half of the north star.
+
+A paged-KV decode engine with continuous batching:
+
+- :mod:`~apex_tpu.inference.kv_cache` — fixed-size pages in a
+  preallocated pool, per-sequence page tables, a host-side allocator
+  with a reserved garbage page for masked writes;
+- :mod:`~apex_tpu.inference.decode` — ONE jitted decode step (shared
+  transformer blocks via :func:`apex_tpu.models.gpt.forward_decode`,
+  paged single-query attention and the fused sampling head from
+  :mod:`apex_tpu.ops.decode_attention_pallas` /
+  :mod:`apex_tpu.ops.decode_sampling_pallas`) that compiles once and
+  serves every cache length and batch occupancy, plus the static-shape
+  prompt prefill riding the training forward;
+- :mod:`~apex_tpu.inference.scheduler` — FIFO continuous batching:
+  admit into freed pages between steps, evict finished sequences,
+  degrade-once kernel fallback via :mod:`apex_tpu.resilience`.
+
+See docs/inference.md for the architecture and knob table, and
+``examples/gpt/serve_gpt.py`` for the load-generator driver.
+"""
+
+from apex_tpu.inference.decode import (
+    DecodeConfig, make_decode_step, make_prefill,
+)
+from apex_tpu.inference.kv_cache import (
+    GARBAGE_PAGE, KVCacheConfig, PageAllocator, alloc_pools, pages_needed,
+    write_decode_kv, write_prompt_kv,
+)
+from apex_tpu.inference.scheduler import (
+    Completion, ContinuousBatchingScheduler, Request,
+)
+
+__all__ = [
+    "Completion", "ContinuousBatchingScheduler", "DecodeConfig",
+    "GARBAGE_PAGE", "KVCacheConfig", "PageAllocator", "Request",
+    "alloc_pools", "make_decode_step", "make_prefill", "pages_needed",
+    "write_decode_kv", "write_prompt_kv",
+]

@@ -1,0 +1,147 @@
+"""Fused single-token decode step and the prefill step.
+
+``make_decode_step`` builds ONE jitted function that advances every
+resident sequence by one token: embedding lookup, all transformer
+blocks (QKV projection, RoPE at each sequence's own position, paged
+single-query attention, MLP — the block code shared with training via
+:func:`apex_tpu.models.gpt.forward_decode`), and the fused sampling
+head (logits → temperature/top-k → token in one kernel,
+:mod:`apex_tpu.ops.decode_sampling_pallas` — the full-vocab fp32
+softmax never reaches HBM).
+
+Compile-once discipline: every input shape is static — the KV pools,
+the (max_batch, pages_per_seq) page-table block, the per-slot scalar
+arrays — and occupancy/length live in DATA (``active``, ``positions``),
+so the step traces exactly once and serves every batch occupancy and
+cache length from that one executable
+(tests/test_lowered_invariants.py pins the trace count and that the
+lowering has zero host transfers).  The pools donate: the caller
+rebinds them every step, and XLA updates the cache in place instead of
+holding two pool copies live.
+
+``make_prefill`` runs an admitted sequence's prompt through the
+EXISTING training forward (``gpt_forward(return_kv=True)``) at one
+static padded shape, scatters the captured per-layer k/v into the
+sequence's pages, and samples the first generated token from the last
+prompt position's hidden state.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.inference.kv_cache import KVCacheConfig, write_prompt_kv
+from apex_tpu.models.gpt import GPTConfig, forward_decode, gpt_forward
+from apex_tpu.ops.decode_sampling_pallas import fused_sample
+
+__all__ = ["DecodeConfig", "make_decode_step", "make_prefill"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeConfig:
+    """Static serving configuration — everything here bakes into the
+    compiled steps (thread impl choices HERE, never via env vars:
+    the APX101/102 contract).
+
+    ``max_batch``: decode-slot count (the step's batch dimension).
+    ``max_prompt_len``: the prefill pad length (one prefill compile).
+    ``temperature``/``top_k``: the sampling head; ``temperature=0`` is
+    greedy argmax and ignores ``top_k``.
+    ``attn_impl``/``sample_impl``: "auto" | "pallas" | "interpret" |
+    "xla" for the decode-attention and sampling kernels (chosen
+    impls degrade once through ``resilience.fallback``).
+    ``sample_dot_dtype``: MXU dot dtype of the sampling head (None =
+    the fused-CE default, bf16; tests pass fp32 for exact parity).
+    """
+
+    cache: KVCacheConfig = dataclasses.field(default_factory=KVCacheConfig)
+    max_batch: int = 8
+    max_prompt_len: int = 128
+    temperature: float = 1.0
+    top_k: int = 0
+    attn_impl: str = "auto"
+    sample_impl: str = "auto"
+    sample_dot_dtype: Any = None
+    base_seed: int = 0
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0 (got {self.temperature}); "
+                "0 means greedy")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (got {self.top_k})")
+
+
+def make_decode_step(config: GPTConfig, dcfg: DecodeConfig,
+                     return_logits: bool = False):
+    """Build the jitted one-token-per-sequence decode step.
+
+    Returns ``step(params, pools, tokens, positions, active,
+    page_tables, seeds) -> (pools, next_tokens)`` with
+
+    - ``pools``: the ``{"k", "v"}`` page pools (DONATED — rebind on
+      every call);
+    - ``tokens``/``positions``/``active``: (B,) current token ids,
+      their positions, slot liveness; inactive slots are fully masked
+      (their cache writes land on the garbage page, their sampled
+      token is meaningless);
+    - ``page_tables``: (B, P) int32; ``seeds``: (B,) uint32 per-slot
+      sampling counters.
+
+    With ``return_logits=True`` the step instead returns
+    ``(pools, logits)`` — the fp32 full-vocab head exactly as the
+    training forward computes it — for the prefill↔decode parity band;
+    serving never materializes those logits.
+    """
+    def step(params, pools, tokens, positions, active, page_tables, seeds):
+        hidden, pools = forward_decode(
+            params, tokens, positions, active, pools, page_tables,
+            config, attn_impl=dcfg.attn_impl)
+        if return_logits:
+            logits = jnp.matmul(hidden.astype(jnp.float32),
+                                params["embed"].T.astype(jnp.float32))
+            return pools, logits
+        next_tokens = fused_sample(
+            hidden, params["embed"], seeds,
+            temperature=dcfg.temperature, top_k=dcfg.top_k,
+            impl=dcfg.sample_impl, dot_dtype=dcfg.sample_dot_dtype)
+        return pools, next_tokens
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+def make_prefill(config: GPTConfig, dcfg: DecodeConfig):
+    """Build the jitted prompt-prefill step (one static padded shape).
+
+    Returns ``prefill(params, pools, prompt, prompt_len,
+    page_table_row, seed) -> (pools, first_token)`` where ``prompt``
+    is (1, max_prompt_len) int32 (zero-padded past ``prompt_len``; the
+    padded tail's k/v go to the garbage page and its causal rows are
+    never read), ``page_table_row`` is the admitted sequence's (P,)
+    table, and ``first_token`` is sampled from the LAST prompt
+    position's hidden state with the same sampling head as decode.
+    Pools donate, as in the decode step.
+    """
+    S = dcfg.max_prompt_len
+
+    def prefill(params, pools, prompt, prompt_len, page_table_row, seed):
+        hidden, kv = gpt_forward(params, prompt, config,
+                                 return_hidden=True, return_kv=True)
+        k_stack, v_stack = kv  # (L, 1, KVH, S, hd)
+        ks = k_stack[:, 0].transpose(0, 2, 1, 3)  # (L, S, KVH, hd)
+        vs = v_stack[:, 0].transpose(0, 2, 1, 3)
+        kp, vp = write_prompt_kv(pools["k"], pools["v"], ks, vs,
+                                 page_table_row, prompt_len)
+        h_last = hidden[jnp.clip(prompt_len - 1, 0, S - 1), 0]  # (H,)
+        first = fused_sample(
+            h_last[None], params["embed"], seed[None],
+            temperature=dcfg.temperature, top_k=dcfg.top_k,
+            impl=dcfg.sample_impl, dot_dtype=dcfg.sample_dot_dtype)
+        return {"k": kp, "v": vp}, first[0]
+
+    return jax.jit(prefill, donate_argnums=(1,))

@@ -1,0 +1,323 @@
+"""Continuous-batching scheduler: admit, decode, evict — between steps.
+
+The serving loop's control plane (Orca-style continuous batching): the
+jitted decode step always runs at the STATIC ``max_batch`` shape, and
+this scheduler fills its slots —
+
+- **admit**: between decode steps, queued requests move into free slots
+  strictly FIFO.  A request is admitted only when a slot is free AND
+  the page allocator can reserve its WORST-CASE page count
+  (``ceil((prompt_len + max_new_tokens) / page_size)``), so a resident
+  sequence can never hit a mid-generation allocation failure and the
+  queue head can never be overtaken (no starvation: when the head does
+  not fit, nothing behind it is considered).
+- **prefill**: an admitted prompt runs through the training forward at
+  ONE static padded shape (``DecodeConfig.max_prompt_len``), its
+  per-layer k/v scatter into the reserved pages, and the first
+  generated token is sampled from the last prompt position.
+- **decode**: one fused step advances every active slot; inactive
+  slots ride along masked.
+- **evict**: finished sequences (max_new reached, or ``eos_id``) free
+  their pages back to the allocator — the next ``step()`` can admit
+  into them.
+
+The scheduler is time-agnostic (drivers decide when to ``submit``;
+tests replay seeded traces step-by-step, the load-generator example
+submits on wall-clock Poisson arrivals) and deterministic: sampling
+seeds derive from ``(base_seed, slot, per-slot draw counter)``, so the
+same trace of submits produces the same tokens.
+
+Kernel resilience: trace-time kernel failures already degrade through
+the fallback registry inside the step build; a DEFERRED jit-compile
+failure surfaces on the first call, is attributed via
+``resilience.fallback.trip_from_exception``, and the steps are rebuilt
+once — the fresh trace lowers the XLA reference and the server keeps
+serving (the same recovery ``examples/gpt/pretrain_gpt.py`` wires for
+training).
+"""
+
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from apex_tpu.inference.decode import (
+    DecodeConfig, make_decode_step, make_prefill,
+)
+from apex_tpu.inference.kv_cache import (
+    PageAllocator, alloc_pools, pages_needed,
+)
+from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.utils.logging import get_logger, log_structured
+
+__all__ = ["Request", "Completion", "ContinuousBatchingScheduler"]
+
+_logger = get_logger("apex_tpu.inference")
+
+_MASK32 = (1 << 32) - 1
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: ``prompt`` token ids, ``max_new_tokens``
+    to generate, optional ``eos_id`` early stop."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request with its wall-clock trace: ``token_times[i]``
+    is when ``tokens[i]`` became available (``token_times[0]`` is the
+    prefill / time-to-first-token)."""
+
+    rid: int
+    prompt: List[int]
+    tokens: List[int]
+    submit_time: float
+    finish_time: float
+    token_times: List[float]
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    pages: List[int]
+    generated: List[int]
+    token_times: List[float]
+    submit_time: float
+
+
+class ContinuousBatchingScheduler:
+    """The serve loop's control plane: FIFO admission into freed KV
+    pages between decode steps, static-shape slot management, eviction
+    with page recycling, deterministic per-slot sampling seeds, and
+    degrade-once step rebuild on deferred kernel failures (see the
+    module docstring for the full semantics)."""
+
+    def __init__(self, params, config: GPTConfig, dcfg: DecodeConfig,
+                 time_fn=time.monotonic):
+        cache = dcfg.cache
+        if config.moe:
+            raise NotImplementedError("MoE decode is not wired")
+        if dcfg.max_prompt_len > config.max_seq_len \
+                and config.position_embedding_type == "learned":
+            raise ValueError(
+                f"max_prompt_len ({dcfg.max_prompt_len}) exceeds the "
+                f"learned position table ({config.max_seq_len})")
+        self.params = params
+        self.config = config
+        self.dcfg = dcfg
+        self._time = time_fn
+        tp_local_kv = config.kv_heads  # single-process serving: tp=1
+        self.pools = alloc_pools(config.num_layers, tp_local_kv,
+                                 config.head_dim, cache)
+        self.allocator = PageAllocator(cache.num_pages)
+        self.queue: deque = deque()
+        B, P = dcfg.max_batch, cache.pages_per_seq
+        self._slots: List[Optional[_Slot]] = [None] * B
+        self._page_tables = np.zeros((B, P), np.int32)
+        self._positions = np.zeros((B,), np.int32)
+        self._tokens = np.zeros((B,), np.int32)
+        self._active = np.zeros((B,), bool)
+        self._draws = np.zeros((B,), np.int64)
+        self.completed: List[Completion] = []
+        self.stats: Dict[str, int] = {
+            "admitted": 0, "evicted": 0, "decode_steps": 0,
+            "prefills": 0, "step_rebuilds": 0,
+        }
+        self._rebuilt_once = False
+        self._build_steps()
+
+    # ------------------------------------------------------------ build
+    def _build_steps(self) -> None:
+        self._decode = make_decode_step(self.config, self.dcfg)
+        self._prefill = make_prefill(self.config, self.dcfg)
+
+    def decode_cache_size(self) -> int:
+        """Compiled-variant count of the decode step — the
+        compile-once pin (1 after any number of steps at any
+        occupancy/length mix)."""
+        return self._decode._cache_size()
+
+    def _call(self, attr: str, *args):
+        """Run a compiled step; on a deferred kernel-compile failure,
+        attribute it to the registry, rebuild both steps ONCE (the new
+        trace lowers the fallback impls), and retry."""
+        try:
+            return getattr(self, attr)(*args)
+        except Exception as exc:  # noqa: BLE001 — attribution decides
+            from apex_tpu.resilience.fallback import trip_from_exception
+
+            tripped = trip_from_exception(exc)
+            if not tripped or self._rebuilt_once:
+                raise
+            self._rebuilt_once = True
+            self.stats["step_rebuilds"] += 1
+            log_structured(
+                _logger, logging.WARNING, "inference.step_rebuilt",
+                tripped=tripped, error=f"{type(exc).__name__}: {exc}")
+            self._build_steps()
+            return getattr(self, attr)(*args)
+
+    # ------------------------------------------------------------ seeds
+    def _seed(self, slot: int) -> int:
+        d = int(self._draws[slot])
+        self._draws[slot] += 1
+        s = (self.dcfg.base_seed
+             + slot * 0x9E3779B9 + d * 0x85EBCA6B) & _MASK32
+        return s
+
+    # ---------------------------------------------------------- requests
+    def submit(self, request: Request) -> None:
+        """Queue a request (FIFO).  Requests that can NEVER fit the
+        static shapes fail here, loudly, instead of wedging the queue
+        head forever."""
+        plen = len(request.prompt)
+        if plen < 1:
+            raise ValueError("empty prompt")
+        if plen > self.dcfg.max_prompt_len:
+            raise ValueError(
+                f"prompt ({plen} tokens) exceeds max_prompt_len "
+                f"({self.dcfg.max_prompt_len})")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        need = pages_needed(plen + request.max_new_tokens,
+                            self.dcfg.cache.page_size)
+        P = self.dcfg.cache.pages_per_seq
+        if need > P:
+            raise ValueError(
+                f"request needs {need} pages; page tables hold {P} "
+                f"(pages_per_seq) — raise pages_per_seq or shorten the "
+                f"request")
+        if need > self.allocator.num_pages - 1:
+            raise ValueError(
+                f"request needs {need} pages; the pool only has "
+                f"{self.allocator.num_pages - 1} allocatable")
+        self.queue.append(request)
+
+    @property
+    def num_active(self) -> int:
+        return int(self._active.sum())
+
+    def idle(self) -> bool:
+        return not self.queue and not self._active.any()
+
+    # ------------------------------------------------------------- admit
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self) -> int:
+        admitted = 0
+        while self.queue:
+            req = self.queue[0]
+            slot = self._free_slot()
+            need = pages_needed(len(req.prompt) + req.max_new_tokens,
+                                self.dcfg.cache.page_size)
+            if slot is None or not self.allocator.can_allocate(need):
+                break  # FIFO: the head blocks, nothing overtakes it
+            self.queue.popleft()
+            pages = self.allocator.allocate(need)
+            self._admit_into(slot, req, pages)
+            admitted += 1
+        return admitted
+
+    def _admit_into(self, slot: int, req: Request, pages: List[int]) -> None:
+        t0 = self._time()
+        plen = len(req.prompt)
+        P = self.dcfg.cache.pages_per_seq
+        row = np.zeros((P,), np.int32)
+        row[: len(pages)] = pages
+        prompt = np.zeros((1, self.dcfg.max_prompt_len), np.int32)
+        prompt[0, :plen] = req.prompt
+        self.pools, first = self._call(
+            "_prefill", self.params, self.pools,
+            jnp.asarray(prompt), jnp.int32(plen), jnp.asarray(row),
+            jnp.uint32(self._seed(slot)))
+        first = int(first)
+        self._slots[slot] = _Slot(request=req, pages=pages,
+                                  generated=[first],
+                                  token_times=[self._time()],
+                                  submit_time=t0)
+        self._page_tables[slot] = row
+        self._positions[slot] = plen  # where `first` will be cached
+        self._tokens[slot] = first
+        self._active[slot] = True
+        self.stats["admitted"] += 1
+        self.stats["prefills"] += 1
+        if (req.max_new_tokens == 1
+                or (req.eos_id is not None and first == req.eos_id)):
+            self._evict(slot)
+
+    # ------------------------------------------------------------- evict
+    def _evict(self, slot: int) -> None:
+        s = self._slots[slot]
+        self.allocator.free(s.pages)
+        self.completed.append(Completion(
+            rid=s.request.rid, prompt=list(s.request.prompt),
+            tokens=list(s.generated), submit_time=s.submit_time,
+            finish_time=self._time(), token_times=list(s.token_times)))
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._page_tables[slot] = 0
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+        self.stats["evicted"] += 1
+
+    # -------------------------------------------------------------- step
+    def step(self) -> bool:
+        """Admit waiting requests, then advance every active sequence
+        one token.  Returns True when any work (admission or decode)
+        happened."""
+        admitted = self._admit()
+        if not self._active.any():
+            return admitted > 0
+        B = self.dcfg.max_batch
+        seeds = np.zeros((B,), np.uint32)
+        for i in range(B):
+            if self._active[i]:
+                seeds[i] = self._seed(i)
+        self.pools, next_tokens = self._call(
+            "_decode", self.params, self.pools,
+            jnp.asarray(self._tokens), jnp.asarray(self._positions),
+            jnp.asarray(self._active), jnp.asarray(self._page_tables),
+            jnp.asarray(seeds))
+        next_tokens = np.asarray(next_tokens)
+        now = self._time()
+        self.stats["decode_steps"] += 1
+        for i in range(B):
+            if not self._active[i]:
+                continue
+            s = self._slots[i]
+            tok = int(next_tokens[i])
+            s.generated.append(tok)
+            s.token_times.append(now)
+            self._tokens[i] = tok
+            self._positions[i] += 1
+            if (len(s.generated) >= s.request.max_new_tokens
+                    or (s.request.eos_id is not None
+                        and tok == s.request.eos_id)):
+                self._evict(i)
+        return True
+
+    def run_until_drained(self, max_steps: int = 10_000) -> List[Completion]:
+        """Drive ``step()`` until queue and slots are empty (the
+        test/driver convenience loop)."""
+        for _ in range(max_steps):
+            if self.idle():
+                return self.completed
+            self.step()
+        raise RuntimeError(
+            f"serve loop not drained after {max_steps} steps "
+            f"(queue={len(self.queue)}, active={self.num_active})")

@@ -237,7 +237,20 @@ def _add_pos_embed(x, params, config: GPTConfig, cp_axis):
     return x + pos[:, None, :]
 
 
-def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
+def _col_proj(x, w, b, axis_name, sp=False):
+    """Column-parallel projection, dense when ``axis_name`` is None —
+    the ONE dispatch both the training attention block and the decode
+    twin (:func:`forward_decode`) use, so the dense/tp seam cannot
+    drift between them."""
+    if axis_name is None:
+        return jnp.matmul(x, w.T.astype(x.dtype)) + b.astype(x.dtype)
+    return column_parallel_linear(
+        x, w, b, gather_output=False, sequence_parallel_enabled=sp,
+        axis_name=axis_name)
+
+
+def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None,
+               collect_kv=False):
     """Self attention with column-parallel QKV and row-parallel output
     proj (reference standalone_transformer_lm.py ParallelAttention).
     The core is selectable: fused-softmax einsum (default), flash
@@ -259,11 +272,7 @@ def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
     sp = config.sequence_parallel and axis_name is not None
 
     def col(x_, w, b):
-        if axis_name is None:
-            return jnp.matmul(x_, w.T.astype(x_.dtype)) + b.astype(x_.dtype)
-        return column_parallel_linear(
-            x_, w, b, gather_output=False, sequence_parallel_enabled=sp, axis_name=axis_name
-        )
+        return _col_proj(x_, w, b, axis_name, sp=sp)
 
     q = col(x, p["wq"], p["bq"])
     k = col(x, p["wk"], p["bk"])
@@ -283,6 +292,10 @@ def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
         positions = start + jnp.arange(S)
         q = apply_rope(q, positions, config.rope_theta)
         k = apply_rope(k, positions, config.rope_theta)
+    # the prefill path captures each layer's post-RoPE k/v (B, kv, S, hd)
+    # BEFORE any head repeat, so the paged cache stores the group-shared
+    # GQA heads exactly as the decode kernels expect them
+    kv_out = (k, v) if collect_kv else None
     if cp_axis is not None:
         from apex_tpu.ops.attention import repeat_kv_heads
         from apex_tpu.transformer.context_parallel import ring_attention
@@ -304,11 +317,13 @@ def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None):
     ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, n_local_heads * hd)
 
     if axis_name is None:
-        return jnp.matmul(ctx, p["wo"].T.astype(ctx.dtype)) + p["bo"].astype(ctx.dtype)
-    return row_parallel_linear(
-        ctx, p["wo"], p["bo"], input_is_parallel=True,
-        sequence_parallel_enabled=sp, axis_name=axis_name,
-    )
+        out = jnp.matmul(ctx, p["wo"].T.astype(ctx.dtype)) + p["bo"].astype(ctx.dtype)
+    else:
+        out = row_parallel_linear(
+            ctx, p["wo"], p["bo"], input_is_parallel=True,
+            sequence_parallel_enabled=sp, axis_name=axis_name,
+        )
+    return (out, kv_out) if collect_kv else out
 
 
 def _mlp(x, p, config: GPTConfig, axis_name):
@@ -341,11 +356,19 @@ def _moe_mlp(x, p, config: GPTConfig, ep_axis):
     return out, aux
 
 
-def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None, ep_axis=None):
-    """Returns (x, aux) — aux is the MoE load-balancing loss (0 when dense)."""
+def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None,
+           ep_axis=None, collect_kv=False):
+    """Returns (x, aux) — aux is the MoE load-balancing loss (0 when
+    dense).  With ``collect_kv`` the aux slot becomes ``(aux, k, v)``
+    with the layer's post-RoPE keys/values (the prefill capture)."""
     H = config.hidden_size
     ln1 = fused_layer_norm_affine(x, p["ln1_scale"], p["ln1_bias"], (H,), config.layernorm_eps)
-    x = x + _attention(ln1.astype(config.compute_dtype), p, config, axis_name, n_local_heads, cp_axis)
+    attn = _attention(ln1.astype(config.compute_dtype), p, config, axis_name,
+                      n_local_heads, cp_axis, collect_kv=collect_kv)
+    kv = None
+    if collect_kv:
+        attn, kv = attn
+    x = x + attn
     ln2 = fused_layer_norm_affine(x, p["ln2_scale"], p["ln2_bias"], (H,), config.layernorm_eps)
     if config.moe:
         h, aux = _moe_mlp(ln2.astype(config.compute_dtype), p, config, ep_axis)
@@ -353,6 +376,8 @@ def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None, ep_a
         h = _mlp(ln2.astype(config.compute_dtype), p, config, axis_name)
         aux = jnp.float32(0.0)
     x = x + h
+    if collect_kv:
+        return x, (aux, kv[0], kv[1])
     return x, aux
 
 
@@ -360,6 +385,7 @@ def gpt_forward(
     params, tokens, config: GPTConfig, axis_name: Optional[str] = None,
     cp_axis: Optional[str] = None, ep_axis: Optional[str] = None,
     return_aux: bool = False, return_hidden: bool = False,
+    return_kv: bool = False,
 ):
     """tokens (B, S) → logits.
 
@@ -371,6 +397,10 @@ def gpt_forward(
     With MoE (``config.moe_num_experts > 0``), ``ep_axis`` shards the
     experts (EP rides DP); ``return_aux=True`` additionally returns the
     summed load-balancing loss.
+    With ``return_kv=True`` a trailing ``(k, v)`` pair is appended —
+    each ``(L, B, kv_heads_local, S, head_dim)``, every layer's
+    post-RoPE keys/values — the prefill capture the paged-KV serving
+    path (:mod:`apex_tpu.inference`) writes into its page pool.
     """
     if cp_axis is not None and config.sequence_parallel:
         raise ValueError("sequence_parallel (tp) and context parallelism both shard "
@@ -398,14 +428,23 @@ def gpt_forward(
 
     layer = partial(
         _layer, config=config, axis_name=axis_name, n_local_heads=n_local_heads,
-        cp_axis=cp_axis, ep_axis=ep_axis,
+        cp_axis=cp_axis, ep_axis=ep_axis, collect_kv=return_kv,
     )
     if config.checkpoint_layers:
         layer = remat_layer(layer, config.remat_policy)
 
     # _layer's (carry, lp) -> (x, aux) is exactly the scan contract
-    x, aux_per_layer = jax.lax.scan(layer, x, params["layers"])
+    x, ys = jax.lax.scan(layer, x, params["layers"])
+    if return_kv:
+        aux_per_layer, kv_k, kv_v = ys
+        kv = (kv_k, kv_v)
+    else:
+        aux_per_layer, kv = ys, None
     aux = jnp.sum(aux_per_layer)
+
+    def _out(*vals):
+        return vals + (kv,) if return_kv else (
+            vals if len(vals) > 1 else vals[0])
 
     if config.sequence_parallel and axis_name is not None:
         from apex_tpu.transformer.tensor_parallel.mappings import (
@@ -433,11 +472,11 @@ def gpt_forward(
         # pre-head activations for the chunked fused CE (fused_ce.py);
         # the copy-to-region above already carries the head's dx
         # all-reduce, so the fused op's local dx composes unchanged
-        return (x, aux) if return_aux else x  # (S, B, H)
+        return _out(x, aux) if return_aux else _out(x)  # (S, B, H)
     logits = jnp.matmul(x.astype(jnp.float32), params["embed"].T.astype(jnp.float32))
     if return_aux:
-        return logits, aux  # (S, B, V_local), scalar
-    return logits  # (S, B, V_local)
+        return _out(logits, aux)  # (S, B, V_local), scalar
+    return _out(logits)  # (S, B, V_local)
 
 
 def lm_head_loss(x, embed, targets, config: GPTConfig,
@@ -465,6 +504,120 @@ def lm_head_loss(x, embed, targets, config: GPTConfig,
         tgt = jnp.take_along_axis(logits, t_cl[..., None], axis=-1)[..., 0]
         return lse - tgt
     return vocab_parallel_cross_entropy(logits, targets, 0.0, axis_name)
+
+
+def forward_decode(params, tokens, positions, active, kv_pools, page_tables,
+                   config: GPTConfig, axis_name: Optional[str] = None,
+                   attn_impl: str = "auto"):
+    """Single-token decode forward over the paged KV cache.
+
+    The serving-side twin of :func:`gpt_forward`: same weights, same
+    block expression (the LN/projection/MLP helpers are shared, run at
+    sequence length 1), but attention is single-query over the page
+    pool (:func:`apex_tpu.ops.decode_attention_pallas.decode_attention`)
+    and each layer first scatters the current token's post-RoPE k/v
+    into its pages.  Every shape is static — batch is the slot count,
+    the page-table block is (B, pages_per_seq) — so the jitted step
+    compiles ONCE and is reused across all cache lengths and batch
+    occupancies (inactive slots are masked, their writes land on the
+    reserved garbage page).
+
+    ``tokens``/``positions``/``active``: (B,) current token ids, their
+    0-based positions, and the slot-live mask.  ``kv_pools``: the
+    ``{"k", "v"}`` pools from :func:`apex_tpu.inference.kv_cache
+    .alloc_pools` (kv heads LOCAL under tp).  ``page_tables``: (B, P)
+    int32.  With ``axis_name`` the projections run column/row-parallel
+    inside shard_map exactly as in training (kv heads shard over tp,
+    so each rank's pool carries its local heads).
+
+    Returns ``(hidden, new_pools)`` — hidden (B, H) is the pre-head
+    activation (post final-LN, post copy-to-region under tp), the same
+    contract as ``gpt_forward(return_hidden=True)``; the caller owns
+    the head (fused sampling for serving, the fp32 logits matmul for
+    the parity band).
+    """
+    from apex_tpu.inference.kv_cache import write_decode_kv
+    from apex_tpu.ops.decode_attention_pallas import decode_attention
+
+    if config.moe:
+        raise NotImplementedError(
+            "MoE decode is not wired (expert routing at batch 1 needs "
+            "its own capacity plan); see ROADMAP follow-ons")
+    if config.sequence_parallel:
+        raise ValueError(
+            "sequence_parallel shards the sequence axis; a decode step "
+            "is one token — build the decode config without it")
+    B = tokens.shape[0]
+    H = config.hidden_size
+    hd = config.head_dim
+    tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    if config.kv_heads % tp != 0:
+        raise ValueError(
+            f"num_query_groups ({config.kv_heads}) must be divisible by "
+            f"the tensor-parallel size ({tp}): kv heads (and the KV page "
+            "pools) shard over tp")
+    n_local_heads = config.num_attention_heads // tp
+    n_local_kv = config.kv_heads // tp
+    positions = positions.astype(jnp.int32)
+    lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+
+    if axis_name is None:
+        emb = jnp.take(params["embed"], tokens, axis=0)  # (B, H)
+    else:
+        emb = vocab_parallel_embedding(
+            tokens[:, None], params["embed"], axis_name=axis_name)[:, 0]
+    x = emb[None]  # (1, B, H) — the (S, B, H) layout at S = 1
+    if config.position_embedding_type == "learned":
+        pos = jnp.take(params["pos_embed"],
+                       jnp.clip(positions, 0, config.max_seq_len - 1), axis=0)
+        x = x + pos[None]
+    x = x.astype(config.compute_dtype)
+
+    def layer(x, inp):
+        p, k_pool, v_pool = inp
+        ln1 = fused_layer_norm_affine(
+            x, p["ln1_scale"], p["ln1_bias"], (H,), config.layernorm_eps)
+        h = ln1.astype(config.compute_dtype)
+        col = lambda w, b: _col_proj(h, w, b, axis_name)  # noqa: E731
+        q = col(p["wq"], p["bq"])[0].reshape(B, n_local_heads, hd)
+        k = col(p["wk"], p["bk"])[0].reshape(B, n_local_kv, hd)
+        v = col(p["wv"], p["bv"])[0].reshape(B, n_local_kv, hd)
+        if config.position_embedding_type == "rope":
+            from apex_tpu.ops.rope import apply_rope_at
+
+            q = apply_rope_at(q, positions, config.rope_theta)
+            k = apply_rope_at(k, positions, config.rope_theta)
+        k_pool, v_pool = write_decode_kv(
+            k_pool, v_pool, k, v, page_tables, positions, active)
+        ctx = decode_attention(q, k_pool, v_pool, page_tables, lengths,
+                               impl=attn_impl)
+        ctx = ctx.astype(config.compute_dtype).reshape(
+            1, B, n_local_heads * hd)
+        if axis_name is None:
+            attn = jnp.matmul(ctx, p["wo"].T.astype(ctx.dtype)) \
+                + p["bo"].astype(ctx.dtype)
+        else:
+            attn = row_parallel_linear(
+                ctx, p["wo"], p["bo"], input_is_parallel=True,
+                sequence_parallel_enabled=False, axis_name=axis_name)
+        x = x + attn
+        ln2 = fused_layer_norm_affine(
+            x, p["ln2_scale"], p["ln2_bias"], (H,), config.layernorm_eps)
+        x = x + _mlp(ln2.astype(config.compute_dtype), p, config, axis_name)
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv_pools["k"], kv_pools["v"]))
+    x = fused_layer_norm_affine(
+        x, params["final_ln_scale"], params["final_ln_bias"], (H,),
+        config.layernorm_eps)
+    if axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            copy_to_tensor_model_parallel_region,
+        )
+
+        x = copy_to_tensor_model_parallel_region(x, axis_name)
+    return x[0], {"k": new_k, "v": new_v}
 
 
 def sp_grad_sync(grads, axis_name: str):

@@ -1,0 +1,268 @@
+"""Pallas TPU paged single-query decode attention.
+
+The decode-side sibling of :mod:`apex_tpu.ops.flash_attention_pallas`:
+one generated token per sequence attends over that sequence's KV cache,
+which lives as fixed-size *pages* scattered through a preallocated pool
+(:mod:`apex_tpu.inference.kv_cache`).  Small-batch decode is dominated
+by the softmax reductions and per-op launch overheads around a tiny
+matmul (PAPERS.md: "LLM Inference Acceleration via Efficient Operation
+Fusion", arxiv 2502.17728), so the whole per-head attention — page
+gather, scores, online softmax, weighted sum — runs as ONE kernel:
+
+- grid ``(batch, kv_heads, pages_per_seq)``, pages sequential;
+- the page table rides as a **scalar-prefetch** operand
+  (``pltpu.PrefetchScalarGridSpec``), so each k/v BlockSpec index map
+  dereferences ``page_table[b, p]`` and the DMA fetches exactly that
+  page out of the pool — the gathered (B, S_max, H_kv, D) key tensor
+  the XLA reference materializes in HBM never exists here;
+- grouped-query attention reads the group-shared kv page ONCE per kv
+  head and scores all ``H // H_kv`` q heads of the group against it
+  (no ``repeat_kv_heads`` materialization, same as the flash kernels);
+- the per-sequence length masks both granularities: whole pages past
+  the length are skipped via ``pl.when`` (no wasted MXU work on a
+  fresh sequence in a long-cache-shaped step), and the tail page is
+  masked per position.
+
+The XLA reference :func:`decode_attention_xla` is the numerics
+specification: it mirrors the TRAINING attention expression
+(scores / sqrt(D), ``-10000.0`` mask fill, fp32 softmax — the
+``scaled_upper_triang_masked_softmax`` semantics) exactly, so
+token-by-token decode logits can be pinned against the full-sequence
+training forward bitwise in fp32 (tests/test_inference.py).  Kernel
+failures degrade to it once through
+:mod:`apex_tpu.resilience.fallback` ("decode_attention").
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._pallas_tiling import LANES as _LANES
+from apex_tpu.transformer.functional.fused_softmax import MASK_FILL_VALUE
+
+NEG_INF = -1e30
+
+_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+# ---------------------------------------------------------------- reference
+def decode_attention_xla(q, k_pool, v_pool, page_table, lengths,
+                         softmax_scale=None):
+    """Single-query attention over a paged KV cache, in XLA.
+
+    ``q``: (B, H, D) — one query per sequence (the current token's
+    heads).  ``k_pool``/``v_pool``: (num_pages, page_size, H_kv, D)
+    one layer's page pool.  ``page_table``: (B, P) int32 page ids,
+    CLAMPED into the pool before the gather (a stale/garbage entry
+    reads the reserved garbage page instead of wrapping).  ``lengths``:
+    (B,) int32 valid cache positions per sequence (0 = inactive slot —
+    every position masks out and the output row is 0).
+
+    Returns (B, H, D) in ``v_pool``'s dtype.  The expression mirrors
+    the training attention row-for-row (division by sqrt(D), -1e4 mask
+    fill, fp32 softmax, probs cast to v's dtype before the weighted
+    sum) so decode logits can be compared bitwise against the training
+    forward in fp32.
+    """
+    B, H, D = q.shape
+    num_pages, page_size, h_kv, _ = k_pool.shape
+    P = page_table.shape[1]
+    group = H // h_kv
+    pt = jnp.clip(page_table, 0, num_pages - 1)
+    # (B, P, page, H_kv, D) -> (B, H_kv, S_max, D)
+    k = k_pool[pt].reshape(B, P * page_size, h_kv, D).transpose(0, 2, 1, 3)
+    v = v_pool[pt].reshape(B, P * page_size, h_kv, D).transpose(0, 2, 1, 3)
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    # the storage dtype may be narrower than the scores' f32: widen the
+    # cache reads explicitly at the seam (the APX306 contract)
+    kf = k.astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    if softmax_scale is None:
+        scores = jnp.einsum("bhd,bhtd->bht", qf, kf) / np.sqrt(D)
+    else:
+        scores = jnp.einsum("bhd,bhtd->bht", qf, kf) * softmax_scale
+    t = jnp.arange(P * page_size, dtype=jnp.int32)
+    valid = t[None, None, :] < lengths[:, None, None]
+    scores = jnp.where(valid, scores, MASK_FILL_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bht,bhtd->bhd", probs.astype(v.dtype), v)
+    # an ALL-masked row (inactive slot, length 0) softmaxes to a
+    # uniform distribution over garbage pages; pin it to the kernel's
+    # semantic (zero output).  Active rows always have >= 1 valid
+    # position, so the training-parity expression above is untouched.
+    return jnp.where(lengths[:, None, None] > 0, ctx,
+                     jnp.zeros_like(ctx))
+
+
+# ------------------------------------------------------------------ kernel
+def _decode_attn_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_ref, l_ref, acc_ref, *,
+                        page_size, pages_per_seq, denom, scale):
+    """One (sequence, kv-head) pair; the sequential grid dim walks that
+    sequence's pages through VMEM.  Online softmax exactly as the flash
+    forward: running max/sum/accumulator in f32 scratch, finalize on
+    the last page."""
+    b, p = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b]
+
+    # whole pages at/after the length hold no valid position: skip the
+    # dots entirely (a freshly-admitted sequence costs page-1 work even
+    # when the step shape is sized for the longest resident cache)
+    @pl.when(p * page_size < length)
+    def _compute():
+        q = q_ref[0, 0]          # (group, D)
+        k = k_ref[0, :, 0, :]    # (page, D) — group-shared GQA page
+        v = v_ref[0, :, 0, :]
+        if k.dtype != q.dtype:
+            # bf16 (or narrower) cache with an f32 query: widen the
+            # cache read rather than rounding q down (APX306)
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = s / denom if scale is None else s * scale
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(s > NEG_INF / 2, pexp, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(pexp, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)  # inactive rows: l == 0
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(q, k_pool, v_pool, page_table, lengths,
+                                  softmax_scale=None, interpret=False):
+    """The Pallas paged decode-attention launcher (see module doc).
+
+    Shapes as :func:`decode_attention_xla`.  The flattened page table
+    and the lengths ride as scalar-prefetch operands so the k/v
+    BlockSpec index maps can dereference them — each grid step DMAs
+    exactly one (page_size, D) page of the group-shared kv head out of
+    the pool.
+    """
+    B, H, D = q.shape
+    num_pages, page_size, h_kv, _ = k_pool.shape
+    P = page_table.shape[1]
+    if H % h_kv != 0:
+        raise ValueError(f"q heads ({H}) not divisible by kv heads ({h_kv})")
+    group = H // h_kv
+    qg = q.reshape(B, h_kv, group, D)
+    # clamp BEFORE prefetch: the index map output becomes a DMA source
+    # address, where a garbage entry must hit the reserved garbage page,
+    # never wrap (APX107's contract for page-table gathers)
+    pt = jnp.clip(page_table, 0, num_pages - 1).reshape(B * P).astype(jnp.int32)
+
+    kv_spec = pl.BlockSpec(
+        (1, page_size, 1, D),
+        lambda b, g, p, pt_ref, len_ref: (pt_ref[b * P + p], 0, g, 0),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, h_kv, P),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, D),
+                         lambda b, g, p, pt_ref, len_ref: (b, g, 0, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, D),
+                               lambda b, g, p, pt_ref, len_ref: (b, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_attn_kernel, page_size=page_size, pages_per_seq=P,
+            denom=float(np.sqrt(D)), scale=softmax_scale,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, h_kv, group, D), v_pool.dtype),
+        compiler_params=_DIM_SEMANTICS,
+        interpret=interpret,
+    )(pt, lengths.astype(jnp.int32), qg, k_pool, v_pool)
+    return out.reshape(B, H, D)
+
+
+# ---------------------------------------------------------------- dispatch
+def pallas_decode_attn_available(q, k_pool) -> bool:
+    """Kernel path: real TPU, MXU-friendly head dim, sublane-aligned
+    pages.  (No env-var override — thread ``attn_impl`` through
+    :class:`apex_tpu.inference.DecodeConfig` instead; APX101/102.)"""
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+    return (on_tpu and q.shape[-1] % 8 == 0 and k_pool.shape[1] % 8 == 0
+            and q.dtype in (jnp.float32, jnp.bfloat16))
+
+
+def decode_attention(q, k_pool, v_pool, page_table, lengths,
+                     impl="auto", softmax_scale=None):
+    """Paged single-query decode attention — the ONE dispatch between
+    the Pallas kernel and the XLA reference.
+
+    ``impl``: "auto" (kernel on TPU, reference elsewhere), "pallas"
+    (force the kernel, fail loudly), "interpret" (kernel via the Pallas
+    interpreter — the CPU test path), or "xla".  Chosen (non-forced)
+    kernel use routes through the resilience fallback registry
+    ("decode_attention"): the first Mosaic/launch failure degrades this
+    process to the reference once, with one structured warning, instead
+    of killing the serve loop (:mod:`apex_tpu.resilience.fallback`).
+    """
+    if impl not in ("auto", "pallas", "interpret", "xla"):
+        raise ValueError(
+            f"impl must be 'auto', 'pallas', 'interpret', or 'xla'; "
+            f"got {impl!r}")
+
+    def xla_impl():
+        return decode_attention_xla(q, k_pool, v_pool, page_table, lengths,
+                                    softmax_scale=softmax_scale)
+
+    if impl == "xla":
+        return xla_impl()
+    forced = impl in ("pallas", "interpret")
+    if not forced and not pallas_decode_attn_available(q, k_pool):
+        return xla_impl()
+
+    def kernel_impl():
+        return paged_decode_attention_pallas(
+            q, k_pool, v_pool, page_table, lengths,
+            softmax_scale=softmax_scale, interpret=(impl == "interpret"))
+
+    from apex_tpu.resilience.fallback import get_registry, registry_engaged
+
+    if registry_engaged(forced=forced):
+        return get_registry().call("decode_attention", kernel_impl, xla_impl)
+    return kernel_impl()

@@ -1,0 +1,272 @@
+"""Pallas TPU fused sampling head: hidden → sampled token, no HBM logits.
+
+The decode-side sibling of :mod:`apex_tpu.ops.fused_ce_pallas`, and the
+second fusion the operation-fusion paper calls out for small-batch
+decode (arxiv 2502.17728): the LM head matmul, temperature, top-k
+restriction, and the categorical draw collapse into ONE kernel over
+vocab tiles — the (B, V) fp32 logits (200 KB/row at 50k vocab) are
+never written to HBM, let alone the softmax over them.
+
+Sampling is the **Gumbel-max trick**: ``argmax_v(logits_v / T + g_v)``
+with ``g_v`` i.i.d. standard Gumbel draws a token from exactly
+``softmax(logits / T)`` — an online argmax reduction, which streams
+over vocab tiles the way the fused-CE forward streams its logsumexp.
+The Gumbel noise comes from a **counter-based hash** of (per-row seed,
+vocab column) — pure uint32 vector math, identical in the kernel and
+the XLA reference, so the two implementations draw the SAME token for
+the same seed (bitwise parity is testable, unlike a kernel-side PRNG).
+
+Top-k runs as a first sweep over the same tiles: a per-row running
+top-K scratch (K <= 128, one lane row) is merged with each tile by a
+K-step select-extract loop; the k-th largest (the min of the scratch)
+then thresholds the sampling sweep.  The grid is
+``(row_tiles, sweeps * vocab_tiles)`` with the vocab dimension
+sequential, so the whole head is still one kernel launch.
+
+The XLA reference :func:`fused_sample_xla` materializes the logits and
+is the numerics specification; kernel failures degrade to it once via
+:mod:`apex_tpu.resilience.fallback` ("decode_sampling").
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._pallas_tiling import LANES as _LANES
+from apex_tpu.ops._pallas_tiling import sublane as _sublane
+from apex_tpu.ops.fused_ce_pallas import (
+    NEG_INF, _ceil_block, _grid, _masked_scores,
+)
+
+#: the kernel's running top-K scratch is one (sublane, lane) tile row
+#: per sequence row — K beyond the 128-lane tile would need a second
+#: lane row and a cross-lane merge; the dispatch falls back to XLA
+MAX_KERNEL_TOP_K = 128
+
+
+# ------------------------------------------------------------ shared noise
+def _hash_u32(z):
+    """Counter-based uint32 mix (splitmix-style avalanche).  Pure
+    vector integer ops so the kernel and the XLA reference compute the
+    IDENTICAL stream — the property the sampling parity tests pin."""
+    z = z * jnp.uint32(2654435761)
+    z = z ^ (z >> 16)
+    z = z * jnp.uint32(0x45D9F3B)
+    z = z ^ (z >> 16)
+    z = z * jnp.uint32(0x45D9F3B)
+    z = z ^ (z >> 16)
+    return z
+
+
+def gumbel_from_seed(seeds, cols):
+    """Standard Gumbel noise for (row seed, vocab column) pairs.
+
+    ``seeds`` uint32 broadcastable against int32 ``cols``; the uniform
+    is built from the hash's top 24 bits at odd half-steps
+    (``(bits + 0.5) / 2^24``), so it lives in the OPEN interval (0, 1)
+    and the double log never hits an infinity."""
+    z = _hash_u32(seeds.astype(jnp.uint32)
+                  ^ (cols.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)))
+    u = ((z >> 8).astype(jnp.float32) + 0.5) * jnp.float32(1.0 / (1 << 24))
+    return -jnp.log(-jnp.log(u))
+
+
+# ---------------------------------------------------------------- reference
+def fused_sample_xla(x2, embed, seeds, temperature=1.0, top_k=0):
+    """Sample one token per row from the tied LM head, in XLA.
+
+    ``x2`` (N, H) pre-head activations; ``embed`` (V, H); ``seeds``
+    (N,) uint32.  ``temperature == 0`` is greedy argmax; ``top_k > 0``
+    restricts the draw to the k largest logits (ties at the k-th value
+    are INCLUDED — the same ``>=`` semantics as the kernel's threshold).
+    Returns (N,) int32 token ids.  Materializes the (N, V) fp32 logits
+    — this is the specification and the degrade target, not the fast
+    path."""
+    logits = jnp.matmul(x2.astype(jnp.float32),
+                        embed.T.astype(jnp.float32))
+    N, V = logits.shape
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    cand = logits / jnp.float32(temperature)
+    cols = jnp.arange(V, dtype=jnp.int32)
+    cand = cand + gumbel_from_seed(seeds[:, None], cols[None, :])
+    if top_k and top_k < V:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        cand = jnp.where(logits >= kth, cand, NEG_INF)
+    return jnp.argmax(cand, axis=-1).astype(jnp.int32)
+
+
+# ------------------------------------------------------------------ kernel
+def _merge_top_k(running, s, k):
+    """Merge one tile's scores into the running per-row top-K values:
+    K iterations of (argmax, extract, mask-one) over the concatenated
+    candidates — no sort primitive, so Mosaic only needs max/argmax.
+    ``running``/result: (bn, LANES) f32 with columns >= k at -inf."""
+    cur = jnp.concatenate([running, s], axis=1)
+    out0 = jnp.full_like(running, NEG_INF)
+
+    def body(i, carry):
+        cur, out = carry
+        m = jnp.max(cur, axis=1, keepdims=True)
+        am = jnp.argmax(cur, axis=1)
+        oh = (jax.lax.broadcasted_iota(jnp.int32, cur.shape, 1)
+              == am[:, None])
+        out = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, out.shape, 1) == i, m, out)
+        return jnp.where(oh, NEG_INF, cur), out
+
+    _, out = jax.lax.fori_loop(0, k, body, (cur, out0))
+    return out
+
+
+def _sample_kernel(x_ref, e_ref, seed_ref, tok_out,
+                   topk_ref, best_v, best_i, *,
+                   bv, nv, V, dot_dtype, temperature, top_k, sweeps):
+    j = pl.program_id(1)
+    jj = j % nv
+
+    @pl.when(j == 0)
+    def _init():
+        topk_ref[:] = jnp.full_like(topk_ref, NEG_INF)
+        best_v[:] = jnp.full_like(best_v, NEG_INF)
+        best_i[:] = jnp.zeros_like(best_i)
+
+    s, cols, valid, _ = _masked_scores(x_ref, e_ref, jj, bv, V, dot_dtype)
+
+    if sweeps == 2:
+        @pl.when(j < nv)
+        def _threshold_sweep():
+            topk_ref[:] = _merge_top_k(topk_ref[:], s, top_k)
+
+    @pl.when(j >= (nv if sweeps == 2 else 0))
+    def _sample_sweep():
+        elig = valid
+        if sweeps == 2:
+            lane = jax.lax.broadcasted_iota(jnp.int32, topk_ref.shape, 1)
+            tau = jnp.min(jnp.where(lane < top_k, topk_ref[:], jnp.inf),
+                          axis=1, keepdims=True)
+            elig = elig & (s >= tau)
+        if temperature > 0.0:
+            gcols = jj * bv + cols
+            g = gumbel_from_seed(seed_ref[:, 0:1].astype(jnp.uint32), gcols)
+            cand = s / jnp.float32(temperature) + g
+        else:
+            cand = s
+        cand = jnp.where(elig, cand, NEG_INF)
+        m = jnp.max(cand, axis=1, keepdims=True)
+        idx = (jnp.argmax(cand, axis=1).astype(jnp.int32)
+               + jj * bv)[:, None]
+        # strict > : on an exact cross-tile tie the EARLIER tile wins,
+        # matching jnp.argmax's first-hit semantics in the reference
+        better = m > best_v[:, 0:1]
+        best_i[:] = jnp.broadcast_to(
+            jnp.where(better, idx, best_i[:, 0:1]), best_i.shape)
+        best_v[:] = jnp.broadcast_to(
+            jnp.where(better, m, best_v[:, 0:1]), best_v.shape)
+
+    @pl.when(j == sweeps * nv - 1)
+    def _finalize():
+        tok_out[:] = best_i[:, 0:1]
+
+
+def fused_sample_pallas(x2, embed, seeds, temperature=1.0, top_k=0,
+                        dot_dtype=None, block_n=256, block_v=512,
+                        interpret=False):
+    """The fused sampling-head launcher (see module doc).  Shapes and
+    semantics as :func:`fused_sample_xla`; ``dot_dtype`` as in the
+    fused-CE kernels (bf16 MXU dots with f32 accumulation by default,
+    f32 for exact-parity tests)."""
+    from apex_tpu.ops.fused_ce_pallas import _default_dot_dtype
+
+    dot_dtype = dot_dtype or _default_dot_dtype()
+    N, H = x2.shape
+    V = embed.shape[0]
+    greedy = temperature <= 0.0
+    sweeps = 2 if (top_k and top_k < V and not greedy) else 1
+    if sweeps == 2 and top_k > MAX_KERNEL_TOP_K:
+        raise ValueError(
+            f"the kernel's running top-k scratch holds one lane tile "
+            f"({MAX_KERNEL_TOP_K}); top_k={top_k} must take the XLA path")
+    bn = _ceil_block(N, block_n, align=_sublane(x2.dtype))
+    bv = _ceil_block(V, block_v, align=_LANES)
+    nn, nv = _grid(N, bn), _grid(V, bv)
+
+    tok = pl.pallas_call(
+        functools.partial(
+            _sample_kernel, bv=bv, nv=nv, V=V, dot_dtype=dot_dtype,
+            temperature=float(temperature),
+            top_k=int(top_k) if sweeps == 2 else 0, sweeps=sweeps,
+        ),
+        grid=(nn, sweeps * nv),
+        in_specs=[
+            pl.BlockSpec((bn, H), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            # the sampling sweep revisits the vocab tiles: j % nv maps
+            # both sweeps onto the same embed block sequence
+            pl.BlockSpec((bv, H), lambda i, j: (j % nv, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, 1), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N, 1), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((bn, _LANES), jnp.float32),
+            pltpu.VMEM((bn, _LANES), jnp.float32),
+            pltpu.VMEM((bn, _LANES), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x2, embed, seeds.reshape(N, 1).astype(jnp.uint32))
+    return tok[:, 0]
+
+
+# ---------------------------------------------------------------- dispatch
+def pallas_sample_available(x2, embed, top_k) -> bool:
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+    return (on_tpu and (not top_k or top_k <= MAX_KERNEL_TOP_K)
+            and x2.dtype in (jnp.float32, jnp.bfloat16))
+
+
+def fused_sample(x2, embed, seeds, temperature=1.0, top_k=0,
+                 impl="auto", dot_dtype=None):
+    """hidden (N, H) → sampled token ids (N,): the ONE dispatch between
+    the fused Pallas sampling head and the materialize-then-sample XLA
+    reference.  ``impl`` as in
+    :func:`apex_tpu.ops.decode_attention_pallas.decode_attention`;
+    chosen kernel use degrades once through the fallback registry
+    ("decode_sampling")."""
+    if impl not in ("auto", "pallas", "interpret", "xla"):
+        raise ValueError(
+            f"impl must be 'auto', 'pallas', 'interpret', or 'xla'; "
+            f"got {impl!r}")
+
+    def xla_impl():
+        return fused_sample_xla(x2, embed, seeds, temperature=temperature,
+                                top_k=top_k)
+
+    if impl == "xla":
+        return xla_impl()
+    forced = impl in ("pallas", "interpret")
+    if not forced and not pallas_sample_available(x2, embed, top_k):
+        return xla_impl()
+
+    def kernel_impl():
+        return fused_sample_pallas(
+            x2, embed, seeds, temperature=temperature, top_k=top_k,
+            dot_dtype=dot_dtype, interpret=(impl == "interpret"))
+
+    from apex_tpu.resilience.fallback import get_registry, registry_engaged
+
+    if registry_engaged(forced=forced):
+        return get_registry().call("decode_sampling", kernel_impl, xla_impl)
+    return kernel_impl()

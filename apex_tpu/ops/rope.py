@@ -64,6 +64,19 @@ def rope_angles(positions, head_dim: int, theta: float = 10000.0):
     return jnp.mod(ang, two_pi)
 
 
+def apply_rope_at(x, positions, theta: float = 10000.0):
+    """Rotate per-SEQUENCE single-token heads: ``x`` (B, nh, D) with one
+    position per batch row (``positions`` (B,)) — the decode-step shape,
+    where every sequence sits at its own depth.  Implemented BY
+    :func:`apply_rope` (the batch rows become its sequence axis), so a
+    token decoded at position ``p`` carries bitwise the same q/k as the
+    training forward computed for row ``p`` — by construction, not by
+    keeping two copies of the rotation in sync."""
+    # (B, nh, D) -> (nh, B, D): apply_rope rotates axis -2 by positions
+    return apply_rope(x.transpose(1, 0, 2), positions,
+                      theta).transpose(1, 0, 2)
+
+
 def apply_rope(x, positions, theta: float = 10000.0):
     """Rotate ``x`` (..., S, D) by its positions (S,).
 

@@ -91,6 +91,11 @@ KERNELS: Dict[str, tuple] = {
                  "_dembed_kernel"),
     "layer_norm": ("layer_norm_pallas", "_ln_fwd_kernel",
                    "_ln_bwd_kernel"),
+    "decode_attention": ("decode_attention_pallas",
+                         "paged_decode_attention_pallas",
+                         "_decode_attn_kernel"),
+    "decode_sampling": ("decode_sampling_pallas", "fused_sample_pallas",
+                        "_sample_kernel", "_merge_top_k"),
 }
 
 

@@ -912,6 +912,91 @@ def _progress(msg):
 _BUDGET_SEC = float(os.environ.get("BENCH_DEADLINE_SEC", "2700"))
 _DEADLINE = time.monotonic() + _BUDGET_SEC  # re-armed in main() post-preflight
 _DEVICE_WEDGED = False
+def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
+                       vocab=50304, prompt_len=64, max_new=32,
+                       requests_per_stream=2, page_size=16,
+                       attn_impls=None, seed=0):
+    """The SERVING section: the paged-KV decode engine
+    (apex_tpu.inference) on GPT-124M — aggregate decode tokens/sec and
+    per-token latency p50/p99 at N concurrent streams, with a decode-
+    attention Pallas-vs-XLA A/B (same scheduler, same requests, only
+    ``attn_impl`` flipped).  Requests all arrive at t0 (closed-loop:
+    the numbers measure the engine, not an arrival process; the
+    example's Poisson driver measures open-loop latency).  In --smoke
+    this compiles tiny on CPU with the kernel A/B through the Pallas
+    interpreter."""
+    from apex_tpu.inference import (
+        ContinuousBatchingScheduler, DecodeConfig, KVCacheConfig, Request,
+        pages_needed,
+    )
+    from apex_tpu.models.gpt import GPTConfig, init_params
+
+    if attn_impls is None:
+        on_tpu = jax.devices()[0].platform == "tpu"
+        attn_impls = ("pallas", "xla") if on_tpu else ("xla",)
+    cfg = GPTConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads,
+        max_seq_len=max(64, prompt_len + max_new + 1),
+        position_embedding_type="rope",
+        compute_dtype=jnp.float32 if _SMOKE else jnp.bfloat16,
+        checkpoint_layers=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    pages_per = pages_needed(prompt_len + max_new, page_size)
+    out = {"model": f"L{layers} H{hidden} V{vocab}",
+           "prompt_len": prompt_len, "max_new": max_new,
+           "page_size": page_size}
+
+    def run_one(impl, n):
+        dcfg = DecodeConfig(
+            cache=KVCacheConfig(
+                num_pages=1 + n * pages_per, page_size=page_size,
+                pages_per_seq=pages_per,
+                dtype=jnp.float32 if _SMOKE else jnp.bfloat16),
+            max_batch=n, max_prompt_len=prompt_len,
+            temperature=1.0, top_k=0, attn_impl=impl,
+            sample_impl="xla" if _SMOKE else "auto", base_seed=seed)
+        sched = ContinuousBatchingScheduler(params, cfg, dcfg)
+        rng = np.random.RandomState(seed)
+        n_req = n * (1 if _SMOKE else requests_per_stream)
+        for rid in range(n_req):
+            plen = int(rng.randint(max(2, prompt_len // 2), prompt_len + 1))
+            sched.submit(Request(
+                rid=rid, prompt=rng.randint(0, vocab, size=plen).tolist(),
+                max_new_tokens=max_new))
+        t0 = time.perf_counter()
+        done = sched.run_until_drained()
+        dt = time.perf_counter() - t0
+        per_token = []
+        for c in done:
+            per_token.extend(np.diff(c.token_times))
+        n_tok = sum(len(c.tokens) for c in done)
+        rec = {"requests": n_req,
+               "tokens_per_sec": round(n_tok / max(dt, 1e-9), 2),
+               "decode_steps": sched.stats["decode_steps"],
+               "decode_compiles": sched.decode_cache_size()}
+        if per_token:
+            rec["per_token_p50_ms"] = round(
+                1e3 * float(np.percentile(per_token, 50)), 3)
+            rec["per_token_p99_ms"] = round(
+                1e3 * float(np.percentile(per_token, 99)), 3)
+        return rec
+
+    for impl in attn_impls:
+        out[impl] = {f"n{n}": run_one(impl, n) for n in streams}
+    if len(attn_impls) == 2 and not _SMOKE:
+        a, b = attn_impls
+        n_top = f"n{max(streams)}"
+        out["ab_decode_attn"] = {
+            "pair": f"{a}_vs_{b}", "at": n_top,
+            "speedup": round(
+                out[a][n_top]["tokens_per_sec"]
+                / max(out[b][n_top]["tokens_per_sec"], 1e-9), 3),
+        }
+    return out
+
+
 _SECTIONS_PATH = os.environ.get("BENCH_SECTIONS_PATH", "BENCH_sections.jsonl")
 
 
@@ -1165,6 +1250,12 @@ def _smoke_main(only=None) -> int:
         # otherwise (tests/test_bench_smoke.py runs this section alone
         # under a 2-device XLA_FLAGS to pin the reshard branch)
         "elastic_resume": lambda: bench_elastic_resume(),
+        # serving: continuous-batching decode with the paged-attention
+        # kernel A/B through the Pallas interpreter
+        "serve_gpt124": lambda: bench_serve_gpt124(
+            streams=(1, 2), layers=2, hidden=64, heads=2, vocab=512,
+            prompt_len=8, max_new=4, page_size=4,
+            attn_impls=("interpret", "xla")),
     }
     if only:
         unknown = set(only) - set(sections)
@@ -1485,6 +1576,10 @@ def main():
     elastic = (_try("elastic_resume", bench_elastic_resume,
                     section_budget=300.0)
                if want("elastic_resume") else skipped)
+    # serving: decode tokens/sec + latency percentiles at N streams,
+    # paged-attention Pallas-vs-XLA A/B (apex_tpu.inference)
+    serve = (_try("serve_gpt124", bench_serve_gpt124, section_budget=900.0)
+             if want("serve_gpt124") else skipped)
 
     headline = adam.get("speedup_vs_eager") if isinstance(adam, dict) else None
     if headline is None and only is not None and "fused_adam" not in only:
@@ -1510,6 +1605,7 @@ def main():
         "zero2_vs_fused": zero2,
         "zero_gpt124": zero_gpt,
         "elastic_resume": elastic,
+        "serve_gpt124": serve,
     }
     if not _DEVICE_WEDGED:
         try:

@@ -340,3 +340,21 @@ def test_second_sigterm_during_drain_still_exits_clean(tmp_path):
     out2 = _run(["--tp", "2", "--steps", "1", "--checkpoint", str(ck),
                  "--auto-resume"])
     assert "resumed at step" in out2
+
+
+def test_serve_gpt_smoke_contract():
+    """The serving driver's acceptance contract end-to-end:
+    ``serve_gpt.py --smoke`` must admit/evict >= 3 generations through
+    recycled pages, reproduce the training forward's greedy
+    continuation for every served token, and compile the decode step
+    exactly once (the script asserts all three; rc 0 == contract)."""
+    import json
+
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples/gpt/serve_gpt.py"), "--smoke"],
+        capture_output=True, text=True, timeout=600, env=_env(),
+    )
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["smoke"] is True and rec["decode_compiles"] == 1
+    assert rec["stats"]["evicted"] >= 3

@@ -1,0 +1,546 @@
+"""Inference-engine tests: prefill↔decode parity, the paged KV cache,
+the fused sampling head, and the continuous-batching scheduler.
+
+The parity band is the load-bearing contract: token-by-token decode
+over the paged cache must reproduce the full-sequence TRAINING forward
+(same weights, causal) — in fp32 to reduction-reorder ulps (XLA CPU
+picks different matmul microkernels for an (S, S) score block and a
+single-query row, so literally-bitwise equality across shapes does not
+exist on this backend; the single-token case, where the shapes agree,
+IS pinned bitwise), with GQA and tp=2 shard_map variants.  The
+scheduler band pins the admission/eviction/recycling semantics and the
+chaos seam (a decode-kernel failure degrades once, the server keeps
+serving the SAME tokens).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.inference import (
+    ContinuousBatchingScheduler, DecodeConfig, GARBAGE_PAGE, KVCacheConfig,
+    PageAllocator, Request, alloc_pools, pages_needed, write_decode_kv,
+    write_prompt_kv,
+)
+from apex_tpu.inference.decode import make_decode_step, make_prefill
+from apex_tpu.models.gpt import (
+    GPTConfig, forward_decode, gpt_forward, init_params, param_specs,
+)
+from apex_tpu.ops.decode_attention_pallas import (
+    decode_attention_xla, paged_decode_attention_pallas,
+)
+from apex_tpu.ops.decode_sampling_pallas import (
+    fused_sample_pallas, fused_sample_xla, gumbel_from_seed,
+)
+from apex_tpu.resilience.chaos import ChaosMonkey, ChaosPlan
+from apex_tpu.resilience.fallback import get_registry
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        vocab_size=61, hidden_size=32, num_layers=2,
+        num_attention_heads=4, max_seq_len=64,
+        position_embedding_type="rope", compute_dtype=jnp.float32,
+        checkpoint_layers=False,
+    )
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _decode_logits_tokenwise(params, cfg, tokens, prefix, kcfg, pt_row,
+                             attn_impl="xla"):
+    """Prefill ``tokens[:prefix]`` through the training forward, then
+    decode positions ``prefix..S-1`` one token at a time, returning the
+    per-position fp32 logits."""
+    S = tokens.shape[1]
+    _, kv = gpt_forward(params, tokens[:, :S], cfg, return_kv=True)
+    ks = kv[0][:, 0].transpose(0, 2, 1, 3)[:, :prefix]
+    vs = kv[1][:, 0].transpose(0, 2, 1, 3)[:, :prefix]
+    pools = alloc_pools(cfg.num_layers, cfg.kv_heads, cfg.head_dim, kcfg)
+    kp, vp = write_prompt_kv(pools["k"], pools["v"], ks, vs, pt_row,
+                             jnp.int32(prefix))
+    pools = {"k": kp, "v": vp}
+    out = []
+    for pos in range(prefix, S):
+        hidden, pools = forward_decode(
+            params, tokens[:, pos], jnp.asarray([pos], jnp.int32),
+            jnp.asarray([True]), pools, pt_row[None], cfg,
+            attn_impl=attn_impl)
+        out.append(jnp.matmul(hidden.astype(jnp.float32),
+                              params["embed"].T.astype(jnp.float32))[0])
+    return jnp.stack(out)  # (S - prefix, V)
+
+
+# ------------------------------------------------------ prefill <-> decode
+class TestDecodeParity:
+    @pytest.mark.parametrize("pet,gqa", [
+        ("learned", None), ("rope", None), ("rope", 2)])
+    def test_decode_logits_match_training_fp32(self, pet, gqa):
+        cfg = tiny_cfg(position_embedding_type=pet, num_query_groups=gqa,
+                       num_layers=3)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(1)
+        S, prefix = 12, 5
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(1, S)))
+        ref = gpt_forward(params, tokens, cfg)  # (S, 1, V)
+        kcfg = KVCacheConfig(num_pages=8, page_size=4, pages_per_seq=5,
+                             dtype=jnp.float32)
+        pt_row = jnp.asarray([1, 2, 3, 4, 5], jnp.int32)
+        dec = _decode_logits_tokenwise(params, cfg, tokens, prefix, kcfg,
+                                       pt_row)
+        np.testing.assert_allclose(
+            np.asarray(dec), np.asarray(ref[prefix:, 0]),
+            rtol=0, atol=5e-6,
+            err_msg="token-by-token decode logits diverged from the "
+                    "training forward beyond fp32 reduction-reorder ulps")
+
+    def test_first_token_decode_is_bitwise(self):
+        """At matching contraction shapes (a length-1 sequence) the
+        decode expression IS the training expression: bitwise fp32.
+        This pins that every per-op formula (LN, projections, RoPE,
+        softmax fill, head) is shared, so the general-case tolerance
+        above covers ONLY shape-dependent reduction reordering."""
+        cfg = tiny_cfg(num_layers=2)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.asarray([[7]])
+        ref = gpt_forward(params, tokens, cfg)[0, 0]
+        kcfg = KVCacheConfig(num_pages=3, page_size=1, pages_per_seq=1,
+                             dtype=jnp.float32)
+        pools = alloc_pools(cfg.num_layers, cfg.kv_heads, cfg.head_dim, kcfg)
+        hidden, _ = forward_decode(
+            params, tokens[:, 0], jnp.asarray([0], jnp.int32),
+            jnp.asarray([True]), pools, jnp.asarray([[1]], jnp.int32), cfg,
+            attn_impl="xla")
+        dec = jnp.matmul(hidden.astype(jnp.float32),
+                         params["embed"].T.astype(jnp.float32))[0]
+        assert bool(jnp.all(dec == ref)), (
+            "single-token decode is no longer bitwise against the "
+            "training forward — a shared-expression seam drifted")
+
+    def test_decode_matches_training_bf16(self):
+        """bf16 compute + bf16 KV storage: parity within bf16
+        tolerance (the cache round-trips k/v through the storage dtype
+        once; activations already round at every op)."""
+        cfg = tiny_cfg(compute_dtype=jnp.bfloat16)
+        params = init_params(cfg, jax.random.PRNGKey(2))
+        rng = np.random.RandomState(3)
+        S, prefix = 8, 3
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(1, S)))
+        ref = gpt_forward(params, tokens, cfg)
+        kcfg = KVCacheConfig(num_pages=6, page_size=4, pages_per_seq=2,
+                             dtype=jnp.bfloat16)
+        dec = _decode_logits_tokenwise(
+            params, cfg, tokens, prefix, kcfg,
+            jnp.asarray([1, 2], jnp.int32))
+        np.testing.assert_allclose(
+            np.asarray(dec), np.asarray(ref[prefix:, 0]),
+            rtol=0.05, atol=0.1)
+
+    def test_tp2_decode_matches_dense_training(self, devices8):
+        """forward_decode inside a tp=2 shard_map (column/row-parallel
+        projections, kv heads sharded over tp, vocab-parallel head)
+        matches the DENSE training forward."""
+        cfg = tiny_cfg(vocab_size=64)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(1)
+        S = 8
+        tokens = jnp.asarray(rng.randint(0, 64, size=(1, S)))
+        nxt = jnp.asarray([[5]], jnp.int32)
+        full = jnp.concatenate([tokens, nxt], axis=1)
+        ref = gpt_forward(params, full, cfg)[S, 0]
+
+        kcfg = KVCacheConfig(num_pages=6, page_size=4, pages_per_seq=3,
+                             dtype=jnp.float32)
+        mesh = Mesh(np.array(devices8[:2]).reshape(2, 1), ("tp", "dp"))
+        pool_spec = P(None, None, None, "tp", None)
+        pools = alloc_pools(cfg.num_layers, cfg.kv_heads, cfg.head_dim, kcfg)
+        pt_row = jnp.asarray([[1, 2, 3]], jnp.int32)
+
+        def local(params, kpool, vpool, toks, pos, active, pt):
+            _, kv = gpt_forward(params, toks[:, :S], cfg, axis_name="tp",
+                                return_hidden=True, return_kv=True)
+            ks = kv[0][:, 0].transpose(0, 2, 1, 3)
+            vs = kv[1][:, 0].transpose(0, 2, 1, 3)
+            kpool, vpool = write_prompt_kv(kpool, vpool, ks, vs, pt[0],
+                                           jnp.int32(S))
+            h, _ = forward_decode(params, toks[:, S], pos, active,
+                                  {"k": kpool, "v": vpool}, pt, cfg,
+                                  axis_name="tp", attn_impl="xla")
+            return jnp.matmul(h.astype(jnp.float32),
+                              params["embed"].T.astype(jnp.float32))
+
+        fn = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(param_specs(cfg), pool_spec, pool_spec,
+                      P(), P(), P(), P()),
+            out_specs=P(None, "tp"), check_vma=False)
+        got = fn(params, pools["k"], pools["v"], full,
+                 jnp.asarray([S], jnp.int32), jnp.asarray([True]), pt_row)
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref),
+                                   rtol=0, atol=5e-6)
+
+
+# -------------------------------------------------- decode attention kernel
+class TestDecodeAttentionKernel:
+    def _case(self, rng, B=3, H=4, KVH=2, D=16, num_pages=9, page=8, P=4):
+        q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+        kp = jnp.asarray(rng.randn(num_pages, page, KVH, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(num_pages, page, KVH, D), jnp.float32)
+        pt = jnp.asarray(rng.randint(1, num_pages, size=(B, P)), jnp.int32)
+        return q, kp, vp, pt
+
+    def test_kernel_matches_reference_gqa_partial_inactive(self):
+        rng = np.random.RandomState(0)
+        q, kp, vp, pt = self._case(rng)
+        lengths = jnp.asarray([0, 5, 25], jnp.int32)  # inactive/tail/full
+        ref = decode_attention_xla(q, kp, vp, pt, lengths)
+        out = paged_decode_attention_pallas(q, kp, vp, pt, lengths,
+                                            interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=0, atol=1e-5)
+        assert float(jnp.max(jnp.abs(out[0]))) == 0.0, (
+            "inactive (length 0) row must attend to nothing")
+
+    def test_bf16_storage_widens_at_read(self):
+        rng = np.random.RandomState(1)
+        q, kp, vp, pt = self._case(rng)
+        lengths = jnp.asarray([8, 16, 32], jnp.int32)
+        ref = decode_attention_xla(q, kp.astype(jnp.bfloat16),
+                                   vp.astype(jnp.bfloat16), pt, lengths)
+        out = paged_decode_attention_pallas(
+            q, kp.astype(jnp.bfloat16), vp.astype(jnp.bfloat16), pt,
+            lengths, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=0.05, atol=0.05)
+
+    def test_out_of_range_page_ids_clamp_not_wrap(self):
+        """A corrupt page table (negative / past-pool ids) must behave
+        exactly like its clamped self — in BOTH implementations (the
+        APX107 contract at runtime)."""
+        rng = np.random.RandomState(2)
+        q, kp, vp, _ = self._case(rng, B=2, P=3)
+        pt_bad = jnp.asarray([[-3, 2, 99], [1, -1, 1000]], jnp.int32)
+        pt_ok = jnp.clip(pt_bad, 0, kp.shape[0] - 1)
+        lengths = jnp.asarray([20, 24], jnp.int32)
+        for impl in (decode_attention_xla,
+                     lambda *a: paged_decode_attention_pallas(
+                         *a, interpret=True)):
+            a = impl(q, kp, vp, pt_bad, lengths)
+            b = impl(q, kp, vp, pt_ok, lengths)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------- fused sampling
+class TestFusedSampling:
+    def _case(self, rng, N=5, H=32, V=307):
+        x2 = jnp.asarray(rng.randn(N, H), jnp.float32)
+        emb = jnp.asarray(rng.randn(V, H), jnp.float32)
+        seeds = jnp.asarray(rng.randint(0, 2 ** 31, size=(N,)), jnp.uint32)
+        return x2, emb, seeds
+
+    @pytest.mark.parametrize("temperature,top_k", [
+        (0.0, 0), (1.0, 0), (0.7, 13), (1.3, 1), (0.9, 400)])
+    def test_kernel_matches_reference_bitwise(self, temperature, top_k):
+        """Same counter-based Gumbel stream, same threshold semantics:
+        the kernel and the reference draw the IDENTICAL token (fp32
+        dots pin the logits bitwise on CPU)."""
+        rng = np.random.RandomState(0)
+        x2, emb, seeds = self._case(rng)
+        a = fused_sample_xla(x2, emb, seeds, temperature, top_k)
+        b = fused_sample_pallas(x2, emb, seeds, temperature, top_k,
+                                dot_dtype=jnp.float32, interpret=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_greedy_is_argmax(self):
+        rng = np.random.RandomState(1)
+        x2, emb, seeds = self._case(rng)
+        logits = x2 @ emb.T
+        np.testing.assert_array_equal(
+            np.asarray(fused_sample_xla(x2, emb, seeds, 0.0, 0)),
+            np.asarray(jnp.argmax(logits, axis=-1)))
+
+    def test_top_k_restricts_support(self):
+        """Over many seeds, every draw lands inside the top-k set."""
+        rng = np.random.RandomState(2)
+        x2, emb, _ = self._case(rng, N=1)
+        k = 7
+        logits = x2 @ emb.T
+        topset = set(np.asarray(jax.lax.top_k(logits, k)[1][0]).tolist())
+        xs = jnp.broadcast_to(x2, (256, x2.shape[1]))
+        seeds = jnp.arange(256, dtype=jnp.uint32)
+        toks = np.asarray(fused_sample_xla(xs, emb, seeds, 0.8, k))
+        assert set(toks.tolist()) <= topset
+        assert len(set(toks.tolist())) > 1, "top-k sampling degenerated " \
+            "to greedy (no variety across seeds)"
+
+    @pytest.mark.slow
+    def test_temperature_sampling_tracks_softmax(self):
+        """Empirical distribution over 4000 seeds vs the true softmax:
+        total-variation distance at the sampling-noise scale."""
+        rng = np.random.RandomState(3)
+        x2, emb, _ = self._case(rng, N=1, V=101)
+        n = 4000
+        xs = jnp.broadcast_to(x2, (n, x2.shape[1]))
+        toks = np.asarray(fused_sample_xla(
+            xs, emb, jnp.arange(n, dtype=jnp.uint32), 1.0, 0))
+        p_emp = np.bincount(toks, minlength=101) / n
+        p_true = np.asarray(jax.nn.softmax(x2[0] @ emb.T))
+        assert 0.5 * np.abs(p_emp - p_true).sum() < 0.05
+
+    def test_gumbel_stream_is_open_interval(self):
+        g = gumbel_from_seed(jnp.arange(4096, dtype=jnp.uint32)[:, None],
+                             jnp.arange(64, dtype=jnp.int32)[None, :])
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+
+# -------------------------------------------------------------- KV cache
+class TestKVCache:
+    def test_allocator_reserves_garbage_page(self):
+        a = PageAllocator(num_pages=5)
+        got = a.allocate(4)
+        assert got == [1, 2, 3, 4] and GARBAGE_PAGE not in got
+        assert a.allocate(1) is None, "over-allocation must refuse, " \
+            "never hand out the garbage page"
+
+    def test_allocator_recycles_and_guards(self):
+        a = PageAllocator(num_pages=4)
+        pages = a.allocate(3)
+        a.free(pages)
+        assert a.free_pages == 3
+        with pytest.raises(ValueError, match="double free"):
+            a.free([pages[0]])  # already back in the free list
+        with pytest.raises(ValueError, match="reserved"):
+            a.free([GARBAGE_PAGE])
+        with pytest.raises(ValueError, match="outside"):
+            a.free([99])
+
+    def test_pages_needed(self):
+        assert pages_needed(1, 4) == 1
+        assert pages_needed(4, 4) == 1
+        assert pages_needed(5, 4) == 2
+
+    def test_inactive_decode_write_hits_garbage_page_only(self):
+        rng = np.random.RandomState(0)
+        kp = jnp.asarray(rng.randn(4, 2, 1, 8), jnp.float32)
+        vp = kp + 1
+        k_new = jnp.ones((2, 1, 8))
+        pt = jnp.asarray([[2], [3]], jnp.int32)
+        pos = jnp.asarray([0, 1], jnp.int32)
+        active = jnp.asarray([False, False])
+        nk, nv = write_decode_kv(kp, vp, k_new, k_new, pt, pos, active)
+        np.testing.assert_array_equal(np.asarray(nk[1:]), np.asarray(kp[1:]))
+        np.testing.assert_array_equal(np.asarray(nv[1:]), np.asarray(vp[1:]))
+
+    def test_prompt_pad_tail_hits_garbage_page_only(self):
+        kp = jnp.zeros((2, 5, 4, 1, 8))
+        ks = jnp.ones((2, 6, 1, 8))
+        row = jnp.asarray([2, 3], jnp.int32)
+        nk, _ = write_prompt_kv(kp, kp, ks, ks, row, jnp.int32(5))
+        # positions 0..4 land in pages 2 (0..3) and 3 (slot 0); the
+        # padded position 5 must NOT touch page 3 slot 1
+        assert float(jnp.sum(jnp.abs(nk[:, 3, 1:]))) == 0.0
+        assert float(jnp.sum(nk[:, 2])) == 4 * 8 * 2
+        assert float(jnp.sum(nk[:, 3, 0])) == 8 * 2
+
+
+# -------------------------------------------------------------- scheduler
+def _sched(params, cfg, *, num_pages=10, page_size=4, pages_per_seq=6,
+           max_batch=3, temperature=0.0, top_k=0, attn="xla", sample="xla",
+           max_prompt=8, seed=0):
+    dcfg = DecodeConfig(
+        cache=KVCacheConfig(num_pages=num_pages, page_size=page_size,
+                            pages_per_seq=pages_per_seq, dtype=jnp.float32),
+        max_batch=max_batch, max_prompt_len=max_prompt,
+        temperature=temperature, top_k=top_k,
+        attn_impl=attn, sample_impl=sample,
+        sample_dot_dtype=jnp.float32, base_seed=seed)
+    return ContinuousBatchingScheduler(params, cfg, dcfg)
+
+
+def _requests(rng, n, vocab, plen=(2, 7), max_new=(2, 6)):
+    return [Request(rid=i,
+                    prompt=list(rng.randint(0, vocab,
+                                            size=rng.randint(*plen))),
+                    max_new_tokens=int(rng.randint(*max_new)))
+            for i in range(n)]
+
+
+class TestScheduler:
+    @pytest.fixture(scope="class")
+    def model(self):
+        cfg = tiny_cfg()
+        return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+    def test_greedy_serving_matches_training_forward(self, model):
+        """Every served token is the training forward's argmax
+        continuation — end-to-end decode parity through admission,
+        page recycling, and eviction."""
+        cfg, params = model
+        sched = _sched(params, cfg)
+        rng = np.random.RandomState(7)
+        for r in _requests(rng, 6, cfg.vocab_size):
+            sched.submit(r)
+        done = sched.run_until_drained()
+        assert len(done) == 6
+        assert sched.stats["admitted"] == 6 and sched.stats["evicted"] == 6
+        for c in done[:3]:
+            seq = list(c.prompt)
+            for tok in c.tokens:
+                logits = gpt_forward(params, jnp.asarray([seq]), cfg)
+                assert int(jnp.argmax(logits[len(seq) - 1, 0])) == tok
+                seq.append(tok)
+
+    def test_admission_only_when_pages_free(self, model):
+        """Pool of 3 allocatable pages, requests needing 2 each: at
+        most one resident at a time, queued requests wait."""
+        cfg, params = model
+        sched = _sched(params, cfg, num_pages=4, page_size=4,
+                       pages_per_seq=2, max_batch=3)
+        rng = np.random.RandomState(1)
+        for i in range(3):
+            sched.submit(Request(rid=i,
+                                 prompt=list(rng.randint(0, 61, size=4)),
+                                 max_new_tokens=3))
+        max_resident = 0
+        for _ in range(100):
+            if sched.idle():
+                break
+            sched.step()
+            max_resident = max(max_resident, sched.num_active)
+        assert sched.idle() and len(sched.completed) == 3
+        assert max_resident == 1, (
+            f"pages for one 2-page request were free, yet "
+            f"{max_resident} sequences were resident")
+
+    def test_fifo_order_pinned_no_starvation(self, model):
+        """A page-hungry queue head must NOT be overtaken by small
+        requests behind it (FIFO admission, starvation-free)."""
+        cfg, params = model
+        sched = _sched(params, cfg, num_pages=7, page_size=4,
+                       pages_per_seq=6, max_batch=3)
+        admitted_order = []
+        orig = sched._admit_into
+
+        def record(slot, req, pages):
+            admitted_order.append(req.rid)
+            return orig(slot, req, pages)
+
+        sched._admit_into = record
+        rng = np.random.RandomState(2)
+        # rid 0 small (occupies pages), rid 1 HUGE (blocks), rid 2 small
+        sched.submit(Request(0, list(rng.randint(0, 61, size=4)), 8))
+        sched.step()  # rid 0 resident, holds 3 of 6 pages
+        sched.submit(Request(1, list(rng.randint(0, 61, size=8)), 16))
+        sched.submit(Request(2, list(rng.randint(0, 61, size=2)), 2))
+        done = sched.run_until_drained()
+        assert admitted_order == [0, 1, 2], (
+            f"admission order {admitted_order} broke FIFO — a small "
+            f"request overtook the blocked head")
+        assert len(done) == 3
+
+    def test_page_recycling_serves_more_than_pool(self, model):
+        """Total page demand across the run exceeds the pool several
+        times over; eviction must recycle pages back to admission."""
+        cfg, params = model
+        sched = _sched(params, cfg, num_pages=5, page_size=4,
+                       pages_per_seq=2, max_batch=2)
+        rng = np.random.RandomState(3)
+        n = 8
+        for i in range(n):
+            sched.submit(Request(i, list(rng.randint(0, 61, size=3)), 4))
+        done = sched.run_until_drained()
+        assert len(done) == n
+        total_pages = n * pages_needed(3 + 4, 4)
+        assert total_pages > 4, "test must oversubscribe the pool"
+        assert sched.allocator.free_pages == 4, "pages leaked"
+
+    def test_deterministic_under_seeded_trace(self, model):
+        """Same seeded arrival trace + temperature sampling: bitwise
+        the same served tokens, twice."""
+        cfg, params = model
+
+        def run():
+            sched = _sched(params, cfg, temperature=0.9, top_k=5, seed=11)
+            rng = np.random.RandomState(5)
+            for r in _requests(rng, 5, cfg.vocab_size):
+                sched.submit(r)
+            return [(c.rid, tuple(c.tokens))
+                    for c in sched.run_until_drained()]
+
+        assert run() == run()
+
+    def test_eos_stops_generation_early(self, model):
+        cfg, params = model
+        sched = _sched(params, cfg)
+        sched.submit(Request(0, [5, 9, 12], max_new_tokens=20, eos_id=None))
+        done = sched.run_until_drained()
+        toks = done[0].tokens
+        # re-serve with eos = some generated token: generation must cut
+        # at its FIRST occurrence (greedy is deterministic, so the
+        # prefix is reproduced exactly)
+        eos = toks[-1]
+        cut = toks.index(eos) + 1
+        sched2 = _sched(params, cfg)
+        sched2.submit(Request(0, [5, 9, 12], max_new_tokens=20, eos_id=eos))
+        done2 = sched2.run_until_drained()
+        assert done2[0].tokens == toks[:cut]
+
+    def test_submit_validation(self, model):
+        cfg, params = model
+        sched = _sched(params, cfg)
+        with pytest.raises(ValueError, match="max_prompt_len"):
+            sched.submit(Request(0, list(range(9)), 2))
+        with pytest.raises(ValueError, match="pages_per_seq"):
+            sched.submit(Request(1, [1, 2], 1000))
+        with pytest.raises(ValueError, match="empty"):
+            sched.submit(Request(2, [], 2))
+
+    def test_chaos_decode_kernel_failure_degrades_once_keeps_serving(
+            self, model):
+        """An injected decode-attention launch failure (the Mosaic
+        stand-in) trips the registry ONCE; the serve loop degrades to
+        the XLA reference and produces the SAME tokens."""
+        cfg, params = model
+
+        def serve():
+            sched = _sched(params, cfg, attn="interpret",
+                           sample="interpret", temperature=0.8, top_k=6,
+                           seed=4)
+            rng = np.random.RandomState(6)
+            for r in _requests(rng, 4, cfg.vocab_size):
+                sched.submit(r)
+            return [(c.rid, tuple(c.tokens))
+                    for c in sched.run_until_drained()]
+
+        get_registry().reset()
+        try:
+            baseline = serve()
+            get_registry().reset()
+            monkey = ChaosMonkey(ChaosPlan.make(
+                kernel_failures={"decode_attention": 1}))
+            with monkey.active():
+                served = serve()
+            status = get_registry().status()["decode_attention"]
+            assert status["tripped"] and status["fallback_calls"] >= 1
+            assert served == baseline, (
+                "the degraded (XLA) serve produced different tokens")
+            assert monkey.injected.get("kernel:decode_attention") == 1
+        finally:
+            get_registry().reset()
+
+    def test_decode_step_compiles_once_across_occupancy(self, model):
+        """The compile-once contract at the scheduler level: varying
+        occupancy (1..3 active), cache lengths, admissions and
+        evictions all reuse ONE compiled decode step."""
+        cfg, params = model
+        sched = _sched(params, cfg)
+        rng = np.random.RandomState(8)
+        for r in _requests(rng, 7, cfg.vocab_size, plen=(2, 8),
+                           max_new=(2, 8)):
+            sched.submit(r)
+        sched.run_until_drained()
+        assert sched.decode_cache_size() == 1

@@ -284,3 +284,104 @@ class TestCheckerSelfConsistency:
         assert lw.hlo_text("module {}") == "module {}"
         with pytest.raises(TypeError):
             lw.hlo_text(42)
+
+    def test_host_transfer_checker_on_real_lowerings(self):
+        clean = jax.jit(lambda x: x * 2.0).lower(jnp.ones((4,)))
+        lw.assert_no_host_transfer(clean)
+
+        def dirty(x):
+            jax.debug.print("x={x}", x=x)
+            return x * 2.0
+
+        low = jax.jit(dirty).lower(jnp.ones((4,)))
+        assert lw.host_transfer_sites(low), \
+            "a debug.print callback must register as a host transfer"
+        with pytest.raises(AssertionError, match="host-transfer"):
+            lw.assert_no_host_transfer(low)
+
+
+# ------------------------------------------------------------- decode step
+class TestDecodeStep:
+    """The serving engine's compiled-step contracts (ROADMAP: 'decode
+    step pinned to zero host transfers and zero re-compiles across
+    cache lengths'): the one jitted decode step runs entirely on
+    device, donates the KV pools, and is reused — one compiled
+    executable — across every cache length and batch occupancy."""
+
+    @staticmethod
+    def _build():
+        from apex_tpu.inference import (
+            DecodeConfig, KVCacheConfig, alloc_pools,
+        )
+        from apex_tpu.inference.decode import make_decode_step, make_prefill
+        from apex_tpu.models.gpt import init_params
+
+        cfg = GPTConfig(
+            vocab_size=64, hidden_size=32, num_layers=2,
+            num_attention_heads=4, max_seq_len=64,
+            position_embedding_type="rope",
+            compute_dtype=jnp.float32, checkpoint_layers=False)
+        dcfg = DecodeConfig(
+            cache=KVCacheConfig(num_pages=8, page_size=4, pages_per_seq=4,
+                                dtype=jnp.float32),
+            max_batch=3, max_prompt_len=8, temperature=0.0,
+            attn_impl="xla", sample_impl="xla")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        pools = alloc_pools(cfg.num_layers, cfg.kv_heads, cfg.head_dim,
+                            dcfg.cache)
+        return cfg, dcfg, params, pools, make_decode_step, make_prefill
+
+    def _decode_args(self, dcfg, params, pools):
+        B, P = dcfg.max_batch, dcfg.cache.pages_per_seq
+        return (params, pools,
+                jnp.zeros((B,), jnp.int32),          # tokens
+                jnp.zeros((B,), jnp.int32),          # positions
+                jnp.zeros((B,), bool),               # active
+                jnp.zeros((B, P), jnp.int32),        # page tables
+                jnp.zeros((B,), jnp.uint32))         # seeds
+
+    def test_decode_step_has_zero_host_transfers(self):
+        cfg, dcfg, params, pools, make_step, _ = self._build()
+        step = make_step(cfg, dcfg)
+        low = step.lower(*self._decode_args(dcfg, params, pools))
+        lw.assert_no_host_transfer(low)
+
+    def test_prefill_has_zero_host_transfers(self):
+        cfg, dcfg, params, pools, _, make_prefill = self._build()
+        prefill = make_prefill(cfg, dcfg)
+        low = prefill.lower(
+            params, pools, jnp.zeros((1, dcfg.max_prompt_len), jnp.int32),
+            jnp.int32(3), jnp.zeros((dcfg.cache.pages_per_seq,), jnp.int32),
+            jnp.uint32(0))
+        lw.assert_no_host_transfer(low)
+
+    def test_kv_pools_donate_through_decode_step(self):
+        """The pools are the resident serving state: both buffers must
+        really alias through the compiled step, or every token pays a
+        pool-sized copy."""
+        cfg, dcfg, params, pools, make_step, _ = self._build()
+        step = make_step(cfg, dcfg)
+        low = step.lower(*self._decode_args(dcfg, params, pools))
+        lw.assert_donation_covers(low, pools, compiled=True)
+
+    def test_decode_step_compiles_once_across_lengths_and_occupancy(self):
+        """One executable serves occupancy 0..B and any positions mix:
+        shape-identical calls with different occupancy/length DATA must
+        not add cache entries."""
+        cfg, dcfg, params, pools, make_step, _ = self._build()
+        step = make_step(cfg, dcfg)
+        B, P = dcfg.max_batch, dcfg.cache.pages_per_seq
+        pt = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P) % 7 + 1
+        for active, positions in [
+            ((False,) * B, (0,) * B),
+            ((True, False, False), (0, 0, 0)),
+            ((True, True, True), (3, 9, 14)),
+            ((False, True, False), (0, 15, 0)),
+        ]:
+            pools, _tok = step(
+                params, pools, jnp.zeros((B,), jnp.int32),
+                jnp.asarray(positions, jnp.int32), jnp.asarray(active),
+                pt, jnp.zeros((B,), jnp.uint32))
+        assert step._cache_size() == 1, (
+            f"decode step compiled {step._cache_size()} variants — "
+            "occupancy or length leaked into a traced shape")

@@ -137,9 +137,16 @@ class TestKernelFallbackRegistry:
         assert not get_registry().tripped("layer_norm")
 
     def test_trip_from_exception_generic_mosaic_trips_all(self):
+        from apex_tpu.resilience.fallback import KERNELS
+
         got = trip_from_exception(
             RuntimeError("INTERNAL: Mosaic failed to compile module"))
-        assert sorted(got) == ["flash_attention", "fused_ce", "layer_norm"]
+        # an unattributable Mosaic error must trip EVERY registered
+        # kernel (incl. the decode pair) — pin against the registry
+        # itself so a new kernel cannot silently escape the net
+        assert sorted(got) == sorted(KERNELS)
+        assert {"flash_attention", "fused_ce", "layer_norm",
+                "decode_attention", "decode_sampling"} <= set(got)
 
     def test_trip_from_exception_ignores_unrelated(self):
         assert trip_from_exception(ValueError("shape mismatch")) == []

@@ -36,6 +36,8 @@ from apex_tpu.analysis.rules_collectives import (
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_precision import (
+    KvCacheReadDtypeMismatch,
+    PageTableGatherUnclamped,
     QuantizedSyncStateDtype,
     Fp32ConstantInBf16Path,
     ScratchAccumDtypeMismatch,
@@ -984,6 +986,192 @@ class TestScratchAccumDtypeMismatch:
                     preferred_element_type=jnp.float32)
                 return acc
             """, tmp_path, [ScratchAccumDtypeMismatch()])
+        assert got == []
+
+
+# ------------------------------- APX107 page-table gathers (decode path)
+class TestPageTableGatherUnclamped:
+    """The APX401 unclamped-gather family extended to the serving
+    path's mutable page indirection: page-table reads and table-valued
+    pool indexing must clamp (or choose an explicit mode)."""
+
+    def test_positive_take_through_page_table(self, tmp_path):
+        got = run("""
+            import jax.numpy as jnp
+
+            def gather_pages(page_table_row, page_ix):
+                return jnp.take(page_table_row, page_ix)
+            """, tmp_path, [PageTableGatherUnclamped()])
+        assert rule_ids(got) == ["APX107"]
+        assert "page_table_row" in got[0].message
+
+    def test_positive_table_values_index_the_pool(self, tmp_path):
+        """The vLLM-shaped hazard: the table's VALUES address the pool;
+        a stale entry wraps into a live sequence's page."""
+        got = run("""
+            def gather(k_pool, page_tables):
+                return k_pool[page_tables]
+            """, tmp_path, [PageTableGatherUnclamped()])
+        assert rule_ids(got) == ["APX107"]
+        assert "LIVE sequence" in got[0].message
+
+    def test_positive_scatter_through_at(self, tmp_path):
+        got = run("""
+            def write(k_pool, page_tables, slot, k_new):
+                return k_pool.at[page_tables, slot].set(k_new)
+            """, tmp_path, [PageTableGatherUnclamped()])
+        assert rule_ids(got) == ["APX107"]
+
+    def test_negative_clipped_index(self, tmp_path):
+        """The kv_cache.py contract shape: indices clipped (directly
+        or through a clipped local) are clean."""
+        got = run("""
+            import jax.numpy as jnp
+
+            def gather_pages(page_table_row, s, P, num_pages):
+                page_ix = jnp.clip(s // 4, 0, P - 1)
+                rows = jnp.take(page_table_row, page_ix)
+                return jnp.clip(rows, 0, num_pages - 1)
+
+            def gather(k_pool, page_table, num_pages):
+                pt = jnp.clip(page_table, 0, num_pages - 1)
+                return k_pool[pt]
+            """, tmp_path, [PageTableGatherUnclamped()])
+        assert got == []
+
+    def test_negative_explicit_mode(self, tmp_path):
+        got = run("""
+            import jax.numpy as jnp
+
+            def gather_pages(page_table_row, ix):
+                return jnp.take(page_table_row, ix, mode="clip")
+            """, tmp_path, [PageTableGatherUnclamped()])
+        assert got == []
+
+    def test_negative_at_scatter_with_explicit_mode(self, tmp_path):
+        """``.at[...].set(..., mode=...)`` chose its out-of-bounds
+        semantic explicitly — the mode lives on the ENCLOSING set/get
+        call, and must acquit like take's mode= does."""
+        got = run("""
+            def write(k_pool, page_tables, slot, k_new):
+                return k_pool.at[page_tables, slot].set(k_new, mode="drop")
+
+            def read(k_pool, page_tables):
+                return k_pool.at[page_tables].get(mode="fill", fill_value=0)
+            """, tmp_path, [PageTableGatherUnclamped()])
+        assert got == []
+
+    def test_negative_non_page_table_names_quiet(self, tmp_path):
+        """Ordinary gathers (embedding lookups, host bookkeeping) stay
+        out of reach — the rule is scoped to page-table names."""
+        got = run("""
+            import jax.numpy as jnp
+
+            def embed(table, tokens):
+                return jnp.take(table, tokens, axis=0)
+
+            def host_side(slots, i):
+                return slots[i]
+            """, tmp_path, [PageTableGatherUnclamped()])
+        assert got == []
+
+
+# ------------------------------ APX306 kv-cache read dtype (decode path)
+class TestKvCacheReadDtypeMismatch:
+    """Narrow (bf16) cache storage feeding a wider-accumulator dot
+    needs the widen SPELLED at the read."""
+
+    def test_positive_bf16_pool_into_f32_dot(self, tmp_path):
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def attend(q, i):
+                k_cache = jnp.zeros((8, 16, 64), dtype=jnp.bfloat16)
+                return jax.lax.dot_general(
+                    q, k_cache[i], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            """, tmp_path, [KvCacheReadDtypeMismatch()])
+        assert rule_ids(got) == ["APX306"]
+        assert "k_cache" in got[0].message and "bfloat16" in got[0].message
+
+    def test_positive_via_dtype_lattice(self, tmp_path):
+        """Storage dtype resolved through a local alias
+        (``store = jnp.bfloat16``) — the APX303-style lattice hop."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            store = jnp.bfloat16
+
+            def attend(q, pages):
+                kv_pool = pages.astype(store)
+                return jax.lax.dot_general(
+                    q, kv_pool, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            """, tmp_path, [KvCacheReadDtypeMismatch()])
+        assert rule_ids(got) == ["APX306"]
+
+    def test_negative_widened_at_the_read(self, tmp_path):
+        """The decode kernels' contract shape: the cache operand is
+        astype-widened where it meets the dot."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def attend(q, i):
+                k_cache = jnp.zeros((8, 16, 64), dtype=jnp.bfloat16)
+                return jax.lax.dot_general(
+                    q, k_cache[i].astype(jnp.float32),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            """, tmp_path, [KvCacheReadDtypeMismatch()])
+        assert got == []
+
+    def test_negative_wide_storage(self, tmp_path):
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def attend(q, i):
+                k_cache = jnp.zeros((8, 16, 64), dtype=jnp.float32)
+                return jax.lax.dot_general(
+                    q, k_cache[i], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            """, tmp_path, [KvCacheReadDtypeMismatch()])
+        assert got == []
+
+    def test_negative_unresolvable_astype_at_read_stays_quiet(
+            self, tmp_path):
+        """An explicit cast at the read whose dtype the lattice cannot
+        resolve (a parameter, a config attribute) is still the SPELLED
+        widen the rule demands — quiet-when-unprovable applies to the
+        cast too, not just the buffer."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def attend(q, pages, acc_dtype, i):
+                kv_pool = pages.astype(jnp.bfloat16)
+                return jax.lax.dot_general(
+                    q, kv_pool[i].astype(acc_dtype),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            """, tmp_path, [KvCacheReadDtypeMismatch()])
+        assert got == []
+
+    def test_negative_unresolvable_dtype_stays_quiet(self, tmp_path):
+        """A pool whose dtype the lattice cannot prove (the real
+        kernels: the ref's dtype is whatever the caller allocated)
+        must not be guessed at."""
+        got = run("""
+            import jax
+
+            def attend(q, k_pool, i):
+                return jax.lax.dot_general(
+                    q, k_pool[i], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            """, tmp_path, [KvCacheReadDtypeMismatch()])
         assert got == []
 
 
