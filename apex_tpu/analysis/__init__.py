@@ -42,6 +42,12 @@ machine-checked invariants):
   and a KV-cache buffer provably narrower than the
   ``preferred_element_type`` of a dot it feeds with no explicit widen
   at the read (the ``inference.kv_cache`` storage-dtype contract).
+- **APX108** blocking host sync in a step loop (``rules_host_sync``):
+  ``float()``/``.item()``/``np.asarray``/f-string formatting of a
+  proven device array inside a ``for``/``while`` loop that dispatches
+  a compiled step — the per-step sync barrier
+  ``apex_tpu.observability.stepstats`` (the allowed async-fetch
+  spelling) exists to remove.
 
 CLI: ``python -m apex_tpu.analysis [paths] [--baseline FILE]`` — see
 ``docs/static_analysis.md`` for rule details, the baseline format, and
@@ -66,6 +72,7 @@ from apex_tpu.analysis.rules_collectives import (
     CollectiveOutsideSpmdContext, UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
+from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_precision import (
     Fp32ConstantInBf16Path, KvCacheReadDtypeMismatch,
@@ -92,6 +99,7 @@ def default_rules(vmem_budget_bytes=None):
         ProcessGlobalEnvMutation(),
         DonatedBufferReuse(),
         NonAtomicCheckpointWrite(),
+        BlockingHostSyncInStepLoop(),
         UnknownCollectiveAxis(),
         CollectiveOutsideSpmdContext(),
         CollectiveAxisUnboundUnderJit(),

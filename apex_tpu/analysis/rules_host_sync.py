@@ -1,0 +1,302 @@
+"""Host-sync hygiene: blocking device reads inside step loops.
+
+The repo's hot loops are asynchronous by construction — the host
+dispatches step N+1 while the device runs step N — and ONE blocking
+spelling silently serializes them: converting a device array to a host
+value inside the loop (``float(loss)``, ``loss.item()``,
+``np.asarray(tokens)``, ``f"loss={loss}"``).  On CPU tests this is
+invisible; on TPU it drains the dispatch queue every iteration — the
+exact per-step host sync ``apex_tpu.observability.stepstats`` exists
+to remove (its :class:`~apex_tpu.observability.stepstats.AsyncFetcher`
+is the allowed spelling: hand the array over, harvest the copy N steps
+later).
+
+- **APX108**: inside a ``for``/``while`` loop that dispatches a
+  compiled step, a value *proven* to be a device array is converted to
+  a host value.
+
+What "proven" means (the only-statically-certain contract every rule
+family here follows):
+
+- a *step binding* is a name assigned from ``jax.jit(...)``, from a
+  ``make_*step``/``make_prefill`` builder call (the repo's step-builder
+  naming), or from a local zero-arg builder function whose return is
+  one of those calls (the ``step = build_step()`` rebuild idiom);
+- a *step-calling function* is a local def whose return statement
+  calls a step binding (the ``run_step`` retry-wrapper idiom) — its
+  call results are device arrays too;
+- *device names* are the assignment targets (incl. tuple unpacking) of
+  calls to either, resolved through the lexical scope chain;
+- a *step loop* is a ``for``/``while`` whose body calls a step binding
+  or step-calling function;
+- flagged sinks inside a step loop: ``float(x)``/``int(x)``,
+  ``x.item()``, ``np.asarray(x)``/``np.array(x)`` (numpy only —
+  ``jnp.asarray`` stays on device), and f-string formatting of ``x``,
+  where ``x`` is a device name (or an attribute off one, e.g.
+  ``scaler_state.loss_scale``).
+
+Values threaded through containers, attributes (``self._decode``), or
+multi-value builder returns are trusted, same as the donation rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from apex_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, last_name,
+)
+
+__all__ = ["BlockingHostSyncInStepLoop"]
+
+#: builder callees whose result is a compiled step function
+_STEP_BUILDER = re.compile(r"^make_\w*step$|^make_prefill$")
+
+#: numpy spellings whose call materializes on host
+_NP_SINKS = {"asarray", "array"}
+
+
+def _is_step_builder_call(call: ast.Call) -> bool:
+    name = last_name(call.func)
+    return name == "jit" or (name is not None
+                             and _STEP_BUILDER.match(name) is not None)
+
+
+def _target_name_positions(stmt: ast.Assign) -> List[str]:
+    """Plain names an assignment binds (single name or a flat tuple of
+    names); anything fancier returns [] (trusted)."""
+    if len(stmt.targets) != 1:
+        return []
+    t = stmt.targets[0]
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            if isinstance(e, ast.Name):
+                out.append(e.id)
+        return out
+    return []
+
+
+class BlockingHostSyncInStepLoop(Rule):
+    """APX108: device array forced to host inside a step loop."""
+
+    rule_id = "APX108"
+    severity = "error"
+    fix_hint = ("move the conversion after the loop, or route it through "
+                "the async telemetry seam "
+                "(apex_tpu.observability.stepstats.AsyncFetcher: put() the "
+                "device array in the loop, harvest ready() copies without "
+                "blocking) — every in-loop float()/.item()/np.asarray/"
+                "f-string of a device array drains the dispatch queue and "
+                "serializes host and device once per step")
+
+    # ------------------------------------------------------------ facts
+    def _scope_of(self, ctx: ModuleContext, node: ast.AST) -> ast.AST:
+        return ctx.enclosing_function(node) or ctx.tree
+
+    def _collect(self, ctx: ModuleContext
+                 ) -> Tuple[Dict[int, Set[str]], Set[str]]:
+        """Per-scope step bindings and the step-calling function names
+        (two-pass fixpoint: builders can chain one level deep per
+        pass)."""
+        step_bindings: Dict[int, Set[str]] = {}
+        builder_fns: Set[str] = set()    # defs returning a step build
+        step_callers: Set[str] = set()   # defs returning a step CALL
+
+        def record(node: ast.AST, name: str) -> None:
+            step_bindings.setdefault(id(self._scope_of(ctx, node)),
+                                     set()).add(name)
+
+        for _ in range(3):  # bounded fixpoint: jit -> builder -> caller
+            changed = False
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    callee = last_name(node.value.func)
+                    is_step = _is_step_builder_call(node.value) \
+                        or callee in builder_fns
+                    if not is_step:
+                        continue
+                    for name in _target_name_positions(node):
+                        scope = id(self._scope_of(ctx, node))
+                        if name not in step_bindings.get(scope, set()):
+                            record(node, name)
+                            changed = True
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for ret in ast.walk(node):
+                        if not (isinstance(ret, ast.Return)
+                                and isinstance(ret.value, ast.Call)):
+                            continue
+                        callee = last_name(ret.value.func)
+                        if _is_step_builder_call(ret.value) \
+                                or callee in builder_fns:
+                            if node.name not in builder_fns:
+                                builder_fns.add(node.name)
+                                changed = True
+                        elif callee is not None and self._is_step_name(
+                                ctx, ret.value.func, ret, step_bindings):
+                            if node.name not in step_callers:
+                                step_callers.add(node.name)
+                                changed = True
+            if not changed:
+                break
+        return step_bindings, builder_fns | step_callers
+
+
+    def _is_step_name(self, ctx: ModuleContext, func: ast.AST,
+                      site: ast.AST,
+                      step_bindings: Dict[int, Set[str]]) -> bool:
+        """Does ``func`` (at ``site``) resolve to a step binding through
+        the lexical scope chain?"""
+        if not isinstance(func, ast.Name):
+            return False
+        scope: Optional[ast.AST] = ctx.enclosing_function(site)
+        while True:
+            node = scope if scope is not None else ctx.tree
+            if func.id in step_bindings.get(id(node), set()):
+                return True
+            if scope is None:
+                return False
+            scope = ctx.enclosing_function(scope)
+
+    def _device_names(self, ctx: ModuleContext,
+                      step_bindings: Dict[int, Set[str]],
+                      step_fns: Set[str]) -> Dict[int, Set[str]]:
+        """Per-scope names bound from a step (or step-calling fn) call —
+        the proven device arrays."""
+        out: Dict[int, Set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            callee = node.value.func
+            is_step_call = self._is_step_name(ctx, callee, node,
+                                              step_bindings) \
+                or (isinstance(callee, ast.Name) and callee.id in step_fns)
+            if not is_step_call:
+                continue
+            scope = id(self._scope_of(ctx, node))
+            out.setdefault(scope, set()).update(
+                _target_name_positions(node))
+        return out
+
+    # ------------------------------------------------------------- sinks
+    def _base_device_name(self, ctx: ModuleContext, expr: ast.AST,
+                          device: Dict[int, Set[str]]) -> Optional[str]:
+        """``expr``'s base Name if it is a proven device value
+        (``loss``, ``scaler_state.loss_scale``, ``stats[0]``)."""
+        node = expr
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        scope: Optional[ast.AST] = ctx.enclosing_function(expr)
+        while True:
+            s = scope if scope is not None else ctx.tree
+            if node.id in device.get(id(s), set()):
+                return node.id
+            if scope is None:
+                return None
+            scope = ctx.enclosing_function(scope)
+
+    def _numpy_call(self, ctx: ModuleContext, call: ast.Call) -> bool:
+        name = last_name(call.func)
+        if name not in _NP_SINKS:
+            return False
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name):
+            head = call.func.value.id
+            mod = ctx.import_aliases.get(head, head)
+            return mod == "numpy" or head == "np"
+        if isinstance(call.func, ast.Name):
+            tgt = ctx.from_imports.get(call.func.id)
+            return tgt is not None and tgt[0] == "numpy"
+        return False
+
+    def _call_sink(self, ctx: ModuleContext, node: ast.Call,
+                   device: Dict[int, Set[str]]
+                   ) -> Optional[Tuple[str, str]]:
+        fname = last_name(node.func)
+        if fname in ("float", "int") and isinstance(node.func, ast.Name) \
+                and len(node.args) == 1:
+            dn = self._base_device_name(ctx, node.args[0], device)
+            if dn is not None:
+                return dn, f"{fname}()"
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            dn = self._base_device_name(ctx, node.func.value, device)
+            if dn is not None:
+                return dn, ".item()"
+        elif self._numpy_call(ctx, node) and node.args:
+            dn = self._base_device_name(ctx, node.args[0], device)
+            if dn is not None:
+                return dn, "np.asarray()"
+        return None
+
+    def _sinks_in(self, ctx: ModuleContext, loop: ast.AST,
+                  device: Dict[int, Set[str]]
+                  ) -> Iterator[Tuple[ast.AST, str, str]]:
+        # pass 1: conversion calls (float/int/.item/np.asarray)
+        call_sinks: List[Tuple[ast.Call, str, str]] = []
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call):
+                hit = self._call_sink(ctx, node, device)
+                if hit is not None:
+                    call_sinks.append((node, *hit))
+        flagged = {id(n) for n, _, _ in call_sinks}
+        yield from ((n, dn, how) for n, dn, how in call_sinks)
+        # pass 2: f-string interpolation (formats = host-materializes);
+        # skip ones whose conversion call was already reported
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.FormattedValue):
+                continue
+            if any(id(sub) in flagged for sub in ast.walk(node.value)):
+                continue
+            dn = None
+            for sub in ast.walk(node.value):
+                if isinstance(sub, (ast.Name, ast.Attribute,
+                                    ast.Subscript)):
+                    dn = self._base_device_name(ctx, sub, device)
+                    if dn is not None:
+                        break
+            if dn is not None:
+                yield node, dn, "f-string formatting"
+
+    # ------------------------------------------------------------- check
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.mentions("jit", "make_"):
+            return
+        step_bindings, step_fns = self._collect(ctx)
+        if not step_bindings and not step_fns:
+            return
+        device = self._device_names(ctx, step_bindings, step_fns)
+        if not device:
+            return
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            # a STEP loop: its body dispatches a compiled step
+            dispatches = any(
+                isinstance(n, ast.Call) and (
+                    self._is_step_name(ctx, n.func, n, step_bindings)
+                    or (isinstance(n.func, ast.Name)
+                        and n.func.id in step_fns))
+                for n in ast.walk(loop))
+            if not dispatches:
+                continue
+            seen: Set[int] = set()
+            for node, dn, how in self._sinks_in(ctx, loop, device):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                yield self.finding(
+                    ctx, node,
+                    f"{how} of device array `{dn}` inside this step "
+                    f"loop (line {loop.lineno}) blocks the host on the "
+                    f"device every iteration — the loop dispatches a "
+                    f"compiled step, so this is a per-step sync barrier")

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.contrib.optimizers import _quantized_sync as qs
+from apex_tpu.observability import stepstats as _stepstats
 from apex_tpu.optimizers import bucketing
 from apex_tpu.optimizers.base import bias_corrections
 from apex_tpu.transformer.parallel_state import DATA_AXIS
@@ -576,10 +577,18 @@ class ZeroOptimizerBase:
             leaf_sq = jax.lax.psum(leaf_sq, ax)  # assemble dp-disjoint shards
             total_sq = (sumsq_reduce([leaf_sq[i] for i in range(plan.n_leaves)])
                         if sumsq_reduce is not None else jnp.sum(leaf_sq))
+            # the telemetry seam reuses the clip's globally agreed norm
+            # (the observability.stepstats no-new-HBM-pass contract)
+            _stepstats.offer("grad_norm", jnp.sqrt(total_sq))
             # ONE clip expression (torch semantics) with the replicated
             # engine — the two trajectories must not drift
             coef = _clip_coef(jnp.sqrt(total_sq), clip_norm)
             g_shards = [g * coef for g in g_shards]
+        else:
+            # no clip to reuse: the shared rank-local fold — no dp psum
+            # (the stat must add zero collectives), so this is this
+            # rank's 1/dp-shard norm, documented
+            _stepstats.offer_local_grad_norm(g_shards)
         return g_shards, tuple(new_residuals), pred, rank, world
 
     def _commit_residuals(self, new_residuals, old_residuals, pred):

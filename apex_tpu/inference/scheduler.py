@@ -53,6 +53,7 @@ from apex_tpu.inference.kv_cache import (
     PageAllocator, alloc_pools, pages_needed,
 )
 from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.observability import metrics as _metrics
 from apex_tpu.utils.logging import get_logger, log_structured
 
 __all__ = ["Request", "Completion", "ContinuousBatchingScheduler"]
@@ -135,7 +136,22 @@ class ContinuousBatchingScheduler:
             "prefills": 0, "step_rebuilds": 0,
         }
         self._rebuilt_once = False
+        #: true submit wall-time per queued rid (Completion.submit_time
+        #: is the ADMIT time for driver compatibility; the metrics
+        #: histograms — admission wait, TTFT — need the real submit)
+        self._submit_times: Dict[int, float] = {}
         self._build_steps()
+
+    def _record_occupancy(self) -> None:
+        """Serving gauges on the current registry (the scope seam:
+        ``with MetricsScope(reg):`` around the serve loop routes them)."""
+        _metrics.set_gauge("apex_serve_queue_depth", len(self.queue),
+                           help="requests waiting for a slot+pages")
+        _metrics.set_gauge("apex_serve_active_slots", self.num_active,
+                           help="resident decoding sequences")
+        _metrics.set_gauge("apex_serve_free_pages",
+                           self.allocator.free_pages,
+                           help="allocatable KV pages")
 
     # ------------------------------------------------------------ build
     def _build_steps(self) -> None:
@@ -202,7 +218,9 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"request needs {need} pages; the pool only has "
                 f"{self.allocator.num_pages - 1} allocatable")
+        self._submit_times[request.rid] = self._time()
         self.queue.append(request)
+        self._record_occupancy()
 
     @property
     def num_active(self) -> int:
@@ -235,6 +253,10 @@ class ContinuousBatchingScheduler:
 
     def _admit_into(self, slot: int, req: Request, pages: List[int]) -> None:
         t0 = self._time()
+        submitted = self._submit_times.pop(req.rid, t0)
+        _metrics.observe("apex_serve_admission_wait_seconds",
+                         t0 - submitted,
+                         help="submit -> slot+pages reserved")
         plen = len(req.prompt)
         P = self.dcfg.cache.pages_per_seq
         row = np.zeros((P,), np.int32)
@@ -246,9 +268,12 @@ class ContinuousBatchingScheduler:
             jnp.asarray(prompt), jnp.int32(plen), jnp.asarray(row),
             jnp.uint32(self._seed(slot)))
         first = int(first)
+        t_first = self._time()
+        _metrics.observe("apex_serve_ttft_seconds", t_first - submitted,
+                         help="submit -> first token (prefill incl. queue)")
         self._slots[slot] = _Slot(request=req, pages=pages,
                                   generated=[first],
-                                  token_times=[self._time()],
+                                  token_times=[t_first],
                                   submit_time=t0)
         self._page_tables[slot] = row
         self._positions[slot] = plen  # where `first` will be cached
@@ -274,6 +299,11 @@ class ContinuousBatchingScheduler:
         self._positions[slot] = 0
         self._tokens[slot] = 0
         self.stats["evicted"] += 1
+        _metrics.inc("apex_serve_completions_total",
+                     help="finished generations")
+        _metrics.inc("apex_serve_generated_tokens_total", len(s.generated),
+                     help="tokens served")
+        self._record_occupancy()
 
     # -------------------------------------------------------------- step
     def step(self) -> bool:
@@ -296,11 +326,15 @@ class ContinuousBatchingScheduler:
         next_tokens = np.asarray(next_tokens)
         now = self._time()
         self.stats["decode_steps"] += 1
+        self._record_occupancy()
         for i in range(B):
             if not self._active[i]:
                 continue
             s = self._slots[i]
             tok = int(next_tokens[i])
+            _metrics.observe("apex_serve_inter_token_seconds",
+                             now - s.token_times[-1],
+                             help="previous token -> this token")
             s.generated.append(tok)
             s.token_times.append(now)
             self._tokens[i] = tok

@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from apex_tpu.io import native
+from apex_tpu.observability import metrics as _metrics
 
 _MAGIC = b"APEXTPU1"
 
@@ -83,6 +84,9 @@ def _with_io_retries(fn, op: str, path, retries=None):
                 "checkpoint.io_retry", op=op, path=str(path),
                 attempt=attempt + 1, retries=n, delay_s=round(delay, 4),
                 error=f"{type(e).__name__}: {e}")
+            _metrics.inc("apex_checkpoint_io_retries_total",
+                         help="transient checkpoint I/O errors retried",
+                         op=op)
             time.sleep(delay)
 
 

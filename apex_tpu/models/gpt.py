@@ -28,6 +28,7 @@ import numpy as np
 
 from apex_tpu.normalization import fused_layer_norm_affine
 from apex_tpu.models._remat import remat_layer, validate_policy
+from apex_tpu.observability.stepstats import offer as _stat_offer
 from apex_tpu.transformer.functional import scaled_upper_triang_masked_softmax
 from apex_tpu.transformer.tensor_parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.transformer.tensor_parallel.layers import (
@@ -755,6 +756,7 @@ def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
         new_params, new_state = optimizer.update(
             grads, opt_state, params, grads_finite=finite
         )
+    _stat_offer("all_finite", finite)
     new_scaler_state = loss_scaler.update(scaler_state, finite)
     if step_guard is None:
         return new_params, new_state, new_scaler_state
@@ -785,25 +787,65 @@ def _apply_guarded_update(grads, optimizer, opt_state, params, sync_axes,
         new_params, new_state = optimizer.update(
             grads, opt_state, params, grads_finite=finite
         )
+    _stat_offer("all_finite", finite)
     return new_params, new_state, step_guard.update(guard_state, finite)
 
 
+def _telemetry_wrap(fn, n_state, has_scaler, telemetry):
+    """Wrap one local-step variant with the StepStats observer: a
+    :class:`~apex_tpu.observability.StepStats` pytree rides right after
+    the scaler/guard states (before the data), accumulating loss, the
+    grad norm the fused clip reduction already computed (captured
+    through the trace-time :mod:`~apex_tpu.observability.stepstats`
+    seam — never a second read of the grads), the agreed finite vote,
+    the loss scale, and the param/update norms.  Stats are observers,
+    never participants: the wrapped step's params/loss are the
+    UNWRAPPED step's, bitwise (pinned in tests/test_observability.py),
+    and the wrapper adds no collectives and no host transfers (pinned
+    in tests/test_lowered_invariants.py)."""
+    from apex_tpu.observability import stepstats as _st
+
+    def wrapped(params, opt_state, *rest):
+        states = rest[:n_state]
+        stats, tokens, targets = rest[n_state:]
+        with _st.capture() as cap:
+            out = fn(params, opt_state, *states, tokens, targets)
+        loss = out[-1]
+        # with a scaler the NEW scaler state sits right after opt_state
+        scale = out[2].loss_scale if has_scaler else None
+        new_stats = telemetry.accumulate(
+            stats, loss=loss, grad_norm=cap.get("grad_norm"),
+            finite=cap.get("all_finite"), loss_scale=scale,
+            new_params=out[0], old_params=params)
+        return (*out[:-1], new_stats, loss)
+
+    return wrapped
+
+
 def _step_variant(loss_scaler, step_guard, variants, specs, sspec,
-                  data_spec):
+                  data_spec, telemetry=None):
     """Pick the local-step variant and its shard_map specs for a
-    scaler×guard combination.  ``variants`` maps (has_scaler, has_guard)
-    to the local step fn; each enabled feature adds one replicated
-    scalar-state arg (scaler state, then guard state) between the
-    optimizer state and the data, and one replicated output before the
-    loss."""
+    scaler×guard(×telemetry) combination.  ``variants`` maps
+    (has_scaler, has_guard) to the local step fn; each enabled feature
+    adds one replicated scalar-state arg (scaler state, then guard
+    state, then the StepStats window) between the optimizer state and
+    the data, and one replicated output before the loss.  Returns
+    ``(fn, in_specs, out_specs, stats_argnum)`` — ``stats_argnum`` is
+    the StepStats position (for donation), or None."""
     from jax.sharding import PartitionSpec as P
 
     fn = variants[(loss_scaler is not None, step_guard is not None)]
     n_state = int(loss_scaler is not None) + int(step_guard is not None)
+    stats_argnum = None
+    if telemetry is not None:
+        fn = _telemetry_wrap(fn, n_state, loss_scaler is not None,
+                             telemetry)
+        stats_argnum = 2 + n_state
+        n_state += 1
     state_specs = (P(),) * n_state
     in_specs = (specs, sspec, *state_specs, data_spec, data_spec)
     out_specs = (specs, sspec, *state_specs, P())
-    return fn, in_specs, out_specs
+    return fn, in_specs, out_specs, stats_argnum
 
 
 def make_train_step(
@@ -820,8 +862,22 @@ def make_train_step(
     chaos=None,
     clip_grad_norm=None,
     grad_sync_dtype=None,
+    telemetry=None,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
+
+    ``telemetry``: a :class:`apex_tpu.observability.StepTelemetry` — a
+    :class:`~apex_tpu.observability.StepStats` window rides the step
+    right after the guard state (or scaler state, or in their place):
+    ``step(params, opt_state, [scaler], [guard], stats, tokens,
+    targets) -> (..., stats, loss)``.  Loss, the global grad norm
+    (REUSED from the fused clip reduction — rank-local when
+    ``clip_grad_norm`` is off), the finite vote, the loss scale, and
+    param/update norms accumulate device-side; fetch the window
+    asynchronously with :class:`~apex_tpu.observability.AsyncFetcher`
+    and swap in ``telemetry.init()`` — the stats buffers are ALWAYS
+    donated (rebind every call).  Telemetry adds zero collectives,
+    zero host transfers, and leaves loss/params bitwise identical.
 
     ``grad_sync_dtype``: quantize the REPLICATED data-parallel
     gradient sync (``int8``/``float8_e4m3fn``/``float8_e5m2``): the dp
@@ -1101,13 +1157,17 @@ def make_train_step(
     data_spec = P(dp_axis, cp_axis)  # batch over dp, sequence over cp
 
     donate = (0, 1) if donate_state else ()
-    fn, in_specs, out_specs = _step_variant(
+    fn, in_specs, out_specs, stats_argnum = _step_variant(
         loss_scaler, step_guard,
         {(True, True): guarded_scaled_local_step,
          (True, False): scaled_local_step,
          (False, True): guarded_local_step,
          (False, False): local_step},
-        specs, sspec, data_spec)
+        specs, sspec, data_spec, telemetry=telemetry)
+    if stats_argnum is not None:
+        # the StepStats window is always rebound (fetch swaps in fresh
+        # zeros), so its tiny buffers always donate
+        donate = (*donate, stats_argnum)
     sharded = jax.shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
@@ -1173,8 +1233,14 @@ def make_pp_train_step(
     step_guard=None,
     chaos=None,
     clip_grad_norm=None,
+    telemetry=None,
 ):
     """3D-parallel (tp × pp × dp) train step via the pipeline schedule.
+
+    ``telemetry``: same contract as :func:`make_train_step` — a
+    :class:`~apex_tpu.observability.StepStats` window rides after the
+    scaler/guard states, accumulated device-side, always donated,
+    never a participant in the update.
 
     ``clip_grad_norm``: global-l2 grad clip folded into the engine
     optimizer's fused grad pass (see :func:`make_train_step`).
@@ -1473,13 +1539,15 @@ def make_pp_train_step(
     data_spec = P(dp_axis, cp_axis) if dp_axis is not None else P(None, cp_axis)
 
     donate = (0, 1) if donate_state else ()
-    fn, in_specs, out_specs = _step_variant(
+    fn, in_specs, out_specs, stats_argnum = _step_variant(
         loss_scaler, step_guard,
         {(True, True): guarded_scaled_local_step,
          (True, False): scaled_local_step,
          (False, True): guarded_local_step,
          (False, False): local_step},
-        specs, sspec, data_spec)
+        specs, sspec, data_spec, telemetry=telemetry)
+    if stats_argnum is not None:
+        donate = (*donate, stats_argnum)
     sharded = jax.shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,

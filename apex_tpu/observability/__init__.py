@@ -1,0 +1,47 @@
+"""apex_tpu.observability — metrics, step telemetry, goodput.
+
+The unified telemetry layer (TorchTitan's built-in-metrics pillar,
+PAPERS.md arxiv 2410.06511) over three sub-modules:
+
+- :mod:`~apex_tpu.observability.metrics`: process-local rank-aware
+  counters/gauges/histograms with labels, a JSONL time-series sidecar
+  (the ``log_structured`` greppability contract) and a Prometheus text
+  exporter; :class:`MetricsScope` routes the resilience/IO/serving
+  retrofit counters (fallback trips, io retries, watchdog wedges,
+  preemption drains, queue depth, TTFT) into a caller-owned registry.
+- :mod:`~apex_tpu.observability.stepstats`: the :class:`StepStats`
+  pytree riding ``make_train_step(telemetry=...)`` — loss, the grad
+  norm reused from the fused clip reduction, the finite vote, the
+  loss scale, param/update norms — accumulated device-side and fetched
+  asynchronously (:class:`AsyncFetcher`; zero ``.item()`` in the hot
+  loop — analyzer rule APX108 enforces the seam).
+- :mod:`~apex_tpu.observability.goodput`: per-session wall-time
+  attribution (checkpoint / restore / restart / wedge vs productive)
+  whose report fractions sum to 1 across elastic restarts, plus the
+  centralized model-FLOPs/MFU formulas.
+
+See docs/observability.md for the metric name schema, the fetch-cadence
+knob, and the goodput attribution table.
+"""
+
+from apex_tpu.observability.correlation import (
+    clear_step_context, set_step_context, step_context,
+)
+from apex_tpu.observability.goodput import (
+    GoodputAccountant, decode_flops_per_token, goodput_report,
+    model_flops_per_step, model_flops_per_token, param_count,
+)
+from apex_tpu.observability.metrics import (
+    MetricsRegistry, MetricsScope, append_jsonl, get_metrics,
+)
+from apex_tpu.observability.stepstats import (
+    AsyncFetcher, StepStats, StepTelemetry,
+)
+
+__all__ = [
+    "AsyncFetcher", "GoodputAccountant", "MetricsRegistry", "MetricsScope",
+    "StepStats", "StepTelemetry", "append_jsonl", "clear_step_context",
+    "decode_flops_per_token", "get_metrics", "goodput_report",
+    "model_flops_per_step", "model_flops_per_token", "param_count",
+    "set_step_context", "step_context",
+]

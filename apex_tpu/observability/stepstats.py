@@ -1,0 +1,301 @@
+"""Device-side per-step training telemetry with asynchronous fetch.
+
+The blocking spelling of training telemetry — ``float(loss)`` every
+step — costs a full host sync per step (the device drains its dispatch
+queue while the host formats a string).  This module is the allowed
+spelling (analyzer rule APX108 flags the blocking one):
+
+- :class:`StepStats` is a tiny pytree of device scalars that rides the
+  jitted train step exactly like
+  :class:`~apex_tpu.resilience.step_guard.GuardState` does: loss
+  (last + window sum), the global gradient norm **reused from the
+  optimizer's fused clip reduction** (never a new HBM pass — see the
+  capture seam below), the all-finite vote, the loss scale, and the
+  param/update norms.  Accumulation is branch-free device arithmetic
+  fused into the compiled step; the stats buffers are donated.
+- :class:`AsyncFetcher` is the host half: the loop hands it device
+  arrays (``put``) — it starts a non-blocking device→host copy and the
+  loop keeps dispatching; completed copies are harvested later
+  (``ready``, non-blocking; ``flush`` blocks, for end of run).  Zero
+  ``.item()``/``float()`` of a device array ever runs in the hot loop.
+
+**Capture seam** (:func:`capture`/:func:`offer`): the step builders
+wrap the traced step body in ``with capture() as cap:``; the optimizer
+engines *offer* interior traced values (the clip's global grad norm,
+the agreed all-finite flag) into it at trace time.  This is a
+trace-time side channel — it costs nothing at run time and lets the
+stats reuse reductions the update already computes instead of re-reading
+the gradients.  When no clip is configured the engines fold a local
+Σx² into the same grad read (fused by XLA — still no extra pass), so
+``grad_norm`` is then the *rank-local* norm on sharded axes; with
+``clip_grad_norm`` set it is the exact global norm the clip agreed.
+
+Stats are **observers, never participants**: nothing here feeds back
+into the update, so telemetry-on and telemetry-off steps produce
+bitwise-identical losses and params (pinned in
+tests/test_observability.py) and identical collective counts (pinned
+in tests/test_lowered_invariants.py).
+"""
+
+import contextlib
+from collections import deque
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["AsyncFetcher", "StepStats", "StepTelemetry", "capture",
+           "capturing", "offer"]
+
+
+class StepStats(NamedTuple):
+    """The windowed device-side accumulator (all scalars; donated)."""
+
+    steps: jnp.ndarray          # i32: steps accumulated in this window
+    loss_sum: jnp.ndarray       # f32: Σ loss over the window
+    loss_last: jnp.ndarray      # f32
+    grad_norm_sum: jnp.ndarray  # f32: Σ grad-norm (see module doc)
+    grad_norm_last: jnp.ndarray  # f32
+    notfinite: jnp.ndarray      # i32: non-finite (skipped) steps in window
+    loss_scale: jnp.ndarray     # f32: last loss scale (nan without amp)
+    param_norm: jnp.ndarray     # f32: last ||params|| (local shards)
+    update_norm: jnp.ndarray    # f32: last ||Δparams|| (local shards)
+
+
+# ------------------------------------------------------------ capture seam
+_CAPTURE: List[Dict[str, Any]] = []
+
+
+def capturing() -> bool:
+    """True while a step builder's telemetry wrapper is tracing — the
+    engines use this to fold the (otherwise skipped) Σx² stat into
+    their one grad read."""
+    return bool(_CAPTURE)
+
+
+def offer(key: str, value) -> None:
+    """Trace-time: expose an interior traced value (``grad_norm``,
+    ``all_finite``) to the innermost active :func:`capture`.  No-op —
+    one truthiness check — when nothing captures."""
+    if _CAPTURE:
+        _CAPTURE[-1][key] = value
+
+
+@contextlib.contextmanager
+def capture():
+    """``with capture() as cap:`` around a traced step body; ``cap``
+    collects everything the interior :func:`offer`'d."""
+    cap: Dict[str, Any] = {}
+    _CAPTURE.append(cap)
+    try:
+        yield cap
+    finally:
+        _CAPTURE.pop()
+
+
+def offer_local_grad_norm(arrays) -> None:
+    """The no-clip grad-norm stat, in ONE place for all three engine
+    paths (bucketed prepare, per-leaf dispatch, ZeRO shards): when a
+    telemetry wrapper captures and no clip reduction exists to reuse,
+    fold a rank-local Σx² over ``arrays`` into the engine's one grad
+    read (XLA fuses the reduce with the read — still no extra HBM
+    pass) and offer its sqrt.  No-op when nothing captures."""
+    if not _CAPTURE:
+        return
+    offer("grad_norm", jnp.sqrt(sum(
+        jnp.sum(jnp.square(jnp.asarray(a).astype(jnp.float32)))
+        for a in arrays)))
+
+
+# ------------------------------------------------------------- device side
+class StepTelemetry:
+    """Build-time telemetry spec for ``make_train_step(telemetry=...)``.
+
+    ``norms=False`` drops the param/update norm stats (two extra — XLA
+    fuses them, but nonzero — elementwise reads of the param trees per
+    step); everything else reuses values the step already computes.
+    """
+
+    def __init__(self, norms: bool = True):
+        self.norms = bool(norms)
+
+    def init(self) -> StepStats:
+        """Fresh zeroed window (also what the loop swaps in after each
+        fetch — the fetched buffers must NOT ride into the next step:
+        they are donated).  Every field gets its OWN buffer: the stats
+        ride a donating step, and donating one shared buffer at several
+        argument positions is an Execute()-time crash (the
+        ``base.make_master`` copy=True lesson)."""
+        return StepStats(
+            steps=jnp.int32(0),
+            loss_sum=jnp.float32(0.0),
+            loss_last=jnp.float32(0.0),
+            grad_norm_sum=jnp.float32(0.0),
+            grad_norm_last=jnp.float32(jnp.nan),
+            notfinite=jnp.int32(0),
+            loss_scale=jnp.float32(jnp.nan),
+            param_norm=jnp.float32(jnp.nan),
+            update_norm=jnp.float32(jnp.nan))
+
+    def init_like(self, stats: StepStats) -> StepStats:
+        """Fresh zeroed window placed with ``stats``' shardings — what
+        the fetch seam swaps in mid-run.  The jit cache keys on input
+        shardings, so resetting with uncommitted host scalars would
+        retrace the step once per fetch; matching the outgoing window's
+        (replicated) placement keeps the steady-state signature — and
+        the compiled-variant count — fixed."""
+        return jax.tree.map(
+            lambda z, old: jax.device_put(z, old.sharding),
+            self.init(), stats)
+
+    def accumulate(self, stats: StepStats, *, loss, grad_norm=None,
+                   finite=None, loss_scale=None, new_params=None,
+                   old_params=None) -> StepStats:
+        """One step's device-side accounting (branch-free, traced into
+        the step).  ``grad_norm``/``finite`` come from the capture
+        seam and may be absent (non-engine optimizers, unguarded
+        unscaled steps): absent ``finite`` counts as finite, absent
+        ``grad_norm`` freezes the nan placeholder."""
+        loss = jnp.asarray(loss, jnp.float32)
+        if grad_norm is not None:
+            gn = jnp.asarray(grad_norm, jnp.float32)
+            gn_sum = stats.grad_norm_sum + gn
+        else:
+            gn = stats.grad_norm_last
+            gn_sum = stats.grad_norm_sum
+        bad = (jnp.int32(0) if finite is None else
+               jnp.where(jnp.asarray(finite), jnp.int32(0), jnp.int32(1)))
+        scale = (stats.loss_scale if loss_scale is None
+                 else jnp.asarray(loss_scale, jnp.float32))
+        pn, un = stats.param_norm, stats.update_norm
+        if self.norms and new_params is not None and old_params is not None:
+            psq = sum(jnp.sum(jnp.square(p.astype(jnp.float32)))
+                      for p in jax.tree.leaves(new_params))
+            usq = sum(jnp.sum(jnp.square(
+                n.astype(jnp.float32) - o.astype(jnp.float32)))
+                for n, o in zip(jax.tree.leaves(new_params),
+                                jax.tree.leaves(old_params)))
+            pn, un = jnp.sqrt(psq), jnp.sqrt(usq)
+        return StepStats(
+            steps=stats.steps + jnp.int32(1),
+            loss_sum=stats.loss_sum + loss, loss_last=loss,
+            grad_norm_sum=gn_sum, grad_norm_last=gn,
+            notfinite=stats.notfinite + bad,
+            loss_scale=scale, param_norm=pn, update_norm=un)
+
+    # ---------------------------------------------------------- host side
+    @staticmethod
+    def summary(stats_np: Dict[str, Any]) -> Dict[str, float]:
+        """Harvested window (a ``{field: np scalar}`` dict from
+        :class:`AsyncFetcher`) → plain floats for printing/metrics."""
+        n = max(int(stats_np["steps"]), 1)
+        gn_last = float(stats_np["grad_norm_last"])
+        # the window never received a grad norm (non-engine optimizer):
+        # grad_norm_sum sat at its 0.0 init — report "unavailable"
+        # (nan, matching grad_norm_last), never a fake 0.0 mean
+        gn_mean = (float(stats_np["grad_norm_sum"]) / n
+                   if np.isfinite(gn_last) else float("nan"))
+        out = {
+            "steps": int(stats_np["steps"]),
+            "loss_mean": float(stats_np["loss_sum"]) / n,
+            "loss_last": float(stats_np["loss_last"]),
+            "grad_norm_last": gn_last,
+            "grad_norm_mean": gn_mean,
+            "bad_steps": int(stats_np["notfinite"]),
+            "loss_scale": float(stats_np["loss_scale"]),
+            "param_norm": float(stats_np["param_norm"]),
+            "update_norm": float(stats_np["update_norm"]),
+        }
+        return out
+
+    @staticmethod
+    def emit(registry, stats_np: Dict[str, Any],
+             prefix: str = "apex_train") -> Dict[str, float]:
+        """Record a harvested window onto a
+        :class:`~apex_tpu.observability.metrics.MetricsRegistry`
+        (gauges for the point-in-time stats, counters for the
+        cumulative ones); returns the summary dict."""
+        s = StepTelemetry.summary(stats_np)
+        registry.counter(f"{prefix}_steps_total",
+                         "train steps accumulated").inc(s["steps"])
+        registry.counter(f"{prefix}_bad_steps_total",
+                         "non-finite (skipped) steps").inc(s["bad_steps"])
+        g = registry.gauge
+        # every gauge is isfinite-gated: a skipped overflow step (routine
+        # while an fp16 scaler searches down) puts inf in the window's
+        # loss_sum — bad_steps_total carries that fact; the loss gauges
+        # must keep tracking the real trend, not freeze a dashboard at
+        # inf (the summary dict returns the raw values regardless)
+        for key, gname, help_ in (
+                ("loss_mean", f"{prefix}_loss", "window-mean train loss"),
+                ("loss_last", f"{prefix}_loss_last", "last step's loss"),
+                ("grad_norm_last", f"{prefix}_grad_norm_last", ""),
+                ("grad_norm_mean", f"{prefix}_grad_norm_mean", ""),
+                ("loss_scale", f"{prefix}_loss_scale", ""),
+                ("param_norm", f"{prefix}_param_norm", ""),
+                ("update_norm", f"{prefix}_update_norm", "")):
+            if np.isfinite(s[key]):
+                g(gname, help_).set(s[key])
+        return s
+
+
+# ------------------------------------------------------------- async fetch
+def _start_copy(leaf):
+    try:
+        leaf.copy_to_host_async()
+    except AttributeError:
+        pass  # non-jax leaf (plain number): nothing to overlap
+
+
+def _is_ready(leaf) -> bool:
+    fn = getattr(leaf, "is_ready", None)
+    return True if fn is None else bool(fn())
+
+
+class AsyncFetcher:
+    """The non-blocking device→host telemetry channel.
+
+    ``put(kind, step, tree)`` starts an async copy of every array leaf
+    and queues the entry; ``ready()`` harvests — in FIFO order, so
+    printed lines stay step-ordered — every entry whose arrays have
+    materialized, WITHOUT blocking (an entry still in flight stops the
+    harvest); ``flush()`` blocks for the stragglers (end of run /
+    preemption exit, where a sync is correct).  Harvested trees are
+    plain numpy.
+
+    The loop must not pass a ``put`` tree onward into a donating step
+    call (the stats protocol swaps in fresh
+    :meth:`StepTelemetry.init` buffers at each fetch) — the fetcher
+    holds the only live reference until harvest."""
+
+    def __init__(self):
+        self._pending: deque = deque()
+
+    def put(self, kind: str, step: int, tree) -> None:
+        jax.tree.map(_start_copy, tree)
+        self._pending.append((kind, int(step), tree))
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def _to_np(self, tree):
+        return jax.tree.map(np.asarray, tree)
+
+    def ready(self) -> List[Tuple[str, int, Any]]:
+        out = []
+        while self._pending:
+            kind, step, tree = self._pending[0]
+            if not all(_is_ready(x) for x in jax.tree.leaves(tree)):
+                break
+            self._pending.popleft()
+            out.append((kind, step, self._to_np(tree)))
+        return out
+
+    def flush(self) -> List[Tuple[str, int, Any]]:
+        out = []
+        while self._pending:
+            kind, step, tree = self._pending.popleft()
+            out.append((kind, step, self._to_np(tree)))
+        return out

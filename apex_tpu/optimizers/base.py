@@ -35,6 +35,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.observability import stepstats as _stepstats
 from apex_tpu.optimizers import bucketing
 
 Tree = Any
@@ -231,8 +232,15 @@ def prepare_grads_bucketed(params, grads, scale=None, clip_norm=None,
             plan, g, lambda x: jnp.sum(jnp.square(x)))
         total_sq = (jnp.stack(sq).sum() if sumsq_reduce is None
                     else sumsq_reduce(sq))
+        # the telemetry seam reuses the clip's (globally agreed) norm —
+        # the "no new HBM pass" contract of observability.stepstats
+        _stepstats.offer("grad_norm", jnp.sqrt(total_sq))
         coef = _clip_coef(jnp.sqrt(total_sq), clip_norm)
         g = [a * coef for a in g]
+    else:
+        # no clip to reuse: the shared rank-local fold (no-op unless a
+        # telemetry wrapper captures; docs/observability.md)
+        _stepstats.offer_local_grad_norm(g)
     return PreparedGrads(plan=plan, g=tuple(g), finite=finite)
 
 
@@ -304,9 +312,12 @@ class OptimizerBase:
                       for x in jax.tree.leaves(g)]
                 total_sq = (jnp.stack(sq).sum() if sumsq_reduce is None
                             else sumsq_reduce(sq))
+                _stepstats.offer("grad_norm", jnp.sqrt(total_sq))
                 coef = _clip_coef(jnp.sqrt(total_sq), clip_norm)
                 g = jax.tree.map(
                     lambda x: x.astype(jnp.float32) * coef, g)
+            else:
+                _stepstats.offer_local_grad_norm(jax.tree.leaves(g))
             p, s = self._leaf_update(g, state, params,
                                      grads_finite=finite, lr=lr, **kw)
             return p, s, finite

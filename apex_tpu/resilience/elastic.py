@@ -48,6 +48,7 @@ from typing import Any, Dict, Mapping, NamedTuple, Optional
 
 import numpy as np
 
+from apex_tpu.observability import metrics as _metrics
 from apex_tpu.utils.logging import get_logger, log_structured
 
 import logging
@@ -105,7 +106,11 @@ class StepWatchdog:
     ``first_deadline_sec`` covers the first interval (jit compiles make
     step 0 legitimately slow); defaults to ``deadline_sec``.
     ``on_fire`` replaces the exit for tests: called with the fire-info
-    dict instead of terminating.  ``preemption`` (a
+    dict instead of terminating.  ``on_wedge`` is a best-effort
+    pre-exit hook called with the fire-info dict BEFORE the drain (the
+    goodput accountant's ``finalize("wedge")`` rides it, so the wedged
+    tail is attributable after the ``os._exit``); its failures are
+    swallowed — the watchdog must still exit.  ``preemption`` (a
     :class:`~apex_tpu.resilience.preemption.PreemptionHandler`) routes
     the drain through its re-entrancy guard so a watchdog firing while
     the loop already drains cannot double-enter the flush.
@@ -115,7 +120,7 @@ class StepWatchdog:
                  exit_code: int = EXIT_WEDGED, poll_sec: Optional[float] = None,
                  first_deadline_sec: Optional[float] = None,
                  drain_timeout_sec: float = 60.0, on_fire=None,
-                 preemption=None):
+                 preemption=None, on_wedge=None):
         if deadline_sec <= 0:
             raise ValueError(f"deadline_sec must be > 0, got {deadline_sec}")
         self.deadline_sec = float(deadline_sec)
@@ -127,6 +132,7 @@ class StepWatchdog:
         self._preemption = preemption
         self._drain_timeout = float(drain_timeout_sec)
         self._on_fire = on_fire
+        self.on_wedge = on_wedge
         self._poll = float(poll_sec) if poll_sec is not None else min(
             1.0, self.deadline_sec / 4.0)
         self._lock = threading.Lock()
@@ -233,6 +239,20 @@ class StepWatchdog:
                 "deadline_s": deadline, "exit_code": self.exit_code}
         log_structured(_logger, logging.ERROR, "watchdog.step_wedged",
                        **info)
+        # two SEPARATE best-effort blocks: a metrics registration clash
+        # must not also rob the goodput accountant of its wedge stamp
+        # (the attribution the report exists to make)
+        _metrics.inc("apex_watchdog_wedges_total",
+                     help="steps the watchdog declared wedged")
+        try:
+            if self.on_wedge is not None:
+                self.on_wedge(info)
+        except Exception as e:  # noqa: BLE001 — the hook is best-effort;
+            # the watchdog's one job is to exit, so a broken accountant
+            # must never wedge the wedge handler
+            log_structured(_logger, logging.WARNING,
+                           "watchdog.on_wedge_failed",
+                           error=f"{type(e).__name__}: {e}")
         info["drain"] = self._drain_bounded()
         log_structured(_logger, logging.ERROR, "watchdog.exiting",
                        **info)

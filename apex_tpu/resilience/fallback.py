@@ -60,6 +60,7 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional
 
+from apex_tpu.observability import metrics as _metrics
 from apex_tpu.utils.logging import get_logger, log_structured
 
 __all__ = [
@@ -177,6 +178,9 @@ class KernelFallbackRegistry:
             _logger, logging.WARNING, "kernel_fallback.tripped",
             kernel=name, error=e.error,
             action="using XLA reference impl for every later trace")
+        _metrics.inc("apex_kernel_fallback_trips_total",
+                     help="Pallas kernels degraded to their XLA reference",
+                     kernel=name)
 
     def tripped(self, name: str) -> bool:
         return self._entry(name).tripped

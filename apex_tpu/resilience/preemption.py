@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Optional
 
+from apex_tpu.observability import metrics as _metrics
 from apex_tpu.utils.logging import get_logger, log_structured
 
 __all__ = [
@@ -117,6 +118,8 @@ class PreemptionHandler:
             self.reason = reason
             log_structured(_logger, logging.WARNING, "preemption.received",
                            reason=reason)
+            _metrics.inc("apex_preemptions_total",
+                         help="preemption notices received")
         self._event.set()
 
     def simulate(self, reason: str = "simulated (chaos)") -> None:
@@ -174,9 +177,14 @@ class PreemptionHandler:
         try:
             t0 = time.monotonic()
             checkpointer.wait_until_finished()
+            flush_s = time.monotonic() - t0
             log_structured(_logger, logging.WARNING, "preemption.drained",
                            reason=self.reason,
-                           flush_seconds=round(time.monotonic() - t0, 3))
+                           flush_seconds=round(flush_s, 3))
+            _metrics.inc("apex_preemption_drains_total",
+                         help="async-checkpoint queue drains")
+            _metrics.observe("apex_preemption_drain_seconds", flush_s,
+                             help="drain flush latency")
         except BaseException as e:
             self._drain_done.error = e  # visible to piggybacked waiters
             raise

@@ -28,6 +28,8 @@ from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 
+from apex_tpu.observability import metrics as _metrics
+
 __all__ = ["GuardState", "StepGuard", "BadStepBudgetExceeded"]
 
 
@@ -86,6 +88,8 @@ class StepGuard:
         syncs (the loss print).  Raises :class:`BadStepBudgetExceeded`
         when the streak hits the budget; returns the state otherwise."""
         if int(state.consecutive_bad) >= self.max_consecutive_bad:
+            _metrics.inc("apex_bad_step_budget_aborts_total",
+                         help="runs aborted on the consecutive-bad budget")
             raise BadStepBudgetExceeded(
                 f"{int(state.consecutive_bad)} consecutive non-finite "
                 f"steps (budget {self.max_consecutive_bad}); "

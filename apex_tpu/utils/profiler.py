@@ -34,8 +34,19 @@ _range_stack: List[object] = []
 @contextlib.contextmanager
 def nvtx_range(name: str):
     """Named range visible in both the HLO (op metadata) and the host
-    trace.  Usable inside traced code (the named_scope part) and out."""
-    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+    trace.  Usable inside traced code (the named_scope part) and out.
+
+    When the loop set a step-correlation context
+    (:func:`apex_tpu.observability.set_step_context`), the scope name
+    carries a ``.run_<id>.s<step>`` suffix, so an xprof range joins a
+    structured log line and a metrics point on ``(run_id, step)``."""
+    try:
+        from apex_tpu.observability.correlation import span_suffix
+
+        tagged = name + span_suffix()
+    except ImportError:  # pragma: no cover — torn installs only
+        tagged = name
+    with jax.named_scope(tagged), jax.profiler.TraceAnnotation(tagged):
         yield
 
 

@@ -438,8 +438,12 @@ def _bench_gpt_at_batch(layers, hidden, heads, seq, batch, roofline_tflops,
     dt = (time.perf_counter() - t0) / iters
 
     tokens_per_sec = batch * seq / dt
-    # model FLOPs per token: 6N params + attention 12·L·S·H (fwd+bwd)
-    flops_per_token = 6 * n_params + 12 * layers * seq * hidden
+    # model FLOPs per token: 6N params + attention 12·L·S·H (fwd+bwd) —
+    # the ONE formula, shared with the trainer's goodput report
+    from apex_tpu.observability import goodput as _goodput
+
+    flops_per_token = _goodput.model_flops_per_token(
+        n_params, layers, seq, hidden)
     tflops = flops_per_token * tokens_per_sec / 1e12
     return {
         "params_m": round(n_params / 1e6, 1),
@@ -447,6 +451,10 @@ def _bench_gpt_at_batch(layers, hidden, heads, seq, batch, roofline_tflops,
         "tokens_per_sec": round(tokens_per_sec, 0),
         "ms_per_step": round(dt * 1e3, 2),
         "model_tflops": round(tflops, 1),
+        # goodput column: what the trainer's goodput accountant would
+        # report as model flops for a restart-free run at this step time
+        "flops_per_step": _goodput.model_flops_per_step(
+            n_params, layers, seq, hidden, batch),
         # MFU only against a *measured* roofline — no hardcoded denominator
         "mfu_vs_measured_roofline": (
             round(tflops / roofline_tflops, 3) if roofline_tflops else None
@@ -915,7 +923,7 @@ _DEVICE_WEDGED = False
 def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
                        vocab=50304, prompt_len=64, max_new=32,
                        requests_per_stream=2, page_size=16,
-                       attn_impls=None, seed=0):
+                       attn_impls=None, seed=0, roofline_tflops=None):
     """The SERVING section: the paged-KV decode engine
     (apex_tpu.inference) on GPT-124M — aggregate decode tokens/sec and
     per-token latency p50/p99 at N concurrent streams, with a decode-
@@ -942,7 +950,11 @@ def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
         compute_dtype=jnp.float32 if _SMOKE else jnp.bfloat16,
         checkpoint_layers=False,
     )
+    from apex_tpu.observability import goodput as _goodput
+
     params = init_params(cfg, jax.random.PRNGKey(seed))
+    decode_flops = _goodput.decode_flops_per_token(
+        _goodput.param_count(params))
     pages_per = pages_needed(prompt_len + max_new, page_size)
     out = {"model": f"L{layers} H{hidden} V{vocab}",
            "prompt_len": prompt_len, "max_new": max_new,
@@ -972,10 +984,18 @@ def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
         for c in done:
             per_token.extend(np.diff(c.token_times))
         n_tok = sum(len(c.tokens) for c in done)
+        tps = n_tok / max(dt, 1e-9)
+        # serving MFU: decode matmul FLOPs (2N/token) over the measured
+        # roofline — the decode-side goodput column
+        tflops = decode_flops * tps / 1e12
         rec = {"requests": n_req,
-               "tokens_per_sec": round(n_tok / max(dt, 1e-9), 2),
+               "tokens_per_sec": round(tps, 2),
                "decode_steps": sched.stats["decode_steps"],
-               "decode_compiles": sched.decode_cache_size()}
+               "decode_compiles": sched.decode_cache_size(),
+               "model_tflops": round(tflops, 3),
+               "mfu_vs_measured_roofline": (
+                   round(tflops / roofline_tflops, 4)
+                   if roofline_tflops else None)}
         if per_token:
             rec["per_token_p50_ms"] = round(
                 1e3 * float(np.percentile(per_token, 50)), 3)
@@ -1001,21 +1021,26 @@ _SECTIONS_PATH = os.environ.get("BENCH_SECTIONS_PATH", "BENCH_sections.jsonl")
 
 
 def _record_section(name, result) -> None:
-    """Stream each completed section to a sidecar JSONL, append+fsync —
-    a mid-run wedge (the failure mode observed in rounds 3 AND 4)
-    preserves every section that finished instead of losing the whole
-    ~7-section run.  stdout keeps the one-final-JSON-line contract;
-    this file is the partial-evidence channel."""
+    """Stream each completed section to a sidecar JSONL — a mid-run
+    wedge (the failure mode observed in rounds 3 AND 4) preserves every
+    section that finished instead of losing the whole ~7-section run.
+    stdout keeps the one-final-JSON-line contract; this file is the
+    partial-evidence channel.  The writer is the observability
+    registry's ONE append+flush+fsync JSONL writer (the fields are
+    unchanged — ``_load_sections`` and the banked-fallback merge read
+    the same records they always did), and each section also ticks the
+    ``apex_bench_sections_total`` counter so ``--smoke`` can cover the
+    Prometheus exporter end-to-end."""
     try:
-        line = json.dumps({
+        from apex_tpu.observability import metrics as om
+
+        om.append_jsonl(_SECTIONS_PATH, {
             "section": name,
             "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "result": result,
         })
-        with open(_SECTIONS_PATH, "a") as f:
-            f.write(line + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        om.inc("apex_bench_sections_total",
+               help="bench sections recorded", section=name)
     except Exception as e:  # noqa: BLE001 — the sidecar is best-effort;
         # a serialization surprise must not kill the stdout contract
         _progress(f"section sidecar write failed: {e}")
@@ -1206,6 +1231,41 @@ def _smoke_params(seed=0):
     }
 
 
+def _smoke_metrics_exporter():
+    """--smoke coverage of the observability exporter seam bench rides:
+    record a section through :func:`_record_section` (the registry +
+    sidecar writer), then assert the Prometheus text and the JSONL
+    snapshot both contain it."""
+    import json as _json
+    import tempfile
+
+    from apex_tpu.observability import metrics as om
+
+    global _SECTIONS_PATH
+    old_path = _SECTIONS_PATH
+    with tempfile.TemporaryDirectory() as d:
+        try:
+            # the probe must not pollute the REAL sidecar (the banked-
+            # evidence channel a wedged run resumes from)
+            _SECTIONS_PATH = os.path.join(d, "sections.jsonl")
+            with om.MetricsScope() as reg:
+                _record_section("smoke_exporter_probe", {"ok": True})
+                txt = reg.prometheus_text()
+                assert "apex_bench_sections_total" in txt, txt[:400]
+                assert 'section="smoke_exporter_probe"' in txt, txt[:400]
+                p = os.path.join(d, "m.jsonl")
+                n = reg.snapshot_jsonl(p)
+                assert n >= 1
+                recs = [_json.loads(l) for l in open(p)]
+                assert any(r["metric"] == "apex_bench_sections_total"
+                           for r in recs)
+            sidecar = [_json.loads(l) for l in open(_SECTIONS_PATH)]
+            assert sidecar[0]["section"] == "smoke_exporter_probe"
+        finally:
+            _SECTIONS_PATH = old_path
+    return {"exporter": "ok"}
+
+
 def _smoke_main(only=None) -> int:
     """``--smoke``: trace + compile + single-execute a SMALL config of
     every bench section on the host platform (CPU in tier-1).  No
@@ -1256,6 +1316,10 @@ def _smoke_main(only=None) -> int:
             streams=(1, 2), layers=2, hidden=64, heads=2, vocab=512,
             prompt_len=8, max_new=4, page_size=4,
             attn_impls=("interpret", "xla")),
+        # the observability exporter: the registry the section sidecar
+        # records through must round-trip both export formats
+        # (Prometheus text + the JSONL snapshot)
+        "metrics_exporter": _smoke_metrics_exporter,
     }
     if only:
         unknown = set(only) - set(sections)
@@ -1468,7 +1532,8 @@ def main():
     known = {"matmul_roofline", "fused_adam", "fused_ln", "gpt124_s1024",
              "gpt124_s4096", "gpt345_s1024", "gpt124_s1024_fce",
              "resnet50_b64", "bert_base_lamb", "flash_attn",
-             "zero2_vs_fused", "zero_gpt124", "elastic_resume"}
+             "zero2_vs_fused", "zero_gpt124", "elastic_resume",
+             "serve_gpt124"}
     only = set(cli.only.split(",")) if cli.only else None
     if only is not None and not only <= known:
         # a typo'd section name must fail loudly BEFORE the multi-minute
@@ -1578,7 +1643,8 @@ def main():
                if want("elastic_resume") else skipped)
     # serving: decode tokens/sec + latency percentiles at N streams,
     # paged-attention Pallas-vs-XLA A/B (apex_tpu.inference)
-    serve = (_try("serve_gpt124", bench_serve_gpt124, section_budget=900.0)
+    serve = (_try("serve_gpt124", bench_serve_gpt124, section_budget=900.0,
+                  roofline_tflops=roof)
              if want("serve_gpt124") else skipped)
 
     headline = adam.get("speedup_vs_eager") if isinstance(adam, dict) else None

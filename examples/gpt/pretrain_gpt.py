@@ -110,6 +110,25 @@ def parse_args():
                         "--chaos-wedge-secs (pair with --watchdog-secs "
                         "to demonstrate the drain-and-exit path)")
     p.add_argument("--chaos-wedge-secs", type=float, default=120.0)
+    p.add_argument("--metrics-dir", default=None,
+                   help="observability sink dir (apex_tpu.observability): "
+                        "device-side StepStats telemetry rides the jitted "
+                        "step and is fetched ASYNCHRONOUSLY (no per-step "
+                        "host sync), windows land in metrics.jsonl, a "
+                        "final Prometheus snapshot in metrics.prom, and "
+                        "goodput accounting (productive vs checkpoint/"
+                        "restore/restart/wedge wall time, surviving "
+                        "elastic restarts) in goodput_*.json + "
+                        "goodput_report.json")
+    p.add_argument("--telemetry-every", type=int, default=8,
+                   help="StepStats fetch cadence (steps per window): the "
+                        "accumulated window is handed to the async "
+                        "fetcher and a fresh one swapped in — lower = "
+                        "finer time series, higher = less host work")
+    p.add_argument("--run-id", default="gpt",
+                   help="correlation id stamped on structured logs, "
+                        "metrics points, and xprof trace spans (join key "
+                        "is (run_id, step))")
     p.add_argument("--auto-resume", action="store_true",
                    help="preemption-safe mode (needs --checkpoint): resume "
                         "from the newest VALID checkpoint in the dir if one "
@@ -139,6 +158,9 @@ def main():
     if args.auto_resume and not args.checkpoint:
         raise SystemExit("--auto-resume needs --checkpoint (the dir it "
                          "both resumes from and saves into)")
+    if args.telemetry_every < 1:
+        raise SystemExit("--telemetry-every must be >= 1 (steps per "
+                         "StepStats fetch window)")
 
     mesh = ps.initialize_model_parallel(
         tensor_model_parallel_size_=args.tp,
@@ -207,6 +229,56 @@ def main():
     scaler = DynamicLossScaler(init_scale=2.0 ** 12) if args.fp16 else None
     scaler_state = scaler.init() if scaler else None
 
+    # Observability (apex_tpu.observability): the StepStats window rides
+    # the jitted step (device-side accumulation, donated buffers) and is
+    # fetched asynchronously — the loop below has ZERO blocking device
+    # reads (`float(loss)` per step is the spelling analyzer rule APX108
+    # flags); even without --metrics-dir the loss print itself goes
+    # through the async fetcher.  With --metrics-dir the windows feed
+    # the metrics registry (JSONL time series + final Prometheus
+    # snapshot) and a goodput accountant attributes checkpoint/restore/
+    # restart/wedge wall time across elastic restarts.
+    from apex_tpu import observability as obs
+    from apex_tpu.observability import stepstats
+
+    obs.set_step_context(run_id=args.run_id, step=0)
+    fetcher = stepstats.AsyncFetcher()
+    telemetry = stepstats.StepTelemetry() if args.metrics_dir else None
+    registry = obs.get_metrics()
+    # multi-process: metrics files are per-rank (rank labels alone can't
+    # save a last-writer-wins file clobber on a shared FS), and the
+    # goodput accountant runs on process 0 ONLY — every rank shares one
+    # wall clock, so folding N concurrent session records as if they
+    # were sequential restarts would double-count attributed time and
+    # break the fractions-sum-to-1 closure
+    proc = jax.process_index()
+    rank_sfx = f"_rank{proc}" if jax.process_count() > 1 else ""
+    if args.metrics_dir:
+        # every rank writes its own metrics files — the dir must exist
+        # on every rank, not just the accountant-owning process 0
+        Path(args.metrics_dir).mkdir(parents=True, exist_ok=True)
+    acct = (obs.GoodputAccountant(args.metrics_dir, run_id=args.run_id)
+            if args.metrics_dir and proc == 0 else None)
+    metrics_jsonl = (Path(args.metrics_dir) / f"metrics{rank_sfx}.jsonl"
+                     if args.metrics_dir else None)
+
+    def emit_harvested(kind, at_step, tree):
+        """Print/record one harvested async fetch (host numpy values —
+        the loop never touches device scalars)."""
+        if kind == "loss":
+            extra = (f" scale={float(tree['scale']):.0f}"
+                     if "scale" in tree else "")
+            print(f"step {at_step}: loss={float(tree['loss']):.4f}{extra}",
+                  flush=True)
+        else:  # a StepStats window
+            s = stepstats.StepTelemetry.emit(registry, tree)
+            registry.snapshot_jsonl(metrics_jsonl, window_end_step=at_step)
+            if acct is not None:
+                acct.heartbeat()
+            print(f"telemetry[{at_step}]: loss_mean={s['loss_mean']:.4f} "
+                  f"grad_norm={s['grad_norm_last']:.3g} "
+                  f"bad={s['bad_steps']}", flush=True)
+
     def build_step():
         # donate_state: the loop rebinds params/state every step and the
         # async checkpointer host-snapshots at save() time, so donation
@@ -216,9 +288,10 @@ def main():
         if args.pp > 1:
             return make_pp_train_step(config, optimizer, mesh,
                                       num_microbatches=args.micro_batches,
-                                      loss_scaler=scaler, donate_state=True)
+                                      loss_scaler=scaler, donate_state=True,
+                                      telemetry=telemetry)
         return make_train_step(config, optimizer, mesh, loss_scaler=scaler,
-                               donate_state=True)
+                               donate_state=True, telemetry=telemetry)
 
     step = build_step()
 
@@ -286,6 +359,7 @@ def main():
     # there; --auto-resume resumes from --checkpoint when it holds a
     # valid checkpoint and silently starts fresh otherwise (first
     # launch and post-preemption restart share one command line).
+    t_restore = time.time()
     resume_dir = args.resume or (args.checkpoint if args.auto_resume
                                  else None)
     ck = None
@@ -404,6 +478,10 @@ def main():
                     "without --fp16 or point at a matching run's dir")
             scaler_state = scaler.load_state_dict(ck["scaler"])
         print(f"resumed at step {start_step}")
+    if acct is not None and start_step:
+        # goodput: restore (incl. any elastic reshard) is attributable
+        # downtime, not productive time
+        acct.add_segment("restore", time.time() - t_restore)
 
     mb_size = args.global_batch  # sampler yields global batches here
 
@@ -466,7 +544,11 @@ def main():
     if args.watchdog_secs is not None:
         watchdog = resilience.StepWatchdog(
             args.watchdog_secs, checkpointer=ckpt, preemption=pre,
-            first_deadline_sec=args.watchdog_compile_grace)
+            first_deadline_sec=args.watchdog_compile_grace,
+            # goodput: stamp the session wedged BEFORE os._exit so the
+            # report can attribute the lost tail per cause
+            on_wedge=((lambda info: acct.finalize("wedge"))
+                      if acct is not None else None))
         watchdog.start()
     # the controller's on_step drives both from here on
     run_ctl.watchdog = watchdog
@@ -488,6 +570,12 @@ def main():
         return bool(np.max(flags))
 
     def save_at(tree, step_no):
+        if acct is None:
+            return _save_at(tree, step_no)
+        with acct.attribute("checkpoint"):
+            return _save_at(tree, step_no)
+
+    def _save_at(tree, step_no):
         if multiproc:
             # each process snapshots + writes only its addressable
             # shards (non-addressable global arrays never hit host);
@@ -526,10 +614,17 @@ def main():
                 except OSError:
                     pass
 
+    stats = telemetry.init() if telemetry is not None else None
+    window_steps = 0  # host-side: steps accumulated since the last fetch
+
     def run_step(tokens, targets):
         nonlocal step
-        step_args = (params, state, scaler_state, tokens, targets) \
-            if scaler is not None else (params, state, tokens, targets)
+        step_args = [params, state]
+        if scaler is not None:
+            step_args.append(scaler_state)
+        if stats is not None:
+            step_args.append(stats)
+        step_args = (*step_args, tokens, targets)
         if not args.auto_resume or multiproc:
             # fail-fast: without --auto-resume, kernel compile errors
             # surface to the operator (the degrade-and-rebuild retry
@@ -587,16 +682,41 @@ def main():
         # iteration's allowance covers the jit compile
         run_ctl.on_step(i, deadline=(args.watchdog_compile_grace
                                      if i == start_step else None))
+        obs.set_step_context(step=i)
         batch = next(prefetch)
         tokens = jnp.asarray(batch[:, :-1])
         targets = jnp.asarray(batch[:, 1:])
+        out = run_step(tokens, targets)
+        params, state = out[0], out[1]
+        k = 2
         if scaler is not None:
-            params, state, scaler_state, loss = run_step(tokens, targets)
-            extra = f" scale={float(scaler_state.loss_scale):.0f}"
-        else:
-            params, state, loss = run_step(tokens, targets)
-            extra = ""
-        print(f"step {i}: loss={float(loss):.4f}{extra}", flush=True)
+            scaler_state = out[k]
+            k += 1
+        if stats is not None:
+            stats = out[k]
+            k += 1
+            window_steps += 1
+        loss = out[-1]
+        # the ASYNC telemetry seam: hand the device scalars to the
+        # fetcher (starts a non-blocking copy) and print whatever
+        # earlier steps have materialized — zero blocking host reads in
+        # this loop (analyzer rule APX108 pins the spelling)
+        push = {"loss": loss}
+        if scaler is not None:
+            push["scale"] = scaler_state.loss_scale
+        fetcher.put("loss", i, push)
+        if acct is not None:
+            acct.step_done(tokens=args.global_batch * args.seq)
+        if telemetry is not None \
+                and (i + 1 - start_step) % args.telemetry_every == 0:
+            # fetch the accumulated window, swap in a fresh one placed
+            # like the old (the stats buffers are donated AND the jit
+            # cache keys on shardings)
+            fetcher.put("stats", i + 1, stats._asdict())
+            stats = telemetry.init_like(stats)
+            window_steps = 0
+        for kind, at_step, tree in fetcher.ready():
+            emit_harvested(kind, at_step, tree)
         if ckpt and (i + 1) % args.save_every == 0:
             save_at(ckpt_tree(params, state, i + 1, scaler_state), i + 1)
             last_saved = i + 1
@@ -613,9 +733,38 @@ def main():
     if watchdog is not None:
         watchdog.stop()  # the loop is done; the queue flush below may
         # legitimately outlast a step deadline
+    # final async harvest: the tail window plus any loss lines still in
+    # flight (blocking is correct here — the run is over)
+    if telemetry is not None and stats is not None and window_steps > 0:
+        fetcher.put("stats", start_step + done, stats._asdict())
+    for kind, at_step, tree in fetcher.flush():
+        emit_harvested(kind, at_step, tree)
     if ckpt:
+        t_close = time.time()
         ckpt.close()
+        if acct is not None:
+            acct.add_segment("checkpoint", time.time() - t_close)
         print(f"checkpoint: {args.checkpoint}")
+    if args.metrics_dir:
+        (Path(args.metrics_dir) / f"metrics{rank_sfx}.prom").write_text(
+            registry.prometheus_text())
+    if acct is not None:  # process 0 owns the goodput record
+        import json
+
+        from apex_tpu.observability import goodput as gp
+
+        acct.finalize("preempted" if (pre is not None and pre.preempted)
+                      else "clean")
+        n_params = gp.param_count(params)
+        report = gp.goodput_report(
+            args.metrics_dir,
+            flops_per_token=gp.model_flops_per_token(
+                n_params, args.layers, args.seq, args.hidden))
+        (Path(args.metrics_dir) / "goodput_report.json").write_text(
+            json.dumps(report, indent=1))
+        print("goodput: " + " ".join(
+            f"{k}={v:.1%}" for k, v in sorted(report["fractions"].items())),
+            flush=True)
     dt = time.time() - t0
     print(f"{done} steps in {dt:.1f}s "
           f"({args.global_batch * args.seq * done / dt:.0f} tokens/s)")

@@ -79,6 +79,14 @@ def build_args():
     p.add_argument("--sample-impl", default="auto",
                    choices=["auto", "pallas", "interpret", "xla"])
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--metrics-dir", default=None,
+                   help="observability sink dir: serving metrics (queue "
+                        "depth, slot/page occupancy, admission wait, "
+                        "TTFT, inter-token latency histograms) land in "
+                        "metrics.jsonl plus a final Prometheus snapshot "
+                        "metrics.prom (apex_tpu.observability)")
+    p.add_argument("--run-id", default="serve",
+                   help="correlation id on metrics points and trace spans")
     return p
 
 
@@ -193,6 +201,10 @@ def main(argv=None):
         sample_dot_dtype=jnp.float32 if args.smoke else None,
         base_seed=args.seed,
     )
+    from apex_tpu.observability import get_metrics, set_step_context
+
+    set_step_context(run_id=args.run_id, step=0)
+    registry = get_metrics()  # the scheduler's gauges/histograms land here
     sched = ContinuousBatchingScheduler(params, config, dcfg)
     reqs, arrivals = make_requests(args, rng)
 
@@ -203,6 +215,12 @@ def main(argv=None):
     out = report(completions, wall)
     out["stats"] = dict(sched.stats)
     out["decode_compiles"] = sched.decode_cache_size()
+    if args.metrics_dir:
+        mdir = Path(args.metrics_dir)
+        mdir.mkdir(parents=True, exist_ok=True)
+        registry.snapshot_jsonl(mdir / "metrics.jsonl")
+        (mdir / "metrics.prom").write_text(registry.prometheus_text())
+        out["metrics_dir"] = str(mdir)
 
     if args.smoke:
         assert len(completions) == args.requests, (

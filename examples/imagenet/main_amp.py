@@ -127,6 +127,17 @@ def main():
           f"{args.steps} steps, global batch {args.batch_size}, dp={args.dp}, "
           f"opt_level={args.opt_level}")
 
+    # loss printing rides the async telemetry seam (the APX108-clean
+    # spelling): the loop never blocks on a device scalar — completed
+    # copies print a step or two late, the flush drains the rest
+    from apex_tpu.observability.stepstats import AsyncFetcher
+
+    fetcher = AsyncFetcher()
+
+    def emit(harvested):
+        for _, s, tree in harvested:
+            print(f"step {s}: loss {float(tree['loss']):.4f}")
+
     t_start = None
     for step in range(start_step, start_step + args.steps):
         x, y = synthetic_batch(rng, args.batch_size, args.image_size)
@@ -134,7 +145,9 @@ def main():
         if step == start_step:
             jax.block_until_ready(loss)
             t_start = time.perf_counter()  # exclude compile
-        print(f"step {step}: loss {float(loss):.4f}")
+        fetcher.put("loss", step, {"loss": loss})
+        emit(fetcher.ready())
+    emit(fetcher.flush())
     jax.block_until_ready(params)
     if t_start and args.steps > 1:
         dt = time.perf_counter() - t_start

@@ -55,10 +55,19 @@ def main():
         )
     )
 
+    # loss printing rides the async fetch seam (the APX108-clean
+    # spelling: no blocking device read inside the step loop)
+    from apex_tpu.observability.stepstats import AsyncFetcher
+
+    fetcher = AsyncFetcher()
     for i in range(60):
         params, state, loss = step(params, state, jnp.asarray(X), jnp.asarray(Y))
         if i % 15 == 0:
-            print(f"step {i}: loss {float(loss):.6f}")
+            fetcher.put("loss", i, {"loss": loss})
+        for _, s, tree in fetcher.ready():
+            print(f"step {s}: loss {float(tree['loss']):.6f}")
+    for _, s, tree in fetcher.flush():
+        print(f"step {s}: loss {float(tree['loss']):.6f}")
     err = float(jnp.max(jnp.abs(params["w"] - w_true)))
     print(f"max |w - w_true| = {err:.4f}")
     assert err < 0.1

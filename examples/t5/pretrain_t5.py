@@ -128,6 +128,17 @@ def main():
     rng = np.random.RandomState(0)
     pool = [make_batch(rng, args.global_batch, args.seq, args.vocab)
             for _ in range(4)]
+    # loss printing rides the async telemetry seam (the APX108-clean
+    # spelling): the loop never blocks on a device array — completed
+    # copies print a step or two later, the flush drains the rest
+    from apex_tpu.observability.stepstats import AsyncFetcher
+
+    fetcher = AsyncFetcher()
+
+    def emit(harvested):
+        for _, s, tree in harvested:
+            print(f"step {s}: loss={float(tree['loss']):.4f}", flush=True)
+
     t0 = time.time()
     for i in range(args.steps):
         src, dec_in, tgt = pool[i % len(pool)]
@@ -136,7 +147,9 @@ def main():
                                                src, dec_in, tgt)
         else:
             params, state, loss = step(params, state, src, dec_in, tgt)
-        print(f"step {i}: loss={float(loss):.4f}", flush=True)
+        fetcher.put("loss", i, {"loss": loss})
+        emit(fetcher.ready())
+    emit(fetcher.flush())
     dt = time.time() - t0
     tok = args.steps * args.global_batch * args.seq
     print(f"{args.steps} steps in {dt:.1f}s ({tok / dt:.0f} tokens/s)")

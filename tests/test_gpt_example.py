@@ -342,6 +342,109 @@ def test_second_sigterm_during_drain_still_exits_clean(tmp_path):
     assert "resumed at step" in out2
 
 
+def test_metrics_dir_telemetry(tmp_path):
+    """--metrics-dir end to end: per-step loss lines still print (now
+    through the async fetch seam), the StepStats windows land in
+    metrics.jsonl with the (run_id, step) correlation, a final
+    Prometheus snapshot exists, and the goodput report's fractions sum
+    to 1 with productive time dominating an uninterrupted run."""
+    import json
+
+    md = tmp_path / "metrics"
+    out = _run(["--tp", "2", "--steps", "4", "--metrics-dir", str(md),
+                "--telemetry-every", "2", "--run-id", "mtest"])
+    losses = [float(l.split("loss=")[1].split()[0])
+              for l in out.splitlines() if l.startswith("step ")]
+    assert len(losses) == 4 and all(np.isfinite(losses))
+    assert "telemetry[" in out
+    recs = [json.loads(l) for l in (md / "metrics.jsonl").read_text()
+            .splitlines()]
+    by_metric = {}
+    for r in recs:
+        by_metric.setdefault(r["metric"], []).append(r)
+    assert "apex_train_loss" in by_metric
+    assert "apex_train_grad_norm_last" in by_metric
+    assert all(r["run_id"] == "mtest" for r in recs)
+    # counters accumulate across windows: the last steps_total sample
+    # covers every step
+    assert by_metric["apex_train_steps_total"][-1]["value"] == 4
+    prom = (md / "metrics.prom").read_text()
+    assert "# TYPE apex_train_loss gauge" in prom
+    report = json.loads((md / "goodput_report.json").read_text())
+    f = report["fractions"]
+    assert abs(sum(f.values()) - 1.0) < 1e-9
+    assert f["productive"] > 0.5
+    assert report["tokens"] == 4 * 8 * 64  # steps x batch x seq
+    assert "goodput:" in out
+
+
+def test_goodput_attributes_wedge(tmp_path):
+    """The ISSUE 10 acceptance run: a chaos-interrupted `--zero
+    --auto-resume --metrics-dir` run (wedged step -> watchdog exit 75
+    -> elastic resume) yields a goodput report whose fractions sum to
+    1 AND attribute the injected fault: wedge > 0 (the watchdog's
+    on_wedge hook stamped the dying session), restart > 0 (the gap to
+    the relaunch), checkpoint time accounted."""
+    import json
+    import subprocess as sp
+
+    ck, md = tmp_path / "ck", tmp_path / "metrics"
+    base = ["--tp", "2", "--zero", "--save-every", "2",
+            "--checkpoint", str(ck), "--auto-resume",
+            "--metrics-dir", str(md), "--telemetry-every", "2"]
+    r = sp.run(
+        [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
+         *base, "--steps", "6", "--watchdog-secs", "3",
+         "--chaos-wedge-step", "3", "--chaos-wedge-secs", "300"],
+        capture_output=True, text=True, timeout=600, env=_env(_devs(4)),
+    )
+    assert r.returncode == 75, f"rc={r.returncode}\n{r.stderr[-1500:]}"
+    sessions = list(md.glob("goodput_session_*.json"))
+    assert len(sessions) == 1
+    assert json.loads(sessions[0].read_text())["exit_cause"] == "wedge"
+    out = _run([*base, "--steps", "2"], extra_env=_devs(4))
+    assert "resumed at step 2" in out
+    report = json.loads((md / "goodput_report.json").read_text())
+    assert report["sessions"] == 2
+    assert report["wedge_events"] == 1
+    assert report["exit_causes"] == ["wedge", "clean"]
+    f = report["fractions"]
+    assert abs(sum(f.values()) - 1.0) < 1e-9, f
+    assert f.get("wedge", 0) > 0, f
+    assert f.get("restart", 0) > 0, f
+    assert f.get("productive", 0) > 0, f
+    assert "checkpoint" in report["seconds"]
+
+
+def test_serve_metrics_dir(tmp_path):
+    """serve_gpt.py --metrics-dir: the scheduler's queue/occupancy
+    gauges and admission/TTFT/inter-token histograms land in both
+    export formats."""
+    import json
+
+    md = tmp_path / "smetrics"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples/gpt/serve_gpt.py"),
+         "--smoke", "--metrics-dir", str(md)],
+        capture_output=True, text=True, timeout=600, env=_env(),
+    )
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metrics_dir"] == str(md)
+    prom = (md / "metrics.prom").read_text()
+    for name in ("apex_serve_queue_depth", "apex_serve_active_slots",
+                 "apex_serve_free_pages", "apex_serve_ttft_seconds",
+                 "apex_serve_inter_token_seconds",
+                 "apex_serve_admission_wait_seconds",
+                 "apex_serve_completions_total"):
+        assert name in prom, name
+    recs = [json.loads(l)
+            for l in (md / "metrics.jsonl").read_text().splitlines()]
+    counts = {r_["metric"]: r_["value"] for r_ in recs}
+    assert counts["apex_serve_ttft_seconds_count"] == rec["stats"]["admitted"]
+    assert counts["apex_serve_completions_total"] == rec["stats"]["evicted"]
+
+
 def test_serve_gpt_smoke_contract():
     """The serving driver's acceptance contract end-to-end:
     ``serve_gpt.py --smoke`` must admit/evict >= 3 generations through

@@ -300,6 +300,140 @@ class TestCheckerSelfConsistency:
             lw.assert_no_host_transfer(low)
 
 
+# --------------------------------------------------------------- telemetry
+class TestTelemetryTrainStep:
+    """ISSUE 10's zero-overhead pins: a telemetry-enabled
+    ``make_train_step`` lowers with the SAME collective structure as
+    the telemetry-off step (the grad-norm stat reuses the clip
+    reduction — never a new psum), adds zero host transfers, donates
+    the StepStats buffers, and never retraces across window resets."""
+
+    KINDS = ("all_reduce", "reduce_scatter", "all_gather",
+             "collective_permute", "all_to_all")
+
+    @staticmethod
+    def _telemetry():
+        from apex_tpu.observability import StepTelemetry
+
+        return StepTelemetry()
+
+    def _pair(self, devices8, *, zero, clip=None, opt_kw=None):
+        """(lowering_on, lowering_off, stats) for one optimizer mode."""
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        tokens, targets = _data()
+        tel = self._telemetry()
+        stats = tel.init()
+
+        def build(telemetry):
+            if zero:
+                opt = DistributedFusedAdam(lr=1e-2, axis_name="dp",
+                                           bucket_cap_mb=TINY_CAP_MB,
+                                           **(opt_kw or {}))
+                state = opt.init(params, world_size=DP)
+                step = make_train_step(CFG, opt, _mesh(devices8),
+                                       donate_state=True,
+                                       clip_grad_norm=clip,
+                                       telemetry=telemetry)
+            else:
+                opt = FusedAdam(lr=1e-2)
+                state = opt.init(params)
+                sspec = AdamState(step=P(), exp_avg=param_specs(CFG),
+                                  exp_avg_sq=param_specs(CFG), master=None)
+                step = make_train_step(CFG, opt, _mesh(devices8),
+                                       donate_state=True,
+                                       opt_state_spec=sspec,
+                                       clip_grad_norm=clip,
+                                       telemetry=telemetry)
+            args = (params, state, stats, tokens, targets) \
+                if telemetry is not None else (params, state, tokens,
+                                               targets)
+            return step.lower(*args), state, step
+
+        low_on, state, step_on = build(tel)
+        low_off, _, _ = build(None)
+        return low_on, low_off, stats, state, step_on
+
+    @pytest.mark.parametrize("zero,clip,opt_kw", [
+        (False, None, None),
+        (False, 1.0, None),
+        (True, 1.0, None),
+        (True, None, {"grad_sync_dtype": "int8"}),
+    ], ids=["replicated", "replicated_clip", "zero_clip", "zero_int8"])
+    def test_same_collective_counts(self, devices8, zero, clip, opt_kw):
+        low_on, low_off, *_ = self._pair(devices8, zero=zero, clip=clip,
+                                         opt_kw=opt_kw)
+        on, off = low_on.as_text(), low_off.as_text()
+        for kind in self.KINDS:
+            n_on = lw.count_collectives(on, kind, minimum=0)
+            n_off = lw.count_collectives(off, kind, minimum=0)
+            assert n_on == n_off, (
+                f"telemetry changed {kind} count: {n_off} -> {n_on}")
+
+    def test_zero_host_transfers(self, devices8):
+        low_on, _, _, _, _ = self._pair(devices8, zero=True, clip=1.0)
+        lw.assert_no_host_transfer(low_on)
+
+    def test_pp_step_telemetry_same_collectives_no_host_transfer(
+            self, devices8):
+        """make_pp_train_step carries the same contract: the StepStats
+        observer adds no collectives (the pipeline's ppermutes
+        included) and no host transfers to the 3D step."""
+        from apex_tpu.models.gpt import make_pp_train_step
+
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        mesh = Mesh(np.array(devices8[:4]).reshape(1, 2, 2),
+                    ("dp", "pp", "tp"))
+        tel = self._telemetry()
+        stats = tel.init()
+        tokens = jnp.asarray(np.random.RandomState(0).randint(
+            0, CFG.vocab_size, size=(2, 16)))
+        targets = jnp.roll(tokens, -1, axis=1)
+
+        def build(telemetry):
+            step = make_pp_train_step(CFG, opt, mesh, num_microbatches=2,
+                                      clip_grad_norm=1.0,
+                                      telemetry=telemetry)
+            args = (params, state, stats, tokens, targets) \
+                if telemetry is not None else (params, state, tokens,
+                                               targets)
+            return step.lower(*args)
+
+        low_on, low_off = build(tel), build(None)
+        on, off = low_on.as_text(), low_off.as_text()
+        for kind in self.KINDS:
+            assert lw.count_collectives(on, kind, minimum=0) \
+                == lw.count_collectives(off, kind, minimum=0), kind
+        lw.assert_no_host_transfer(low_on)
+
+    #: StepStats inputs accumulate() READS in this (unscaled) config —
+    #: steps, loss_sum, grad_norm_sum, notfinite, loss_scale.  The
+    #: write-only last-value fields (loss_last, grad_norm_last,
+    #: param_norm, update_norm) are dead inputs the lowering cannot —
+    #: and need not — declare donatable.
+    LIVE_STATS = 5
+
+    def test_stats_buffers_are_donated(self, devices8):
+        low_on, low_off, stats, state, _ = self._pair(
+            devices8, zero=True, clip=1.0)
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        lw.assert_donation_covers(low_on, params, state,
+                                  extra=self.LIVE_STATS, compiled=False)
+        # and the live-stat donors really are ADDITIONAL to the
+        # telemetry-off step's params+state donations
+        assert (lw.donated_buffer_count(low_on)
+                - lw.donated_buffer_count(low_off)) == self.LIVE_STATS
+
+    @pytest.mark.slow
+    def test_stats_donation_survives_compilation(self, devices8):
+        low_on, _low_off, stats, state, _ = self._pair(
+            devices8, zero=True, clip=1.0)
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        lw.assert_donation_covers(low_on, params, state,
+                                  extra=self.LIVE_STATS, compiled=True)
+
+
 # ------------------------------------------------------------- decode step
 class TestDecodeStep:
     """The serving engine's compiled-step contracts (ROADMAP: 'decode

@@ -34,6 +34,7 @@ from apex_tpu.analysis.rules_collectives import (
     UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
+from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_precision import (
     KvCacheReadDtypeMismatch,
@@ -2028,6 +2029,136 @@ class TestCliUpdateBaselineAndSarif:
             ["mod.py", "--vmem-budget-mib", "0.125"], tmp_path)
         assert r.returncode == 1
         assert "APX304" in r.stdout
+
+
+# --------------------------------------- APX108 host sync in step loops
+class TestBlockingHostSyncInStepLoop:
+    """APX108: float()/.item()/np.asarray/f-string of a proven device
+    array inside a loop that dispatches a compiled step — the per-step
+    sync barrier the observability async-fetch seam exists to remove."""
+
+    def test_positive_float_of_jit_result_in_loop(self, tmp_path):
+        got = run("""
+            import jax
+            step = jax.jit(lambda p: (p, p.sum()))
+            def train(params):
+                for i in range(10):
+                    params, loss = step(params)
+                    print(float(loss))
+            """, tmp_path, [BlockingHostSyncInStepLoop()])
+        assert rule_ids(got) == ["APX108"]
+        assert "float()" in got[0].message
+
+    def test_positive_builder_and_run_step_indirection(self, tmp_path):
+        """The pre-fix pretrain_gpt shape: the step comes from a
+        builder (`step = build_step()`), dispatch goes through a local
+        retry wrapper (`run_step`), and the f-string formats the
+        wrapper's result — still proven, still flagged."""
+        got = run("""
+            from apex_tpu.models.gpt import make_train_step
+
+            def main():
+                def build_step():
+                    return make_train_step(None, None, None)
+
+                step = build_step()
+
+                def run_step(t):
+                    return step(t)
+
+                for i in range(8):
+                    params, state, loss = run_step(i)
+                    print(f"step {i}: loss={loss:.4f}")
+            """, tmp_path, [BlockingHostSyncInStepLoop()])
+        assert rule_ids(got) == ["APX108"]
+        assert "f-string" in got[0].message
+
+    def test_positive_item_and_np_asarray_in_while(self, tmp_path):
+        got = run("""
+            import jax
+            import numpy as np
+            f = jax.jit(lambda x: x)
+            def loop():
+                out = None
+                while True:
+                    out = f(1)
+                    a = out.item()
+                    b = np.asarray(out)
+            """, tmp_path, [BlockingHostSyncInStepLoop()])
+        assert rule_ids(got) == ["APX108", "APX108"]
+        assert {".item()" in f.message or "np.asarray" in f.message
+                for f in got} == {True}
+
+    def test_positive_attribute_off_device_tuple(self, tmp_path):
+        """float(scaler_state.loss_scale): the base name is the step
+        result, the attribute read still materializes on host."""
+        got = run("""
+            from apex_tpu.models.gpt import make_train_step
+            step = make_train_step(1, 2, 3)
+            def train(p, s, sc, t):
+                for i in range(4):
+                    p, s, sc, loss = step(p, s, sc, t)
+                    print(float(sc.loss_scale))
+            """, tmp_path, [BlockingHostSyncInStepLoop()])
+        assert rule_ids(got) == ["APX108"]
+
+    def test_negative_conversion_after_loop_and_async_seam(self, tmp_path):
+        """The allowed spellings: hand the array to the fetch seam in
+        the loop, convert AFTER the loop, format only harvested host
+        values."""
+        got = run("""
+            import jax
+            step = jax.jit(lambda p: (p, p))
+            def train(params, fetcher):
+                loss = None
+                for i in range(10):
+                    params, loss = step(params)
+                    fetcher.put("loss", i, {"loss": loss})
+                    for kind, s, tree in fetcher.ready():
+                        print(f"step {s}: loss={float(tree['loss']):.4f}")
+                print(float(loss))
+            """, tmp_path, [BlockingHostSyncInStepLoop()])
+        assert got == []
+
+    def test_negative_jnp_asarray_and_non_device_values(self, tmp_path):
+        """jnp.asarray stays on device; float() of a plain loop index
+        or of an unproven name is not flagged."""
+        got = run("""
+            import jax
+            import jax.numpy as jnp
+            step = jax.jit(lambda p: p)
+            def train(params, mystery):
+                for i in range(10):
+                    params = step(params)
+                    x = jnp.asarray(params)
+                    y = float(i)
+                    z = float(mystery)
+            """, tmp_path, [BlockingHostSyncInStepLoop()])
+        assert got == []
+
+    def test_negative_loop_without_step_dispatch(self, tmp_path):
+        """A conversion in a loop that does NOT dispatch a step is not
+        a per-step sync barrier (the post-run report loop shape)."""
+        got = run("""
+            import jax
+            step = jax.jit(lambda p: p)
+            def report(params):
+                out = step(params)
+                for i in range(10):
+                    print(float(out))
+            """, tmp_path, [BlockingHostSyncInStepLoop()])
+        assert got == []
+
+    def test_rides_default_rules(self, tmp_path):
+        got = run("""
+            import jax
+            step = jax.jit(lambda p: p)
+            def train(p):
+                for i in range(4):
+                    p = step(p)
+                    print(float(p))
+            """, tmp_path, DEFAULT_RULES)
+        assert "APX108" in rule_ids(got)
 
 
 # ------------------------------------------------- the repo-wide rider
