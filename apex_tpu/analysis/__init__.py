@@ -14,6 +14,11 @@ machine-checked invariants):
   ``open(..., "wb")`` on a checkpoint path bypassing the
   ``io.native.atomic_output`` tmp+fsync+rename helper — the
   torn-write class ``io.validate_checkpoint`` exists to detect.
+- **APX109** swallowed exception in a recovery path
+  (``rules_resilience``): an ``except`` whose body is only
+  ``pass``/``...`` inside resilience/io/inference modules — no
+  re-raise, no ``log_structured``, no metrics record, so the failure
+  is invisible to the supervisor and the postmortem.
 - **APX201/202** collective-axis consistency against the
   ``parallel_state.py`` mesh registry (``rules_collectives``).
 - **APX203/204** axis-scope dataflow (``dataflow`` + ``rules_collectives``):
@@ -74,6 +79,9 @@ from apex_tpu.analysis.rules_collectives import (
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
+from apex_tpu.analysis.rules_resilience import (
+    SwallowedExceptionInRecoveryPath,
+)
 from apex_tpu.analysis.rules_precision import (
     Fp32ConstantInBf16Path, KvCacheReadDtypeMismatch,
     PageTableGatherUnclamped, QuantizedSyncStateDtype,
@@ -99,6 +107,7 @@ def default_rules(vmem_budget_bytes=None):
         ProcessGlobalEnvMutation(),
         DonatedBufferReuse(),
         NonAtomicCheckpointWrite(),
+        SwallowedExceptionInRecoveryPath(),
         BlockingHostSyncInStepLoop(),
         UnknownCollectiveAxis(),
         CollectiveOutsideSpmdContext(),

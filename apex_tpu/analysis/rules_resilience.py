@@ -1,0 +1,81 @@
+"""Recovery-path hygiene rules (the silent-swallow class).
+
+The resilience/io/inference modules ARE the error-handling layer: an
+``except`` block there that does literally nothing (``pass``/``...``)
+erases the one signal a postmortem needs — PR 10's review kept
+hand-auditing exactly this pattern, because a swallowed drain error or
+a silently-dropped shard-read failure turns "the run wedged and we know
+why" into "the run wedged".  The tree's own convention is that every
+recovery path reports: re-raise, ``log_structured`` (the greppable
+``EVENT {json}`` contract), or a metrics record
+(``apex_tpu.observability.metrics.inc/observe/set_gauge``).
+
+- APX109: an ``except`` handler in a resilience/io/inference module
+  whose body is ONLY ``pass``/``...``/a bare string — no re-raise, no
+  logging, no metrics, no fallback value, nothing.  Handlers with ANY
+  other statement (a ``return`` default, a log call, a counter bump, a
+  flag set) are trusted: the rule targets the zero-information
+  swallow, not defensive defaults.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from apex_tpu.analysis.core import Finding, ModuleContext, Rule
+
+__all__ = ["SwallowedExceptionInRecoveryPath"]
+
+#: Directory components that mark a module as recovery-path code: the
+#: fault-handling runtime, the checkpoint/restore layer, and the
+#: serving engine (whose error paths feed the supervisor's restart
+#: decisions).  Matched as path SEGMENTS, so ``examples/gpt/...`` and
+#: ``observability/...`` stay out of scope.
+_RECOVERY_DIRS = frozenset({"resilience", "io", "inference"})
+
+
+def _is_noop(stmt: ast.stmt) -> bool:
+    """``pass``, ``...``, or a bare constant expression (a stray string
+    used as a comment) — statements that observably do nothing."""
+    if isinstance(stmt, ast.Pass):
+        return True
+    return isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant)
+
+
+class SwallowedExceptionInRecoveryPath(Rule):
+    """APX109: a do-nothing ``except`` in a recovery-path module — the
+    error is swallowed with no re-raise, no structured log, and no
+    metrics record, so the failure it caught is invisible to the
+    supervisor, the goodput report, and the postmortem."""
+
+    rule_id = "APX109"
+    severity = "error"
+    fix_hint = ("recovery paths must report what they survive: re-raise, "
+                "emit a log_structured event (the greppable EVENT {json} "
+                "contract), or record a metric "
+                "(observability.metrics.inc/observe) — if the error is "
+                "truly ignorable, say WHY in a handler that at least "
+                "logs it; a bare `except: pass` in "
+                "resilience/io/inference erases the one signal a wedged "
+                "run's postmortem needs")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        dirs = re.split(r"[\\/]", ctx.path)[:-1]
+        if not _RECOVERY_DIRS.intersection(dirs):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not node.body or not all(_is_noop(s) for s in node.body):
+                continue
+            caught = (ast.get_source_segment(ctx.source, node.type)
+                      if node.type is not None else "BaseException (bare)")
+            yield self.finding(
+                ctx, node,
+                f"except block swallows {caught} with a do-nothing body "
+                f"in a recovery-path module ({os.path.basename(ctx.path)})"
+                " — no re-raise, no log_structured, no metrics record")

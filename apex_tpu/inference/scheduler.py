@@ -34,6 +34,15 @@ failure surfaces on the first call, is attributed via
 once — the fresh trace lowers the XLA reference and the server keeps
 serving (the same recovery ``examples/gpt/pretrain_gpt.py`` wires for
 training).
+
+Wedge resilience: a ``watchdog=`` (:class:`apex_tpu.resilience
+.StepWatchdog`) gets a heartbeat per scheduler step; a decode step that
+never returns (dead tunnel, hung collective) fires it — the scheduler's
+``on_wedge`` hook logs every queued and in-flight request id
+(``serve.step_wedged`` — the requeue manifest for the layer above) and
+records ``apex_serve_wedges_total``, then the watchdog drains and exits
+75 so a :class:`~apex_tpu.resilience.supervisor.Supervisor` restarts
+the server (``serve_gpt.py --supervise --watchdog-secs``).
 """
 
 import dataclasses
@@ -54,6 +63,7 @@ from apex_tpu.inference.kv_cache import (
 )
 from apex_tpu.models.gpt import GPTConfig
 from apex_tpu.observability import metrics as _metrics
+from apex_tpu.resilience.chaos import active_monkey
 from apex_tpu.utils.logging import get_logger, log_structured
 
 __all__ = ["Request", "Completion", "ContinuousBatchingScheduler"]
@@ -105,7 +115,7 @@ class ContinuousBatchingScheduler:
     module docstring for the full semantics)."""
 
     def __init__(self, params, config: GPTConfig, dcfg: DecodeConfig,
-                 time_fn=time.monotonic):
+                 time_fn=time.monotonic, watchdog=None):
         cache = dcfg.cache
         if config.moe:
             raise NotImplementedError("MoE decode is not wired")
@@ -140,7 +150,42 @@ class ContinuousBatchingScheduler:
         #: is the ADMIT time for driver compatibility; the metrics
         #: histograms — admission wait, TTFT — need the real submit)
         self._submit_times: Dict[int, float] = {}
+        self._watchdog = watchdog
+        self._beaten = False
+        if watchdog is not None:
+            # chain, don't clobber: the driver may have wired its own
+            # pre-exit hook (the trainer's goodput finalize pattern)
+            prev = watchdog.on_wedge
+
+            def hook(info, _prev=prev):
+                if _prev is not None:
+                    _prev(info)
+                self._on_wedge(info)
+
+            watchdog.on_wedge = hook
         self._build_steps()
+
+    def _on_wedge(self, info) -> None:
+        """Watchdog pre-exit hook: one structured record naming every
+        queued and in-flight request id — the requeue manifest a
+        frontend replays after the supervisor restarts the engine —
+        plus the wedge counter.  Runs on the watchdog thread; reads of
+        the slot arrays are racy-but-safe (the decode thread is by
+        definition wedged)."""
+        queued = [r.rid for r in list(self.queue)]
+        inflight = [s.request.rid for s in self._slots if s is not None]
+        # EVERY id, untruncated: this record IS the requeue manifest —
+        # a frontend replaying it cannot recover ids a cap dropped.
+        # One long line once per process death is the cheap side of
+        # that trade (the wedge exits the process right after this).
+        log_structured(
+            _logger, logging.ERROR, "serve.step_wedged",
+            decode_step=self.stats["decode_steps"],
+            queued_rids=queued, inflight_rids=inflight,
+            queued=len(queued), inflight=len(inflight),
+            elapsed_s=info.get("elapsed_s"))
+        _metrics.inc("apex_serve_wedges_total",
+                     help="decode steps the watchdog declared wedged")
 
     def _record_occupancy(self) -> None:
         """Serving gauges on the current registry (the scope seam:
@@ -310,6 +355,21 @@ class ContinuousBatchingScheduler:
         """Admit waiting requests, then advance every active sequence
         one token.  Returns True when any work (admission or decode)
         happened."""
+        if self._watchdog is not None:
+            # the first interval covers the prefill/decode jit compiles
+            # (the trainer loop's compile-grace pattern); steady state
+            # uses the watchdog's own deadline
+            self._watchdog.beat(
+                self.stats["decode_steps"],
+                deadline=(self._watchdog.first_deadline_sec
+                          if not self._beaten else None))
+            self._beaten = True
+        monkey = active_monkey()
+        if monkey is not None:
+            # deterministic wedged-decode-step fault: the sleep holds
+            # THIS step past the watchdog deadline, exactly how a dead
+            # tunnel presents (plan key: decode steps taken so far)
+            monkey.maybe_wedge_step(self.stats["decode_steps"])
         admitted = self._admit()
         if not self._active.any():
             return admitted > 0

@@ -4,6 +4,7 @@ input prefetch."""
 from apex_tpu.io import native
 from apex_tpu.io.checkpoint import (
     AllCheckpointsTornError,
+    CorruptCheckpoint,
     checkpoint_step,
     latest_checkpoint,
     latest_distributed_step,
@@ -11,6 +12,9 @@ from apex_tpu.io.checkpoint import (
     load_distributed_checkpoint,
     load_sharded_checkpoint,
     make_global_array_tree,
+    probe_checkpoint,
+    probe_checkpoint_dir,
+    quarantine_checkpoint,
     read_index,
     save_checkpoint,
     save_distributed_checkpoint,
@@ -23,6 +27,7 @@ from apex_tpu.io.prefetch import PrefetchIterator
 __all__ = [
     "AllCheckpointsTornError",
     "AsyncCheckpointer",
+    "CorruptCheckpoint",
     "native",
     "save_checkpoint",
     "load_checkpoint",
@@ -33,6 +38,9 @@ __all__ = [
     "make_global_array_tree",
     "latest_checkpoint",
     "latest_distributed_step",
+    "probe_checkpoint",
+    "probe_checkpoint_dir",
+    "quarantine_checkpoint",
     "read_index",
     "validate_checkpoint",
     "checkpoint_step",

@@ -18,8 +18,9 @@ import json
 import os
 import struct
 import time
+import zlib
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -124,8 +125,14 @@ def save_checkpoint(path, tree: Any) -> None:
         arrays.append(np.ascontiguousarray(a))
         meta.append({"shape": list(a.shape), "dtype": _dtype_str(a.dtype)})
     blob = native.flatten(arrays) if arrays else np.empty(0, np.uint8)
+    # blob checksum: the torn-write class is caught by the size check,
+    # but a bit-flipped blob (dying disk, cosmic ray, a fault injector)
+    # is SIZE-preserving — the crc is what the corruption probe and the
+    # load-time verify key on.  Old checkpoints without the key still
+    # load (and probe shallowly).
     header = json.dumps(
-        {"treedef": str(treedef), "leaves": meta}
+        {"treedef": str(treedef), "leaves": meta,
+         "crc32": zlib.crc32(memoryview(blob))}
     ).encode()
     # structure is rebuilt from an example tree on load; the treedef
     # string is stored for sanity checking only
@@ -186,6 +193,14 @@ def load_checkpoint(path) -> Any:
         raise ValueError(
             f"{path} is torn: header promises a {need}-byte blob, file "
             f"holds {blob.size} (interrupted write?)")
+    crc = header.get("crc32")
+    if crc is not None and zlib.crc32(memoryview(blob)) != int(crc):
+        # size-preserving corruption (bit-flips): the class the
+        # supervisor's quarantine path exists for — restoring garbage
+        # state silently would be strictly worse than failing here
+        raise ValueError(
+            f"{path} is corrupt: blob crc32 does not match the header "
+            "(size-preserving corruption — bit flips, not a torn write)")
     leaves = native.unflatten(blob, shapes, dtypes)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
@@ -289,6 +304,144 @@ def validate_checkpoint(path) -> dict:
             f"{path} is torn: header promises a {need}-byte blob, file "
             f"holds {got} (interrupted write?)")
     return header
+
+
+def probe_checkpoint(path) -> dict:
+    """Deep integrity probe of one checkpoint file: everything
+    :func:`validate_checkpoint` checks (magic, header, exact blob size)
+    PLUS the blob crc when the header carries one — so size-preserving
+    corruption (bit flips from a dying disk or the chaos injector) is
+    caught here instead of deep inside a restore.  Reads the whole blob
+    (unlike ``validate_checkpoint``); raises ``ValueError`` with the
+    reason, returns the parsed header.  Checkpoints written before the
+    crc existed probe shallowly (no false corruption on old files)."""
+    header = validate_checkpoint(path)
+    crc = header.get("crc32")
+    if crc is None:
+        return header
+
+    def read():
+        _chaos_io("ckpt.read")
+        got = 0
+        with open(path, "rb") as f:
+            _read_header(f, path)
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    return got
+                got = zlib.crc32(chunk, got)
+
+    if _with_io_retries(read, "read", path) != int(crc):
+        raise ValueError(
+            f"{path} is corrupt: blob crc32 does not match the header "
+            "(size-preserving corruption — bit flips, not a torn write)")
+    return header
+
+
+class CorruptCheckpoint(NamedTuple):
+    """What :func:`probe_checkpoint_dir` reports: the newest restore
+    candidate failed its deep probe — the supervisor quarantines it."""
+
+    path: str      # the step dir (or single-file checkpoint) at fault
+    reason: str    # the probe's ValueError text
+
+
+def probe_checkpoint_dir(dir_path) -> Optional[CorruptCheckpoint]:
+    """Deep-probe the checkpoint the NEXT restore would load: the
+    newest COMPLETE ``step_*`` dir (every shard through
+    :func:`probe_checkpoint`) or, in a single-file layout, the newest
+    validating ``.ckpt``/``.apex`` file.
+
+    Returns ``None`` when the candidate is healthy or there is nothing
+    to probe (missing/empty dir), and a :class:`CorruptCheckpoint`
+    naming the candidate when its bytes are corrupt beyond what the
+    completeness/torn-size seams can see — or when step dirs exist but
+    NONE is complete (a hard-killed first publish), the one state the
+    resume side can only refuse loudly.  This is the supervisor's
+    quarantine trigger: one corrupt-or-interrupted newest save must
+    cost one save interval, never a crash loop."""
+    d = Path(dir_path)
+    if not d.is_dir():
+        return None
+    if any(p.is_dir() for p in d.glob("step_*")):
+        try:
+            step = latest_distributed_step(d)
+        except AllCheckpointsTornError as e:
+            # step dirs exist but NONE is complete: the resume side
+            # refuses loudly by design (it cannot tell an interrupted
+            # FIRST publish from destroyed progress), which under a
+            # supervisor is a guaranteed crash loop.  Report the newest
+            # incomplete dir for quarantine instead: the bytes survive
+            # for the postmortem, and the relaunch resumes from an
+            # older dir once one is exposed — or starts fresh, losing
+            # only what was never durably published anyway.
+            dirs = sorted((p for p in d.glob("step_*") if p.is_dir()),
+                          key=checkpoint_step)
+            return CorruptCheckpoint(
+                str(dirs[-1]), f"incomplete publish (hard-killed "
+                f"writer?): {e}")
+        if step < 0:
+            return None
+        sd = d / f"step_{step:08d}"
+        try:
+            world = int(read_index(sd)["world_size"])
+            for r in range(world):
+                probe_checkpoint(sd / _shard_name(r, world))
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            return CorruptCheckpoint(str(sd), f"{type(e).__name__}: {e}")
+        return None
+    cands = sorted(
+        (p for p in d.iterdir()
+         if p.is_file() and p.suffix in (".ckpt", ".apex")),
+        key=checkpoint_step, reverse=True)
+    for p in cands:
+        try:
+            validate_checkpoint(p)
+        except ValueError:
+            continue  # torn: latest_checkpoint already skips it
+        try:
+            probe_checkpoint(p)
+        except (OSError, ValueError) as e:
+            return CorruptCheckpoint(str(p), f"{type(e).__name__}: {e}")
+        return None  # the file the next restore loads is healthy
+    return None
+
+
+def quarantine_checkpoint(dir_path, target, reason: str) -> str:
+    """Atomically move a corrupt checkpoint (a ``step_*`` dir or a
+    single ``.ckpt`` file) into a ``quarantine/`` sibling with a reason
+    file, so the next restore resumes from the previous complete step
+    and the bad bytes stay available for the postmortem.
+
+    The move is one same-filesystem ``os.replace`` — no restore can
+    ever observe a half-quarantined dir.  A same-named earlier
+    quarantine entry is replaced (elastic restarts can re-save a step
+    number).  Returns the quarantined path."""
+    import logging
+
+    from apex_tpu.utils.logging import get_logger, log_structured
+
+    t = Path(target)
+    q = Path(dir_path) / "quarantine"
+    q.mkdir(parents=True, exist_ok=True)
+    dest = q / t.name
+    if dest.is_dir():
+        import shutil
+
+        shutil.rmtree(dest, ignore_errors=True)
+    os.replace(str(t), str(dest))
+    payload = json.dumps({
+        "path": str(t), "quarantined_to": str(dest),
+        "reason": str(reason), "time": time.time(),
+    }, sort_keys=True).encode()
+    with native.atomic_output(q / f"{t.name}.reason.json") as f:
+        f.write(payload)
+    log_structured(get_logger("apex_tpu.io"), logging.ERROR,
+                   "checkpoint.quarantined", path=str(t),
+                   quarantined_to=str(dest), reason=str(reason))
+    _metrics.inc("apex_checkpoint_quarantines_total",
+                 help="corrupt checkpoints moved aside by the supervisor")
+    return str(dest)
 
 
 def checkpoint_step(path) -> int:

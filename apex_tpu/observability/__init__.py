@@ -30,6 +30,7 @@ from apex_tpu.observability.correlation import (
 from apex_tpu.observability.goodput import (
     GoodputAccountant, decode_flops_per_token, goodput_report,
     model_flops_per_step, model_flops_per_token, param_count,
+    session_progress,
 )
 from apex_tpu.observability.metrics import (
     MetricsRegistry, MetricsScope, append_jsonl, get_metrics,
@@ -43,5 +44,5 @@ __all__ = [
     "StepStats", "StepTelemetry", "append_jsonl", "clear_step_context",
     "decode_flops_per_token", "get_metrics", "goodput_report",
     "model_flops_per_step", "model_flops_per_token", "param_count",
-    "set_step_context", "step_context",
+    "session_progress", "set_step_context", "step_context",
 ]

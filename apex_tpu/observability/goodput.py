@@ -36,6 +36,7 @@ import numpy as np
 __all__ = [
     "GoodputAccountant", "decode_flops_per_token", "goodput_report",
     "model_flops_per_step", "model_flops_per_token", "param_count",
+    "session_progress",
 ]
 
 SCHEMA = "apex_tpu_goodput_v1"
@@ -202,6 +203,15 @@ def _load_sessions(dir_path) -> List[Dict[str, Any]]:
             out.append(rec)
     out.sort(key=lambda r: r["start"])
     return out
+
+
+def session_progress(dir_path) -> int:
+    """Total steps recorded across every session file in ``dir_path``
+    (0 when the dir is missing/empty) — monotone over a run's life, so
+    the supervisor's crash-loop breaker can compare it across restarts:
+    a relaunch that adds no steps before dying made NO progress, and K
+    of those in a row is a crash loop, not a recoverable fault."""
+    return sum(int(r.get("steps", 0)) for r in _load_sessions(dir_path))
 
 
 def goodput_report(dir_path, flops_per_token: Optional[float] = None,

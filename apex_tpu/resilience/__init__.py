@@ -19,9 +19,16 @@ first-class pillar of a pre-training stack):
   (a dp=4 checkpoint reshards for dp=2 through the bucket plan's own
   pad formula), a step watchdog that drains and exits on wedged
   collectives, and the run controller composing both.
+- :mod:`~apex_tpu.resilience.supervisor` — the self-healing restart
+  orchestrator that CONSUMES the exit-code/backoff contract: launches
+  the trainer or server as a child, restarts with full-jitter backoff,
+  trips a crash-loop circuit breaker after K no-progress failures,
+  and quarantines a corrupt newest checkpoint so one bad save never
+  crash-loops a job to death (``pretrain_gpt.py --supervise``).
 - :mod:`~apex_tpu.resilience.chaos` — deterministic fault injection
   (NaN grads, kernel-launch failures, preemptions, wedges, per-rank
-  host kills, slow/failing checkpoint I/O) so all of the above is
+  host kills, slow/failing checkpoint I/O, supervisor-level fault
+  scripts incl. corrupt-newest-checkpoint) so all of the above is
   testable on the virtual 8-device CPU mesh today.
 
 See ``docs/resilience.md`` for the fault model and usage.
@@ -33,7 +40,10 @@ from apex_tpu.resilience.chaos import (
     ChaosKernelFailure,
     ChaosMonkey,
     ChaosPlan,
+    SupervisorFault,
+    SupervisorFaultScript,
     active_monkey,
+    corrupt_newest_checkpoint,
 )
 from apex_tpu.resilience.elastic import (
     EXIT_KILLED,
@@ -61,6 +71,11 @@ from apex_tpu.resilience.step_guard import (
     GuardState,
     StepGuard,
 )
+from apex_tpu.resilience.supervisor import (
+    EXIT_CRASH_LOOP,
+    Supervisor,
+    strip_supervisor_argv,
+)
 
 __all__ = [
     "BadStepBudgetExceeded",
@@ -69,6 +84,7 @@ __all__ = [
     "ChaosKernelFailure",
     "ChaosMonkey",
     "ChaosPlan",
+    "EXIT_CRASH_LOOP",
     "EXIT_KILLED",
     "EXIT_WEDGED",
     "ElasticRestore",
@@ -78,7 +94,11 @@ __all__ = [
     "PreemptionHandler",
     "StepGuard",
     "StepWatchdog",
+    "Supervisor",
+    "SupervisorFault",
+    "SupervisorFaultScript",
     "active_monkey",
+    "corrupt_newest_checkpoint",
     "get_registry",
     "load_rng_tracker_state_dict",
     "registry_engaged",
@@ -86,5 +106,6 @@ __all__ = [
     "restore_elastic_checkpoint",
     "rng_tracker_state_dict",
     "save_elastic_checkpoint",
+    "strip_supervisor_argv",
     "trip_from_exception",
 ]

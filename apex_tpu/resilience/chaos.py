@@ -47,7 +47,9 @@ from apex_tpu.utils.logging import get_logger, log_structured
 
 __all__ = [
     "ChaosHostKilled", "ChaosIOError", "ChaosKernelFailure", "ChaosPlan",
-    "ChaosMonkey", "active_monkey", "check_io", "check_kernel",
+    "ChaosMonkey", "SupervisorFault", "SupervisorFaultScript",
+    "active_monkey", "check_io", "check_kernel",
+    "corrupt_newest_checkpoint",
 ]
 
 _logger = get_logger("apex_tpu.resilience")
@@ -317,6 +319,118 @@ class ChaosMonkey:
             yield self
         finally:
             _ACTIVE = prev
+
+
+# ------------------------------------------------- supervisor-level faults
+def corrupt_newest_checkpoint(dir_path, flip_bytes: int = 64) -> str:
+    """Deterministic stand-in for silent storage corruption: XOR the
+    LAST ``flip_bytes`` of the newest restore candidate (a complete
+    ``step_*`` dir's rank-0 shard, or the newest single-file
+    checkpoint) with 0xFF — **size-preserving**, so the index
+    completeness check and the torn-size validation both still pass and
+    only the blob-crc corruption probe (``io.probe_checkpoint``) or the
+    load-time crc verify can see it.  The tail of the file is blob
+    bytes by the format's layout (header first), so the flip never
+    fabricates a different-but-parseable header.  Returns the corrupted
+    file's path; raises ``FileNotFoundError`` when the dir holds no
+    complete checkpoint to corrupt."""
+    import os
+    from pathlib import Path
+
+    from apex_tpu.io.checkpoint import (
+        _shard_name, checkpoint_step, latest_distributed_step, read_index,
+    )
+
+    d = Path(dir_path)
+    target = None
+    if any(d.glob("step_*/index.json")):
+        step = latest_distributed_step(d)
+        if step >= 0:
+            sd = d / f"step_{step:08d}"
+            world = int(read_index(sd)["world_size"])
+            target = sd / _shard_name(0, world)
+    else:
+        cands = sorted(
+            (p for p in d.iterdir()
+             if p.is_file() and p.suffix in (".ckpt", ".apex")),
+            key=checkpoint_step, reverse=True) if d.is_dir() else []
+        target = cands[0] if cands else None
+    if target is None or not target.exists():
+        raise FileNotFoundError(
+            f"no complete checkpoint under {dir_path} to corrupt")
+    size = target.stat().st_size
+    n = min(int(flip_bytes), size)
+    # r+b (no truncate, no append): the size must not change — that is
+    # the whole point of this fault class
+    with open(target, "r+b") as f:
+        f.seek(size - n)
+        tail = f.read(n)
+        f.seek(size - n)
+        f.write(bytes(b ^ 0xFF for b in tail))
+        f.flush()
+        os.fsync(f.fileno())
+    log_structured(_logger, logging.WARNING, "chaos.checkpoint_corrupted",
+                   path=str(target), flipped_bytes=n)
+    return str(target)
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorFault:
+    """One restart attempt's planned fault, applied by the
+    :class:`~apex_tpu.resilience.supervisor.Supervisor` around a spawn:
+    ``extra_args`` append to the child argv (arming the child-side
+    chaos flags — kill at step N, wedge a step — for THIS attempt
+    only, so the fault does not recur on every relaunch), and
+    ``corrupt_newest_checkpoint`` flips bytes in the newest restore
+    candidate before the child launches."""
+
+    extra_args: tuple = ()
+    corrupt_newest_checkpoint: bool = False
+
+
+class SupervisorFaultScript:
+    """attempt index -> :class:`SupervisorFault`: the deterministic
+    script that turns the whole fault gauntlet (kill, wedge storm,
+    corrupt checkpoint, recover) into ONE supervised invocation.
+
+    JSON shape (``from_file`` / ``pretrain_gpt.py --fault-script``)::
+
+        {"0": {"args": ["--chaos-kill-at-step", "3"]},
+         "1": {"args": ["--watchdog-secs", "3",
+                         "--chaos-wedge-step", "4",
+                         "--chaos-wedge-secs", "300"]},
+         "2": {"corrupt_newest_checkpoint": true}}
+
+    Unlisted attempts run clean."""
+
+    def __init__(self, faults: Mapping[int, SupervisorFault]):
+        self.faults = {int(k): v for k, v in dict(faults).items()}
+
+    @classmethod
+    def from_dict(cls, spec: Mapping) -> "SupervisorFaultScript":
+        faults = {}
+        for k, v in dict(spec).items():
+            unknown = set(v) - {"args", "corrupt_newest_checkpoint"}
+            if unknown:
+                raise ValueError(
+                    f"fault script attempt {k!r}: unknown key(s) "
+                    f"{sorted(unknown)} (valid: args, "
+                    "corrupt_newest_checkpoint)")
+            faults[int(k)] = SupervisorFault(
+                extra_args=tuple(str(a) for a in v.get("args", ())),
+                corrupt_newest_checkpoint=bool(
+                    v.get("corrupt_newest_checkpoint", False)))
+        return cls(faults)
+
+    @classmethod
+    def from_file(cls, path) -> "SupervisorFaultScript":
+        import json
+
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def fault_for(self, attempt: int) -> Optional[SupervisorFault]:
+        return self.faults.get(int(attempt))
 
 
 _ACTIVE: Optional[ChaosMonkey] = None

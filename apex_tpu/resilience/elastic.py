@@ -74,19 +74,26 @@ EXIT_KILLED = 137
 
 
 def restart_backoff(attempt: int, base: float = 2.0, cap: float = 300.0,
-                    seed: int = 0) -> float:
+                    seed: int = 0, rng=None) -> float:
     """The documented supervisor backoff contract: full-jitter
     exponential — attempt ``k`` sleeps ``uniform(0, min(cap, base·2^k))``
     seconds.  Deterministic per ``(seed, attempt)`` so the chaos matrix
     can assert the schedule; a real supervisor seeds per host (rank) so
-    a pod's restarts don't re-land in lockstep."""
+    a pod's restarts don't re-land in lockstep.
+
+    ``rng`` (anything with ``uniform(a, b)``) overrides the per-(seed,
+    attempt) derivation — the :class:`~apex_tpu.resilience.supervisor
+    .Supervisor` tests pin exact jittered delays through it; when
+    omitted the historical seeded behavior is unchanged."""
     import random
 
     if attempt < 0:
         raise ValueError(f"attempt must be >= 0, got {attempt}")
     hi = min(float(cap), float(base) * (2.0 ** int(attempt)))
-    # int seed (not a tuple): tuple seeding is hash-based + deprecated
-    return random.Random(int(seed) * 1000003 + int(attempt)).uniform(0.0, hi)
+    if rng is None:
+        # int seed (not a tuple): tuple seeding is hash-based + deprecated
+        rng = random.Random(int(seed) * 1000003 + int(attempt))
+    return rng.uniform(0.0, hi)
 
 
 # ---------------------------------------------------------- step watchdog

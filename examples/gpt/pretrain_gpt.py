@@ -28,6 +28,12 @@ Runs out of the box on the virtual CPU mesh (synthetic data):
     ... --watchdog-secs 60   # wedged-step watchdog: drain + exit 75
     #   (EX_TEMPFAIL) for supervisor restart-with-backoff
     ... --chaos-kill-at-step 3   # pod chaos: die hard (exit 137, no save)
+    ... --supervise --zero --checkpoint /tmp/gpt_ck --auto-resume   # SELF-
+    #   HEALING: an outer supervisor relaunches this same command on
+    #   75/137/crash with full-jitter backoff, quarantines a corrupt
+    #   newest checkpoint (resume falls back one step), trips a circuit
+    #   breaker (exit 76) after K no-progress failures, and prints the
+    #   whole-job goodput report (apex_tpu.resilience.supervisor)
 """
 
 import argparse
@@ -138,11 +144,28 @@ def parse_args():
                         "failures to the XLA fallback instead of dying — "
                         "the same command line works for the first launch "
                         "and every restart")
+    from apex_tpu.resilience.supervisor import add_supervisor_args
+
+    add_supervisor_args(p)
     return p.parse_args()
 
 
 def main():
     args = parse_args()
+
+    if args.supervise:
+        # the self-healing outer loop: relaunch THIS command (minus the
+        # supervisor flags) as a child and run the restart state
+        # machine — exit-code table, crash-loop breaker, checkpoint
+        # quarantine, goodput summary.  Runs before any jax backend
+        # init: the parent must never hold the devices the child needs.
+        from apex_tpu.resilience.supervisor import run_supervised_cli
+
+        if not args.auto_resume and args.checkpoint:
+            raise SystemExit("--supervise needs --auto-resume with "
+                             "--checkpoint: a restarted child that does "
+                             "not resume would retrain from step 0")
+        raise SystemExit(run_supervised_cli(args))
 
     from apex_tpu import io, resilience
     from apex_tpu.amp import DynamicLossScaler

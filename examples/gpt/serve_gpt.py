@@ -87,6 +87,23 @@ def build_args():
                         "metrics.prom (apex_tpu.observability)")
     p.add_argument("--run-id", default="serve",
                    help="correlation id on metrics points and trace spans")
+    p.add_argument("--watchdog-secs", type=float, default=None,
+                   help="serving step watchdog: a decode step exceeding "
+                        "this many seconds (dead tunnel, wedged "
+                        "collective) logs every queued/in-flight request "
+                        "id (the requeue manifest), records "
+                        "apex_serve_wedges_total, and exits 75 so a "
+                        "supervisor restarts the engine")
+    p.add_argument("--watchdog-compile-grace", type=float, default=600.0,
+                   help="the FIRST step's watchdog allowance (the "
+                        "prefill/decode jit compiles make it slow)")
+    p.add_argument("--chaos-wedge-decode-step", type=int, default=None,
+                   help="chaos: wedge this decode step's dispatch for "
+                        "--chaos-wedge-secs (pair with --watchdog-secs)")
+    p.add_argument("--chaos-wedge-secs", type=float, default=120.0)
+    from apex_tpu.resilience.supervisor import add_supervisor_args
+
+    add_supervisor_args(p)
     return p
 
 
@@ -160,6 +177,15 @@ def check_greedy_parity(params, config, completions, max_check=3):
 
 def main(argv=None):
     args = build_args().parse_args(argv)
+    if args.supervise:
+        # same self-healing outer loop as the trainer (no checkpoint
+        # dir: a serving restart is stateless — the wedge manifest in
+        # the logs is what a frontend replays)
+        from apex_tpu.resilience.supervisor import run_supervised_cli
+
+        return run_supervised_cli(args, argv=(None if argv is None
+                                              else [sys.argv[0], *argv]),
+                                  checkpoint_dir=None)
     if args.smoke:
         # tiny, deterministic, greedy: the CPU acceptance contract
         args.layers, args.hidden, args.heads, args.vocab = 2, 64, 4, 128
@@ -202,15 +228,39 @@ def main(argv=None):
         base_seed=args.seed,
     )
     from apex_tpu.observability import get_metrics, set_step_context
+    from apex_tpu.resilience import ChaosMonkey, ChaosPlan, StepWatchdog
 
     set_step_context(run_id=args.run_id, step=0)
     registry = get_metrics()  # the scheduler's gauges/histograms land here
-    sched = ContinuousBatchingScheduler(params, config, dcfg)
+
+    # wedged-decode-step watchdog: heartbeats ride scheduler.step(); a
+    # wedge logs the queued/in-flight request ids and exits 75 for the
+    # supervisor (no checkpointer to drain — serving state is the logs)
+    watchdog = None
+    if args.watchdog_secs is not None:
+        watchdog = StepWatchdog(
+            args.watchdog_secs,
+            first_deadline_sec=args.watchdog_compile_grace)
+        watchdog.start()
+    monkey = None
+    if args.chaos_wedge_decode_step is not None:
+        monkey = ChaosMonkey(ChaosPlan.make(
+            wedge_step_at=args.chaos_wedge_decode_step,
+            wedge_step_seconds=args.chaos_wedge_secs))
+
+    sched = ContinuousBatchingScheduler(params, config, dcfg,
+                                        watchdog=watchdog)
     reqs, arrivals = make_requests(args, rng)
 
     t0 = time.monotonic()
-    completions = serve(sched, reqs, arrivals)
+    if monkey is not None:
+        with monkey.active():
+            completions = serve(sched, reqs, arrivals)
+    else:
+        completions = serve(sched, reqs, arrivals)
     wall = time.monotonic() - t0
+    if watchdog is not None:
+        watchdog.stop()
 
     out = report(completions, wall)
     out["stats"] = dict(sched.stats)

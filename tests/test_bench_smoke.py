@@ -70,6 +70,20 @@ def test_elastic_resume_smoke_resharded():
     assert list(report["sections"]) == ["elastic_resume"]
 
 
+def test_elastic_resume_supervised_mode_rides_smoke():
+    """The section's ``supervised`` sub-mode (the save→kill→restore
+    cycle driven by the REAL Supervisor over the trainer CLI) asserts
+    internally — rc 0 after exactly one restart — so ``ok`` above
+    already covers it; this pins that the mode actually runs by default
+    (a refactor that silently drops the sub-call must fail here, not
+    ship).  Source-text pin, no import: bench.py is a script with heavy
+    module-level imports."""
+    src = open(BENCH).read()
+    assert "def bench_supervised_elastic" in src
+    assert 'out["supervised"] = bench_supervised_elastic()' in src
+    assert "supervised=True" in src
+
+
 def test_zero_wire_bytes_accounting_ratios():
     """The ``zero_gpt124`` section's ``wire_bytes_per_step`` field,
     validated at the accounting level (pure plan arithmetic, no step

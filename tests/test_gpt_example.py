@@ -445,6 +445,126 @@ def test_serve_metrics_dir(tmp_path):
     assert counts["apex_serve_completions_total"] == rec["stats"]["evicted"]
 
 
+def test_supervised_gauntlet_one_invocation_survives_all(tmp_path):
+    """The ISSUE 11 acceptance run: ONE `pretrain_gpt.py --supervise
+    --zero --auto-resume` invocation survives the scripted fault
+    gauntlet — attempt 0 hard-killed (rc 137), attempt 1's step wedged
+    until the watchdog fires (rc 75), attempt 2's newest checkpoint
+    corrupted (size-preserving bit flips the completeness/torn-size
+    seams cannot see) so its restore crashes — and the supervisor
+    quarantines exactly the bad step dir, attempt 3 resumes from the
+    prior step, reaches the target, and the whole job exits 0 with
+    goodput fractions summing to exactly 1 and the restart/wedge
+    downtime attributed."""
+    import json
+    import subprocess as sp
+
+    ck, md = tmp_path / "ck", tmp_path / "metrics"
+    script = tmp_path / "faults.json"
+    script.write_text(json.dumps({
+        "0": {"args": ["--chaos-kill-at-step", "3"]},
+        "1": {"args": ["--watchdog-secs", "3", "--chaos-wedge-step", "4",
+                       "--chaos-wedge-secs", "300"]},
+        "2": {"corrupt_newest_checkpoint": True},
+    }))
+    r = sp.run(
+        [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
+         "--supervise", "--tp", "2", "--zero", "--auto-resume",
+         "--steps", "6", "--save-every", "2", "--checkpoint", str(ck),
+         "--metrics-dir", str(md), "--fault-script", str(script),
+         "--max-restarts", "8", "--backoff-base", "0.05",
+         "--backoff-cap", "0.2"],
+        capture_output=True, text=True, timeout=600, env=_env(_devs(4)),
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\n{r.stderr[-3000:]}"
+    # every fault fired, in order, and each was survived
+    assert "chaos.host_killed" in r.stderr          # attempt 0: rc 137
+    assert "watchdog.step_wedged" in r.stderr       # attempt 1: rc 75
+    assert "checkpoint.quarantined" in r.stderr     # attempt 2: corrupt
+    assert "supervisor.quarantined" in r.stderr
+    assert r.stderr.count("supervisor.restarting") == 3
+    # quarantine semantics: EXACTLY the bad step dir moved aside, with
+    # its reason file, and the run resumed from the PRIOR step
+    q = ck / "quarantine"
+    assert [p.name for p in sorted(q.glob("step_*")) if p.is_dir()] \
+        == ["step_00000004"]
+    reason = json.loads((q / "step_00000004.reason.json").read_text())
+    assert "crc32" in reason["reason"]
+    assert "resumed at step 2" in r.stdout          # fell back one step
+    assert "step 7:" in r.stdout                    # reached the target
+    assert "supervisor goodput:" in r.stdout        # one job summary
+    # goodput: 4 sessions, the wedge stamped, fractions closed over the
+    # whole supervised job (restart gaps = backoff + relaunch)
+    report = json.loads((md / "goodput_report.json").read_text())
+    assert report["sessions"] == 4
+    assert report["wedge_events"] == 1
+    f = report["fractions"]
+    assert abs(sum(f.values()) - 1.0) < 1e-9, f
+    assert f.get("wedge", 0) > 0, f
+    assert f.get("restart", 0) > 0, f
+    assert f.get("productive", 0) > 0, f
+
+
+def test_supervised_crash_loop_trips_breaker(tmp_path):
+    """The crash-loop acceptance contract at process level: a fault
+    script that kills EVERY attempt at step 0 (no checkpoint ever
+    published, no goodput steps — zero progress) trips the circuit
+    breaker after exactly K=3 consecutive failures and the supervisor
+    exits the documented breaker code 76 — never an unbounded restart
+    loop.  (The pinned-backoff-schedule half of the contract rides the
+    rng seam in tests/test_supervisor.py.)"""
+    import json
+    import subprocess as sp
+
+    ck = tmp_path / "ck"
+    script = tmp_path / "faults.json"
+    kill = {"args": ["--chaos-kill-at-step", "0"]}
+    script.write_text(json.dumps({"0": kill, "1": kill, "2": kill}))
+    r = sp.run(
+        [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
+         "--supervise", "--zero", "--auto-resume", "--steps", "4",
+         "--save-every", "100", "--checkpoint", str(ck),
+         "--fault-script", str(script), "--crash-loop-threshold", "3",
+         "--backoff-base", "0.05", "--backoff-cap", "0.1"],
+        capture_output=True, text=True, timeout=600, env=_env(),
+    )
+    assert r.returncode == 76, f"rc={r.returncode}\n{r.stderr[-2000:]}"
+    assert "supervisor.circuit_breaker_tripped" in r.stderr
+    assert '"no_progress_failures": 3' in r.stderr
+    # two backoff sleeps, then the breaker — no fourth launch
+    assert r.stderr.count("supervisor.restarting") == 2
+    assert r.stderr.count("chaos.host_killed") == 3
+
+
+def test_serve_supervised_recovers_from_wedge(tmp_path):
+    """Serving rides the same machinery: attempt 0's decode step 3
+    wedges, the serving watchdog logs the queued/in-flight request ids
+    (the requeue manifest) and exits 75, the supervisor restarts the
+    engine WITHOUT the fault, and the job finishes 0."""
+    import json
+    import subprocess as sp
+
+    script = tmp_path / "faults.json"
+    script.write_text(json.dumps({
+        "0": {"args": ["--watchdog-secs", "2",
+                       "--chaos-wedge-decode-step", "3",
+                       "--chaos-wedge-secs", "300"]},
+    }))
+    r = sp.run(
+        [sys.executable, str(REPO / "examples/gpt/serve_gpt.py"),
+         "--smoke", "--supervise", "--fault-script", str(script),
+         "--max-restarts", "3", "--backoff-base", "0.05",
+         "--backoff-cap", "0.2"],
+        capture_output=True, text=True, timeout=600, env=_env(),
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\n{r.stderr[-2000:]}"
+    assert "serve.step_wedged" in r.stderr
+    assert '"queued_rids"' in r.stderr
+    assert r.stderr.count("supervisor.restarting") == 1
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["smoke"] is True  # attempt 1 met the full smoke contract
+
+
 def test_serve_gpt_smoke_contract():
     """The serving driver's acceptance contract end-to-end:
     ``serve_gpt.py --smoke`` must admit/evict >= 3 generations through

@@ -350,7 +350,7 @@ class TestKVCache:
 # -------------------------------------------------------------- scheduler
 def _sched(params, cfg, *, num_pages=10, page_size=4, pages_per_seq=6,
            max_batch=3, temperature=0.0, top_k=0, attn="xla", sample="xla",
-           max_prompt=8, seed=0):
+           max_prompt=8, seed=0, watchdog=None):
     dcfg = DecodeConfig(
         cache=KVCacheConfig(num_pages=num_pages, page_size=page_size,
                             pages_per_seq=pages_per_seq, dtype=jnp.float32),
@@ -358,7 +358,8 @@ def _sched(params, cfg, *, num_pages=10, page_size=4, pages_per_seq=6,
         temperature=temperature, top_k=top_k,
         attn_impl=attn, sample_impl=sample,
         sample_dot_dtype=jnp.float32, base_seed=seed)
-    return ContinuousBatchingScheduler(params, cfg, dcfg)
+    return ContinuousBatchingScheduler(params, cfg, dcfg,
+                                       watchdog=watchdog)
 
 
 def _requests(rng, n, vocab, plen=(2, 7), max_new=(2, 6)):
@@ -544,3 +545,49 @@ class TestScheduler:
             sched.submit(r)
         sched.run_until_drained()
         assert sched.decode_cache_size() == 1
+
+    def test_chaos_wedged_decode_step_fires_serving_watchdog(self, model):
+        """The serving-side watchdog contract: one decode step stalls
+        (chaos ``wedge_step_at`` keyed on the decode-step counter), the
+        per-step heartbeat stops, and the watchdog fires WHILE the step
+        is hung — the scheduler's on_wedge hook logs every queued and
+        in-flight request id (the requeue manifest for the layer above)
+        and records ``apex_serve_wedges_total`` — instead of the server
+        hanging forever.  ``on_fire`` captures the firing in place of
+        the real exit-75 (which ``serve_gpt.py --watchdog-secs`` takes
+        and the supervisor restarts on)."""
+        import time
+
+        from apex_tpu.observability import MetricsScope
+        from apex_tpu.resilience import StepWatchdog
+
+        cfg, params = model
+        fired = []
+        wd = StepWatchdog(0.5, poll_sec=0.05, first_deadline_sec=120.0,
+                          on_fire=fired.append)
+        with MetricsScope() as reg:
+            sched = _sched(params, cfg, watchdog=wd)
+            rng = np.random.RandomState(9)
+            # warmup WITHOUT the watchdog thread: compiles prefill +
+            # decode so the armed phase's step times are real step
+            # times, not jit compiles tripping a spurious fire
+            sched.submit(Request(100, list(rng.randint(0, 61, size=3)), 2))
+            sched.run_until_drained()
+            wedge_at = sched.stats["decode_steps"] + 1
+            monkey = ChaosMonkey(ChaosPlan.make(
+                wedge_step_at=wedge_at, wedge_step_seconds=2.0))
+            for r in _requests(rng, 4, cfg.vocab_size):
+                sched.submit(r)
+            with wd, monkey.active():
+                t0 = time.monotonic()
+                done = sched.run_until_drained()
+                hung = time.monotonic() - t0
+            assert len(done) == 5  # warmup + 4: the wedge cost time, not work
+            assert hung >= 1.5, "the injected wedge did not hold the step"
+            assert monkey.injected.get("wedge_step") == 1
+            assert fired and fired[0]["exit_code"] == 75
+            assert reg.counter("apex_serve_wedges_total").value() == 1
+            # the wedge fired while requests were still queued/in
+            # flight: the manifest hook had rids to report (admitted 5
+            # total, only the warmup was complete before the wedge)
+            assert sched.stats["evicted"] == 5

@@ -36,6 +36,9 @@ from apex_tpu.analysis.rules_collectives import (
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
+from apex_tpu.analysis.rules_resilience import (
+    SwallowedExceptionInRecoveryPath,
+)
 from apex_tpu.analysis.rules_precision import (
     KvCacheReadDtypeMismatch,
     PageTableGatherUnclamped,
@@ -515,6 +518,102 @@ class TestNonAtomicCheckpointWrite:
                     f.write(blob)
             """, tmp_path, [NonAtomicCheckpointWrite()])
         assert got == []
+
+
+# ---------------------------------- APX109 swallowed recovery-path except
+class TestSwallowedExceptionInRecoveryPath:
+    """The silent-swallow pattern PR 10's review kept hand-auditing:
+    a do-nothing `except` in resilience/io/inference erases the one
+    signal a wedged run's postmortem needs."""
+
+    def _run_scoped(self, src, tmp_path, subdir):
+        """Fixture placed under a scoped directory: APX109 keys on the
+        path's directory segments (resilience/io/inference), not on the
+        file name."""
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "fixture.py"
+        p.write_text(textwrap.dedent(src))
+        return analyze_file(str(p), [SwallowedExceptionInRecoveryPath()],
+                            set(AXES))
+
+    def test_positive_except_pass_in_resilience(self, tmp_path):
+        """The motivating shape: a drain error swallowed whole — the
+        supervisor restarts on a wedge and nobody ever learns the
+        flush failed too."""
+        got = self._run_scoped("""
+            def drain(checkpointer):
+                try:
+                    checkpointer.wait_until_finished()
+                except OSError:
+                    pass
+            """, tmp_path, "resilience")
+        assert rule_ids(got) == ["APX109"]
+        assert "OSError" in got[0].message
+        assert "log_structured" in got[0].fix_hint
+
+    def test_positive_bare_except_ellipsis_in_io(self, tmp_path):
+        got = self._run_scoped("""
+            def read_shard(path):
+                try:
+                    return open(path, "rb").read()
+                except:
+                    ...
+            """, tmp_path, "io")
+        assert rule_ids(got) == ["APX109"]
+        assert "bare" in got[0].message
+
+    def test_positive_stray_string_body_in_inference(self, tmp_path):
+        """A bare string is not a report — it is a comment that
+        evaluates to nothing."""
+        got = self._run_scoped("""
+            def evict(slot, allocator, pages):
+                try:
+                    allocator.free(pages)
+                except ValueError:
+                    "double free: already recycled"
+            """, tmp_path, "inference")
+        assert rule_ids(got) == ["APX109"]
+
+    def test_negative_logging_metrics_reraise_and_defaults(self, tmp_path):
+        """Handlers that report (log_structured, a metrics record), re-
+        raise, or return a fallback value are the sanctioned shapes."""
+        got = self._run_scoped("""
+            import logging
+
+            def recover(step, logger, metrics):
+                try:
+                    step()
+                except OSError as e:
+                    log_structured(logger, logging.WARNING,
+                                   "step.recovered", error=str(e))
+                try:
+                    step()
+                except ValueError:
+                    metrics.inc("apex_bad_steps_total")
+                try:
+                    step()
+                except KeyError:
+                    raise
+                try:
+                    return step()
+                except RuntimeError:
+                    return None
+            """, tmp_path, "resilience")
+        assert got == []
+
+    def test_negative_out_of_scope_modules_trusted(self, tmp_path):
+        """The same swallow OUTSIDE the recovery-path packages (an
+        example script, an op) is not this rule's business."""
+        src = """
+            def cleanup(path):
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+            """
+        for subdir in ("examples/gpt", "ops", "observability"):
+            assert self._run_scoped(src, tmp_path, subdir) == []
 
 
 # ------------------------------------------- APX201 unknown collective axis
