@@ -21,7 +21,7 @@ machine-checked invariants):
   is invisible to the supervisor and the postmortem.
 - **APX201/202** collective-axis consistency against the
   ``parallel_state.py`` mesh registry (``rules_collectives``).
-- **APX203/204** axis-scope dataflow (``dataflow`` + ``rules_collectives``):
+- **APX203/204/205** axis-scope dataflow (``dataflow`` + ``rules_collectives``):
   a registered-axis collective reachable only from ``jit``/``pjit``
   (no axis bound), or under a ``shard_map`` nest that binds only OTHER
   axes.
@@ -74,7 +74,8 @@ from apex_tpu.analysis.core import (
 )
 from apex_tpu.analysis.rules_collectives import (
     CollectiveAxisOutsideShardMapNest, CollectiveAxisUnboundUnderJit,
-    CollectiveOutsideSpmdContext, UnknownCollectiveAxis,
+    CollectiveOutsideSpmdContext, CollectiveTupleAxisUnbound,
+    UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
@@ -113,6 +114,7 @@ def default_rules(vmem_budget_bytes=None):
         CollectiveOutsideSpmdContext(),
         CollectiveAxisUnboundUnderJit(),
         CollectiveAxisOutsideShardMapNest(),
+        CollectiveTupleAxisUnbound(),
         BlockShapeTilingViolation(),
         BlockSpecIndexMapArity(),
         HardCodedSublaneAlignment(),

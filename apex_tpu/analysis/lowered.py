@@ -39,6 +39,7 @@ import jax
 
 __all__ = [
     "hlo_text", "count_collectives", "operand_dtypes",
+    "collective_sites", "mesh_axis_groups", "assert_collective_axes",
     "assert_collective_dtype", "assert_no_host_transfer",
     "assert_no_whole_tree_concat", "assert_donation_covers",
     "donated_buffer_count", "host_transfer_sites",
@@ -72,27 +73,148 @@ def _op_occurrences(txt: str, kind: str) -> List[str]:
         r'(?:stablehlo|mhlo)\.' + re.escape(kind) + r'\b', txt)
 
 
+#: how far past an op's name to look for its attribute dict — the
+#: replica_groups attr precedes the (possibly multi-line) reduction
+#: region, so a bounded window is enough and never bleeds into the
+#: NEXT collective's attrs (ops are > 40 chars of SSA plumbing apart).
+_ATTR_WINDOW = 4000
+
+
+def _parse_replica_groups(window: str) -> Optional[List[List[int]]]:
+    m = re.search(r'replica_groups\s*=\s*dense<([^>]*)>', window)
+    if m is None:
+        return None
+    body = m.group(1).strip()
+    try:
+        if body.startswith("["):
+            import ast
+
+            val = ast.literal_eval(body)
+            if isinstance(val, list) and val and not isinstance(val[0], list):
+                val = [val]
+            return [[int(x) for x in grp] for grp in val]
+        # splat form dense<0> : tensor<1x1xi64> — a single singleton
+        return [[int(body)]]
+    except (ValueError, SyntaxError):
+        return None
+
+
+def collective_sites(artifact, kind: str) -> List[dict]:
+    """Every ``kind`` collective in program order, as
+    ``{"dtype": str|None, "replica_groups": [[int, ...], ...]|None}``
+    — the per-site view :func:`count_collectives`'s ``axes=`` filter
+    and :func:`assert_collective_axes` are built on.  ``dtype`` is the
+    first operand's element type (as in :func:`operand_dtypes`);
+    ``replica_groups`` indexes the mesh's logical device order (what
+    shard_map lowers), None when the op carries no parseable groups."""
+    txt = hlo_text(artifact)
+    if kind in _REGION_OPS:
+        dt_pat = re.compile(r'\}\)\s*:\s*\(tensor<[0-9x]*x?(\w+)>', re.S)
+    else:
+        dt_pat = re.compile(r':\s*\(tensor<[0-9x]*x?(\w+)>')
+    sites = []
+    for m in re.finditer(
+            r'"?(?:stablehlo|mhlo)\.' + re.escape(kind) + r'\b', txt):
+        window = txt[m.start():m.start() + _ATTR_WINDOW]
+        dt = dt_pat.search(window)
+        sites.append({
+            "dtype": dt.group(1) if dt else None,
+            "replica_groups": _parse_replica_groups(window),
+        })
+    return sites
+
+
+def mesh_axis_groups(mesh, axes) -> List[List[int]]:
+    """The ``replica_groups`` a collective over ``axes`` of ``mesh``
+    lowers with: the partition of the mesh's logical device indices
+    (row-major over ``mesh.axis_names``) that varies exactly the named
+    axes and holds every other axis fixed — e.g. on
+    ``Mesh((2, 2), ("dp_out", "dp_in"))``, ``("dp_in",)`` gives
+    ``[[0, 1], [2, 3]]`` and ``("dp_out",)`` gives ``[[0, 2], [1, 3]]``."""
+    import numpy as np
+
+    names = list(mesh.axis_names)
+    axes = [axes] if isinstance(axes, str) else list(axes)
+    unknown = [a for a in axes if a not in names]
+    if unknown:
+        raise ValueError(f"axes {unknown} not on mesh {tuple(names)}")
+    shape = [mesh.shape[n] for n in names]
+    ids = np.arange(int(np.prod(shape))).reshape(shape)
+    other = [i for i, n in enumerate(names) if n not in axes]
+    coll = [names.index(a) for a in axes]
+    group_size = int(np.prod([shape[i] for i in coll])) if coll else 1
+    return ids.transpose(other + coll).reshape(-1, group_size).tolist()
+
+
+def _groups_key(groups) -> Optional[frozenset]:
+    """Order-insensitive identity of a replica-group partition (the
+    lowering may emit groups, and ids within groups, in any order)."""
+    if groups is None:
+        return None
+    return frozenset(frozenset(g) for g in groups)
+
+
 def count_collectives(artifact, kind: str, *,
                       minimum: Optional[int] = None,
-                      maximum: Optional[int] = None) -> int:
+                      maximum: Optional[int] = None,
+                      axes=None, mesh=None) -> int:
     """Occurrences of one collective (``reduce_scatter``,
     ``all_gather``, ``all_reduce``, ``all_to_all``,
     ``collective_permute``, ...) in the lowering.  With ``minimum``/
     ``maximum`` given, asserts the count is inside the bounds — the
     per-bucket contract reads ``count_collectives(txt,
-    "reduce_scatter", minimum=n_buckets, maximum=n_buckets)``."""
-    txt = hlo_text(artifact)
-    n = len(_op_occurrences(txt, kind))
+    "reduce_scatter", minimum=n_buckets, maximum=n_buckets)``.
+
+    ``axes=`` (with ``mesh=``) counts only the occurrences whose
+    ``replica_groups`` equal the partition a collective over exactly
+    those mesh axes lowers with — the per-hop contract of the
+    hierarchical sync plan reads ``count_collectives(txt,
+    "reduce_scatter", axes=("dp_in",), mesh=mesh, minimum=n,
+    maximum=n)``."""
+    if axes is not None:
+        if mesh is None:
+            raise ValueError("axes= filtering needs mesh= (the groups "
+                             "are computed from the mesh layout)")
+        want = _groups_key(mesh_axis_groups(mesh, axes))
+        sites = collective_sites(artifact, kind)
+        n = sum(1 for s in sites
+                if _groups_key(s["replica_groups"]) == want)
+        label = f"{kind} over axes {tuple(axes) if not isinstance(axes, str) else (axes,)}"
+    else:
+        txt = hlo_text(artifact)
+        n = len(_op_occurrences(txt, kind))
+        label = kind
     if minimum is not None:
         assert n >= minimum, (
-            f"expected >= {minimum} {kind} collective(s) in the "
+            f"expected >= {minimum} {label} collective(s) in the "
             f"lowering, found {n} — the per-bucket plan did not lower "
             f"to per-bucket collectives")
     if maximum is not None:
         assert n <= maximum, (
-            f"expected <= {maximum} {kind} collective(s) in the "
+            f"expected <= {maximum} {label} collective(s) in the "
             f"lowering, found {n} — something introduced extra "
             f"collectives (a whole-tree sync path?)")
+    return n
+
+
+def assert_collective_axes(artifact, kind: str, axes, mesh, *,
+                           minimum: Optional[int] = None,
+                           maximum: Optional[int] = None,
+                           dtype: Optional[str] = None) -> int:
+    """The per-hop pin: count ``kind`` collectives running over exactly
+    ``axes`` of ``mesh`` (bounds as in :func:`count_collectives`), and
+    — with ``dtype`` — assert EVERY one of those carries that operand
+    element type (the hop's wire dtype).  Returns the matched count."""
+    n = count_collectives(artifact, kind, axes=axes, mesh=mesh,
+                          minimum=minimum, maximum=maximum)
+    if dtype is not None:
+        want = _groups_key(mesh_axis_groups(mesh, axes))
+        bad = [s["dtype"] for s in collective_sites(artifact, kind)
+               if _groups_key(s["replica_groups"]) == want
+               and s["dtype"] != dtype]
+        assert not bad, (
+            f"{kind} over axes {axes} must run in {dtype}, found "
+            f"{bad} — a hop is not on its wire dtype")
     return n
 
 

@@ -16,7 +16,9 @@ hazard:
   pass (``dataflow.scopes_at``) PROVES how the collective's function is
   reached — only through ``jit``/``pjit`` with the axis unbound
   (APX203), or through a ``shard_map`` nest none of whose axes match
-  (APX204).
+  (APX204).  Scalar axis spellings only: tuple-of-axes collectives
+  (``psum(x, ("dp_out", "dp_in"))``, the hierarchical-sync spelling)
+  belong to APX205, which judges the whole tuple at once.
 - APX202 (heuristic): no scope information at all — the collective's
   callers are outside static reach, and the module shows no spmd
   machinery either; the old invisible-caller-contract warning.
@@ -40,17 +42,24 @@ _COLLECTIVES = {
 _SPMD_MARKERS = ("shard_map", "pmap", "xmap", "Mesh(", "mesh=")
 
 
-def _axis_literals(call: ast.Call, pos: int) -> List[Tuple[ast.AST, str]]:
-    """(node, literal) pairs for every string literal in the axis-name
-    argument — handles both ``"tp"`` and ``("dcn", "dp")``.  Dynamic
-    axis names (parameters, variables) yield nothing: threading the
-    axis as an argument is exactly the pattern we want."""
+def _axis_arg(call: ast.Call, pos: int) -> Optional[ast.AST]:
+    """The axis-name argument expression of a collective call (the
+    ``axis_name=`` keyword wins over the positional slot), or None."""
     arg = None
     for kw in call.keywords:
         if kw.arg == "axis_name":
             arg = kw.value
     if arg is None and len(call.args) > pos:
         arg = call.args[pos]
+    return arg
+
+
+def _axis_literals(call: ast.Call, pos: int) -> List[Tuple[ast.AST, str]]:
+    """(node, literal) pairs for every string literal in the axis-name
+    argument — handles both ``"tp"`` and ``("dcn", "dp")``.  Dynamic
+    axis names (parameters, variables) yield nothing: threading the
+    axis as an argument is exactly the pattern we want."""
+    arg = _axis_arg(call, pos)
     if arg is None:
         return []
     out: List[Tuple[ast.AST, str]] = []
@@ -171,6 +180,8 @@ class CollectiveAxisUnboundUnderJit(Rule):
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         for call, name, pos in _collective_calls(ctx):
+            if isinstance(_axis_arg(call, pos), (ast.Tuple, ast.List)):
+                continue  # APX205 owns tuple-of-axes spellings
             for node, literal in _axis_literals(call, pos):
                 if literal not in ctx.axis_registry:
                     continue  # APX201's finding
@@ -206,6 +217,8 @@ class CollectiveAxisOutsideShardMapNest(Rule):
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         for call, name, pos in _collective_calls(ctx):
+            if isinstance(_axis_arg(call, pos), (ast.Tuple, ast.List)):
+                continue  # APX205 owns tuple-of-axes spellings
             for node, literal in _axis_literals(call, pos):
                 if literal not in ctx.axis_registry:
                     continue  # APX201's finding
@@ -219,3 +232,57 @@ class CollectiveAxisOutsideShardMapNest(Rule):
                         f"never bound on any reaching path, so the "
                         f"collective fails at trace time — on the "
                         f"chip, for TPU-gated kernels")
+
+
+class CollectiveTupleAxisUnbound(Rule):
+    """APX205: a collective invoked with a TUPLE of axis names —
+    ``psum(x, ("dp_out", "dp_in"))``, the hierarchical-sync spelling —
+    where some member axis is provably unbound on every reaching path.
+
+    The scalar dataflow rules (APX203/204) yield tuple spellings to
+    this rule: a tuple collective needs EVERY member bound in the SAME
+    nest, and the one finding here names exactly the members that are
+    not, instead of one scalar finding per member.  Unregistered
+    members stay APX201's finding (the registry tier speaks whether or
+    not dataflow has a verdict); members spelled dynamically leave the
+    unbound check quiet for the whole call (the nest MAY bind them).
+    """
+
+    rule_id = "APX205"
+    severity = "error"
+    fix_hint = ("bind every member axis in the enclosing shard_map's "
+                "mesh (a hierarchical (outer, inner) collective needs "
+                "both hops on the mesh), or thread the axis tuple in "
+                "as an argument")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call, name, pos in _collective_calls(ctx):
+            arg = _axis_arg(call, pos)
+            if not isinstance(arg, (ast.Tuple, ast.List)):
+                continue
+            if not all(isinstance(e, ast.Constant)
+                       and isinstance(e.value, str) for e in arg.elts):
+                continue  # a dynamic member may bind anything — quiet
+            members = [e.value for e in arg.elts]
+            registered = [m for m in members if m in ctx.axis_registry]
+            verdicts = {m: _scope_verdict(ctx, call, m)
+                        for m in registered}
+            unbound = [m for m in registered if verdicts[m] is not None]
+            if not unbound:
+                continue
+            scopes = dataflow.scopes_at(ctx, call)
+            under = ("a shard_map nest that binds only "
+                     f"{{{_bound_axes(scopes)}}}"
+                     if any(s.shard_map for s in scopes)
+                     else "jit/pjit-traced entry points only (jit "
+                          "auto-sharding binds no axis names)")
+            unreg = [m for m in members if m not in ctx.axis_registry]
+            extra = (f" (members {unreg} are not in the mesh registry "
+                     "at all — APX201's finding)" if unreg else "")
+            yield self.finding(
+                ctx, arg,
+                f"lax.{name}({tuple(members)!r}) reaches "
+                f"{under}: member axis(es) "
+                f"{unbound} are never bound on any reaching path, so "
+                f"the whole tuple collective fails at trace time — on "
+                f"the chip, for TPU-gated kernels{extra}")

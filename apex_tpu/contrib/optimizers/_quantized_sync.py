@@ -207,16 +207,34 @@ def quantized_pmean(grads, axis_name: str, spec: QSpec, world: int,
     return bucketing.unpack(plan, out)
 
 
-def grad_sync_bytes(total: int, sync_dtype,
-                    block: int = QBLOCK) -> Tuple[int, int]:
-    """``(payload_bytes, scale_bytes)`` one bucket's grad sync puts on
-    the wire per step: ``total`` elements in the sync dtype, plus — for
-    quantized wires — the fp32 per-block scale vector (the amax psum).
-    The bench's ``wire_bytes_per_step`` accounting reads through here
-    so the reported cut (≈2x int8 vs bf16, ≈4x vs fp32) includes the
-    scale overhead."""
+def grad_sync_bytes(total: int, sync_dtype, block: int = QBLOCK,
+                    hier=None, flat_hop: str = "dp"):
+    """PER-HOP ``{hop: {"payload": bytes, "scales": bytes}}`` one
+    bucket's grad sync puts on the wire per step (per rank: what this
+    rank contributes to each hop's collective).  The scale-vector bytes
+    of the quantized wires (the fp32 per-block amax psum) are EXPLICIT
+    per hop — never folded into a payload approximation — so the
+    bench's ``wire_bytes_per_step`` ratios (≈2x int8 vs bf16, ≈4x vs
+    fp32, the ``1/dp_inner`` cross-slice cut) are exact.
+
+    - flat (``hier=None``): one hop keyed ``flat_hop`` with the full
+      ``total``-element payload in the sync dtype;
+    - hierarchical (``hier`` a :class:`~apex_tpu.contrib.optimizers
+      ._hierarchical_sync.HierarchicalSyncPlan`): the fast inner hop
+      carries the full bucket, the slow outer hop the ``1/dp_inner``
+      chunk — BOTH at the wire dtype, each with its own per-hop-sized
+      scale vector, so the slow-hop bytes are exactly ``1/dp_inner`` of
+      the flat plan's at equal wire dtype."""
     spec = qspec_of(sync_dtype)
-    if spec is None:
-        return total * jnp.dtype(sync_dtype).itemsize, 0
-    return (total * spec.wire_dtype.itemsize,
-            (total // block) * jnp.dtype(jnp.float32).itemsize)
+    item = (spec.wire_dtype.itemsize if spec is not None
+            else jnp.dtype(sync_dtype).itemsize)
+    f32 = jnp.dtype(jnp.float32).itemsize
+
+    def hop(n):
+        return {"payload": n * item,
+                "scales": (n // block) * f32 if spec is not None else 0}
+
+    if hier is None:
+        return {flat_hop: hop(total)}
+    return {hier.inner_axis: hop(total),
+            hier.outer_axis: hop(total // max(hier.inner_size, 1))}

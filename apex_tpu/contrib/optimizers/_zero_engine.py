@@ -35,7 +35,19 @@ TPU shape of that machinery (this module):
   ``param_sync_dtype``**; with ``overlap_param_sync`` the gather runs
   on the pre-commit update (before the cross-rank finite vote
   completes) and the commit is predicated per leaf afterwards, so the
-  gather is not serialized behind the vote's collectives.
+  gather is not serialized behind the vote's collectives;
+- **``dp_axes=(outer, inner)`` makes both syncs topology-aware**
+  (:mod:`apex_tpu.contrib.optimizers._hierarchical_sync`): per bucket
+  the grad sync becomes a TWO-HOP reduce-scatter — intra-slice on the
+  fast inner axis, cross-slice on the slow outer axis at the same
+  wire dtype (quantized wires requantize the partial sums against
+  fresh outer-shared scales and fold the requantization error into
+  the same residual channel) — and the param gathers mirror in
+  reverse.  Shard ownership keeps the FLAT chunk-per-rank layout and
+  the one ``bucketing.padded_total`` formula, so checkpoints reshard
+  across flat <-> hierarchical worlds unchanged; cross-slice wire
+  bytes drop by exactly ``1/dp_inner`` (per-hop accounting in
+  :meth:`ZeroOptimizerBase.wire_bytes_per_step`).
 
 Fail-fast contract: the collectives live INSIDE the optimizer, so this
 engine never routes through the per-process
@@ -52,6 +64,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.contrib.optimizers import _hierarchical_sync as hs
 from apex_tpu.contrib.optimizers import _quantized_sync as qs
 from apex_tpu.observability import stepstats as _stepstats
 from apex_tpu.optimizers import bucketing
@@ -109,10 +122,13 @@ def local_leaf_info(params, param_specs, axis_sizes, zero_axis):
     """Per-leaf LOCAL shard shapes when ``params`` are sharded over
     model-parallel mesh axes per ``param_specs``, plus the sorted model
     axes and — per leaf — the replication factor a psum over those axes
-    over-counts it by (1 for fully sharded leaves).  Raises if a param
-    is sharded over the ZeRO axis itself, or if any sharded DIMENSION
-    is indivisible (floor division would silently misalign the flat
-    layout)."""
+    over-counts it by (1 for fully sharded leaves).  ``zero_axis`` may
+    be one axis name or the hierarchical ``(outer, inner)`` pair.
+    Raises if a param is sharded over any ZeRO axis itself, or if any
+    sharded DIMENSION is indivisible (floor division would silently
+    misalign the flat layout)."""
+    zero_axes = set(zero_axis) if isinstance(zero_axis, (tuple, list)) \
+        else {zero_axis}
     leaves, treedef = jax.tree.flatten(params)
     spec_leaves = treedef.flatten_up_to(param_specs)
     used_axes: List[str] = []
@@ -126,7 +142,7 @@ def local_leaf_info(params, param_specs, axis_sizes, zero_axis):
             if not dim_axes:
                 continue
             for ax in dim_axes:
-                if ax == zero_axis:
+                if ax in zero_axes:
                     raise ValueError(
                         f"params must not be sharded over the ZeRO axis {ax!r}")
             shard = int(np.prod([axis_sizes[ax] for ax in dim_axes]))
@@ -195,6 +211,7 @@ class ZeroOptimizerBase:
         param_sync_dtype=None,
         store_param_remainders: bool = False,
         dtype=jnp.float32,
+        dp_axes: Optional[Sequence[str]] = None,
         process_group=None,
         distributed_process_group=None,
         redundant_process_group=None,
@@ -202,6 +219,22 @@ class ZeroOptimizerBase:
         self.lr = lr
         self.weight_decay = weight_decay
         self.axis_name = axis_name
+        # hierarchical (outer, inner) dp split: grad sync becomes the
+        # two-hop reduce-scatter of _hierarchical_sync (intra-slice on
+        # the fast inner axis, cross-slice on the slow outer axis at
+        # the same wire dtype), param sync the mirrored gathers.  The
+        # HierarchicalSyncPlan itself is built at init (it needs the
+        # axis sizes); ownership keeps the FLAT chunk-per-rank layout,
+        # so checkpoints reshard flat <-> hierarchical unchanged.
+        if dp_axes is not None:
+            dp_axes = tuple(dp_axes)
+            if len(dp_axes) != 2 or len(set(dp_axes)) != 2 \
+                    or not all(isinstance(a, str) for a in dp_axes):
+                raise ValueError(
+                    f"dp_axes must be two distinct mesh axis names "
+                    f"(outer, inner), got {dp_axes!r}")
+        self.dp_axes = dp_axes
+        self._hier_plan: Optional[hs.HierarchicalSyncPlan] = None
         self.grad_average = grad_average
         # per-bucket collectives are independently schedulable by
         # construction — overlap_grad_sync is the reference's knob for
@@ -256,6 +289,20 @@ class ZeroOptimizerBase:
         the optimizer then carries error-feedback residual buckets."""
         return qs.is_quantized(self.grad_sync_dtype)
 
+    @property
+    def _dp_sync_axes(self):
+        """The axis-name argument dp-wide scalar collectives (finite
+        pmin, clip psum) take: the flat axis name, or the hierarchical
+        ``(outer, inner)`` tuple — one collective over the product
+        group either way."""
+        return self.dp_axes if self.dp_axes is not None else self.axis_name
+
+    @property
+    def hier_plan(self) -> Optional[hs.HierarchicalSyncPlan]:
+        """The :class:`~apex_tpu.contrib.optimizers._hierarchical_sync
+        .HierarchicalSyncPlan` built at ``init`` (None on flat dp)."""
+        return self._hier_plan
+
     def _param_dtype(self, bucket) -> jnp.dtype:
         if self.param_sync_dtype is not None:
             return self.param_sync_dtype
@@ -266,12 +313,26 @@ class ZeroOptimizerBase:
         if world_size is None:
             raise ValueError("pass world_size= (the dp axis size)")
         self._world = int(world_size)
+        if self.dp_axes is not None:
+            self._hier_plan = hs.hierarchical_plan(
+                self.dp_axes, axis_sizes,
+                grad_wire_dtype=self.grad_sync_dtype,
+                param_wire_dtype=self.param_sync_dtype)
+            if self._hier_plan.world != self._world:
+                raise ValueError(
+                    f"dp_axes={self.dp_axes!r} sizes "
+                    f"({self._hier_plan.outer_size}, "
+                    f"{self._hier_plan.inner_size}) multiply to "
+                    f"{self._hier_plan.world}, but world_size="
+                    f"{self._world}: the hierarchical split must cover "
+                    "exactly the flat dp world (same 1/dp shards, same "
+                    "padded_total formula)")
         if param_specs is not None:
             if axis_sizes is None:
                 raise ValueError("param_specs requires axis_sizes")
             local_shapes, self._model_axes, self._leaf_repl = \
                 local_leaf_info(params, param_specs, axis_sizes,
-                                self.axis_name)
+                                self.dp_axes or self.axis_name)
         else:
             local_shapes = [tuple(l.shape) for l in jax.tree.leaves(params)]
             self._model_axes, self._leaf_repl = (), None
@@ -358,7 +419,14 @@ class ZeroOptimizerBase:
         from jax.sharding import PartitionSpec as P
 
         axes = getattr(self, "_model_axes", ())
-        flat = P((*axes, self.axis_name)) if axes else P(self.axis_name)
+        # hierarchical shard ownership: (inner, outer) partition order
+        # places flat chunk i*dp_outer + o on mesh rank (o, i) — the
+        # chunk the two-hop scatter delivers there, and the SAME global
+        # chunk-per-rank layout the flat plan has
+        dp = self._hier_plan.shard_axes if self._hier_plan is not None \
+            else (self.axis_name,)
+        flat = P((*axes, *dp)) if (axes or self._hier_plan is not None) \
+            else P(self.axis_name)
         return tuple(flat for _ in self._require_plan().buckets)
 
     @property
@@ -497,10 +565,24 @@ class ZeroOptimizerBase:
         ``(g32_shards, new_residuals, pred, rank, world)`` —
         ``new_residuals`` is ``()`` on wide wires, UNCOMMITTED (the
         caller predicates it on the finite vote: a skipped step leaves
-        residuals untouched)."""
-        ax = self.axis_name
-        world = jax.lax.axis_size(ax)
-        rank = jax.lax.axis_index(ax)
+        residuals untouched).
+
+        With ``dp_axes=(outer, inner)`` every dp collective here is the
+        TWO-HOP form (:mod:`~apex_tpu.contrib.optimizers
+        ._hierarchical_sync`): reduce-scatter intra-slice on the fast
+        inner axis, then cross-slice on the slow outer axis at the same
+        wire dtype — on quantized wires the partial sums requantize
+        against fresh outer-shared scales and the requantization error
+        folds into the SAME residual channel."""
+        ax = self._dp_sync_axes
+        hier = self._hier_plan
+        if hier is not None:
+            outer_sz, inner_sz = hier.traced_sizes()
+            world = outer_sz * inner_sz
+            rank = hier.zero_rank()
+        else:
+            world = jax.lax.axis_size(ax)
+            rank = jax.lax.axis_index(ax)
         leaves = jax.tree.leaves(grads)
         if len(leaves) != plan.n_leaves:
             raise ValueError(f"grad tree has {len(leaves)} leaves; plan "
@@ -522,8 +604,12 @@ class ZeroOptimizerBase:
                     leaves, b, jnp.float32,
                     scale=(1.0 / scale) if scale is not None else None)
                 h = h + residuals[bi].astype(jnp.float32)
-                g_sum, res_new = qs.quantized_reduce_scatter(
-                    h, ax, spec, rank, world)
+                if hier is not None:
+                    g_sum, res_new = hs.quantized_two_hop_reduce_scatter(
+                        h, hier, spec)
+                else:
+                    g_sum, res_new = qs.quantized_reduce_scatter(
+                        h, ax, spec, rank, world)
                 g32 = g_sum / world if self.grad_average else g_sum
                 new_residuals.append(res_new.astype(jnp.dtype(b.dtype)))
                 # a non-finite grad quantizes to garbage the wire may
@@ -542,9 +628,14 @@ class ZeroOptimizerBase:
             bucket = self._pack_bucket(
                 leaves, b, sdt, scale=(1.0 / world) if predivide else None)
             # ZeRO grad sync: each rank owns 1/dp of the dp-SUM — the
-            # one collective read of this bucket's gradient
-            g_loc = jax.lax.psum_scatter(bucket, ax, scatter_dimension=0,
-                                         tiled=True)
+            # one collective read of this bucket's gradient (two plain
+            # hops on a hierarchical mesh, same wire dtype both hops)
+            if hier is not None:
+                g_loc = hs.two_hop_reduce_scatter(bucket, hier)
+            else:
+                g_loc = jax.lax.psum_scatter(bucket, ax,
+                                             scatter_dimension=0,
+                                             tiled=True)
             g32 = g_loc.astype(jnp.float32)
             if self.grad_average and not predivide:
                 g32 = g32 / world
@@ -638,14 +729,21 @@ class ZeroOptimizerBase:
         ``shard_out`` is the UNCOMMITTED updated shard per bucket when
         ``overlap_param_sync`` (the gather starts without waiting for
         the finite vote; ``pred`` then selects per leaf against the old
-        params), else the committed shard (``pred`` None here)."""
+        params), else the committed shard (``pred`` None here).
+
+        On a hierarchical mesh the gather MIRRORS the two-hop scatter:
+        outer (slow) hop first — the slice-shared shard, ``1/dp_inner``
+        of the bucket crossing slices — then the inner (fast) hop."""
         ax = self.axis_name
+        hier = self._hier_plan
         leaves = jax.tree.leaves(params)
         new_leaves: List[Optional[jnp.ndarray]] = [None] * plan.n_leaves
         for bi, b in enumerate(plan.buckets):
-            full = jax.lax.all_gather(
-                shard_out[bi].astype(self._param_dtype(b)), ax, axis=0,
-                tiled=True)
+            shard = shard_out[bi].astype(self._param_dtype(b))
+            if hier is not None:
+                full = hs.two_hop_all_gather(shard, hier)
+            else:
+                full = jax.lax.all_gather(shard, ax, axis=0, tiled=True)
             for bl in b.leaves:
                 leaf = jax.lax.slice(
                     full, (bl.offset,), (bl.offset + bl.size,)
@@ -756,28 +854,60 @@ class ZeroOptimizerBase:
         return [{"dtype": b.dtype, "size": b.size, "total": b.total}
                 for b in plan.buckets]
 
-    def wire_bytes_per_step(self) -> Dict[str, int]:
+    def wire_bytes_per_step(self) -> Dict[str, Any]:
         """Static per-step wire accounting off the bucket plan — what
         the ``zero_gpt124`` bench reports per sync mode:
 
         - ``grad_payload``: Σ bucket totals × the grad wire itemsize
-          (1 B for int8/fp8);
+          (1 B for int8/fp8), summed over every hop;
         - ``grad_scales``: the quantized wires' fp32 per-block scale
-          psum (0 on wide wires) — counted so the reported cut is
-          honest (int8 ≈ 2x vs bf16, ≈ 4x vs fp32, minus ~0.4% scales);
+          psums (0 on wide wires), one per hop — counted so the
+          reported cut is honest (int8 ≈ 2x vs bf16, ≈ 4x vs fp32,
+          minus ~0.4% scales);
         - ``grad_sync`` = payload + scales; ``param_sync``: the
-          all-gather payload in ``param_sync_dtype``; ``total``."""
+          all-gather payload in ``param_sync_dtype``; ``total``;
+        - ``hops``: the PER-HOP split ``{axis: {grad_payload,
+          grad_scales, grad_sync, param_sync, total}}`` — one entry
+          (the flat dp axis) on a flat plan, ``{inner, outer}`` axes on
+          a hierarchical one.  The slow (outer/cross-slice) hop's entry
+          is the bench's ``cross_slice_wire_cut`` numerator input:
+          exactly ``1/dp_inner`` of the flat plan's bytes at equal wire
+          dtype, scales included."""
         plan = self._require_plan()
-        grad = scales = param = 0
+        hier = self._hier_plan
+        hops: Dict[str, Dict[str, int]] = {}
+
+        def add(hop, key, n):
+            d = hops.setdefault(hop, {"grad_payload": 0, "grad_scales": 0,
+                                      "param_sync": 0})
+            d[key] += n
+
         for b in plan.buckets:
-            p_bytes, s_bytes = qs.grad_sync_bytes(b.total,
-                                                  self._grad_dtype(b))
-            grad += p_bytes
-            scales += s_bytes
-            param += b.total * self._param_dtype(b).itemsize
-        return {"grad_payload": grad, "grad_scales": scales,
-                "grad_sync": grad + scales, "param_sync": param,
-                "total": grad + scales + param}
+            for hop, hb in qs.grad_sync_bytes(
+                    b.total, self._grad_dtype(b), hier=hier,
+                    flat_hop=self.axis_name).items():
+                add(hop, "grad_payload", hb["payload"])
+                add(hop, "grad_scales", hb["scales"])
+            p_item = self._param_dtype(b).itemsize
+            if hier is not None:
+                # mirrored gathers: the fast hop reassembles the full
+                # bucket, the slow hop moves the slice-shared 1/inner
+                # chunk across slices
+                add(hier.inner_axis, "param_sync", b.total * p_item)
+                add(hier.outer_axis, "param_sync",
+                    (b.total // max(hier.inner_size, 1)) * p_item)
+            else:
+                add(self.axis_name, "param_sync", b.total * p_item)
+
+        for d in hops.values():
+            d["grad_sync"] = d["grad_payload"] + d["grad_scales"]
+            d["total"] = d["grad_sync"] + d["param_sync"]
+        out: Dict[str, Any] = {
+            k: sum(d[k] for d in hops.values())
+            for k in ("grad_payload", "grad_scales", "grad_sync",
+                      "param_sync", "total")}
+        out["hops"] = hops
+        return out
 
     def _state_arrays(self, state) -> Dict[str, Sequence]:
         """name -> per-bucket arrays, in the subclass's field order."""

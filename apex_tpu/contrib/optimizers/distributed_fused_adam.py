@@ -130,6 +130,7 @@ class DistributedFusedAdam(ZeroOptimizerBase):
         distributed_process_group=None,
         redundant_process_group=None,
         store_param_remainders: bool = False,
+        dp_axes=None,
     ):
         super().__init__(
             lr, weight_decay, axis_name=axis_name, grad_average=grad_average,
@@ -138,6 +139,7 @@ class DistributedFusedAdam(ZeroOptimizerBase):
             bucket_cap_mb=bucket_cap_mb, grad_sync_dtype=grad_sync_dtype,
             param_sync_dtype=param_sync_dtype,
             store_param_remainders=store_param_remainders, dtype=dtype,
+            dp_axes=dp_axes,
             process_group=process_group,
             distributed_process_group=distributed_process_group,
             redundant_process_group=redundant_process_group,

@@ -72,6 +72,7 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
         bucket_cap_mb: float = 100.0,
         grad_sync_dtype=None,
         param_sync_dtype=None,
+        dp_axes=None,
         **parity_kwargs,
     ):
         super().__init__(
@@ -80,7 +81,7 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
             overlap_grad_sync=overlap_grad_sync,
             overlap_param_sync=overlap_param_sync,
             bucket_cap_mb=bucket_cap_mb, grad_sync_dtype=grad_sync_dtype,
-            param_sync_dtype=param_sync_dtype,
+            param_sync_dtype=param_sync_dtype, dp_axes=dp_axes,
         )
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
@@ -109,7 +110,8 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
         replication factor so tp-replicated leaves count once, not once
         per rank."""
         leaf_sq = jax.lax.psum(
-            self._per_leaf_sumsq(plan, shards, rank, world), self.axis_name)
+            self._per_leaf_sumsq(plan, shards, rank, world),
+            self._dp_sync_axes)
         if self._model_axes:
             repl = jnp.asarray(self._leaf_repl, jnp.float32)
             leaf_sq = jax.lax.psum(leaf_sq / repl, self._model_axes)

@@ -685,16 +685,34 @@ def clip_sumsq_reduce(specs):
 
 
 def _check_zero_axis(zero_opt, optimizer, dp_axis):
-    """A ZeRO optimizer's collectives run over ITS ``axis_name``; the
-    step builder's grad calculus (skip the dp pmean, add dp to the
-    finite-vote axes) is keyed on ``dp_axis``.  A mismatch would
-    silently double- or un-sync the grads, so fail at build time."""
+    """A ZeRO optimizer's collectives run over ITS ``axis_name`` (or
+    hierarchical ``dp_axes``); the step builder's grad calculus (skip
+    the dp pmean, add dp to the finite-vote axes) is keyed on
+    ``dp_axis``.  A mismatch would silently double- or un-sync the
+    grads, so fail at build time.  A hierarchical step
+    (``dp_axis=(outer, inner)``) needs an optimizer constructed with
+    the SAME ``dp_axes`` split — its two-hop reduce-scatter owns both
+    hops — and a hierarchical optimizer refuses a flat step."""
     if not zero_opt:
         return
+    opt_axes = getattr(optimizer, "dp_axes", None)
     if isinstance(dp_axis, (tuple, list)):
-        raise NotImplementedError(
-            "ZeRO over a composite data axis (multi-slice dcn x dp) is "
-            "not wired: the optimizer reduce-scatters over ONE mesh axis")
+        dp_axis = tuple(dp_axis)
+        if opt_axes is None or tuple(opt_axes) != dp_axis:
+            have = (tuple(opt_axes) if opt_axes is not None
+                    else getattr(optimizer, "axis_name", None))
+            raise ValueError(
+                f"the train step's dp axis is the hierarchical split "
+                f"{dp_axis!r} but the ZeRO optimizer syncs over "
+                f"{have!r}; construct it with dp_axes={dp_axis!r} (the "
+                "optimizer owns both hops of the grad sync)")
+        return
+    if opt_axes is not None:
+        raise ValueError(
+            f"ZeRO optimizer was built for the hierarchical dp split "
+            f"{tuple(opt_axes)!r} but the train step's dp axis is the "
+            f"flat {dp_axis!r}; pass dp_axis={tuple(opt_axes)!r} to "
+            "make_train_step (or drop the optimizer's dp_axes)")
     opt_axis = getattr(optimizer, "axis_name", None)
     if dp_axis is None or opt_axis != dp_axis:
         raise ValueError(
@@ -853,7 +871,7 @@ def make_train_step(
     optimizer,
     mesh,
     tp_axis: str = "tp",
-    dp_axis: Optional[str] = "dp",
+    dp_axis="dp",
     cp_axis: Optional[str] = None,
     opt_state_spec=None,
     loss_scaler=None,
@@ -865,6 +883,16 @@ def make_train_step(
     telemetry=None,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
+
+    ``dp_axis``: one mesh axis name (flat data parallelism), ``None``,
+    or the HIERARCHICAL ``(outer, inner)`` pair — the dp world split
+    over a slow cross-slice axis and a fast intra-slice axis (a pod's
+    DCN x ICI topology).  With the pair, the batch shards over both
+    axes, the loss pmean runs over the pair, a ZeRO optimizer must be
+    constructed with the same ``dp_axes=`` (its two-hop reduce-scatter
+    owns the grad sync — cross-slice traffic drops to ``1/dp_inner``),
+    and the replicated ``grad_sync_dtype`` knob quantizes the two-hop
+    pmean (:mod:`apex_tpu.contrib.optimizers._hierarchical_sync`).
 
     ``telemetry``: a :class:`apex_tpu.observability.StepTelemetry` — a
     :class:`~apex_tpu.observability.StepStats` window rides the step
@@ -946,6 +974,23 @@ def make_train_step(
     """
     from jax.sharding import PartitionSpec as P
 
+    # hierarchical data parallelism: dp_axis=(outer, inner) splits the
+    # dp world over two mesh axes (slow cross-slice x fast intra-slice)
+    # — the loss pmean runs over the pair, a ZeRO optimizer must carry
+    # the same dp_axes (its two-hop reduce-scatter owns the sync), and
+    # the replicated quantized knob routes through the two-hop pmean
+    dp_hier = isinstance(dp_axis, (tuple, list))
+    if dp_hier:
+        dp_axis = tuple(dp_axis)
+        if len(dp_axis) != 2:
+            raise ValueError(
+                f"a hierarchical dp_axis is the (outer, inner) pair of "
+                f"mesh axes, got {dp_axis!r}")
+        if config.moe:
+            raise NotImplementedError(
+                "MoE expert parallelism over a hierarchical dp split is "
+                "not wired (EP rides a single dp axis)")
+
     ep_axis = dp_axis if config.moe else None  # EP rides DP
     if ep_axis is not None:
         ep = mesh.shape[ep_axis]
@@ -990,6 +1035,17 @@ def make_train_step(
         if qspec is not None and ax == dp_axis:
             from apex_tpu.contrib.optimizers import _quantized_sync
 
+            if dp_hier:
+                from apex_tpu.contrib.optimizers import _hierarchical_sync
+
+                # two-hop quantized all-reduce: scatter inner then
+                # outer, mirrored gathers, every payload hop at the
+                # wire dtype — the cross-slice hop carries 1/dp_inner
+                plan = _hierarchical_sync.hierarchical_plan(
+                    dp_axis, {a: mesh.shape[a] for a in dp_axis},
+                    grad_wire_dtype=grad_sync_dtype)
+                return _hierarchical_sync.quantized_two_hop_pmean(
+                    grads, plan, qspec)
             # quantized all-reduce: reduce-scatter + all-gather, both
             # on the wire dtype (the same scale machinery as ZeRO's
             # compressed sync, minus the residual — no state channel)
@@ -1038,7 +1094,8 @@ def make_train_step(
         raise ValueError("chaos NaN injection needs step_guard (the "
                          "injection step counter lives in GuardState)")
 
-    wedge_axis = dp_axis if dp_axis is not None else tp_axis
+    wedge_axis = ((dp_axis[0] if dp_hier else dp_axis)
+                  if dp_axis is not None else tp_axis)
 
     def chaos_wedge(loss, guard_step):
         """Chaos "wedge one rank's collective site": on the planned
@@ -1072,7 +1129,7 @@ def make_train_step(
     # (pmean'd axes already agree: a nan poisons every rank's copy)
     sync_axes = [tp_axis]
     if (zero_opt or config.moe) and dp_axis is not None:
-        sync_axes.append(dp_axis)
+        sync_axes.extend(dp_axis if dp_hier else (dp_axis,))
 
     def local_step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(gpt_loss)(
@@ -1284,6 +1341,11 @@ def make_pp_train_step(
         forward_backward_pipelining_with_interleaving,
         forward_backward_pipelining_without_interleaving,
     )
+
+    if isinstance(dp_axis, (tuple, list)):
+        raise NotImplementedError(
+            "hierarchical dp (dp_axis=(outer, inner)) is wired into "
+            "make_train_step only; the pipeline step's dp sync is flat")
 
     # MoE composes: experts shard over dp (EP rides DP) inside each
     # pipeline stage; every (dp, pp, tp) rank executes the tick program

@@ -38,6 +38,13 @@ TENSOR_AXIS = "tp"
 # NUM_GPUS_PER_IB_BLOCK) — data parallelism hierarchically decomposed
 # into fast-domain (ICI, "dp") and slow-domain (DCN, "dcn") legs.
 DCN_AXIS = "dcn"
+# Hierarchical data parallelism (topology-aware two-hop grad sync,
+# contrib/optimizers/_hierarchical_sync.py): the dp world split into a
+# slow cross-slice outer axis and a fast intra-slice inner axis —
+# dp_outer x dp_inner = dp.  Registered here so the analyzer's axis
+# registry (discover_axis_registry) knows them like every other axis.
+DATA_OUTER_AXIS = "dp_out"
+DATA_INNER_AXIS = "dp_in"
 AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
 

@@ -716,10 +716,16 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
     ``wire_bytes_per_step`` computed statically from the bucket plan
     (grad payload + fp32 scale vectors; the compressed-sync headline is
     the ``wire_cut_vs_default`` ratio: ≈2x for int8 vs the bf16
-    default, ≈4x vs an fp32 wire).  dp defaults to min(8, visible
-    devices): 8 on a pod slice, the degenerate 1 on a single chip
-    (which still banks the engine's single-chip overhead and the
-    memory split)."""
+    default, ≈4x vs an fp32 wire).  The ``hier_int8_sync`` /
+    ``hier_fp8_e4m3_sync`` modes run the same wires over the
+    HIERARCHICAL (dp_out, dp_in) split (two-hop reduce-scatter, the
+    slow hop still compressed) with per-hop wire columns — their
+    headline is ``cross_slice_wire_cut``: slow-hop bytes drop by
+    exactly dp_in vs the flat plan at the same wire dtype, scales
+    included.  dp defaults to min(8, visible devices): 8 on a pod
+    slice, the degenerate 1 on a single chip (which still banks the
+    engine's single-chip overhead and the memory split — and, via the
+    (1, 1) mesh, compiles the two-hop path in --smoke)."""
     from jax.sharding import Mesh, PartitionSpec as P
 
     from apex_tpu.contrib.optimizers import DistributedFusedAdam
@@ -748,12 +754,13 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
     targets = jnp.roll(tokens, -1, axis=1)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params0))
 
-    def time_mode(optimizer, state, sspec):
-        step = make_train_step(cfg, optimizer, mesh, donate_state=True,
-                               opt_state_spec=sspec)
+    def time_mode(optimizer, state, sspec, use_mesh=None, dp_axis="dp"):
+        m = mesh if use_mesh is None else use_mesh
+        step = make_train_step(cfg, optimizer, m, donate_state=True,
+                               opt_state_spec=sspec, dp_axis=dp_axis)
         params = jax.tree.map(lambda x: x.copy(), params0)
-        live = _per_device_bytes(params, pspecs, mesh) + \
-            _per_device_bytes(state, sspec, mesh)
+        live = _per_device_bytes(params, pspecs, m) + \
+            _per_device_bytes(state, sspec, m)
         params, state, loss = step(params, state, tokens, targets)
         block(loss)
         n = 1 if _SMOKE else iters
@@ -799,6 +806,42 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
         wb = zopt.wire_bytes_per_step()
         out[label]["wire_bytes_per_step"] = wb["grad_sync"]
         out[label]["wire_bytes_param_sync"] = wb["param_sync"]
+
+    # hierarchical two-hop sync over the (dp_out, dp_in) split: the
+    # compressed wire stays compressed on the slow hop and the
+    # cross-slice (outer-hop) bytes drop by exactly 1/dp_in vs the
+    # flat plan at the same wire dtype — the per-hop columns and
+    # cross_slice_wire_cut report it (scales included, exact ratio
+    # pinned in tests/test_bench_smoke.py).  dp_out=2 models the
+    # two-slice pod; a single chip degenerates to the (1, 1) mesh,
+    # which still compiles the two-hop path (--smoke covers it).
+    dp_out = 2 if dp % 2 == 0 else 1
+    dp_in = dp // dp_out
+    mesh_h = Mesh(np.array(devs[:dp]).reshape(dp_out, dp_in, 1),
+                  ("dp_out", "dp_in", "tp"))
+    for label, wire, flat_label in (
+            ("hier_int8_sync", "int8", "zero_int8_sync"),
+            ("hier_fp8_e4m3_sync", "float8_e4m3fn", "zero_fp8_e4m3_sync")):
+        zopt = DistributedFusedAdam(lr=3e-4, weight_decay=0.1,
+                                    dp_axes=("dp_out", "dp_in"),
+                                    grad_sync_dtype=wire)
+        zstate = zopt.init(params0, world_size=dp,
+                           axis_sizes={"dp_out": dp_out, "dp_in": dp_in})
+        _progress(f"zero_gpt124: {label} (dp_out={dp_out}, dp_in={dp_in})...")
+        out[label] = time_mode(zopt, zstate, zopt.state_partition_spec(),
+                               use_mesh=mesh_h,
+                               dp_axis=("dp_out", "dp_in"))
+        wb = zopt.wire_bytes_per_step()
+        out[label]["wire_bytes_per_step"] = wb["grad_sync"]
+        out[label]["wire_bytes_per_hop"] = wb["hops"]
+        out[label]["cross_slice_grad_sync_bytes"] = \
+            wb["hops"]["dp_out"]["grad_sync"]
+        # the headline: slow-hop bytes vs the flat plan on the SAME
+        # wire dtype — exactly dp_in at any model size
+        out[label]["cross_slice_wire_cut"] = round(
+            out[flat_label]["wire_bytes_per_step"]
+            / wb["hops"]["dp_out"]["grad_sync"], 1)
+
     # the compressed-sync headline: grad-sync wire bytes vs the
     # default-wire ZeRO mode (bf16 buckets sync bf16)
     default_wire = out["zero_fp32_master"]["wire_bytes_per_step"]

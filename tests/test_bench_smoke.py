@@ -87,12 +87,18 @@ def test_elastic_resume_supervised_mode_rides_smoke():
 def test_zero_wire_bytes_accounting_ratios():
     """The ``zero_gpt124`` section's ``wire_bytes_per_step`` field,
     validated at the accounting level (pure plan arithmetic, no step
-    compile): the quantized wires cut the grad-sync bytes ~2x vs the
-    bf16 default and ~4x vs an fp32 wire, WITH the fp32 per-block
-    scale vectors counted against them."""
+    compile) — EXACT ratios, scale-vector bytes included per hop
+    (never the old payload approximation): an int8 wire carries
+    ``1 + 4/QBLOCK`` bytes per element (payload + its share of the
+    fp32 per-block scale psum), so the cut vs the 2-byte bf16 default
+    is exactly ``2 / (1 + 4/1024) = 512/257``, and vs a 4-byte fp32
+    wire exactly ``1024/257``."""
+    from fractions import Fraction
+
     import jax.numpy as jnp
 
     from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.contrib.optimizers._quantized_sync import QBLOCK
 
     params = {"w": jnp.zeros((512, 256), jnp.bfloat16),
               "b": jnp.zeros((8192,), jnp.bfloat16)}
@@ -107,8 +113,57 @@ def test_zero_wire_bytes_accounting_ratios():
     f8 = wire(grad_sync_dtype=jnp.float8_e5m2)
     f32 = wire(grad_sync_dtype=jnp.float32)
     assert i8["grad_scales"] > 0 and bf16["grad_scales"] == 0
-    assert round(bf16["grad_sync"] / i8["grad_sync"], 1) >= 2.0
-    assert round(f32["grad_sync"] / i8["grad_sync"], 1) >= 4.0
+    # i8 bytes/element = 1 payload + 4/QBLOCK scales — exact, no
+    # rounding: bucket totals are QBLOCK multiples by construction
+    assert i8["grad_scales"] * QBLOCK == i8["grad_payload"] * 4
+    per_elt_i8 = Fraction(QBLOCK + 4, QBLOCK)
+    assert Fraction(bf16["grad_sync"], i8["grad_sync"]) \
+        == Fraction(2, 1) / per_elt_i8             # = 512/257
+    assert Fraction(f32["grad_sync"], i8["grad_sync"]) \
+        == Fraction(4, 1) / per_elt_i8             # = 1024/257
     assert f8["grad_sync"] == i8["grad_sync"]      # both 1-byte wires
     # param gather is never quantized (no error-feedback channel)
     assert i8["param_sync"] == bf16["param_sync"]
+    # the flat plan reports its one hop under the dp axis, and the
+    # top-level fields are exactly that hop
+    assert set(i8["hops"]) == {"dp"}
+    assert i8["hops"]["dp"]["grad_sync"] == i8["grad_sync"]
+
+
+def test_hierarchical_wire_bytes_cross_slice_cut_exact():
+    """The ``hier_*_sync`` modes' per-hop accounting: the slow (outer)
+    hop's bytes — payload AND scales — are exactly ``1/dp_in`` of the
+    flat plan's at the same wire dtype, which is the bench's
+    ``cross_slice_wire_cut`` headline; the fast (inner) hop carries the
+    full bucket like the flat plan."""
+    import jax.numpy as jnp
+
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    params = {"w": jnp.zeros((512, 256), jnp.bfloat16),
+              "b": jnp.zeros((8192,), jnp.bfloat16)}
+
+    def wire(**kw):
+        sizes = kw.pop("axis_sizes", None)
+        opt = DistributedFusedAdam(lr=1e-3, **kw)
+        opt.init(params, world_size=4, axis_sizes=sizes)
+        return opt.wire_bytes_per_step()
+
+    flat = wire(grad_sync_dtype="int8")
+    hier = wire(grad_sync_dtype="int8", dp_axes=("dp_out", "dp_in"),
+                axis_sizes={"dp_out": 2, "dp_in": 2})
+    inner, outer = hier["hops"]["dp_in"], hier["hops"]["dp_out"]
+    # fast hop == the flat wire (full bucket, same dtype, same scales)
+    assert inner["grad_sync"] == flat["grad_sync"]
+    assert inner["param_sync"] == flat["param_sync"]
+    # slow hop: exactly 1/dp_in of the flat plan, scales included —
+    # the cross_slice_wire_cut the bench reports is exactly dp_in
+    assert outer["grad_payload"] * 2 == flat["grad_payload"]
+    assert outer["grad_scales"] * 2 == flat["grad_scales"]
+    assert outer["grad_sync"] * 2 == flat["grad_sync"]
+    assert outer["param_sync"] * 2 == flat["param_sync"]
+    # top-level fields sum the hops (total wire traffic of the step)
+    assert hier["grad_sync"] == inner["grad_sync"] + outer["grad_sync"]
+    # both hops stay at the compressed dtype: equal bytes/element
+    # implies the slow hop never widened (3/2 = full + half buckets)
+    assert hier["grad_payload"] * 2 == flat["grad_payload"] * 3

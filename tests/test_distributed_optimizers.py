@@ -1142,6 +1142,294 @@ class TestQuantizedGradSync:
         assert opt_w.state_partition_spec().residual == ()
 
 
+# ------------------------------------------------------ hierarchical sync
+HIER_AXES = ("dp_out", "dp_in")
+HIER_SIZES = {"dp_out": 2, "dp_in": 2}
+
+
+def hier_mesh(devices8):
+    return Mesh(np.array(devices8[:4]).reshape(2, 2), HIER_AXES)
+
+
+class TestHierarchicalGradSync:
+    """The multi-hop (fast, slow) dp split (``_hierarchical_sync`` +
+    the engine's ``dp_axes=`` knob): flat-parity bands, the bitwise
+    requantization-error telescoping, residual/state discipline, and
+    the construction-time validation."""
+
+    def test_wide_fp32_bitwise_vs_flat_dp4(self, devices8):
+        """The acceptance parity band: hierarchical fp32-wire sync on
+        the (2, 2) mesh equals flat dp=4 BITWISE over 4 steps — on
+        exactly-representable (dyadic) grads, where the only thing the
+        two hops could change (the dp-sum association: (a+b)+(c+d) vs
+        a flat reduce's order) is exact either way.  Arbitrary fp32
+        grads reorder adds ACROSS hops and track to reduction ulps —
+        the gpt-level band below pins that."""
+        params = make_mixed_tree()
+        flat = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                    axis_name="dp")
+        s_f = flat.init(params, world_size=4)
+        hier = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                    dp_axes=HIER_AXES)
+        s_h = hier.init(params, world_size=4, axis_sizes=HIER_SIZES)
+        assert hier.hier_plan.world == 4
+        mesh_f = Mesh(np.array(devices8[:4]), ("dp",))
+        mesh_h = hier_mesh(devices8)
+        p_f = p_h = params
+        rng = np.random.RandomState(50)
+        for _ in range(4):
+            g = exact_grads(rng, params)
+            p_f, s_f = zero_step(flat, mesh_f, p_f, s_f, g)
+            p_h, s_h = zero_step(hier, mesh_h, p_h, s_h, g)
+        assert_bitwise(p_f, p_h)
+        for a, b in zip(jax.tree.leaves(s_f), jax.tree.leaves(s_h)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_gpt_step_fp32_loss_band_vs_flat(self, devices8):
+        """The full ``make_train_step`` trajectory, hierarchical (2, 2)
+        vs flat dp=4 on REAL grads: fp32 adds reorder only across the
+        two hops, so per-step losses agree to a 1-ulp-class band
+        (measured ~6e-8 rel on this config; pinned at 1e-6) — NOT
+        bitwise, which is why the bitwise acceptance rides the
+        dyadic-grads engine test above."""
+        from apex_tpu.models.gpt import GPTConfig, init_params, \
+            make_train_step
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_seq_len=16,
+                        compute_dtype=jnp.float32, checkpoint_layers=False)
+        params0 = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        data = [jnp.asarray(rng.randint(0, 64, size=(4, 16)))
+                for _ in range(5)]
+
+        def run(mesh, dp_axis, **opt_kw):
+            sizes = HIER_SIZES if "dp_axes" in opt_kw else None
+            opt = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                       **opt_kw)
+            state = opt.init(params0, world_size=4, axis_sizes=sizes)
+            step = make_train_step(cfg, opt, mesh, dp_axis=dp_axis,
+                                   donate_state=True)
+            p = jax.tree.map(lambda x: x.copy(), params0)
+            losses = []
+            for tok in data:
+                p, state, loss = step(p, state, tok,
+                                      jnp.roll(tok, -1, axis=1))
+                losses.append(float(loss))
+            return np.asarray(losses)
+
+        mesh_f = Mesh(np.array(devices8[:4]).reshape(4, 1), ("dp", "tp"))
+        mesh_h = Mesh(np.array(devices8[:4]).reshape(2, 2, 1),
+                      ("dp_out", "dp_in", "tp"))
+        l_f = run(mesh_f, "dp", axis_name="dp")
+        l_h = run(mesh_h, HIER_AXES, dp_axes=HIER_AXES)
+        np.testing.assert_allclose(l_h, l_f, rtol=1e-6)
+
+    def test_requantization_error_telescopes_bitwise(self, devices8):
+        """The crafted dyadic-scale acceptance test: on the (2, 2)
+        mesh, transmitted + Σ_r residual_r == Σ_r h_r BITWISE through
+        BOTH hops.  Per-rank block amaxes are pinned (126, 128)·scale,
+        so hop 1's shared scale is 2·scale exactly; the partial-sum
+        block amaxes then pin to 254·scale per slice, so hop 2's
+        REQUANTIZATION scale is 4·scale exactly — every divide, round,
+        clip, and add in the chain is exact fp32 arithmetic, and the
+        hop-2 error provably lands in the residual (the pinned entries
+        have zero hop-1 error but ±2·scale hop-2 error)."""
+        from apex_tpu.contrib.optimizers import _hierarchical_sync as hsync
+        from apex_tpu.contrib.optimizers import _quantized_sync as qs
+
+        spec = qs.qspec_of("int8")
+        plan = hsync.hierarchical_plan(HIER_AXES, HIER_SIZES)
+        mesh = hier_mesh(devices8)
+        N = 4 * qs.QBLOCK  # 4 blocks/rank; chunk = 2 blocks ≥ block·outer
+        rng = np.random.RandomState(0)
+
+        def craft(scale):
+            # rng ints well under the pins; per block, rank dp_in=0
+            # pins ±126·scale and dp_in=1 pins ±128·scale (amax sum
+            # 254·scale → s1 = 2·scale), alternating sign per block
+            h = (rng.randint(-100, 101, size=(4, N)) * scale
+                 ).astype(np.float32)
+            for d in range(4):  # device order: d = dp_out*2 + dp_in
+                pin = 126.0 if d % 2 == 0 else 128.0
+                for b in range(4):
+                    h[d, b * qs.QBLOCK] = pin * scale * (-1.0) ** b
+            return h
+
+        def one(h_stack):
+            def f(h):
+                h = h.reshape(-1)
+                shard, res = hsync.quantized_two_hop_reduce_scatter(
+                    h, plan, spec)
+                full = hsync.two_hop_all_gather(shard, plan)
+                return full[None], res[None]
+
+            out = jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=P(HIER_AXES),
+                out_specs=(P(HIER_AXES), P(HIER_AXES)),
+                check_vma=False))(h_stack)
+            return map(np.asarray, out)
+
+        for scale in (1.0, 4.0):  # dyadic scales, both exact
+            h = craft(scale)
+            t, res = one(jnp.asarray(h))
+            lhs = t[0] + res.sum(axis=0)
+            rhs = h.sum(axis=0)
+            np.testing.assert_array_equal(
+                lhs.view(np.uint32), rhs.view(np.uint32))
+            # hop-1 error engaged (odd rng ints halve inexactly)...
+            assert np.abs(res).max() > 0
+            # ...and the hop-2 REQUANTIZATION error telescopes too: at
+            # the pinned entries hop 1 is exact (126/2, 128/2 are
+            # integers) while hop 2 rounds 254/4 = 63.5 → 63 (clipped),
+            # leaving exactly ±2·scale in the owning rank's chunk
+            assert abs(abs(res[0, 0]) - 2.0 * scale) < 1e-6
+
+    def test_hier_int8_nonfinite_step_leaves_residual_unchanged(
+            self, devices8):
+        """The guarded no-op contract survives the second hop: a nan
+        grad fails the (pre-quantization) vote and leaves params AND
+        the folded two-hop residuals untouched."""
+        params = make_tree()
+        mesh = hier_mesh(devices8)
+        opt = DistributedFusedAdam(lr=1e-2, dp_axes=HIER_AXES,
+                                   grad_sync_dtype="int8")
+        state = opt.init(params, world_size=4, axis_sizes=HIER_SIZES)
+        sspec = opt.state_partition_spec()
+        rng = np.random.RandomState(5)
+        g = jax.tree.map(
+            lambda x: jnp.asarray(rng.randn(*x.shape).astype(np.float32)),
+            params)
+        step = jax.shard_map(
+            lambda p, s, gg: opt.update_scaled(gg, s, p),
+            mesh=mesh, in_specs=(P(), sspec, P()),
+            out_specs=(P(), sspec, P()), check_vma=False)
+        p1, s1, fin = step(params, state, g)
+        assert bool(fin)
+        assert any(float(jnp.abs(r.astype(jnp.float32)).max()) > 0
+                   for r in s1.residual)
+        bad = jax.tree.map(lambda x: x.at[(0,) * x.ndim].set(jnp.nan), g)
+        p2, s2, fin2 = step(p1, s1, bad)
+        assert not bool(fin2)
+        assert_bitwise(p2, p1)
+        assert_bitwise(s2.residual, s1.residual)
+
+    def test_state_reshards_flat_to_hier_bitwise_same_world(self, devices8):
+        """flat dp=4 state → hierarchical (2, 2) optimizer at the SAME
+        world: shard ownership is unchanged by design (same chunk per
+        flat rank, same padded_total), so the reshard is bitwise and
+        the hierarchical continuation runs on it."""
+        params = make_tree(9)
+        mesh_f = Mesh(np.array(devices8[:4]), ("dp",))
+        opt_f = DistributedFusedAdam(lr=1e-2, axis_name="dp",
+                                     grad_sync_dtype="int8")
+        s_f = opt_f.init(params, world_size=4)
+        rng = np.random.RandomState(21)
+        g = jax.tree.map(
+            lambda x: jnp.asarray(rng.randn(*x.shape).astype(np.float32)),
+            params)
+        p1, s1 = zero_step(opt_f, mesh_f, params, s_f, g)
+        shards = [opt_f.sharded_state_dict(s1, r, 4) for r in range(4)]
+        s_h = DistributedFusedAdam.load_sharded_state_dicts(
+            shards, world_size=4, grad_sync_dtype="int8")
+        for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s_h)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        opt_h = DistributedFusedAdam(lr=1e-2, dp_axes=HIER_AXES,
+                                     grad_sync_dtype="int8")
+        opt_h.init(params, world_size=4, axis_sizes=HIER_SIZES)
+        p2, s2 = zero_step(opt_h, hier_mesh(devices8), p1, s_h, g)
+        assert int(s2.step) == 2
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(p2))
+
+    def test_hier_validation(self, devices8):
+        """Construction-time discipline: malformed splits, missing
+        axis sizes, world mismatches, and step/optimizer axis-layout
+        disagreement all fail loudly with the knob named."""
+        from apex_tpu.models.gpt import (
+            GPTConfig, make_pp_train_step, make_train_step,
+        )
+
+        params = make_tree()
+        with pytest.raises(ValueError, match="distinct"):
+            DistributedFusedAdam(lr=1e-3, dp_axes=("dp", "dp"))
+        with pytest.raises(ValueError, match="two"):
+            DistributedFusedAdam(lr=1e-3, dp_axes=("dp",))
+        opt = DistributedFusedAdam(lr=1e-3, dp_axes=HIER_AXES)
+        with pytest.raises(ValueError, match="axis_sizes"):
+            opt.init(params, world_size=4)
+        with pytest.raises(ValueError, match="world_size"):
+            DistributedFusedAdam(lr=1e-3, dp_axes=HIER_AXES).init(
+                params, world_size=8, axis_sizes=HIER_SIZES)
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_attention_heads=2, max_seq_len=16,
+                        compute_dtype=jnp.float32)
+        mesh_h = Mesh(np.array(devices8[:4]).reshape(2, 2, 1),
+                      ("dp_out", "dp_in", "tp"))
+        mesh_f = Mesh(np.array(devices8[:4]).reshape(4, 1), ("dp", "tp"))
+        flat_opt = DistributedFusedAdam(lr=1e-3, axis_name="dp")
+        flat_opt.init(params, world_size=4)
+        # hier step needs a hier optimizer with the SAME split
+        with pytest.raises(ValueError, match="dp_axes"):
+            make_train_step(cfg, flat_opt, mesh_h, dp_axis=HIER_AXES)
+        # hier optimizer refuses a flat step
+        hier_opt = DistributedFusedAdam(lr=1e-3, dp_axes=HIER_AXES)
+        hier_opt.init(params, world_size=4, axis_sizes=HIER_SIZES)
+        with pytest.raises(ValueError, match="hierarchical"):
+            make_train_step(cfg, hier_opt, mesh_f, dp_axis="dp")
+        # the pipeline step's dp sync is flat-only, loudly
+        with pytest.raises(NotImplementedError, match="hierarchical"):
+            make_pp_train_step(cfg, hier_opt, mesh_h, num_microbatches=2,
+                               dp_axis=HIER_AXES)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("wire", ["int8", "float8_e4m3fn",
+                                      "float8_e5m2"])
+    def test_hier_loss_curve_within_band_of_fp32_sync(self, devices8,
+                                                      wire):
+        """The PR 6 convergence contract on the hierarchical wire:
+        tiny-GPT on the (2, 2) mesh, 50 steps — every quantized-wire
+        loss ≤5% rel of the fp32-wire sync, last-10 mean ≤1%, with the
+        requantized slow hop and the folded residuals in the loop."""
+        from apex_tpu.models.gpt import (
+            GPTConfig, init_params, make_train_step,
+        )
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_seq_len=16,
+                        compute_dtype=jnp.float32, checkpoint_layers=False)
+        mesh = Mesh(np.array(devices8[:4]).reshape(2, 2, 1),
+                    ("dp_out", "dp_in", "tp"))
+        params0 = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        data = [jnp.asarray(rng.randint(0, 64, size=(4, 16)))
+                for _ in range(50)]
+
+        def run(sync):
+            opt = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                       dp_axes=HIER_AXES,
+                                       grad_sync_dtype=sync)
+            state = opt.init(params0, world_size=4,
+                             axis_sizes=HIER_SIZES)
+            step = make_train_step(cfg, opt, mesh, dp_axis=HIER_AXES,
+                                   donate_state=True)
+            p = jax.tree.map(lambda x: x.copy(), params0)
+            losses = []
+            for tok in data:
+                p, state, loss = step(p, state, tok,
+                                      jnp.roll(tok, -1, axis=1))
+                losses.append(float(loss))
+            return np.asarray(losses)
+
+        base = run(jnp.float32)
+        quant = run(wire)
+        rel = np.abs(quant - base) / np.abs(base)
+        assert np.isfinite(quant).all()
+        assert rel.max() <= 0.05, f"per-step dev {rel.max():.4f}"
+        assert rel[-10:].mean() <= 0.01, f"tail dev {rel[-10:].mean():.4f}"
+
+
 # -------------------------------------------------------- step-builder seam
 class TestStepBuilderSeam:
     def test_zero_axis_mismatch_raises(self, devices8):

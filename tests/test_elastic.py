@@ -159,6 +159,125 @@ class TestScenarioMatrix:
             tree_equal(p_r, ref_params)
 
 
+# ------------------------------------------- hierarchical layout elasticity
+class TestHierarchicalElasticChain:
+    """ISSUE 12's elastic coverage: the dp LAYOUT (flat vs the
+    hierarchical (outer, inner) split) is as elastic as the dp world
+    size — shard ownership keeps the flat chunk-per-rank layout and the
+    one ``padded_total`` formula, so checkpoints cross flat <->
+    hierarchical with no special case in the elastic machinery."""
+
+    def _hier_rig(self, devices8):
+        mesh = Mesh(np.array(devices8[:4]).reshape(2, 2, 1),
+                    ("dp_out", "dp_in", "tp"))
+        params0 = init_params(CFG, jax.random.PRNGKey(0))
+        opt = DistributedFusedAdam(
+            lr=1e-2, weight_decay=0.01, dp_axes=("dp_out", "dp_in"),
+            grad_sync_dtype="int8")
+        opt.init(params0, world_size=4, param_specs=param_specs(CFG),
+                 axis_sizes={"tp": 1, "dp_out": 2, "dp_in": 2})
+        step = make_train_step(CFG, opt, mesh,
+                               dp_axis=("dp_out", "dp_in"))
+        return opt, step
+
+    @staticmethod
+    def _residual_sum(state):
+        return sum(float(np.asarray(r, np.float64).sum())
+                   for r in state.residual)
+
+    def test_flat4_to_hier22_to_flat2_resume_chain(self, rig, tmp_path,
+                                                   devices8):
+        """The three-layout chain on the int8 wire: train flat dp=4,
+        resume on the hierarchical (2, 2) mesh (same world — BITWISE
+        state restore, no reshard), train two more steps through the
+        two-hop sync, then resume flat at dp=2 (world change — the
+        error-feedback residuals sum-collapse onto new rank 0, sum
+        preserved exactly) — with every loss inside the quantized
+        continuation band of the uninterrupted flat run."""
+        opt4, state, step4, params = rig("zero_int8", 4)
+        for i in range(2):
+            params, state, _ = step4(params, state, *batch(i))
+        dir_a = tmp_path / "a"
+        save_elastic_checkpoint(
+            dir_a, 2, params=params, opt_state=state, optimizer=opt4,
+            world_size=4, mesh_axes={"tp": 1})
+
+        # hop 1 of the chain: flat save → HIERARCHICAL restore.  Same
+        # world (2·2 = 4), so nothing reshards and the state is bitwise
+        # — the layout change is invisible to the checkpoint.
+        opt_h, step_h = self._hier_rig(devices8)
+        r = restore_elastic_checkpoint(
+            dir_a, optimizer=opt_h, world_size=4, mesh_axes={"tp": 1})
+        assert r is not None and r.step == 2
+        assert r.saved_world == 4 and not r.resharded
+        tree_equal(r.params, params)
+        for a, b in zip(jax.tree.leaves(state),
+                        jax.tree.leaves(r.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        p_h, s_h = r.params, r.opt_state
+        hier_losses = []
+        for i in range(2, 4):
+            p_h, s_h, loss = step_h(p_h, s_h, *batch(i))
+            hier_losses.append(float(loss))
+        res_sum_h = self._residual_sum(s_h)
+        dir_b = tmp_path / "b"
+        save_elastic_checkpoint(
+            dir_b, 4, params=p_h, opt_state=s_h, optimizer=opt_h,
+            world_size=4, mesh_axes={"tp": 1})
+
+        # hop 2: hierarchical save → FLAT dp=2 restore.  The world
+        # changes (4 → 2), so the full state reshards through the one
+        # padded_total formula and the per-rank residuals collapse
+        # onto new rank 0 — error SUM preserved exactly.
+        opt2, _, step2, _ = rig("zero_int8", 2)
+        r2 = restore_elastic_checkpoint(
+            dir_b, optimizer=opt2, world_size=2, mesh_axes={"tp": 1})
+        assert r2 is not None and r2.step == 4
+        assert r2.saved_world == 4 and r2.resharded
+        tree_equal(r2.params, p_h)
+        np.testing.assert_allclose(self._residual_sum(r2.opt_state),
+                                   res_sum_h, rtol=1e-6)
+
+        p_f, s_f = r2.params, r2.opt_state
+        flat_losses = []
+        for i in range(4, 6):
+            p_f, s_f, loss = step2(p_f, s_f, *batch(i))
+            flat_losses.append(float(loss))
+
+        # the whole chain continues the uninterrupted flat-dp=4
+        # trajectory inside the int8 band — layout changes cost only
+        # quantization-order noise, never a restart from scratch
+        _, ref = oracle(rig, "zero_int8", 4)
+        np.testing.assert_allclose(hier_losses + flat_losses, ref[2:6],
+                                   rtol=0.05)
+
+    def test_hier_checkpoint_restores_flat_without_special_case(
+            self, rig, tmp_path, devices8):
+        """A checkpoint SAVED on the hierarchical mesh restores into a
+        flat same-world optimizer bitwise: the index records only the
+        dp world and model axes — the (outer, inner) split never leaks
+        into the format."""
+        opt_h, step_h = self._hier_rig(devices8)
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        state = opt_h.init(params, world_size=4,
+                           param_specs=param_specs(CFG),
+                           axis_sizes={"tp": 1, "dp_out": 2, "dp_in": 2})
+        params, state, _ = step_h(params, state, *batch(0))
+        save_elastic_checkpoint(
+            tmp_path, 1, params=params, opt_state=state, optimizer=opt_h,
+            world_size=4, mesh_axes={"tp": 1})
+        opt4, _, step4, _ = rig("zero_int8", 4)
+        r = restore_elastic_checkpoint(
+            tmp_path, optimizer=opt4, world_size=4, mesh_axes={"tp": 1})
+        assert r is not None and not r.resharded
+        for a, b in zip(jax.tree.leaves(state),
+                        jax.tree.leaves(r.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        p, s, loss = step4(r.params, r.opt_state, *batch(1))
+        assert np.isfinite(float(loss))
+
+
 # ------------------------------------------------------------- pod chaos
 class TestPodChaos:
     def test_kill_one_host_of_n_then_elastic_resume(self, rig, tmp_path):

@@ -76,15 +76,16 @@ class TestZeroTrainStep:
         n_buckets = len(opt._plan.buckets)
         assert n_buckets >= 2, "cap should split the fp32 bucket"
         txt = low.as_text()
-        # exactly one grad reduce-scatter per bucket — a refactor that
-        # reroutes grads through pmean (replicated sync) or fuses the
-        # buckets back into one collective changes this count
-        lw.count_collectives(txt, "reduce_scatter",
-                             minimum=n_buckets, maximum=n_buckets)
-        lw.assert_collective_dtype(txt, "reduce_scatter", "f32",
-                                   mode="all")
-        # params come back per bucket too
-        lw.count_collectives(txt, "all_gather", minimum=n_buckets)
+        # exactly one grad reduce-scatter per bucket, ON the dp axis —
+        # a refactor that reroutes grads through pmean (replicated
+        # sync), fuses the buckets back into one collective, or moves
+        # the scatter to another axis changes this per-axis count
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp",),
+                                  _mesh(devices8), minimum=n_buckets,
+                                  maximum=n_buckets, dtype="f32")
+        # params come back per bucket too, on the same axis
+        lw.assert_collective_axes(txt, "all_gather", ("dp",),
+                                  _mesh(devices8), minimum=n_buckets)
 
     def test_no_whole_tree_concat(self, devices8):
         """With >= 2 buckets nothing may concatenate the FULL flat
@@ -124,12 +125,13 @@ class TestQuantizedZeroTrainStep:
         n_buckets = len(opt._plan.buckets)
         assert n_buckets >= 2
         txt = low.as_text()
-        lw.count_collectives(txt, "reduce_scatter",
-                             minimum=n_buckets, maximum=n_buckets)
-        lw.assert_collective_dtype(txt, "reduce_scatter", "i8", mode="all")
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp",),
+                                  _mesh(devices8), minimum=n_buckets,
+                                  maximum=n_buckets, dtype="i8")
         lw.assert_collective_dtype(txt, "reduce_scatter", "f32",
                                    mode="none")
-        lw.count_collectives(txt, "all_gather", minimum=n_buckets)
+        lw.assert_collective_axes(txt, "all_gather", ("dp",),
+                                  _mesh(devices8), minimum=n_buckets)
 
     def test_fp8_wire_element_types(self, devices8):
         for wire, hlo_dtype in (("float8_e4m3fn", "f8E4M3FN"),
@@ -180,6 +182,143 @@ class TestQuantizedZeroTrainStep:
         low, _opt, params, state = _zero_lowering(
             devices8, grad_sync_dtype="int8")
         lw.assert_donation_covers(low, params, state, compiled=True)
+
+
+# ------------------------------------------------------- hierarchical sync
+HIER_AXES = ("dp_out", "dp_in")
+
+
+def _hier_mesh(devices8):
+    return Mesh(np.array(devices8[:4]).reshape(2, 2, 1),
+                ("dp_out", "dp_in", "tp"))
+
+
+def _hier_lowering(devices8, **opt_kw):
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    opt = DistributedFusedAdam(lr=1e-2, dp_axes=HIER_AXES,
+                               bucket_cap_mb=TINY_CAP_MB, **opt_kw)
+    state = opt.init(params, world_size=4,
+                     axis_sizes={"dp_out": 2, "dp_in": 2, "tp": 1})
+    step = make_train_step(CFG, opt, _hier_mesh(devices8),
+                           dp_axis=HIER_AXES, donate_state=True)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(4, 16)))
+    return (step.lower(params, state, tokens,
+                       jnp.roll(tokens, -1, axis=1)), opt, params, state)
+
+
+class TestHierarchicalZeroTrainStep:
+    """The multi-hop sync pins (ISSUE 12): per bucket, EXACTLY one
+    reduce-scatter on the fast inner axis and one on the slow outer
+    axis — both at the wire dtype (the compressed wire never widens on
+    the cross-slice hop) — the param all-gathers mirrored per hop,
+    zero new whole-tree concats, and donation still covering every
+    shard buffer including the error-feedback residuals.  All read off
+    the real ``make_train_step(dp_axis=("dp_out", "dp_in"))`` lowering
+    via the per-axis ``replica_groups`` filtering in
+    ``analysis.lowered``."""
+
+    def test_wide_wire_one_reduce_scatter_per_bucket_per_hop(self, devices8):
+        low, opt, _params, _state = _hier_lowering(devices8)
+        n = len(opt._plan.buckets)
+        assert n >= 2, "cap should split the fp32 bucket"
+        txt = low.as_text()
+        mesh = _hier_mesh(devices8)
+        # fast hop: the full bucket scatters intra-slice...
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_in",),
+                                  mesh, minimum=n, maximum=n, dtype="f32")
+        # ...slow hop: the 1/dp_in chunk scatters cross-slice
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_out",),
+                                  mesh, minimum=n, maximum=n, dtype="f32")
+        # never a single-hop scatter over the combined dp world
+        lw.count_collectives(txt, "reduce_scatter", axes=HIER_AXES,
+                             mesh=mesh, maximum=0)
+        # param sync mirrors: one gather per bucket per hop
+        lw.assert_collective_axes(txt, "all_gather", ("dp_out",), mesh,
+                                  minimum=n, maximum=n, dtype="f32")
+        lw.assert_collective_axes(txt, "all_gather", ("dp_in",), mesh,
+                                  minimum=n, maximum=n, dtype="f32")
+
+    def test_int8_wire_stays_compressed_on_both_hops(self, devices8):
+        low, opt, params, _state = _hier_lowering(
+            devices8, grad_sync_dtype="int8")
+        n = len(opt._plan.buckets)
+        txt = low.as_text()
+        mesh = _hier_mesh(devices8)
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_in",),
+                                  mesh, minimum=n, maximum=n, dtype="i8")
+        # the headline contract: the SLOW hop still carries int8 — a
+        # dequantize-then-reduce regression would show f32 here
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_out",),
+                                  mesh, minimum=n, maximum=n, dtype="i8")
+        lw.assert_collective_dtype(txt, "reduce_scatter", "f32",
+                                   mode="none")
+        total = sum(int(np.prod(p.shape))
+                    for p in jax.tree_util.tree_leaves(params))
+        lw.assert_no_whole_tree_concat(txt, total)
+
+    def test_fp8_wire_element_types_per_hop(self, devices8):
+        low, opt, _p, _s = _hier_lowering(devices8,
+                                          grad_sync_dtype="float8_e4m3fn")
+        n = len(opt._plan.buckets)
+        mesh = _hier_mesh(devices8)
+        txt = low.as_text()
+        for hop in (("dp_in",), ("dp_out",)):
+            lw.assert_collective_axes(txt, "reduce_scatter", hop, mesh,
+                                      minimum=n, maximum=n,
+                                      dtype="f8E4M3FN")
+
+    def test_no_whole_tree_concat_wide(self, devices8):
+        low, _opt, params, _state = _hier_lowering(devices8)
+        total = sum(int(np.prod(p.shape))
+                    for p in jax.tree_util.tree_leaves(params))
+        lw.assert_no_whole_tree_concat(low.as_text(), total)
+
+    def test_donation_covers_shards_and_residuals(self, devices8):
+        low, opt, params, state = _hier_lowering(devices8,
+                                                 grad_sync_dtype="int8")
+        n_buckets = len(opt._plan.buckets)
+        assert len(jax.tree_util.tree_leaves(state)) == 1 + 4 * n_buckets
+        lw.assert_donation_covers(low, params, state, compiled=False)
+
+    @pytest.mark.slow
+    def test_donation_survives_compilation(self, devices8):
+        low, _opt, params, state = _hier_lowering(devices8,
+                                                  grad_sync_dtype="int8")
+        lw.assert_donation_covers(low, params, state, compiled=True)
+
+
+class TestHierarchicalQuantizedReplicatedStep:
+    """``make_train_step(grad_sync_dtype=..., dp_axis=(outer, inner))``
+    on a NON-ZeRO optimizer: the replicated dp pmean becomes the
+    two-hop quantized scatter + mirrored gathers, every payload hop on
+    the wire dtype."""
+
+    def test_int8_two_hop_rs_ag(self, devices8):
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        pspecs = param_specs(CFG)
+        sspec = AdamState(step=P(), exp_avg=pspecs, exp_avg_sq=pspecs,
+                          master=None)
+        mesh = _hier_mesh(devices8)
+        step = make_train_step(CFG, opt, mesh, dp_axis=HIER_AXES,
+                               opt_state_spec=sspec,
+                               grad_sync_dtype="int8")
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(4, 16)))
+        txt = step.lower(params, state, tokens,
+                         jnp.roll(tokens, -1, axis=1)).as_text()
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_in",),
+                                  mesh, minimum=1, dtype="i8")
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_out",),
+                                  mesh, minimum=1, dtype="i8")
+        lw.assert_collective_axes(txt, "all_gather", ("dp_out",), mesh,
+                                  minimum=1, dtype="i8")
+        # the inner gather moves the int8 payload + the small fp32
+        # hop-2 scale vector (dequantize needs every chunk's scales)
+        for s in lw.collective_sites(txt, "all_gather"):
+            assert s["dtype"] in ("i8", "f32")
 
 
 class TestQuantizedReplicatedTrainStep:

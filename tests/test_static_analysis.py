@@ -31,6 +31,7 @@ from apex_tpu.analysis.rules_collectives import (
     CollectiveAxisOutsideShardMapNest,
     CollectiveAxisUnboundUnderJit,
     CollectiveOutsideSpmdContext,
+    CollectiveTupleAxisUnbound,
     UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
@@ -890,6 +891,130 @@ class TestCollectiveAxisOutsideShardMapNest:
                     in_specs=P("dp"), out_specs=P())(x)
             """, tmp_path, DEFAULT_RULES)
         assert got == []
+
+
+# --------------------------- APX205 tuple-of-axes collective with unbound
+HIER_AXES_REG = AXES | {"dp_out", "dp_in"}
+
+
+class TestCollectiveTupleAxisUnbound:
+    """APX205: the hierarchical-sync spelling ``psum(x, ("dp_out",
+    "dp_in"))`` needs EVERY member bound in the same nest — the scalar
+    dataflow rules (203/204) yield tuple spellings here, which judges
+    the tuple at once and names exactly the bad members."""
+
+    def test_positive_tuple_under_jit_only(self, tmp_path):
+        got = run("""
+            import jax
+
+            def hier_mean(x):
+                return jax.lax.pmean(x, ("dp_out", "dp_in"))
+
+            @jax.jit
+            def f(x):
+                return hier_mean(x)
+            """, tmp_path, [CollectiveTupleAxisUnbound()],
+            axes=HIER_AXES_REG)
+        assert rule_ids(got) == ["APX205"]
+        assert "'dp_out'" in got[0].message and "'dp_in'" in got[0].message
+        assert "jit" in got[0].message
+
+    def test_positive_nest_binds_only_one_member(self, tmp_path):
+        """The case neither APX201 nor the scalar rules report as ONE
+        hazard: both members are registered, the shard_map binds only
+        the inner axis — the tuple collective dies at trace time."""
+        got = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def loss(x):
+                return jax.lax.pmean(x, ("dp_out", "dp_in"))
+
+            def train(x):
+                mesh = Mesh(np.array(jax.devices()), ("dp_in",))
+                return jax.shard_map(loss, mesh=mesh, in_specs=P("dp_in"),
+                                     out_specs=P())(x)
+            """, tmp_path, [CollectiveTupleAxisUnbound()],
+            axes=HIER_AXES_REG)
+        assert rule_ids(got) == ["APX205"]
+        assert "['dp_out']" in got[0].message
+        assert "binds only {dp_in}" in got[0].message
+
+    def test_one_hazard_one_finding_full_rule_set(self, tmp_path):
+        """Reconciliation with the scalar rules: the full set reports
+        exactly ONE finding for a jit-only tuple collective — 203/204
+        skip tuple spellings, APX205 owns them."""
+        got = run("""
+            import jax
+
+            def hier_mean(x):
+                return jax.lax.pmean(x, ("dp_out", "dp_in"))
+
+            @jax.jit
+            def f(x):
+                return hier_mean(x)
+            """, tmp_path, DEFAULT_RULES, axes=HIER_AXES_REG)
+        assert rule_ids(got) == ["APX205"]
+
+    def test_negative_nest_binds_both_members(self, tmp_path):
+        got = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def loss(x):
+                return jax.lax.pmean(x, ("dp_out", "dp_in"))
+
+            def train(x):
+                mesh = Mesh(np.array(jax.devices()).reshape(2, 2),
+                            ("dp_out", "dp_in"))
+                return jax.shard_map(loss, mesh=mesh,
+                                     in_specs=P(("dp_out", "dp_in")),
+                                     out_specs=P())(x)
+            """, tmp_path, DEFAULT_RULES, axes=HIER_AXES_REG)
+        assert got == []
+
+    def test_negative_dynamic_member_stays_quiet(self, tmp_path):
+        """A dynamically-spelled member may be anything the caller's
+        nest binds — the whole tuple stays quiet (the threading-as-
+        argument pattern the scalar rules also bless)."""
+        got = run("""
+            import jax
+
+            def generic(x, outer_axis):
+                return jax.lax.pmean(x, (outer_axis, "dp_in"))
+
+            @jax.jit
+            def f(x):
+                return generic(x, "dp_out")
+            """, tmp_path, [CollectiveTupleAxisUnbound()],
+            axes=HIER_AXES_REG)
+        assert got == []
+
+    def test_unregistered_member_stays_apx201s(self, tmp_path):
+        """Registry-tier findings stay APX201's (one per unknown
+        member, as its own fixtures pin); APX205 names them only as
+        context when an unbound REGISTERED member triggers it."""
+        got = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def loss(x):
+                return jax.lax.pmean(x, ("dp_outer_typo", "dp_in"))
+
+            def train(x):
+                mesh = Mesh(np.array(jax.devices()), ("tp",))
+                return jax.shard_map(loss, mesh=mesh, in_specs=P("tp"),
+                                     out_specs=P())(x)
+            """, tmp_path,
+            [UnknownCollectiveAxis(), CollectiveTupleAxisUnbound()],
+            axes=HIER_AXES_REG)
+        assert sorted(rule_ids(got)) == ["APX201", "APX205"]
+        apx205 = [f for f in got if f.rule == "APX205"][0]
+        assert "'dp_in'" in apx205.message
+        assert "dp_outer_typo" in apx205.message  # context, not a dup
 
 
 # ------------------------------- APX303 scratch/accumulator dtype vs dot
