@@ -47,6 +47,12 @@ machine-checked invariants):
   and a KV-cache buffer provably narrower than the
   ``preferred_element_type`` of a dot it feeds with no explicit widen
   at the read (the ``inference.kv_cache`` storage-dtype contract).
+- **APX110** kv/pool scatter bypassing the allocator/clamp seam
+  (``rules_inference``): an ``.at[...].set`` into a pool-named buffer
+  whose page index is neither clamped/garbage-routed device data nor
+  an allocator-normalized host int — with refcounted prefix-shared
+  pages, a write the copy-on-write pass cannot see mutates pages OTHER
+  sequences still read.
 - **APX108** blocking host sync in a step loop (``rules_host_sync``):
   ``float()``/``.item()``/``np.asarray``/f-string formatting of a
   proven device array inside a ``for``/``while`` loop that dispatches
@@ -79,6 +85,7 @@ from apex_tpu.analysis.rules_collectives import (
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
+from apex_tpu.analysis.rules_inference import KvPoolScatterBypassesSeam
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_resilience import (
     SwallowedExceptionInRecoveryPath,
@@ -124,6 +131,7 @@ def default_rules(vmem_budget_bytes=None):
         KvCacheReadDtypeMismatch(),
         UnclampedTakeAlongAxis(),
         PageTableGatherUnclamped(),
+        KvPoolScatterBypassesSeam(),
         Fp32ConstantInBf16Path(),
     )
 

@@ -41,8 +41,9 @@ __all__ = [
     "hlo_text", "count_collectives", "operand_dtypes",
     "collective_sites", "mesh_axis_groups", "assert_collective_axes",
     "assert_collective_dtype", "assert_no_host_transfer",
-    "assert_no_whole_tree_concat", "assert_donation_covers",
-    "donated_buffer_count", "host_transfer_sites",
+    "assert_no_recompile", "assert_no_whole_tree_concat",
+    "assert_donation_covers", "donated_buffer_count",
+    "host_transfer_sites",
 ]
 
 #: collective ops that carry a reduction REGION in StableHLO — their
@@ -318,6 +319,52 @@ def assert_no_host_transfer(artifact) -> None:
         f"{sites[:5]} — a compiled hot-loop step must run entirely on "
         f"device (drop the callback/debug print, or move the host work "
         f"between steps)")
+
+
+def assert_no_recompile(fn, calls: Sequence = (), *,
+                        label: Optional[str] = None) -> list:
+    """The compile-once pin, generalized: drive a JITTED callable
+    through a call matrix and assert its executable cache never grows
+    past ONE entry.
+
+    ``fn`` is anything carrying jax's ``_cache_size()`` (a
+    ``jax.jit`` result); ``calls`` is an iterable of argument tuples —
+    each is invoked in order, and the cache size is checked after
+    EVERY call, so the failure message names the exact call whose
+    occupancy/length/draft-hit/chunk-phase mix leaked into a traced
+    shape.  With ``calls=()`` only the final state is asserted (the
+    post-hoc spelling: run your scenario first, then pin).  Returns
+    the per-call results.
+
+    Born as the decode step's trace-count pin
+    (tests/test_inference.py); every compile-once contract — decode,
+    speculative verify, chunked prefill — now pins through this one
+    helper.
+    """
+    size = getattr(fn, "_cache_size", None)
+    if size is None or not callable(size):
+        raise TypeError(
+            f"assert_no_recompile needs a jitted callable exposing "
+            f"_cache_size(); got {type(fn).__name__} — wrap the "
+            f"function in jax.jit (or pass the scheduler's step "
+            f"attribute, not its bound method)")
+    name = label or getattr(fn, "__name__", repr(fn))
+    results = []
+    for i, args in enumerate(calls):
+        results.append(fn(*args))
+        n = size()
+        assert n <= 1, (
+            f"{name}: call {i} of the matrix grew the jit cache to {n} "
+            f"compiled variants — an occupancy/length/draft/chunk "
+            f"value leaked into a traced shape (argument shapes/dtypes "
+            f"must be identical across the matrix)")
+    n = size()
+    assert n == 1, (
+        f"{name}: expected exactly one compiled variant after the call "
+        f"matrix, found {n} — "
+        + ("the function was never called" if n == 0 else
+           "shape-polymorphic retraces happened before this check"))
+    return results
 
 
 def donated_buffer_count(artifact) -> int:

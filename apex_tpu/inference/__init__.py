@@ -12,28 +12,43 @@ A paged-KV decode engine with continuous batching:
   :mod:`apex_tpu.ops.decode_sampling_pallas`) that compiles once and
   serves every cache length and batch occupancy, plus the static-shape
   prompt prefill riding the training forward;
-- :mod:`~apex_tpu.inference.scheduler` — FIFO continuous batching:
-  admit into freed pages between steps, evict finished sequences,
-  degrade-once kernel fallback via :mod:`apex_tpu.resilience`.
+- :mod:`~apex_tpu.inference.scheduler` — lane-aware continuous
+  batching: FIFO-per-lane admission into freed pages between steps
+  (interactive lane preempts best-effort residents through the
+  evict→recycle path), chunked prefill interleaved with decode,
+  eviction, degrade-once kernel fallback via
+  :mod:`apex_tpu.resilience`;
+- :mod:`~apex_tpu.inference.spec` — speculative decode: n-gram
+  (prompt-lookup) drafting + longest-matching-prefix acceptance over
+  the batched verify step (bitwise the non-speculative stream);
+- :mod:`~apex_tpu.inference.prefix` — prefix sharing: a refcounted
+  rolling token-hash trie deduping identical prompt-prefix pages,
+  copy-on-write before the first divergent write.
 
 See docs/inference.md for the architecture and knob table, and
 ``examples/gpt/serve_gpt.py`` for the load-generator driver.
 """
 
 from apex_tpu.inference.decode import (
-    DecodeConfig, make_decode_step, make_prefill,
+    DecodeConfig, make_decode_step, make_prefill, make_prefill_chunk,
+    make_sample_head, make_verify_step,
 )
 from apex_tpu.inference.kv_cache import (
-    GARBAGE_PAGE, KVCacheConfig, PageAllocator, alloc_pools, pages_needed,
-    write_decode_kv, write_prompt_kv,
+    GARBAGE_PAGE, KVCacheConfig, PageAllocator, alloc_pools, copy_page,
+    pages_needed, write_decode_kv, write_prompt_kv,
 )
+from apex_tpu.inference.prefix import PrefixCache, PrefixMatch
 from apex_tpu.inference.scheduler import (
-    Completion, ContinuousBatchingScheduler, Request,
+    LANES, Completion, ContinuousBatchingScheduler, Request,
 )
+from apex_tpu.inference.spec import NGramProposer, accepted_tokens
 
 __all__ = [
     "Completion", "ContinuousBatchingScheduler", "DecodeConfig",
-    "GARBAGE_PAGE", "KVCacheConfig", "PageAllocator", "Request",
-    "alloc_pools", "make_decode_step", "make_prefill", "pages_needed",
-    "write_decode_kv", "write_prompt_kv",
+    "GARBAGE_PAGE", "KVCacheConfig", "LANES", "NGramProposer",
+    "PageAllocator", "PrefixCache", "PrefixMatch", "Request",
+    "accepted_tokens", "alloc_pools", "copy_page", "make_decode_step",
+    "make_prefill", "make_prefill_chunk", "make_sample_head",
+    "make_verify_step", "pages_needed", "write_decode_kv",
+    "write_prompt_kv",
 ]

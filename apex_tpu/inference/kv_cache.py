@@ -36,7 +36,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "GARBAGE_PAGE", "KVCacheConfig", "PageAllocator", "alloc_pools",
-    "pages_needed", "write_decode_kv", "write_prompt_kv",
+    "copy_page", "pages_needed", "write_decode_kv", "write_prompt_kv",
 ]
 
 #: page id 0 — reserved, never allocated; the destination of every
@@ -91,11 +91,23 @@ def alloc_pools(num_layers: int, kv_heads: int, head_dim: int,
 
 
 class PageAllocator:
-    """Host-side free list over the pool's pages (page 0 reserved).
+    """Host-side refcounted free list over the pool's pages (page 0
+    reserved).
 
     FIFO recycling: freed pages go to the back of the free list, so a
     use-after-free bug surfaces as stale-but-old data (maximally
     distinguishable) rather than freshly-written lookalike values.
+
+    Refcounts (the prefix-sharing substrate): :meth:`allocate` hands a
+    page out at refcount 1, :meth:`share` takes an extra reference on a
+    LIVE page (a second sequence — or the prefix trie — mapping the
+    same physical page), and :meth:`free` drops one reference, only
+    recycling the page when the count reaches zero.  A page with
+    refcount > 1 must never be written in place — the scheduler
+    copy-on-writes it (:func:`copy_page`) before the first divergent
+    write.  The garbage page is outside the scheme entirely: its
+    refcount is pinned 0 and it can be neither allocated, shared, nor
+    freed.
     """
 
     def __init__(self, num_pages: int):
@@ -103,22 +115,50 @@ class PageAllocator:
             raise ValueError("num_pages must be >= 2 (page 0 reserved)")
         self.num_pages = int(num_pages)
         self._free = deque(range(1, self.num_pages))
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def live_pages(self) -> int:
+        """Pages currently allocated (refcount >= 1)."""
+        return len(self._refs)
+
+    def refcount(self, page: int) -> int:
+        """References held on ``page`` (0 = free; the garbage page is
+        always 0 — it is never allocated)."""
+        return self._refs.get(int(page), 0)
+
     def can_allocate(self, n: int) -> bool:
         return n <= len(self._free)
 
     def allocate(self, n: int) -> Optional[List[int]]:
-        """``n`` pages, or None (never a partial grab) when the pool
-        cannot cover the request."""
+        """``n`` pages at refcount 1 each, or None (never a partial
+        grab) when the pool cannot cover the request."""
         if n > len(self._free):
             return None
-        return [self._free.popleft() for _ in range(n)]
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def share(self, pages) -> None:
+        """Take one extra reference on each (live) page — a sequence or
+        the prefix trie mapping an already-resident physical page."""
+        for p in pages:
+            p = int(p)
+            if p == GARBAGE_PAGE:
+                raise ValueError("page 0 is reserved and never shared")
+            if p not in self._refs:
+                raise ValueError(f"share of free page {p} — only live "
+                                 f"(allocated) pages can gain references")
+            self._refs[p] += 1
 
     def free(self, pages) -> None:
+        """Drop one reference per page; a page recycles to the free
+        list only when its last reference is dropped."""
         for p in pages:
             p = int(p)
             if p == GARBAGE_PAGE:
@@ -126,12 +166,38 @@ class PageAllocator:
             if not (0 < p < self.num_pages):
                 raise ValueError(f"page id {p} outside pool "
                                  f"[1, {self.num_pages})")
-            if p in self._free:
+            if p not in self._refs:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
 
 
 # ----------------------------------------------------------- device writes
+def copy_page(pools, src: int, dst: int):
+    """Copy-on-write seam: duplicate pool page ``src`` into ``dst``
+    across every layer of both pools.
+
+    ``src``/``dst`` are HOST ints handed out by :class:`PageAllocator`
+    (``dst`` freshly allocated, refcount 1) — the scheduler calls this
+    once, before the first divergent write to a shared (refcount > 1)
+    page, then repoints the writing sequence's page table at ``dst``.
+    Neither side may be the reserved garbage page.
+    """
+    src, dst = int(src), int(dst)
+    num_pages = pools["k"].shape[1]
+    for p in (src, dst):
+        if not (GARBAGE_PAGE < p < num_pages):
+            raise ValueError(
+                f"copy_page({src}, {dst}): page {p} outside the "
+                f"allocatable pool (1, {num_pages})")
+    if src == dst:
+        raise ValueError(f"copy_page: src == dst == {src}")
+    return {"k": pools["k"].at[:, dst].set(pools["k"][:, src]),
+            "v": pools["v"].at[:, dst].set(pools["v"][:, src])}
+
+
 def write_decode_kv(k_pool, v_pool, k_new, v_new, page_tables, positions,
                     active):
     """Scatter one decode step's k/v into a layer's pools.
@@ -139,8 +205,11 @@ def write_decode_kv(k_pool, v_pool, k_new, v_new, page_tables, positions,
     ``k_pool``/``v_pool``: (num_pages, page_size, H_kv, D);
     ``k_new``/``v_new``: (B, H_kv, D) the current tokens' heads;
     ``page_tables``: (B, P) int32; ``positions``: (B,) the tokens'
-    0-based positions; ``active``: (B,) bool.  Inactive rows write the
-    garbage page; all page-table reads are clamped (APX107).
+    0-based positions; ``active``: (B,) bool — the WRITE mask (a
+    multi-position verify/chunk caller may pass a narrower mask than
+    slot liveness, e.g. to leave shared prefix pages untouched).
+    Inactive rows write the garbage page; all page-table reads are
+    clamped (APX107).
     """
     num_pages, page_size = k_pool.shape[0], k_pool.shape[1]
     P = page_tables.shape[1]
@@ -154,7 +223,7 @@ def write_decode_kv(k_pool, v_pool, k_new, v_new, page_tables, positions,
 
 
 def write_prompt_kv(k_pool, v_pool, k_stack, v_stack, page_table_row,
-                    prompt_len):
+                    prompt_len, start=0):
     """Scatter a prefilled prompt's k/v into ALL layers' pools at once.
 
     ``k_pool``/``v_pool``: (L, num_pages, page_size, H_kv, D);
@@ -162,7 +231,10 @@ def write_prompt_kv(k_pool, v_pool, k_stack, v_stack, page_table_row,
     per-layer post-RoPE keys/values for the (padded) prompt;
     ``page_table_row``: (P,) the sequence's page table;
     ``prompt_len``: scalar int32 — positions >= it (the pad tail)
-    write the garbage page.
+    write the garbage page.  ``start``: scalar int32 — positions < it
+    ALSO write the garbage page: the prefix-sharing window (those
+    positions' k/v already live in shared pool pages, which must not be
+    rewritten through this sequence's table).
     """
     num_pages, page_size = k_pool.shape[1], k_pool.shape[2]
     P = page_table_row.shape[0]
@@ -170,7 +242,7 @@ def write_prompt_kv(k_pool, v_pool, k_stack, v_stack, page_table_row,
     s = jnp.arange(S, dtype=jnp.int32)
     page_ix = jnp.clip(s // page_size, 0, P - 1)
     rows = jnp.take(page_table_row, page_ix)
-    valid = s < prompt_len
+    valid = (s >= start) & (s < prompt_len)
     dest = jnp.where(valid, jnp.clip(rows, 0, num_pages - 1), GARBAGE_PAGE)
     slot = jnp.where(valid, s % page_size, 0)
     k_pool = k_pool.at[:, dest, slot].set(k_stack.astype(k_pool.dtype))

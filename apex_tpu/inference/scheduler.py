@@ -1,31 +1,55 @@
-"""Continuous-batching scheduler: admit, decode, evict — between steps.
+"""Continuous-batching scheduler: admit, prefill, decode, evict.
 
 The serving loop's control plane (Orca-style continuous batching): the
 jitted decode step always runs at the STATIC ``max_batch`` shape, and
 this scheduler fills its slots —
 
 - **admit**: between decode steps, queued requests move into free slots
-  strictly FIFO.  A request is admitted only when a slot is free AND
-  the page allocator can reserve its WORST-CASE page count
-  (``ceil((prompt_len + max_new_tokens) / page_size)``), so a resident
-  sequence can never hit a mid-generation allocation failure and the
-  queue head can never be overtaken (no starvation: when the head does
-  not fit, nothing behind it is considered).
+  FIFO *within their lane*.  Two lanes (``Request.lane``):
+  ``interactive`` is admitted strictly FIFO with worst-case page
+  reservation (``ceil((prompt + max_new [+ draft]) / page_size)``) so a
+  resident sequence can never hit a mid-generation allocation failure;
+  ``best_effort`` fills leftover capacity only while the interactive
+  queue is empty, and is PREEMPTIBLE — when the interactive head does
+  not fit, the youngest best-effort resident is evicted through the
+  ordinary evict→recycle path and requeued (continuation: prompt +
+  tokens generated so far, remaining budget) at its lane's head.
 - **prefill**: an admitted prompt runs through the training forward at
-  ONE static padded shape (``DecodeConfig.max_prompt_len``), its
-  per-layer k/v scatter into the reserved pages, and the first
-  generated token is sampled from the last prompt position.
+  ONE static padded shape (``DecodeConfig.max_prompt_len``) — or, with
+  ``prefill_chunk`` set, as fixed-size CHUNKS through the
+  multi-position decode forward, one chunk per scheduler step,
+  interleaved with resident streams' decode steps (arbitrary prompt
+  lengths, no TTFT spike for the streams).
+- **prefix sharing** (``prefix_sharing``): admission matches the
+  prompt against the refcounted page trie
+  (:mod:`apex_tpu.inference.prefix`); matched full pages map straight
+  into the page table (one physical copy, N tables), the prefill write
+  window starts past them, and chunked prefill skips their compute.  A
+  shared partial TAIL page is copy-on-written
+  (:func:`~apex_tpu.inference.kv_cache.copy_page`) before the first
+  divergent write, paid from a reserve page allocated at admission —
+  COW can never fail mid-generation.
 - **decode**: one fused step advances every active slot; inactive
-  slots ride along masked.
-- **evict**: finished sequences (max_new reached, or ``eos_id``) free
-  their pages back to the allocator — the next ``step()`` can admit
-  into them.
+  slots ride along masked.  With ``draft_len`` k > 0 the step is the
+  VERIFY step: per slot, an n-gram proposer
+  (:class:`~apex_tpu.inference.spec.NGramProposer`) drafts up to k
+  tokens, one batched pass scores all k+1 positions, and the host
+  accepts the longest matching prefix — the emitted stream is bitwise
+  the non-speculative stream (greedy AND sampled: each emission spends
+  its own (slot, draw) seed), it just arrives up to k+1 tokens per
+  step.
+- **evict**: finished sequences free (decref) their pages back to the
+  allocator — the next ``step()`` can admit into them — and register
+  their quiesced tail page into the prefix trie.
 
 The scheduler is time-agnostic (drivers decide when to ``submit``;
 tests replay seeded traces step-by-step, the load-generator example
 submits on wall-clock Poisson arrivals) and deterministic: sampling
-seeds derive from ``(base_seed, slot, per-slot draw counter)``, so the
-same trace of submits produces the same tokens.
+seeds derive from ``(base_seed, slot, per-slot draw counter)``, and the
+draw counter advances MONOTONICALLY across every generation a slot
+serves (drain-and-resubmit, preemption re-admission) — it never
+resets, so the same trace of submits produces the same tokens and two
+generations can never replay one seed.
 
 Kernel resilience: trace-time kernel failures already degrade through
 the fallback registry inside the step build; a DEFERRED jit-compile
@@ -56,39 +80,50 @@ import numpy as np
 import jax.numpy as jnp
 
 from apex_tpu.inference.decode import (
-    DecodeConfig, make_decode_step, make_prefill,
+    DecodeConfig, make_decode_step, make_prefill, make_prefill_chunk,
+    make_sample_head, make_verify_step,
 )
 from apex_tpu.inference.kv_cache import (
-    PageAllocator, alloc_pools, pages_needed,
+    GARBAGE_PAGE, PageAllocator, alloc_pools, copy_page, pages_needed,
 )
+from apex_tpu.inference.prefix import PrefixCache, PrefixMatch
+from apex_tpu.inference.spec import NGramProposer, accepted_tokens
 from apex_tpu.models.gpt import GPTConfig
 from apex_tpu.observability import metrics as _metrics
 from apex_tpu.resilience.chaos import active_monkey
 from apex_tpu.utils.logging import get_logger, log_structured
 
-__all__ = ["Request", "Completion", "ContinuousBatchingScheduler"]
+__all__ = ["LANES", "Completion", "ContinuousBatchingScheduler", "Request"]
 
 _logger = get_logger("apex_tpu.inference")
 
 _MASK32 = (1 << 32) - 1
 
+#: admission lanes, in priority order: ``interactive`` requests carry
+#: the latency SLO (strict FIFO, worst-case reservation, may preempt);
+#: ``best_effort`` fills leftover capacity and is preemptible
+LANES = ("interactive", "best_effort")
+
 
 @dataclasses.dataclass
 class Request:
     """One generation request: ``prompt`` token ids, ``max_new_tokens``
-    to generate, optional ``eos_id`` early stop."""
+    to generate, optional ``eos_id`` early stop, and the admission
+    ``lane`` (see :data:`LANES`)."""
 
     rid: int
     prompt: List[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
+    lane: str = "interactive"
 
 
 @dataclasses.dataclass
 class Completion:
     """A finished request with its wall-clock trace: ``token_times[i]``
     is when ``tokens[i]`` became available (``token_times[0]`` is the
-    prefill / time-to-first-token)."""
+    prefill / time-to-first-token).  ``preemptions`` counts how often a
+    best-effort generation was evicted-and-requeued on the way."""
 
     rid: int
     prompt: List[int]
@@ -96,23 +131,45 @@ class Completion:
     submit_time: float
     finish_time: float
     token_times: List[float]
+    lane: str = "interactive"
+    preemptions: int = 0
+
+
+@dataclasses.dataclass
+class _Carry:
+    """Cross-preemption continuation state for one rid: the ORIGINAL
+    prompt and submit time, plus tokens/times already emitted by
+    earlier residency legs."""
+
+    prompt: List[int]
+    tokens: List[int]
+    times: List[float]
+    submit_time: float
+    preemptions: int = 0
 
 
 @dataclasses.dataclass
 class _Slot:
     request: Request
-    pages: List[int]
+    pages: List[int]               # page-table entries, in index order
     generated: List[int]
     token_times: List[float]
     submit_time: float
+    admit_seq: int = 0             # admission order (preemption picks max)
+    submitted_at: float = 0.0      # true submit wall-time (TTFT base)
+    shared_len: int = 0            # prompt positions served by shared pages
+    cow_reserve: Optional[int] = None
+    chunk_next: Optional[int] = None  # next prompt position to chunk-prefill
+    proposer: Optional[NGramProposer] = None
 
 
 class ContinuousBatchingScheduler:
-    """The serve loop's control plane: FIFO admission into freed KV
-    pages between decode steps, static-shape slot management, eviction
-    with page recycling, deterministic per-slot sampling seeds, and
-    degrade-once step rebuild on deferred kernel failures (see the
-    module docstring for the full semantics)."""
+    """The serve loop's control plane: lane-aware admission into freed
+    KV pages between decode steps, static-shape slot management,
+    chunked prefill, speculative verify, prefix sharing with COW,
+    eviction with refcounted page recycling, deterministic per-slot
+    sampling seeds, and degrade-once step rebuild on deferred kernel
+    failures (see the module docstring for the full semantics)."""
 
     def __init__(self, params, config: GPTConfig, dcfg: DecodeConfig,
                  time_fn=time.monotonic, watchdog=None):
@@ -132,18 +189,31 @@ class ContinuousBatchingScheduler:
         self.pools = alloc_pools(config.num_layers, tp_local_kv,
                                  config.head_dim, cache)
         self.allocator = PageAllocator(cache.num_pages)
-        self.queue: deque = deque()
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, cache.page_size)
+            if dcfg.prefix_sharing else None)
+        self.queue: deque = deque()      # interactive lane
+        self.be_queue: deque = deque()   # best-effort lane
         B, P = dcfg.max_batch, cache.pages_per_seq
         self._slots: List[Optional[_Slot]] = [None] * B
         self._page_tables = np.zeros((B, P), np.int32)
         self._positions = np.zeros((B,), np.int32)
         self._tokens = np.zeros((B,), np.int32)
         self._active = np.zeros((B,), bool)
+        #: per-slot sampling draw counters — MONOTONIC for the life of
+        #: the scheduler, across every generation a slot serves (the
+        #: determinism contract: no (slot, draw) seed is ever replayed,
+        #: even after drain-and-resubmit or preemption re-admission)
         self._draws = np.zeros((B,), np.int64)
+        self._admit_counter = 0
         self.completed: List[Completion] = []
+        self._carry: Dict[int, _Carry] = {}
         self.stats: Dict[str, int] = {
             "admitted": 0, "evicted": 0, "decode_steps": 0,
             "prefills": 0, "step_rebuilds": 0,
+            "preemptions": 0, "chunk_steps": 0, "cow_copies": 0,
+            "shared_full_pages": 0, "shared_tail_pages": 0,
+            "spec_steps": 0, "spec_emitted": 0,
         }
         self._rebuilt_once = False
         #: true submit wall-time per queued rid (Completion.submit_time
@@ -172,7 +242,8 @@ class ContinuousBatchingScheduler:
         plus the wedge counter.  Runs on the watchdog thread; reads of
         the slot arrays are racy-but-safe (the decode thread is by
         definition wedged)."""
-        queued = [r.rid for r in list(self.queue)]
+        queued = [r.rid for r in list(self.queue)] \
+            + [r.rid for r in list(self.be_queue)]
         inflight = [s.request.rid for s in self._slots if s is not None]
         # EVERY id, untruncated: this record IS the requeue manifest —
         # a frontend replaying it cannot recover ids a cap dropped.
@@ -190,8 +261,16 @@ class ContinuousBatchingScheduler:
     def _record_occupancy(self) -> None:
         """Serving gauges on the current registry (the scope seam:
         ``with MetricsScope(reg):`` around the serve loop routes them)."""
-        _metrics.set_gauge("apex_serve_queue_depth", len(self.queue),
+        _metrics.set_gauge("apex_serve_queue_depth",
+                           len(self.queue) + len(self.be_queue),
                            help="requests waiting for a slot+pages")
+        _metrics.set_gauge("apex_serve_lane_queue_depth", len(self.queue),
+                           help="waiting requests, by lane",
+                           lane="interactive")
+        _metrics.set_gauge("apex_serve_lane_queue_depth",
+                           len(self.be_queue),
+                           help="waiting requests, by lane",
+                           lane="best_effort")
         _metrics.set_gauge("apex_serve_active_slots", self.num_active,
                            help="resident decoding sequences")
         _metrics.set_gauge("apex_serve_free_pages",
@@ -200,18 +279,32 @@ class ContinuousBatchingScheduler:
 
     # ------------------------------------------------------------ build
     def _build_steps(self) -> None:
-        self._decode = make_decode_step(self.config, self.dcfg)
-        self._prefill = make_prefill(self.config, self.dcfg)
+        d = self.dcfg
+        if d.draft_len > 0:
+            self._verify = make_verify_step(self.config, d)
+            self._decode = None
+        else:
+            self._decode = make_decode_step(self.config, d)
+            self._verify = None
+        if d.prefill_chunk is not None:
+            self._chunk = make_prefill_chunk(self.config, d)
+            self._sample_head = make_sample_head(self.config, d)
+            self._prefill = None
+        else:
+            self._prefill = make_prefill(self.config, d)
+            self._chunk = None
+            self._sample_head = None
 
     def decode_cache_size(self) -> int:
-        """Compiled-variant count of the decode step — the
-        compile-once pin (1 after any number of steps at any
-        occupancy/length mix)."""
-        return self._decode._cache_size()
+        """Compiled-variant count of the decode-family step (the verify
+        step when speculation is on) — the compile-once pin (1 after
+        any number of steps at any occupancy/length/draft-hit mix)."""
+        step = self._verify if self.dcfg.draft_len > 0 else self._decode
+        return step._cache_size()
 
     def _call(self, attr: str, *args):
         """Run a compiled step; on a deferred kernel-compile failure,
-        attribute it to the registry, rebuild both steps ONCE (the new
+        attribute it to the registry, rebuild the steps ONCE (the new
         trace lowers the fallback impls), and retry."""
         try:
             return getattr(self, attr)(*args)
@@ -230,29 +323,41 @@ class ContinuousBatchingScheduler:
             return getattr(self, attr)(*args)
 
     # ------------------------------------------------------------ seeds
+    def _seed_at(self, slot: int, draw: int) -> int:
+        return (self.dcfg.base_seed
+                + slot * 0x9E3779B9 + draw * 0x85EBCA6B) & _MASK32
+
     def _seed(self, slot: int) -> int:
         d = int(self._draws[slot])
         self._draws[slot] += 1
-        s = (self.dcfg.base_seed
-             + slot * 0x9E3779B9 + d * 0x85EBCA6B) & _MASK32
-        return s
+        return self._seed_at(slot, d)
 
     # ---------------------------------------------------------- requests
     def submit(self, request: Request) -> None:
-        """Queue a request (FIFO).  Requests that can NEVER fit the
-        static shapes fail here, loudly, instead of wedging the queue
-        head forever."""
+        """Queue a request (FIFO within its lane).  Requests that can
+        NEVER fit the static shapes fail here, loudly, instead of
+        wedging the queue head forever."""
+        if request.lane not in LANES:
+            raise ValueError(
+                f"unknown lane {request.lane!r}; lanes are {LANES}")
         plen = len(request.prompt)
         if plen < 1:
             raise ValueError("empty prompt")
-        if plen > self.dcfg.max_prompt_len:
+        if self.dcfg.prefill_chunk is None \
+                and plen > self.dcfg.max_prompt_len:
             raise ValueError(
                 f"prompt ({plen} tokens) exceeds max_prompt_len "
-                f"({self.dcfg.max_prompt_len})")
+                f"({self.dcfg.max_prompt_len}) — set prefill_chunk to "
+                f"admit long prompts as chunks")
         if request.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        need = pages_needed(plen + request.max_new_tokens,
-                            self.dcfg.cache.page_size)
+        if self.config.position_embedding_type == "learned" \
+                and plen + request.max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens ({plen} + "
+                f"{request.max_new_tokens}) exceeds the learned position "
+                f"table ({self.config.max_seq_len})")
+        need = self._total_pages(request)
         P = self.dcfg.cache.pages_per_seq
         if need > P:
             raise ValueError(
@@ -264,15 +369,26 @@ class ContinuousBatchingScheduler:
                 f"request needs {need} pages; the pool only has "
                 f"{self.allocator.num_pages - 1} allocatable")
         self._submit_times[request.rid] = self._time()
-        self.queue.append(request)
+        (self.queue if request.lane == "interactive"
+         else self.be_queue).append(request)
         self._record_occupancy()
+
+    def _total_pages(self, req: Request) -> int:
+        """Worst-case page-table footprint: prompt + generation budget,
+        plus the speculative write window (draft k/v land up to
+        ``draft_len`` positions past the accepted stream and must never
+        spill into an unreserved — garbage — table entry)."""
+        return pages_needed(
+            len(req.prompt) + req.max_new_tokens + self.dcfg.draft_len,
+            self.dcfg.cache.page_size)
 
     @property
     def num_active(self) -> int:
         return int(self._active.sum())
 
     def idle(self) -> bool:
-        return not self.queue and not self._active.any()
+        return (not self.queue and not self.be_queue
+                and all(s is None for s in self._slots))
 
     # ------------------------------------------------------------- admit
     def _free_slot(self) -> Optional[int]:
@@ -281,80 +397,289 @@ class ContinuousBatchingScheduler:
                 return i
         return None
 
+    def _plan(self, req: Request):
+        """(total_pages, match, need_fresh) for admitting ``req`` NOW —
+        recomputed on every attempt (the trie and pool move under us)."""
+        total = self._total_pages(req)
+        match = (self.prefix.match(req.prompt) if self.prefix is not None
+                 else PrefixMatch((), None, 0))
+        return total, match, total - match.num_full
+
     def _admit(self) -> int:
+        admitted = self._admit_from(self.queue, can_preempt=True)
+        if not self.queue:
+            # best-effort fills leftover capacity only while no
+            # interactive request waits (the lane priority contract)
+            admitted += self._admit_from(self.be_queue, can_preempt=False)
+        return admitted
+
+    def _admit_from(self, queue: deque, can_preempt: bool) -> int:
         admitted = 0
-        while self.queue:
-            req = self.queue[0]
+        while queue:
+            req = queue[0]
             slot = self._free_slot()
-            need = pages_needed(len(req.prompt) + req.max_new_tokens,
-                                self.dcfg.cache.page_size)
-            if slot is None or not self.allocator.can_allocate(need):
+            total, match, need_fresh = self._plan(req)
+            if slot is None or not self.allocator.can_allocate(need_fresh):
+                if slot is not None and self.prefix is not None and \
+                        self.prefix.release(
+                            need_fresh - self.allocator.free_pages):
+                    continue  # trie refs dropped — re-plan and retry
+                if can_preempt and self._preempt_one():
+                    continue  # a best-effort resident yielded — retry
                 break  # FIFO: the head blocks, nothing overtakes it
-            self.queue.popleft()
-            pages = self.allocator.allocate(need)
-            self._admit_into(slot, req, pages)
+            queue.popleft()
+            self._admit_into(slot, req, total, match, need_fresh)
             admitted += 1
         return admitted
 
-    def _admit_into(self, slot: int, req: Request, pages: List[int]) -> None:
+    def _admit_into(self, slot: int, req: Request, total: int,
+                    match: PrefixMatch, need_fresh: int) -> None:
         t0 = self._time()
         submitted = self._submit_times.pop(req.rid, t0)
         _metrics.observe("apex_serve_admission_wait_seconds",
                          t0 - submitted,
-                         help="submit -> slot+pages reserved")
-        plen = len(req.prompt)
+                         help="submit -> slot+pages reserved",
+                         lane=req.lane)
+        fresh = self.allocator.allocate(need_fresh)
+        assert fresh is not None  # _admit_from checked can_allocate
+        if match.num_full:
+            self.allocator.share(match.full_pages)
+            self.stats["shared_full_pages"] += match.num_full
+        table: List[int] = list(match.full_pages)
+        it = iter(fresh)
+        cow_reserve = None
+        if match.tail_page is not None:
+            self.allocator.share([match.tail_page])
+            self.stats["shared_tail_pages"] += 1
+            table.append(match.tail_page)
+            cow_reserve = next(it)  # the tail's COW budget, held aside
+        table.extend(it)
         P = self.dcfg.cache.pages_per_seq
         row = np.zeros((P,), np.int32)
-        row[: len(pages)] = pages
+        row[:len(table)] = table
+        self._page_tables[slot] = row
+        plen = len(req.prompt)
+        self._admit_counter += 1
+        s = _Slot(request=req, pages=table, generated=[],
+                  token_times=[], submit_time=t0,
+                  admit_seq=self._admit_counter, submitted_at=submitted,
+                  shared_len=match.shared_len, cow_reserve=cow_reserve)
+        self._slots[slot] = s
+        self.stats["admitted"] += 1
+        if self.dcfg.prefill_chunk is not None:
+            # chunked admission: compute starts past the shared prefix
+            # (fully-cached prompt → one recompute pass over the last
+            # position, no writes), one chunk per scheduler step
+            s.chunk_next = (match.shared_len if match.shared_len < plen
+                            else plen - 1)
+            return
         prompt = np.zeros((1, self.dcfg.max_prompt_len), np.int32)
         prompt[0, :plen] = req.prompt
         self.pools, first = self._call(
             "_prefill", self.params, self.pools,
-            jnp.asarray(prompt), jnp.int32(plen), jnp.asarray(row),
+            jnp.asarray(prompt), jnp.int32(plen),
+            jnp.int32(match.shared_len), jnp.asarray(row),
             jnp.uint32(self._seed(slot)))
-        first = int(first)
+        self.stats["prefills"] += 1
+        self._start_decoding(slot, int(first), submitted)
+
+    def _start_decoding(self, slot: int, first: int,
+                        submitted: float) -> None:
+        """Common prefill epilogue (classic and chunked): record the
+        first token, index the prompt's full pages into the prefix
+        trie, arm the slot for decode, and evict degenerate (1-token /
+        instant-eos) generations immediately."""
+        s = self._slots[slot]
+        req = s.request
         t_first = self._time()
         _metrics.observe("apex_serve_ttft_seconds", t_first - submitted,
-                         help="submit -> first token (prefill incl. queue)")
-        self._slots[slot] = _Slot(request=req, pages=pages,
-                                  generated=[first],
-                                  token_times=[t_first],
-                                  submit_time=t0)
-        self._page_tables[slot] = row
-        self._positions[slot] = plen  # where `first` will be cached
+                         help="submit -> first token (prefill incl. queue)",
+                         lane=req.lane)
+        s.generated.append(first)
+        s.token_times.append(t_first)
+        s.chunk_next = None
+        if self.prefix is not None:
+            # full pages quiesce the moment the prompt is cached; the
+            # (mutable) tail page waits for eviction
+            self.prefix.register(req.prompt, [int(p) for p in s.pages])
+        if self.dcfg.draft_len > 0:
+            s.proposer = NGramProposer(self.dcfg.draft_len,
+                                       self.dcfg.ngram_max,
+                                       self.dcfg.ngram_min)
+            s.proposer.extend(list(req.prompt) + [first])
+        self._positions[slot] = len(req.prompt)  # where `first` caches
         self._tokens[slot] = first
         self._active[slot] = True
-        self.stats["admitted"] += 1
-        self.stats["prefills"] += 1
         if (req.max_new_tokens == 1
                 or (req.eos_id is not None and first == req.eos_id)):
             self._evict(slot)
 
-    # ------------------------------------------------------------- evict
-    def _evict(self, slot: int) -> None:
+    # --------------------------------------------------------- preemption
+    def _preempt_one(self) -> bool:
+        """Evict the YOUNGEST best-effort resident (decoding or still
+        chunk-prefilling) through the ordinary evict→recycle path and
+        requeue its continuation at its lane's head.  Returns whether a
+        victim yielded."""
+        cands = [i for i, s in enumerate(self._slots)
+                 if s is not None and s.request.lane == "best_effort"]
+        if not cands:
+            return False
+        victim = max(cands, key=lambda i: self._slots[i].admit_seq)
+        s = self._slots[victim]
+        req = s.request
+        c = self._carry.get(req.rid)
+        if c is None:
+            c = _Carry(prompt=list(req.prompt), tokens=[], times=[],
+                       submit_time=s.submit_time)
+            self._carry[req.rid] = c
+        c.preemptions += 1
+        remaining = req.max_new_tokens - len(s.generated)
+        cont_prompt = list(req.prompt) + list(s.generated)
+        can_continue = (
+            s.chunk_next is None and s.generated and remaining >= 1
+            and (self.dcfg.prefill_chunk is not None
+                 or len(cont_prompt) <= self.dcfg.max_prompt_len))
+        if can_continue:
+            c.tokens.extend(s.generated)
+            c.times.extend(s.token_times)
+            cont = Request(rid=req.rid, prompt=cont_prompt,
+                           max_new_tokens=remaining, eos_id=req.eos_id,
+                           lane=req.lane)
+        else:  # restart this leg (its partial work is dropped)
+            cont = Request(rid=req.rid, prompt=list(req.prompt),
+                           max_new_tokens=req.max_new_tokens,
+                           eos_id=req.eos_id, lane=req.lane)
+        self._release_slot(victim)
+        self.stats["preemptions"] += 1
+        _metrics.inc("apex_serve_preemptions_total",
+                     help="best-effort residents evicted for the "
+                          "interactive lane")
+        log_structured(
+            _logger, logging.INFO, "serve.preempted", rid=req.rid,
+            generated=len(s.generated), requeued_prompt=len(cont.prompt))
+        self._submit_times[req.rid] = self._time()
+        self.be_queue.appendleft(cont)
+        return True
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a slot's pages (and unused COW reserve) to the
+        allocator and clear its static-shape arrays."""
         s = self._slots[slot]
         self.allocator.free(s.pages)
-        self.completed.append(Completion(
-            rid=s.request.rid, prompt=list(s.request.prompt),
-            tokens=list(s.generated), submit_time=s.submit_time,
-            finish_time=self._time(), token_times=list(s.token_times)))
+        if s.cow_reserve is not None:
+            self.allocator.free([s.cow_reserve])
         self._slots[slot] = None
         self._active[slot] = False
         self._page_tables[slot] = 0
         self._positions[slot] = 0
         self._tokens[slot] = 0
+
+    # ------------------------------------------------------------- evict
+    def _evict(self, slot: int) -> None:
+        s = self._slots[slot]
+        if self.prefix is not None and s.chunk_next is None:
+            # the tail page is quiesced now — index it (full pages
+            # re-index as a no-op walk, repairing released chains)
+            self.prefix.register(
+                s.request.prompt,
+                [int(p) for p in self._page_tables[slot]], tail=True)
+        c = self._carry.pop(s.request.rid, None)
+        prompt = c.prompt if c is not None else list(s.request.prompt)
+        tokens = (list(c.tokens) if c is not None else []) \
+            + list(s.generated)
+        times = (list(c.times) if c is not None else []) \
+            + list(s.token_times)
+        submit = c.submit_time if c is not None else s.submit_time
+        self._release_slot(slot)
+        self.completed.append(Completion(
+            rid=s.request.rid, prompt=prompt, tokens=tokens,
+            submit_time=submit, finish_time=self._time(),
+            token_times=times, lane=s.request.lane,
+            preemptions=c.preemptions if c is not None else 0))
         self.stats["evicted"] += 1
         _metrics.inc("apex_serve_completions_total",
                      help="finished generations")
-        _metrics.inc("apex_serve_generated_tokens_total", len(s.generated),
+        _metrics.inc("apex_serve_generated_tokens_total", len(tokens),
                      help="tokens served")
         self._record_occupancy()
 
+    # ----------------------------------------------------- chunked prefill
+    def _advance_chunks(self) -> bool:
+        """One prefill chunk per still-prefilling slot: the chunk's k/v
+        scatter into the reserved pages through the multi-position
+        decode forward (shared-prefix positions skip both compute and
+        writes), and the final chunk's last hidden state feeds the
+        sampling head for the first token."""
+        progressed = False
+        C = self.dcfg.prefill_chunk
+        for i, s in enumerate(self._slots):
+            if s is None or s.chunk_next is None:
+                continue
+            plen = len(s.request.prompt)
+            start = s.chunk_next
+            n_valid = min(C, plen - start)
+            tok = np.zeros((C,), np.int32)
+            tok[:n_valid] = s.request.prompt[start:start + n_valid]
+            self.pools, h_last = self._call(
+                "_chunk", self.params, self.pools, jnp.asarray(tok),
+                jnp.int32(start), jnp.int32(n_valid),
+                jnp.int32(s.shared_len),
+                jnp.asarray(self._page_tables[i]))
+            self.stats["chunk_steps"] += 1
+            s.chunk_next = start + n_valid
+            progressed = True
+            if s.chunk_next >= plen:
+                first = int(self._call(
+                    "_sample_head", self.params, h_last,
+                    jnp.uint32(self._seed(i))))
+                self.stats["prefills"] += 1
+                self._start_decoding(i, first, s.submitted_at)
+        return progressed
+
+    # ------------------------------------------------------------- COW
+    def _cow_for_writes(self, width: int) -> None:
+        """Copy-on-write pass before a decode/verify step: any page the
+        step's write window (``positions .. positions + width - 1``)
+        touches with refcount > 1 is copied into the slot's reserve and
+        the table repointed — shared pages are never written through."""
+        if self.prefix is None:
+            return  # no sharing → no page can ever hold refcount > 1
+        ps = self.dcfg.cache.page_size
+        P = self.dcfg.cache.pages_per_seq
+        for i in range(self.dcfg.max_batch):
+            if not self._active[i]:
+                continue
+            p0 = int(self._positions[i])
+            first_ix = p0 // ps
+            last_ix = min((p0 + width - 1) // ps, P - 1)
+            for ix in range(first_ix, last_ix + 1):
+                page = int(self._page_tables[i, ix])
+                if page == GARBAGE_PAGE \
+                        or self.allocator.refcount(page) <= 1:
+                    continue
+                s = self._slots[i]
+                if s.cow_reserve is None:
+                    raise RuntimeError(
+                        f"slot {i}: divergent write into shared page "
+                        f"{page} with no COW reserve — the admission "
+                        f"plan must reserve one page per shared tail")
+                new = s.cow_reserve
+                s.cow_reserve = None
+                self.pools = copy_page(self.pools, page, new)
+                self.allocator.free([page])  # drop this slot's share
+                self._page_tables[i, ix] = new
+                s.pages[ix] = new
+                self.stats["cow_copies"] += 1
+                _metrics.inc("apex_serve_cow_copies_total",
+                             help="shared pages copied before a "
+                                  "divergent write")
+
     # -------------------------------------------------------------- step
     def step(self) -> bool:
-        """Admit waiting requests, then advance every active sequence
-        one token.  Returns True when any work (admission or decode)
-        happened."""
+        """Admit waiting requests (both lanes), advance chunked
+        prefills by one chunk each, then advance every active sequence
+        — one token (plain decode) or up to ``draft_len + 1`` tokens
+        (speculative verify).  Returns True when any work happened."""
         if self._watchdog is not None:
             # the first interval covers the prefill/decode jit compiles
             # (the trainer loop's compile-grace pattern); steady state
@@ -371,9 +696,22 @@ class ContinuousBatchingScheduler:
             # tunnel presents (plan key: decode steps taken so far)
             monkey.maybe_wedge_step(self.stats["decode_steps"])
         admitted = self._admit()
+        progressed = False
+        if self.dcfg.prefill_chunk is not None:
+            progressed = self._advance_chunks()
         if not self._active.any():
-            return admitted > 0
+            return admitted > 0 or progressed
+        if self.dcfg.draft_len > 0:
+            self._step_verify()
+        else:
+            self._step_decode()
+        return True
+
+    def _step_decode(self) -> None:
+        """The plain one-token decode step (PR 9 semantics, plus the
+        COW pass and per-lane latency labels)."""
         B = self.dcfg.max_batch
+        self._cow_for_writes(width=1)
         seeds = np.zeros((B,), np.uint32)
         for i in range(B):
             if self._active[i]:
@@ -394,7 +732,8 @@ class ContinuousBatchingScheduler:
             tok = int(next_tokens[i])
             _metrics.observe("apex_serve_inter_token_seconds",
                              now - s.token_times[-1],
-                             help="previous token -> this token")
+                             help="previous token -> this token",
+                             lane=s.request.lane)
             s.generated.append(tok)
             s.token_times.append(now)
             self._tokens[i] = tok
@@ -403,10 +742,73 @@ class ContinuousBatchingScheduler:
                     or (s.request.eos_id is not None
                         and tok == s.request.eos_id)):
                 self._evict(i)
-        return True
+
+    def _step_verify(self) -> None:
+        """The speculative step: draft, verify all ``draft_len + 1``
+        positions in ONE batched pass, accept the longest matching
+        prefix per slot.  Emissions spend the same (slot, draw) seeds
+        as the plain decode path — the token stream is bitwise the
+        non-speculative stream, delivered faster."""
+        B = self.dcfg.max_batch
+        W = self.dcfg.draft_len + 1
+        self._cow_for_writes(width=W)
+        tokmat = np.zeros((B, W), np.int32)
+        seeds = np.zeros((B, W), np.uint32)
+        for i in range(B):
+            if not self._active[i]:
+                continue
+            tokmat[i, 0] = self._tokens[i]
+            drafts = self._slots[i].proposer.propose()
+            if drafts:
+                k = min(len(drafts), W - 1)
+                tokmat[i, 1:1 + k] = drafts[:k]
+            d0 = int(self._draws[i])
+            for j in range(W):
+                seeds[i, j] = self._seed_at(i, d0 + j)
+        self.pools, sampled = self._call(
+            "_verify", self.params, self.pools,
+            jnp.asarray(tokmat), jnp.asarray(self._positions),
+            jnp.asarray(self._active), jnp.asarray(self._page_tables),
+            jnp.asarray(seeds))
+        sampled = np.asarray(sampled)
+        now = self._time()
+        self.stats["decode_steps"] += 1
+        self.stats["spec_steps"] += 1
+        self._record_occupancy()
+        for i in range(B):
+            if not self._active[i]:
+                continue
+            s = self._slots[i]
+            emit = accepted_tokens(tokmat[i], sampled[i])
+            out: List[int] = []
+            for tok in emit:  # clamp to the generation budget / eos
+                out.append(tok)
+                if s.request.eos_id is not None \
+                        and tok == s.request.eos_id:
+                    break
+                if len(s.generated) + len(out) >= s.request.max_new_tokens:
+                    break
+            self._draws[i] += len(out)  # one draw per consumed emission
+            for tok in out:
+                _metrics.observe("apex_serve_inter_token_seconds",
+                                 now - s.token_times[-1],
+                                 help="previous token -> this token",
+                                 lane=s.request.lane)
+                s.generated.append(tok)
+                s.token_times.append(now)
+            s.proposer.extend(out)
+            self.stats["spec_emitted"] += len(out)
+            _metrics.inc("apex_serve_spec_emitted_total", len(out),
+                         help="tokens emitted by verify steps")
+            self._tokens[i] = out[-1]
+            self._positions[i] += len(out)
+            if (len(s.generated) >= s.request.max_new_tokens
+                    or (s.request.eos_id is not None
+                        and out[-1] == s.request.eos_id)):
+                self._evict(i)
 
     def run_until_drained(self, max_steps: int = 10_000) -> List[Completion]:
-        """Drive ``step()`` until queue and slots are empty (the
+        """Drive ``step()`` until queues and slots are empty (the
         test/driver convenience loop)."""
         for _ in range(max_steps):
             if self.idle():
@@ -414,4 +816,5 @@ class ContinuousBatchingScheduler:
             self.step()
         raise RuntimeError(
             f"serve loop not drained after {max_steps} steps "
-            f"(queue={len(self.queue)}, active={self.num_active})")
+            f"(queue={len(self.queue) + len(self.be_queue)}, "
+            f"active={self.num_active})")

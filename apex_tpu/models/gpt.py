@@ -509,7 +509,8 @@ def lm_head_loss(x, embed, targets, config: GPTConfig,
 
 def forward_decode(params, tokens, positions, active, kv_pools, page_tables,
                    config: GPTConfig, axis_name: Optional[str] = None,
-                   attn_impl: str = "auto"):
+                   attn_impl: str = "auto", verify_width: int = 1,
+                   write_mask=None):
     """Single-token decode forward over the paged KV cache.
 
     The serving-side twin of :func:`gpt_forward`: same weights, same
@@ -526,10 +527,25 @@ def forward_decode(params, tokens, positions, active, kv_pools, page_tables,
     ``tokens``/``positions``/``active``: (B,) current token ids, their
     0-based positions, and the slot-live mask.  ``kv_pools``: the
     ``{"k", "v"}`` pools from :func:`apex_tpu.inference.kv_cache
-    .alloc_pools` (kv heads LOCAL under tp).  ``page_tables``: (B, P)
-    int32.  With ``axis_name`` the projections run column/row-parallel
-    inside shard_map exactly as in training (kv heads shard over tp,
-    so each rank's pool carries its local heads).
+    .alloc_pools` (kv heads LOCAL under tp).  ``page_tables``:
+    (B // verify_width, P) int32.  With ``axis_name`` the projections
+    run column/row-parallel inside shard_map exactly as in training
+    (kv heads shard over tp, so each rank's pool carries its local
+    heads).
+
+    ``verify_width`` W > 1 is the multi-position layout (speculative
+    verification, a prefill chunk): rows come in groups of W
+    CONSECUTIVE positions of one sequence sharing a page-table row.
+    Each layer first scatters ALL W rows' post-RoPE k/v into the pages,
+    then every row attends under its OWN causal length (``positions[i]
+    + 1``) — row j of a group reads the k/v rows 0..j wrote this very
+    step, so the group is exactly a causal block over the paged cache.
+    W is static: one compile per width, reused across every
+    occupancy / draft-hit / chunk-phase mix.  ``write_mask`` (defaults
+    to ``active``) narrows WHICH rows scatter their k/v — attention
+    liveness stays ``active`` — so a chunk can recompute a
+    shared-prefix position's hidden state without rewriting the shared
+    page (the COW discipline).
 
     Returns ``(hidden, new_pools)`` — hidden (B, H) is the pre-head
     activation (post final-LN, post copy-to-region under tp), the same
@@ -561,6 +577,19 @@ def forward_decode(params, tokens, positions, active, kv_pools, page_tables,
     n_local_kv = config.kv_heads // tp
     positions = positions.astype(jnp.int32)
     lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+    if write_mask is None:
+        write_mask = active
+    if verify_width > 1:
+        if B % verify_width != 0:
+            raise ValueError(
+                f"batch ({B}) must be a multiple of verify_width "
+                f"({verify_width})")
+        # one table ROW per sequence rides the attention seam as-is
+        # (the kernel folds b // width); the scatter wants a row per
+        # flattened position
+        write_tables = jnp.repeat(page_tables, verify_width, axis=0)
+    else:
+        write_tables = page_tables
 
     if axis_name is None:
         emb = jnp.take(params["embed"], tokens, axis=0)  # (B, H)
@@ -589,9 +618,9 @@ def forward_decode(params, tokens, positions, active, kv_pools, page_tables,
             q = apply_rope_at(q, positions, config.rope_theta)
             k = apply_rope_at(k, positions, config.rope_theta)
         k_pool, v_pool = write_decode_kv(
-            k_pool, v_pool, k, v, page_tables, positions, active)
+            k_pool, v_pool, k, v, write_tables, positions, write_mask)
         ctx = decode_attention(q, k_pool, v_pool, page_tables, lengths,
-                               impl=attn_impl)
+                               impl=attn_impl, width=verify_width)
         ctx = ctx.astype(config.compute_dtype).reshape(
             1, B, n_local_heads * hd)
         if axis_name is None:

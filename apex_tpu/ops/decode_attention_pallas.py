@@ -52,7 +52,7 @@ _DIM_SEMANTICS = pltpu.CompilerParams(
 
 # ---------------------------------------------------------------- reference
 def decode_attention_xla(q, k_pool, v_pool, page_table, lengths,
-                         softmax_scale=None):
+                         softmax_scale=None, width=1):
     """Single-query attention over a paged KV cache, in XLA.
 
     ``q``: (B, H, D) — one query per sequence (the current token's
@@ -63,16 +63,27 @@ def decode_attention_xla(q, k_pool, v_pool, page_table, lengths,
     (B,) int32 valid cache positions per sequence (0 = inactive slot —
     every position masks out and the output row is 0).
 
+    ``width`` > 1 is the verify/chunk layout: q rows come in groups of
+    ``width`` CONSECUTIVE positions of one sequence (speculative
+    verification, a prefill chunk), so ``q``/``lengths`` are
+    (B * width, ...) while ``page_table`` stays (B, P) — the pool pages
+    are gathered ONCE per sequence and scored against all of its
+    ``width`` queries, each under its own length mask.
+
     Returns (B, H, D) in ``v_pool``'s dtype.  The expression mirrors
     the training attention row-for-row (division by sqrt(D), -1e4 mask
     fill, fp32 softmax, probs cast to v's dtype before the weighted
     sum) so decode logits can be compared bitwise against the training
     forward in fp32.
     """
-    B, H, D = q.shape
+    Bq, H, D = q.shape
     num_pages, page_size, h_kv, _ = k_pool.shape
-    P = page_table.shape[1]
+    B, P = page_table.shape
     group = H // h_kv
+    if B * width != Bq:
+        raise ValueError(
+            f"q rows ({Bq}) must equal page-table rows ({B}) x width "
+            f"({width})")
     pt = jnp.clip(page_table, 0, num_pages - 1)
     # (B, P, page, H_kv, D) -> (B, H_kv, S_max, D)
     k = k_pool[pt].reshape(B, P * page_size, h_kv, D).transpose(0, 2, 1, 3)
@@ -83,12 +94,26 @@ def decode_attention_xla(q, k_pool, v_pool, page_table, lengths,
     # the storage dtype may be narrower than the scores' f32: widen the
     # cache reads explicitly at the seam (the APX306 contract)
     kf = k.astype(jnp.float32)
+    t = jnp.arange(P * page_size, dtype=jnp.int32)
+    if width > 1:
+        qf = q.astype(jnp.float32).reshape(B, width, H, D)
+        if softmax_scale is None:
+            scores = jnp.einsum("bwhd,bhtd->bwht", qf, kf) / np.sqrt(D)
+        else:
+            scores = jnp.einsum("bwhd,bhtd->bwht", qf, kf) * softmax_scale
+        lw = lengths.reshape(B, width)
+        valid = t[None, None, None, :] < lw[:, :, None, None]
+        scores = jnp.where(valid, scores, MASK_FILL_VALUE)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bwht,bhtd->bwhd", probs.astype(v.dtype), v)
+        ctx = jnp.where(lw[:, :, None, None] > 0, ctx,
+                        jnp.zeros_like(ctx))
+        return ctx.reshape(Bq, H, D)
     qf = q.astype(jnp.float32)
     if softmax_scale is None:
         scores = jnp.einsum("bhd,bhtd->bht", qf, kf) / np.sqrt(D)
     else:
         scores = jnp.einsum("bhd,bhtd->bht", qf, kf) * softmax_scale
-    t = jnp.arange(P * page_size, dtype=jnp.int32)
     valid = t[None, None, :] < lengths[:, None, None]
     scores = jnp.where(valid, scores, MASK_FILL_VALUE)
     probs = jax.nn.softmax(scores, axis=-1)
@@ -160,30 +185,42 @@ def _decode_attn_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention_pallas(q, k_pool, v_pool, page_table, lengths,
-                                  softmax_scale=None, interpret=False):
+                                  softmax_scale=None, width=1,
+                                  interpret=False):
     """The Pallas paged decode-attention launcher (see module doc).
 
     Shapes as :func:`decode_attention_xla`.  The flattened page table
     and the lengths ride as scalar-prefetch operands so the k/v
     BlockSpec index maps can dereference them — each grid step DMAs
     exactly one (page_size, D) page of the group-shared kv head out of
-    the pool.
+    the pool.  With ``width`` > 1 (the verify/chunk layout: q rows in
+    groups of ``width`` consecutive positions of one sequence) the
+    index maps fold the row back onto its sequence's table row —
+    ``pt[(b // width) * P + p]`` — so the table is prefetched once per
+    SEQUENCE, not once per query row; ``width`` is static, one compile
+    per verify width.
     """
     B, H, D = q.shape
     num_pages, page_size, h_kv, _ = k_pool.shape
-    P = page_table.shape[1]
+    n_seq, P = page_table.shape
     if H % h_kv != 0:
         raise ValueError(f"q heads ({H}) not divisible by kv heads ({h_kv})")
+    if n_seq * width != B:
+        raise ValueError(
+            f"q rows ({B}) must equal page-table rows ({n_seq}) x width "
+            f"({width})")
     group = H // h_kv
     qg = q.reshape(B, h_kv, group, D)
     # clamp BEFORE prefetch: the index map output becomes a DMA source
     # address, where a garbage entry must hit the reserved garbage page,
     # never wrap (APX107's contract for page-table gathers)
-    pt = jnp.clip(page_table, 0, num_pages - 1).reshape(B * P).astype(jnp.int32)
+    pt = jnp.clip(page_table, 0, num_pages - 1) \
+        .reshape(n_seq * P).astype(jnp.int32)
 
     kv_spec = pl.BlockSpec(
         (1, page_size, 1, D),
-        lambda b, g, p, pt_ref, len_ref: (pt_ref[b * P + p], 0, g, 0),
+        lambda b, g, p, pt_ref, len_ref: (pt_ref[(b // width) * P + p],
+                                          0, g, 0),
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -229,14 +266,17 @@ def pallas_decode_attn_available(q, k_pool) -> bool:
 
 
 def decode_attention(q, k_pool, v_pool, page_table, lengths,
-                     impl="auto", softmax_scale=None):
+                     impl="auto", softmax_scale=None, width=1):
     """Paged single-query decode attention — the ONE dispatch between
     the Pallas kernel and the XLA reference.
 
     ``impl``: "auto" (kernel on TPU, reference elsewhere), "pallas"
     (force the kernel, fail loudly), "interpret" (kernel via the Pallas
-    interpreter — the CPU test path), or "xla".  Chosen (non-forced)
-    kernel use routes through the resilience fallback registry
+    interpreter — the CPU test path), or "xla".  ``width`` > 1 scores
+    groups of consecutive positions per sequence against one shared
+    page-table row (speculative verification / chunked prefill — see
+    :func:`decode_attention_xla`).  Chosen (non-forced) kernel use
+    routes through the resilience fallback registry
     ("decode_attention"): the first Mosaic/launch failure degrades this
     process to the reference once, with one structured warning, instead
     of killing the serve loop (:mod:`apex_tpu.resilience.fallback`).
@@ -248,7 +288,7 @@ def decode_attention(q, k_pool, v_pool, page_table, lengths,
 
     def xla_impl():
         return decode_attention_xla(q, k_pool, v_pool, page_table, lengths,
-                                    softmax_scale=softmax_scale)
+                                    softmax_scale=softmax_scale, width=width)
 
     if impl == "xla":
         return xla_impl()
@@ -259,7 +299,8 @@ def decode_attention(q, k_pool, v_pool, page_table, lengths,
     def kernel_impl():
         return paged_decode_attention_pallas(
             q, k_pool, v_pool, page_table, lengths,
-            softmax_scale=softmax_scale, interpret=(impl == "interpret"))
+            softmax_scale=softmax_scale, width=width,
+            interpret=(impl == "interpret"))
 
     from apex_tpu.resilience.fallback import get_registry, registry_engaged
 

@@ -1121,6 +1121,116 @@ def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
                 out[a][n_top]["tokens_per_sec"]
                 / max(out[b][n_top]["tokens_per_sec"], 1e-9), 3),
         }
+
+    # ---- serving v2 modes: speculative / shared-prefix / chunked ----
+    # (each compiles tiny under --smoke and rides the smoke contract)
+    attn = attn_impls[0]
+    n_v2 = min(4, max(streams))
+    rng = np.random.RandomState(seed + 1)
+
+    def mk_sched(n, extra_pages=0, **dk):
+        per = pages_needed(prompt_len + max_new + dk.get("draft_len", 0),
+                           page_size)
+        dcfg = DecodeConfig(
+            cache=KVCacheConfig(
+                num_pages=1 + n * per + extra_pages, page_size=page_size,
+                pages_per_seq=per + pages_needed(prompt_len * 2,
+                                                 page_size),
+                dtype=jnp.float32 if _SMOKE else jnp.bfloat16),
+            max_batch=n, max_prompt_len=prompt_len,
+            temperature=0.0, top_k=0, attn_impl=attn,
+            sample_impl="xla" if _SMOKE else "auto", base_seed=seed, **dk)
+        return ContinuousBatchingScheduler(params, cfg, dcfg)
+
+    def timed_drain(sched):
+        t0 = time.perf_counter()
+        done = sched.run_until_drained()
+        return done, time.perf_counter() - t0
+
+    def lane_ttft(done):
+        rec = {}
+        for lane in ("interactive", "best_effort"):
+            ts = [c.token_times[0] - c.submit_time for c in done
+                  if c.lane == lane and c.token_times]
+            if ts:
+                rec[lane] = {
+                    "ttft_p50_ms": round(
+                        1e3 * float(np.percentile(ts, 50)), 3),
+                    "ttft_p99_ms": round(
+                        1e3 * float(np.percentile(ts, 99)), 3)}
+        return rec
+
+    # spec_ngram: n-gram drafts verified in one batched pass — on
+    # repetitive text (the workload speculation is for), report
+    # accepted-tokens/step and the decode-step cut vs the plain engine
+    pat = rng.randint(0, vocab, size=4).tolist()
+    reps = [Request(rid=r, prompt=(pat * prompt_len)[:prompt_len],
+                    max_new_tokens=max_new) for r in range(n_v2)]
+    plain = mk_sched(n_v2)
+    for r in reps:
+        plain.submit(Request(r.rid, list(r.prompt), r.max_new_tokens))
+    done_p, dt_p = timed_drain(plain)
+    spec = mk_sched(n_v2, draft_len=4)
+    for r in reps:
+        spec.submit(Request(r.rid, list(r.prompt), r.max_new_tokens))
+    done_s, dt_s = timed_drain(spec)
+    assert ({c.rid: c.tokens for c in done_s}
+            == {c.rid: c.tokens for c in done_p}), \
+        "speculative greedy streams diverged from the plain engine"
+    n_tok = sum(len(c.tokens) for c in done_s)
+    out["spec_ngram"] = {
+        "requests": len(reps), "draft_len": 4,
+        "accepted_tokens_per_step": round(
+            spec.stats["spec_emitted"] / max(spec.stats["spec_steps"], 1),
+            3),
+        "decode_steps": spec.stats["decode_steps"],
+        "decode_steps_plain": plain.stats["decode_steps"],
+        "tokens_per_sec": round(n_tok / max(dt_s, 1e-9), 2),
+        "tokens_per_sec_plain": round(n_tok / max(dt_p, 1e-9), 2),
+        "decode_compiles": spec.decode_cache_size(),
+    }
+
+    # shared_prefix: one system prompt across every request — report
+    # how many full pages the trie deduped away
+    sysp = rng.randint(0, vocab, size=prompt_len - 2).tolist()
+    shared = mk_sched(n_v2, prefix_sharing=True)
+    for r in range(n_v2):
+        shared.submit(Request(rid=r, prompt=sysp + [r],
+                              max_new_tokens=max_new))
+    done_sh, dt_sh = timed_drain(shared)
+    full_per = len(sysp + [0]) // page_size
+    out["shared_prefix"] = {
+        "requests": n_v2, "prompt_full_pages": full_per,
+        "shared_full_pages": shared.stats["shared_full_pages"],
+        "cow_copies": shared.stats["cow_copies"],
+        "page_dedupe_ratio": round(
+            shared.stats["shared_full_pages"]
+            / max(n_v2 * full_per, 1), 3),
+        "tokens_per_sec": round(
+            sum(len(c.tokens) for c in done_sh) / max(dt_sh, 1e-9), 2),
+    }
+
+    # chunked_prefill: prompts past the padded limit admit as chunks,
+    # two lanes mixed — per-lane TTFT is the SLO evidence
+    chunked = mk_sched(n_v2, prefill_chunk=page_size * 2,
+                       extra_pages=n_v2 * pages_needed(prompt_len * 2,
+                                                       page_size))
+    for r in range(n_v2):
+        plen = prompt_len * 2 if r % 2 == 0 else max(2, prompt_len // 2)
+        chunked.submit(Request(
+            rid=r, prompt=rng.randint(0, vocab, size=plen).tolist(),
+            max_new_tokens=max_new,
+            lane="interactive" if r % 2 == 0 else "best_effort"))
+    done_c, dt_c = timed_drain(chunked)
+    out["chunked_prefill"] = {
+        "requests": n_v2, "chunk": page_size * 2,
+        "longest_prompt": prompt_len * 2,
+        "chunk_steps": chunked.stats["chunk_steps"],
+        "preemptions": chunked.stats["preemptions"],
+        "lanes": lane_ttft(done_c),
+        "tokens_per_sec": round(
+            sum(len(c.tokens) for c in done_c) / max(dt_c, 1e-9), 2),
+    }
     return out
 
 

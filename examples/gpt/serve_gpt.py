@@ -10,6 +10,10 @@ by.
 
     python examples/gpt/serve_gpt.py --streams 8 --requests 32
     python examples/gpt/serve_gpt.py --smoke     # tiny CPU acceptance
+    # serving v2: speculative decode + shared system prompt + chunked
+    # prefill + a preemptible best-effort lane, one command
+    python examples/gpt/serve_gpt.py --draft-len 4 --prefix-sharing \\
+        --system-prompt-len 128 --prefill-chunk 64 --best-effort-frac 0.5
 
 ``--smoke`` runs a tiny greedy config end-to-end on CPU and ASSERTS
 the engine's contracts: continuous batching admitted/evicted >= 3
@@ -79,6 +83,32 @@ def build_args():
     p.add_argument("--sample-impl", default="auto",
                    choices=["auto", "pallas", "interpret", "xla"])
     p.add_argument("--seed", type=int, default=0)
+    # ---- serving v2 (all default OFF: the plain PR 9 engine) ----
+    p.add_argument("--draft-len", type=int, default=0,
+                   help="speculative decode: n-gram drafts of up to k "
+                        "tokens verified per step in ONE batched pass "
+                        "(0 disables; the emitted stream is bitwise the "
+                        "non-speculative stream)")
+    p.add_argument("--ngram-max", type=int, default=3,
+                   help="longest prompt-lookup n-gram the drafter sweeps")
+    p.add_argument("--ngram-min", type=int, default=1)
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="chunked prefill: admit prompts as C-token "
+                        "chunks interleaved with decode steps (any "
+                        "prompt length; None = one padded prefill "
+                        "shape, prompts capped at --prompt-len)")
+    p.add_argument("--prefix-sharing", action="store_true",
+                   help="dedupe identical prompt-prefix pages through "
+                        "the refcounted trie, copy-on-write on first "
+                        "divergence")
+    p.add_argument("--system-prompt-len", type=int, default=0,
+                   help="prepend one shared system prompt of this many "
+                        "tokens to every request (the prefix-sharing "
+                        "workload; 0 = fully random prompts)")
+    p.add_argument("--best-effort-frac", type=float, default=0.0,
+                   help="fraction of requests submitted on the "
+                        "preemptible best_effort lane (the rest are "
+                        "interactive); the report splits TTFT by lane")
     p.add_argument("--metrics-dir", default=None,
                    help="observability sink dir: serving metrics (queue "
                         "depth, slot/page occupancy, admission wait, "
@@ -110,11 +140,17 @@ def build_args():
 def make_requests(args, rng):
     reqs, arrivals = [], []
     t = 0.0
+    sysp = (rng.randint(0, args.vocab,
+                        size=args.system_prompt_len).tolist()
+            if args.system_prompt_len > 0 else [])
     for rid in range(args.requests):
-        plen = int(rng.randint(4, args.prompt_len + 1))
-        prompt = rng.randint(0, args.vocab, size=plen).tolist()
+        lo = min(4, args.prompt_len)
+        plen = int(rng.randint(lo, args.prompt_len + 1))
+        prompt = sysp + rng.randint(0, args.vocab, size=plen).tolist()
+        lane = ("best_effort"
+                if rng.uniform() < args.best_effort_frac else "interactive")
         reqs.append(Request(rid=rid, prompt=prompt,
-                            max_new_tokens=args.max_new))
+                            max_new_tokens=args.max_new, lane=lane))
         if args.arrival_rate > 0:
             t += float(rng.exponential(1.0 / args.arrival_rate))
         arrivals.append(t)
@@ -138,10 +174,13 @@ def serve(sched, reqs, arrivals):
 
 def report(completions, wall_secs):
     per_token, ttft = [], []
+    lane_ttft = {}
     n_tokens = 0
     for c in completions:
         n_tokens += len(c.tokens)
-        ttft.append(c.token_times[0] - c.submit_time)
+        t = c.token_times[0] - c.submit_time
+        ttft.append(t)
+        lane_ttft.setdefault(c.lane, []).append(t)
         per_token.extend(np.diff(c.token_times))
     out = {
         "requests": len(completions),
@@ -151,6 +190,14 @@ def report(completions, wall_secs):
         "ttft_p50_ms": round(1e3 * float(np.percentile(ttft, 50)), 2),
         "ttft_p99_ms": round(1e3 * float(np.percentile(ttft, 99)), 2),
     }
+    if len(lane_ttft) > 1:  # mixed lanes: the per-lane SLO evidence
+        out["lanes"] = {
+            lane: {"requests": len(ts),
+                   "ttft_p50_ms": round(
+                       1e3 * float(np.percentile(ts, 50)), 2),
+                   "ttft_p99_ms": round(
+                       1e3 * float(np.percentile(ts, 99)), 2)}
+            for lane, ts in sorted(lane_ttft.items())}
     if per_token:
         out["per_token_p50_ms"] = round(
             1e3 * float(np.percentile(per_token, 50)), 2)
@@ -198,11 +245,13 @@ def main(argv=None):
         if args.sample_impl == "pallas":
             args.sample_impl = "interpret"
 
+    total_prompt = args.system_prompt_len + args.prompt_len
     config = GPTConfig(
         vocab_size=args.vocab, hidden_size=args.hidden,
         num_layers=args.layers, num_attention_heads=args.heads,
         num_query_groups=args.kv_groups,
-        max_seq_len=max(args.prompt_len + args.max_new + 1, 64),
+        max_seq_len=max(total_prompt + args.max_new + args.draft_len + 1,
+                        64),
         position_embedding_type="rope",
         compute_dtype=jnp.float32 if args.smoke else jnp.bfloat16,
         checkpoint_layers=False,
@@ -210,7 +259,10 @@ def main(argv=None):
     rng = np.random.RandomState(args.seed)
     params = init_params(config, jax.random.PRNGKey(args.seed))
 
-    pages_per_seq = -(-(args.prompt_len + args.max_new) // args.page_size)
+    # worst-case footprint: full prompt + generation budget + the
+    # speculative write window (draft k/v land past the accepted stream)
+    pages_per_seq = -(-(total_prompt + args.max_new + args.draft_len)
+                      // args.page_size)
     num_pages = args.num_pages
     if num_pages is None:
         # pool sized so ~streams worst-case sequences fit (+ garbage
@@ -221,11 +273,14 @@ def main(argv=None):
             num_pages=num_pages, page_size=args.page_size,
             pages_per_seq=pages_per_seq,
             dtype=jnp.dtype(args.kv_dtype)),
-        max_batch=args.streams, max_prompt_len=args.prompt_len,
+        max_batch=args.streams, max_prompt_len=total_prompt,
         temperature=args.temperature, top_k=args.top_k,
         attn_impl=args.attn_impl, sample_impl=args.sample_impl,
         sample_dot_dtype=jnp.float32 if args.smoke else None,
         base_seed=args.seed,
+        draft_len=args.draft_len, ngram_max=args.ngram_max,
+        ngram_min=args.ngram_min, prefill_chunk=args.prefill_chunk,
+        prefix_sharing=args.prefix_sharing,
     )
     from apex_tpu.observability import get_metrics, set_step_context
     from apex_tpu.resilience import ChaosMonkey, ChaosPlan, StepWatchdog
@@ -265,6 +320,15 @@ def main(argv=None):
     out = report(completions, wall)
     out["stats"] = dict(sched.stats)
     out["decode_compiles"] = sched.decode_cache_size()
+    if args.draft_len > 0:
+        out["accepted_tokens_per_step"] = round(
+            sched.stats["spec_emitted"]
+            / max(sched.stats["spec_steps"], 1), 3)
+    if args.prefix_sharing:
+        full_per = args.system_prompt_len // args.page_size
+        out["page_dedupe_ratio"] = round(
+            sched.stats["shared_full_pages"]
+            / max(len(completions) * full_per, 1), 3)
     if args.metrics_dir:
         mdir = Path(args.metrics_dir)
         mdir.mkdir(parents=True, exist_ok=True)

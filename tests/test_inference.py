@@ -426,9 +426,9 @@ class TestScheduler:
         admitted_order = []
         orig = sched._admit_into
 
-        def record(slot, req, pages):
+        def record(slot, req, *plan):
             admitted_order.append(req.rid)
-            return orig(slot, req, pages)
+            return orig(slot, req, *plan)
 
         sched._admit_into = record
         rng = np.random.RandomState(2)
@@ -536,7 +536,11 @@ class TestScheduler:
     def test_decode_step_compiles_once_across_occupancy(self, model):
         """The compile-once contract at the scheduler level: varying
         occupancy (1..3 active), cache lengths, admissions and
-        evictions all reuse ONE compiled decode step."""
+        evictions all reuse ONE compiled decode step (pinned through
+        the generalized ``analysis.lowered.assert_no_recompile``
+        guard-rail, post-hoc spelling)."""
+        from apex_tpu.analysis import lowered as lw
+
         cfg, params = model
         sched = _sched(params, cfg)
         rng = np.random.RandomState(8)
@@ -544,6 +548,7 @@ class TestScheduler:
                            max_new=(2, 8)):
             sched.submit(r)
         sched.run_until_drained()
+        lw.assert_no_recompile(sched._decode, label="decode_step")
         assert sched.decode_cache_size() == 1
 
     def test_chaos_wedged_decode_step_fires_serving_watchdog(self, model):

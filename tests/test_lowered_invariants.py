@@ -624,7 +624,8 @@ class TestDecodeStep:
         prefill = make_prefill(cfg, dcfg)
         low = prefill.lower(
             params, pools, jnp.zeros((1, dcfg.max_prompt_len), jnp.int32),
-            jnp.int32(3), jnp.zeros((dcfg.cache.pages_per_seq,), jnp.int32),
+            jnp.int32(3), jnp.int32(0),
+            jnp.zeros((dcfg.cache.pages_per_seq,), jnp.int32),
             jnp.uint32(0))
         lw.assert_no_host_transfer(low)
 
@@ -640,21 +641,76 @@ class TestDecodeStep:
     def test_decode_step_compiles_once_across_lengths_and_occupancy(self):
         """One executable serves occupancy 0..B and any positions mix:
         shape-identical calls with different occupancy/length DATA must
-        not add cache entries."""
-        cfg, dcfg, params, pools, make_step, _ = self._build()
+        not add cache entries — the call-matrix spelling of
+        ``analysis.lowered.assert_no_recompile``."""
+        from apex_tpu.inference import alloc_pools
+
+        cfg, dcfg, params, _pools, make_step, _ = self._build()
         step = make_step(cfg, dcfg)
         B, P = dcfg.max_batch, dcfg.cache.pages_per_seq
         pt = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P) % 7 + 1
+        calls = []
         for active, positions in [
             ((False,) * B, (0,) * B),
             ((True, False, False), (0, 0, 0)),
             ((True, True, True), (3, 9, 14)),
             ((False, True, False), (0, 15, 0)),
         ]:
-            pools, _tok = step(
-                params, pools, jnp.zeros((B,), jnp.int32),
-                jnp.asarray(positions, jnp.int32), jnp.asarray(active),
-                pt, jnp.zeros((B,), jnp.uint32))
-        assert step._cache_size() == 1, (
-            f"decode step compiled {step._cache_size()} variants — "
-            "occupancy or length leaked into a traced shape")
+            # fresh pools per call: the step donates them
+            pools = alloc_pools(cfg.num_layers, cfg.kv_heads,
+                                cfg.head_dim, dcfg.cache)
+            calls.append((params, pools, jnp.zeros((B,), jnp.int32),
+                          jnp.asarray(positions, jnp.int32),
+                          jnp.asarray(active), pt,
+                          jnp.zeros((B,), jnp.uint32)))
+        lw.assert_no_recompile(step, calls, label="decode_step")
+
+    def test_verify_and_chunk_steps_zero_host_transfer_and_donate(self):
+        """The serving-v2 compiled steps inherit every decode-step
+        contract: the speculative verify step and the prefill chunk
+        step run entirely on device, donate the KV pools, and compile
+        once across draft-hit/occupancy/chunk-phase mixes."""
+        from apex_tpu.inference.decode import (
+            make_prefill_chunk, make_verify_step,
+        )
+
+        cfg, dcfg, params, pools, _, _ = self._build()
+        import dataclasses as _dc
+
+        dcfg = _dc.replace(dcfg, draft_len=3, prefill_chunk=4)
+        B, P = dcfg.max_batch, dcfg.cache.pages_per_seq
+        W = dcfg.draft_len + 1
+        verify = make_verify_step(cfg, dcfg)
+        vargs = (params, pools, jnp.zeros((B, W), jnp.int32),
+                 jnp.zeros((B,), jnp.int32), jnp.zeros((B,), bool),
+                 jnp.zeros((B, P), jnp.int32),
+                 jnp.zeros((B, W), jnp.uint32))
+        low = verify.lower(*vargs)
+        lw.assert_no_host_transfer(low)
+        lw.assert_donation_covers(low, pools, compiled=True)
+        # draft hit/miss and occupancy are DATA: shape-identical calls
+        # (fresh pools per call — the step donates them)
+        from apex_tpu.inference import alloc_pools
+
+        def fresh():
+            return alloc_pools(cfg.num_layers, cfg.kv_heads,
+                               cfg.head_dim, dcfg.cache)
+
+        calls = [
+            (params, fresh(), jnp.full((B, W), toks, jnp.int32),
+             jnp.asarray((2, 9, 0), jnp.int32), jnp.asarray(active),
+             jnp.ones((B, P), jnp.int32), jnp.zeros((B, W), jnp.uint32))
+            for active, toks in [
+                ((True, True, True), 5), ((True, False, False), 0),
+                ((False,) * B, 3),
+            ]
+        ]
+        lw.assert_no_recompile(verify, calls, label="verify_step")
+
+        chunk = make_prefill_chunk(cfg, dcfg)
+        cargs = (params, fresh(), jnp.zeros((4,), jnp.int32),
+                 jnp.int32(0), jnp.int32(4), jnp.int32(0),
+                 jnp.zeros((P,), jnp.int32))
+        lowc = chunk.lower(*cargs)
+        lw.assert_no_host_transfer(lowc)
+        lw.assert_donation_covers(lowc, cargs[1], compiled=True)

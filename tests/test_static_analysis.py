@@ -36,6 +36,7 @@ from apex_tpu.analysis.rules_collectives import (
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
+from apex_tpu.analysis.rules_inference import KvPoolScatterBypassesSeam
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_resilience import (
     SwallowedExceptionInRecoveryPath,
@@ -1298,6 +1299,76 @@ class TestPageTableGatherUnclamped:
             def host_side(slots, i):
                 return slots[i]
             """, tmp_path, [PageTableGatherUnclamped()])
+        assert got == []
+
+
+# ----------------------------- APX110 kv/pool scatter bypassing the seam
+class TestKvPoolScatterBypassesSeam:
+    """The COW-bypass hazard class: ``.at[...].set`` into a pool-named
+    buffer whose page index is neither clamped/garbage-routed device
+    data nor an allocator-normalized host int — with refcounted shared
+    pages, a write the scheduler's COW pass cannot see mutates pages
+    other sequences still read."""
+
+    def test_positive_raw_index_scatter(self, tmp_path):
+        got = run("""
+            def poison(pools, page, slot, val):
+                return pools["k"].at[page, slot].set(val)
+            """, tmp_path, [KvPoolScatterBypassesSeam()])
+        assert rule_ids(got) == ["APX110"]
+        assert "copy-on-write" in got[0].message
+
+    def test_positive_arithmetic_on_unrouted_index(self, tmp_path):
+        got = run("""
+            def poison(k_pool, positions, page_size, val):
+                dest = positions // page_size
+                return k_pool.at[dest].add(val)
+            """, tmp_path, [KvPoolScatterBypassesSeam()])
+        assert rule_ids(got) == ["APX110"]
+
+    def test_negative_garbage_routed_seam_shape(self, tmp_path):
+        """The write_decode_kv contract shape: dest built from
+        where(clip(...), GARBAGE_PAGE) is the seam itself."""
+        got = run("""
+            import jax.numpy as jnp
+            GARBAGE_PAGE = 0
+
+            def write(k_pool, rows, slot, active, num_pages, k_new):
+                dest = jnp.where(active,
+                                 jnp.clip(rows, 0, num_pages - 1),
+                                 GARBAGE_PAGE)
+                return k_pool.at[dest, slot].set(k_new)
+            """, tmp_path, [KvPoolScatterBypassesSeam()])
+        assert got == []
+
+    def test_negative_allocator_host_int(self, tmp_path):
+        """copy_page's shape: allocator-issued ids normalized through
+        int(...) — including the tuple-assignment spelling."""
+        got = run("""
+            def copy_page(pools, src, dst):
+                src, dst = int(src), int(dst)
+                k = pools["k"].at[:, dst].set(pools["k"][:, src])
+                return k
+            """, tmp_path, [KvPoolScatterBypassesSeam()])
+        assert got == []
+
+    def test_negative_non_pool_buffers_quiet(self, tmp_path):
+        """Ordinary functional updates (grads, params, stats) stay out
+        of reach — the rule is scoped to kv/pool names."""
+        got = run("""
+            def bump(stats, i, g):
+                return stats.at[i].add(g)
+
+            def read_only(pools, page):
+                return pools["k"].at[page].get(mode="fill", fill_value=0)
+            """, tmp_path, [KvPoolScatterBypassesSeam()])
+        assert rule_ids(got) == []
+
+    def test_negative_static_literal_index(self, tmp_path):
+        got = run("""
+            def reset_garbage(k_pool):
+                return k_pool.at[0].set(0.0)
+            """, tmp_path, [KvPoolScatterBypassesSeam()])
         assert got == []
 
 
