@@ -59,6 +59,12 @@ machine-checked invariants):
   a compiled step — the per-step sync barrier
   ``apex_tpu.observability.stepstats`` (the allowed async-fetch
   spelling) exists to remove.
+- **APX112** unseamed dispatch timing (``rules_host_sync``): a
+  ``time.time()``/``perf_counter()``/``monotonic()`` delta spanning a
+  proven step dispatch with no ``block_until_ready``/host-read/
+  async-fetch seam in between — async dispatch makes the delta an
+  enqueue time, not a step time (host-side tracing spans say so
+  explicitly: see ``apex_tpu.observability.tracing``).
 
 CLI: ``python -m apex_tpu.analysis [paths] [--baseline FILE]`` — see
 ``docs/static_analysis.md`` for rule details, the baseline format, and
@@ -84,7 +90,9 @@ from apex_tpu.analysis.rules_collectives import (
     UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
-from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
+from apex_tpu.analysis.rules_host_sync import (
+    BlockingHostSyncInStepLoop, UnseamedDispatchTiming,
+)
 from apex_tpu.analysis.rules_inference import KvPoolScatterBypassesSeam
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_resilience import (
@@ -117,6 +125,7 @@ def default_rules(vmem_budget_bytes=None):
         NonAtomicCheckpointWrite(),
         SwallowedExceptionInRecoveryPath(),
         BlockingHostSyncInStepLoop(),
+        UnseamedDispatchTiming(),
         UnknownCollectiveAxis(),
         CollectiveOutsideSpmdContext(),
         CollectiveAxisUnboundUnderJit(),

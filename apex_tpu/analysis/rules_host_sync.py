@@ -14,6 +14,15 @@ later).
 - **APX108**: inside a ``for``/``while`` loop that dispatches a
   compiled step, a value *proven* to be a device array is converted to
   a host value.
+- **APX112**: a wall-clock delta (``time.time()``/``perf_counter()``/
+  ``monotonic()``) taken around a step dispatch with NO blocking seam
+  in between — async dispatch returns as soon as the work is queued,
+  so the delta measures enqueue time, not step time (the classic
+  10000x-too-fast "benchmark").  The acquitting seams: a
+  ``block_until_ready``/``device_get`` call, any host materialization
+  (``float()``/``.item()``/``np.asarray``), or an async-fetch drain
+  (``.flush()``/``.wait_until_finished()``) between the dispatch and
+  the second timestamp.
 
 What "proven" means (the only-statically-certain contract every rule
 family here follows):
@@ -49,7 +58,7 @@ from apex_tpu.analysis.core import (
     Finding, ModuleContext, Rule, last_name,
 )
 
-__all__ = ["BlockingHostSyncInStepLoop"]
+__all__ = ["BlockingHostSyncInStepLoop", "UnseamedDispatchTiming"]
 
 #: builder callees whose result is a compiled step function
 _STEP_BUILDER = re.compile(r"^make_\w*step$|^make_prefill$")
@@ -81,18 +90,34 @@ def _target_name_positions(stmt: ast.Assign) -> List[str]:
     return []
 
 
-class BlockingHostSyncInStepLoop(Rule):
-    """APX108: device array forced to host inside a step loop."""
+def _rebound_names(stmt: ast.AST) -> List[str]:
+    """EVERY plain name a statement rebinds (assignment/loop/with-as
+    targets, destructuring included) — used to invalidate clock stamps
+    on reuse: after ``t0 = offsets[0]``, a ``time.time() - t0`` is data
+    math, not a timing, and must not be flagged."""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items
+                   if i.optional_vars is not None]
+    out = []
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.append(node.id)
+    return out
 
-    rule_id = "APX108"
-    severity = "error"
-    fix_hint = ("move the conversion after the loop, or route it through "
-                "the async telemetry seam "
-                "(apex_tpu.observability.stepstats.AsyncFetcher: put() the "
-                "device array in the loop, harvest ready() copies without "
-                "blocking) — every in-loop float()/.item()/np.asarray/"
-                "f-string of a device array drains the dispatch queue and "
-                "serializes host and device once per step")
+
+class _StepDispatchFacts:
+    """The shared step-binding facts both host-sync rules prove their
+    findings on: which names hold compiled steps, which local defs
+    dispatch them, and which names hold their (device-array) results —
+    see the module docstring for the "proven" contract."""
 
     # ------------------------------------------------------------ facts
     def _scope_of(self, ctx: ModuleContext, node: ast.AST) -> ast.AST:
@@ -185,6 +210,44 @@ class BlockingHostSyncInStepLoop(Rule):
                 _target_name_positions(node))
         return out
 
+    def _dispatches_step(self, ctx: ModuleContext, node: ast.AST,
+                         step_bindings: Dict[int, Set[str]],
+                         step_fns: Set[str]) -> bool:
+        """Does any call under ``node`` dispatch a proven step?"""
+        return any(
+            isinstance(n, ast.Call) and (
+                self._is_step_name(ctx, n.func, n, step_bindings)
+                or (isinstance(n.func, ast.Name) and n.func.id in step_fns))
+            for n in ast.walk(node))
+
+    def _numpy_call(self, ctx: ModuleContext, call: ast.Call) -> bool:
+        name = last_name(call.func)
+        if name not in _NP_SINKS:
+            return False
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name):
+            head = call.func.value.id
+            mod = ctx.import_aliases.get(head, head)
+            return mod == "numpy" or head == "np"
+        if isinstance(call.func, ast.Name):
+            tgt = ctx.from_imports.get(call.func.id)
+            return tgt is not None and tgt[0] == "numpy"
+        return False
+
+
+class BlockingHostSyncInStepLoop(_StepDispatchFacts, Rule):
+    """APX108: device array forced to host inside a step loop."""
+
+    rule_id = "APX108"
+    severity = "error"
+    fix_hint = ("move the conversion after the loop, or route it through "
+                "the async telemetry seam "
+                "(apex_tpu.observability.stepstats.AsyncFetcher: put() the "
+                "device array in the loop, harvest ready() copies without "
+                "blocking) — every in-loop float()/.item()/np.asarray/"
+                "f-string of a device array drains the dispatch queue and "
+                "serializes host and device once per step")
+
     # ------------------------------------------------------------- sinks
     def _base_device_name(self, ctx: ModuleContext, expr: ast.AST,
                           device: Dict[int, Set[str]]) -> Optional[str]:
@@ -203,20 +266,6 @@ class BlockingHostSyncInStepLoop(Rule):
             if scope is None:
                 return None
             scope = ctx.enclosing_function(scope)
-
-    def _numpy_call(self, ctx: ModuleContext, call: ast.Call) -> bool:
-        name = last_name(call.func)
-        if name not in _NP_SINKS:
-            return False
-        if isinstance(call.func, ast.Attribute) \
-                and isinstance(call.func.value, ast.Name):
-            head = call.func.value.id
-            mod = ctx.import_aliases.get(head, head)
-            return mod == "numpy" or head == "np"
-        if isinstance(call.func, ast.Name):
-            tgt = ctx.from_imports.get(call.func.id)
-            return tgt is not None and tgt[0] == "numpy"
-        return False
 
     def _call_sink(self, ctx: ModuleContext, node: ast.Call,
                    device: Dict[int, Set[str]]
@@ -281,13 +330,8 @@ class BlockingHostSyncInStepLoop(Rule):
             if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
                 continue
             # a STEP loop: its body dispatches a compiled step
-            dispatches = any(
-                isinstance(n, ast.Call) and (
-                    self._is_step_name(ctx, n.func, n, step_bindings)
-                    or (isinstance(n.func, ast.Name)
-                        and n.func.id in step_fns))
-                for n in ast.walk(loop))
-            if not dispatches:
+            if not self._dispatches_step(ctx, loop, step_bindings,
+                                         step_fns):
                 continue
             seen: Set[int] = set()
             for node, dn, how in self._sinks_in(ctx, loop, device):
@@ -300,3 +344,157 @@ class BlockingHostSyncInStepLoop(Rule):
                     f"loop (line {loop.lineno}) blocks the host on the "
                     f"device every iteration — the loop dispatches a "
                     f"compiled step, so this is a per-step sync barrier")
+
+
+#: wall-clock callables whose deltas APX112 audits
+_CLOCKS = {"time", "perf_counter", "monotonic"}
+
+#: attribute/name calls that force the queued work to finish — any one
+#: of these between a dispatch and the second timestamp makes the
+#: delta truthful (generous on purpose: a seam only ACQUITS)
+_SEAM_ATTRS = {"block_until_ready", "device_get", "item", "flush",
+               "wait_until_finished"}
+
+
+class UnseamedDispatchTiming(_StepDispatchFacts, Rule):
+    """APX112: a wall-clock delta spanning a step dispatch with no
+    blocking seam — async dispatch makes the timing a lie.
+
+    Statement-list dataflow, only-statically-certain: within one
+    straight-line statement sequence, ``t0 = time.time()`` (or
+    ``perf_counter``/``monotonic``, module or from-imported) followed
+    by a statement that dispatches a proven step binding, followed by
+    ``<clock>() - t0`` (or ``t1 = <clock>(); ... t1 - t0``) with no
+    acquitting seam between the dispatch and the second timestamp.
+    Timestamps bound in nested blocks, unproven callees, and deltas
+    over names from other scopes are all trusted."""
+
+    rule_id = "APX112"
+    severity = "error"
+    fix_hint = ("call jax.block_until_ready(...) on the step's outputs "
+                "(or materialize one of them: float()/np.asarray, or "
+                "drain the async fetcher) before taking the second "
+                "timestamp — jit dispatch is asynchronous, so a bare "
+                "wall-clock delta around it measures how fast the work "
+                "was ENQUEUED, not how fast it ran")
+
+    # ------------------------------------------------------------ clocks
+    def _clock_call(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """``time.time()`` / ``tm.perf_counter()`` /
+        ``from time import monotonic; monotonic()`` spellings."""
+        if not (isinstance(node, ast.Call)
+                and last_name(node.func) in _CLOCKS
+                and not node.args and not node.keywords):
+            return False
+        if isinstance(node.func, ast.Attribute):
+            if not isinstance(node.func.value, ast.Name):
+                return False
+            head = node.func.value.id
+            return ctx.import_aliases.get(head, head) == "time"
+        tgt = ctx.from_imports.get(node.func.id)
+        return tgt is not None and tgt[0] == "time"
+
+    def _seam_fns(self, ctx: ModuleContext) -> Set[str]:
+        """Module/function-local defs whose body contains a seam call
+        (the ``def block(tree): ... jax.block_until_ready(tree)``
+        wrapper idiom) — calling one IS the seam."""
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(self._seam_call(ctx, n)
+                            for n in ast.walk(node)
+                            if isinstance(n, ast.Call)):
+                out.add(node.name)
+        return out
+
+    def _seam_call(self, ctx: ModuleContext, n: ast.Call,
+                   seam_fns: Set[str] = frozenset()) -> bool:
+        name = last_name(n.func)
+        if name in _SEAM_ATTRS or name in seam_fns:
+            return True
+        if name in ("float", "int") and isinstance(n.func, ast.Name) \
+                and len(n.args) == 1:
+            return True
+        return self._numpy_call(ctx, n)
+
+    def _is_seam(self, ctx: ModuleContext, stmt: ast.AST,
+                 seam_fns: Set[str]) -> bool:
+        return any(self._seam_call(ctx, n, seam_fns)
+                   for n in ast.walk(stmt) if isinstance(n, ast.Call))
+
+    # ------------------------------------------------------------- check
+    def _statement_lists(self, tree: ast.AST) -> Iterator[List[ast.stmt]]:
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, field, None)
+                if isinstance(stmts, list) and stmts \
+                        and isinstance(stmts[0], ast.stmt):
+                    yield stmts
+
+    def _deltas_in(self, ctx: ModuleContext, stmt: ast.AST,
+                   stamps: Dict[str, int], idx: int
+                   ) -> Iterator[Tuple[ast.AST, str, int, int]]:
+        """``(node, t0_name, t0_idx, t1_idx)`` for each audited
+        subtraction under ``stmt`` (at list position ``idx``)."""
+        for n in ast.walk(stmt):
+            if not (isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub)):
+                continue
+            if not (isinstance(n.right, ast.Name)
+                    and n.right.id in stamps):
+                continue
+            j = stamps[n.right.id]
+            if self._clock_call(ctx, n.left):
+                yield n, n.right.id, j, idx
+            elif isinstance(n.left, ast.Name) and n.left.id in stamps \
+                    and stamps[n.left.id] > j:
+                yield n, n.right.id, j, stamps[n.left.id]
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.mentions("jit", "make_"):
+            return
+        step_bindings, step_fns = self._collect(ctx)
+        if not step_bindings and not step_fns:
+            return
+        seam_fns = self._seam_fns(ctx)
+        for stmts in self._statement_lists(ctx.tree):
+            stamps: Dict[str, int] = {}     # name -> taken-at index
+            dispatch_at: List[int] = []
+            seam_at: List[int] = []
+            for idx, stmt in enumerate(stmts):
+                for node, t0, j, k in self._deltas_in(ctx, stmt, stamps,
+                                                      idx):
+                    # EVERY in-window dispatch needs a seam after it —
+                    # a seam between a warmup dispatch and the timed
+                    # loop must not acquit the loop's own dispatches
+                    uncovered = [
+                        d for d in dispatch_at
+                        if j < d <= k and not any(d <= s <= k
+                                                  for s in seam_at)]
+                    if uncovered:
+                        yield self.finding(
+                            ctx, node,
+                            f"wall-clock delta against `{t0}` (line "
+                            f"{stmts[j].lineno}) spans the step "
+                            f"dispatch on line "
+                            f"{stmts[uncovered[-1]].lineno} with no "
+                            f"block_until_ready/host-read seam in "
+                            f"between — async dispatch means this "
+                            f"times the enqueue, not the step")
+                # facts AFTER deltas: a stmt's own dispatch/seam/stamp
+                # affects later statements only (same-statement order
+                # is uncertain, so same-statement hazards are trusted)
+                if self._is_seam(ctx, stmt, seam_fns):
+                    seam_at.append(idx)
+                if self._dispatches_step(ctx, stmt, step_bindings,
+                                         step_fns):
+                    dispatch_at.append(idx)
+                if isinstance(stmt, ast.Assign) \
+                        and self._clock_call(ctx, stmt.value):
+                    for name in _target_name_positions(stmt):
+                        stamps[name] = idx
+                else:
+                    # a rebind to anything else INVALIDATES the stamp
+                    # — a later delta against the reused name is not a
+                    # dispatch timing and must not turn the gate red
+                    for name in _rebound_names(stmt):
+                        stamps.pop(name, None)

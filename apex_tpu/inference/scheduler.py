@@ -90,6 +90,7 @@ from apex_tpu.inference.prefix import PrefixCache, PrefixMatch
 from apex_tpu.inference.spec import NGramProposer, accepted_tokens
 from apex_tpu.models.gpt import GPTConfig
 from apex_tpu.observability import metrics as _metrics
+from apex_tpu.observability import tracing as _tracing
 from apex_tpu.resilience.chaos import active_monkey
 from apex_tpu.utils.logging import get_logger, log_structured
 
@@ -109,13 +110,18 @@ LANES = ("interactive", "best_effort")
 class Request:
     """One generation request: ``prompt`` token ids, ``max_new_tokens``
     to generate, optional ``eos_id`` early stop, and the admission
-    ``lane`` (see :data:`LANES`)."""
+    ``lane`` (see :data:`LANES`).  ``trace_id`` is assigned at
+    ``submit`` when the caller did not bring one — it is stamped on
+    every span and latency-histogram exemplar the request produces, so
+    a p99 outlier in ``apex_serve_ttft_seconds`` joins back to this
+    request's admission-wait/prefill/decode spans."""
 
     rid: int
     prompt: List[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
     lane: str = "interactive"
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -133,6 +139,7 @@ class Completion:
     token_times: List[float]
     lane: str = "interactive"
     preemptions: int = 0
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -172,7 +179,7 @@ class ContinuousBatchingScheduler:
     failures (see the module docstring for the full semantics)."""
 
     def __init__(self, params, config: GPTConfig, dcfg: DecodeConfig,
-                 time_fn=time.monotonic, watchdog=None):
+                 time_fn=time.monotonic, watchdog=None, anomaly=None):
         cache = dcfg.cache
         if config.moe:
             raise NotImplementedError("MoE decode is not wired")
@@ -220,6 +227,12 @@ class ContinuousBatchingScheduler:
         #: is the ADMIT time for driver compatibility; the metrics
         #: histograms — admission wait, TTFT — need the real submit)
         self._submit_times: Dict[int, float] = {}
+        #: per-lane SLO-burn detection (an
+        #: :class:`~apex_tpu.observability.anomaly.AnomalyMonitor`):
+        #: every TTFT / inter-token sample is also scored, so a lane
+        #: regression raises ``apex_anomaly_ttft_total{lane=}`` and a
+        #: structured alert without the driver polling percentiles
+        self._anomaly = anomaly
         self._watchdog = watchdog
         self._beaten = False
         if watchdog is not None:
@@ -257,6 +270,16 @@ class ContinuousBatchingScheduler:
             elapsed_s=info.get("elapsed_s"))
         _metrics.inc("apex_serve_wedges_total",
                      help="decode steps the watchdog declared wedged")
+
+    def _active_trace_ids(self) -> List[str]:
+        """Trace ids of the resident requests, slot order — stamped on
+        the batch-level decode/verify spans so a per-request exemplar's
+        ``trace_id`` joins to the specific steps that served it, not
+        just the whole-lifetime ``serve.request`` span."""
+        return [self._slots[i].request.trace_id
+                for i in range(self.dcfg.max_batch)
+                if self._active[i] and self._slots[i] is not None
+                and self._slots[i].request.trace_id is not None]
 
     def _record_occupancy(self) -> None:
         """Serving gauges on the current registry (the scope seam:
@@ -368,10 +391,18 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"request needs {need} pages; the pool only has "
                 f"{self.allocator.num_pages - 1} allocatable")
+        if request.trace_id is None:
+            request.trace_id = _tracing.new_trace_id()
         self._submit_times[request.rid] = self._time()
         (self.queue if request.lane == "interactive"
          else self.be_queue).append(request)
         self._record_occupancy()
+
+    def _epoch(self, mono: float) -> float:
+        """Epoch timestamp of the monotonic instant ``mono`` (the
+        retro-emitted spans' clock: both endpoints are measured on
+        ``self._time``, Chrome trace events want wall time)."""
+        return time.time() - (self._time() - mono)
 
     def _total_pages(self, req: Request) -> int:
         """Worst-case page-table footprint: prompt + generation budget,
@@ -439,7 +470,15 @@ class ContinuousBatchingScheduler:
         _metrics.observe("apex_serve_admission_wait_seconds",
                          t0 - submitted,
                          help="submit -> slot+pages reserved",
+                         exemplar={"trace_id": req.trace_id,
+                                   "rid": req.rid},
                          lane=req.lane)
+        tracer = _tracing.get_tracer()
+        if tracer is not None:
+            # both endpoints are known only now — retro-emit the wait
+            tracer.emit("serve.admission_wait", self._epoch(submitted),
+                        t0 - submitted, rid=req.rid,
+                        trace_id=req.trace_id, lane=req.lane)
         fresh = self.allocator.allocate(need_fresh)
         assert fresh is not None  # _admit_from checked can_allocate
         if match.num_full:
@@ -475,11 +514,15 @@ class ContinuousBatchingScheduler:
             return
         prompt = np.zeros((1, self.dcfg.max_prompt_len), np.int32)
         prompt[0, :plen] = req.prompt
-        self.pools, first = self._call(
-            "_prefill", self.params, self.pools,
-            jnp.asarray(prompt), jnp.int32(plen),
-            jnp.int32(match.shared_len), jnp.asarray(row),
-            jnp.uint32(self._seed(slot)))
+        with _tracing.span("serve.prefill", rid=req.rid,
+                           trace_id=req.trace_id, lane=req.lane,
+                           prompt_len=plen,
+                           shared_len=match.shared_len):
+            self.pools, first = self._call(
+                "_prefill", self.params, self.pools,
+                jnp.asarray(prompt), jnp.int32(plen),
+                jnp.int32(match.shared_len), jnp.asarray(row),
+                jnp.uint32(self._seed(slot)))
         self.stats["prefills"] += 1
         self._start_decoding(slot, int(first), submitted)
 
@@ -494,7 +537,12 @@ class ContinuousBatchingScheduler:
         t_first = self._time()
         _metrics.observe("apex_serve_ttft_seconds", t_first - submitted,
                          help="submit -> first token (prefill incl. queue)",
+                         exemplar={"trace_id": req.trace_id,
+                                   "rid": req.rid},
                          lane=req.lane)
+        if self._anomaly is not None:
+            self._anomaly.observe("ttft", t_first - submitted,
+                                  lane=req.lane)
         s.generated.append(first)
         s.token_times.append(t_first)
         s.chunk_next = None
@@ -544,11 +592,12 @@ class ContinuousBatchingScheduler:
             c.times.extend(s.token_times)
             cont = Request(rid=req.rid, prompt=cont_prompt,
                            max_new_tokens=remaining, eos_id=req.eos_id,
-                           lane=req.lane)
+                           lane=req.lane, trace_id=req.trace_id)
         else:  # restart this leg (its partial work is dropped)
             cont = Request(rid=req.rid, prompt=list(req.prompt),
                            max_new_tokens=req.max_new_tokens,
-                           eos_id=req.eos_id, lane=req.lane)
+                           eos_id=req.eos_id, lane=req.lane,
+                           trace_id=req.trace_id)
         self._release_slot(victim)
         self.stats["preemptions"] += 1
         _metrics.inc("apex_serve_preemptions_total",
@@ -591,11 +640,23 @@ class ContinuousBatchingScheduler:
             + list(s.token_times)
         submit = c.submit_time if c is not None else s.submit_time
         self._release_slot(slot)
+        finish = self._time()
         self.completed.append(Completion(
             rid=s.request.rid, prompt=prompt, tokens=tokens,
-            submit_time=submit, finish_time=self._time(),
+            submit_time=submit, finish_time=finish,
             token_times=times, lane=s.request.lane,
-            preemptions=c.preemptions if c is not None else 0))
+            preemptions=c.preemptions if c is not None else 0,
+            trace_id=s.request.trace_id))
+        tracer = _tracing.get_tracer()
+        if tracer is not None:
+            # the whole-lifetime span (admit-time submit -> eviction):
+            # what the TTFT-exemplar trace_id joins to
+            tracer.emit(
+                "serve.request", self._epoch(submit), finish - submit,
+                rid=s.request.rid, trace_id=s.request.trace_id,
+                lane=s.request.lane, tokens=len(tokens),
+                ttft_s=round(times[0] - submit, 6) if times else None,
+                preemptions=c.preemptions if c is not None else 0)
         self.stats["evicted"] += 1
         _metrics.inc("apex_serve_completions_total",
                      help="finished generations")
@@ -620,11 +681,15 @@ class ContinuousBatchingScheduler:
             n_valid = min(C, plen - start)
             tok = np.zeros((C,), np.int32)
             tok[:n_valid] = s.request.prompt[start:start + n_valid]
-            self.pools, h_last = self._call(
-                "_chunk", self.params, self.pools, jnp.asarray(tok),
-                jnp.int32(start), jnp.int32(n_valid),
-                jnp.int32(s.shared_len),
-                jnp.asarray(self._page_tables[i]))
+            with _tracing.span("serve.prefill_chunk", rid=s.request.rid,
+                               trace_id=s.request.trace_id,
+                               lane=s.request.lane, chunk_start=start,
+                               chunk_tokens=n_valid):
+                self.pools, h_last = self._call(
+                    "_chunk", self.params, self.pools, jnp.asarray(tok),
+                    jnp.int32(start), jnp.int32(n_valid),
+                    jnp.int32(s.shared_len),
+                    jnp.asarray(self._page_tables[i]))
             self.stats["chunk_steps"] += 1
             s.chunk_next = start + n_valid
             progressed = True
@@ -716,12 +781,20 @@ class ContinuousBatchingScheduler:
         for i in range(B):
             if self._active[i]:
                 seeds[i] = self._seed(i)
-        self.pools, next_tokens = self._call(
-            "_decode", self.params, self.pools,
-            jnp.asarray(self._tokens), jnp.asarray(self._positions),
-            jnp.asarray(self._active), jnp.asarray(self._page_tables),
-            jnp.asarray(seeds))
-        next_tokens = np.asarray(next_tokens)
+        # attrs (slot scan, active count) are only worth computing when
+        # a tracer is installed — this is the highest-frequency span in
+        # the serving path and the off case must stay near-zero
+        attrs = (dict(decode_step=self.stats["decode_steps"],
+                      active=int(self._active.sum()),
+                      trace_ids=self._active_trace_ids())
+                 if _tracing.enabled() else {})
+        with _tracing.span("serve.decode_step", **attrs):
+            self.pools, next_tokens = self._call(
+                "_decode", self.params, self.pools,
+                jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                jnp.asarray(self._active), jnp.asarray(self._page_tables),
+                jnp.asarray(seeds))
+            next_tokens = np.asarray(next_tokens)
         now = self._time()
         self.stats["decode_steps"] += 1
         self._record_occupancy()
@@ -733,7 +806,13 @@ class ContinuousBatchingScheduler:
             _metrics.observe("apex_serve_inter_token_seconds",
                              now - s.token_times[-1],
                              help="previous token -> this token",
+                             exemplar={"trace_id": s.request.trace_id,
+                                       "rid": s.request.rid},
                              lane=s.request.lane)
+            if self._anomaly is not None:
+                self._anomaly.observe("inter_token",
+                                      now - s.token_times[-1],
+                                      lane=s.request.lane)
             s.generated.append(tok)
             s.token_times.append(now)
             self._tokens[i] = tok
@@ -765,47 +844,74 @@ class ContinuousBatchingScheduler:
             d0 = int(self._draws[i])
             for j in range(W):
                 seeds[i, j] = self._seed_at(i, d0 + j)
-        self.pools, sampled = self._call(
-            "_verify", self.params, self.pools,
-            jnp.asarray(tokmat), jnp.asarray(self._positions),
-            jnp.asarray(self._active), jnp.asarray(self._page_tables),
-            jnp.asarray(seeds))
-        sampled = np.asarray(sampled)
-        now = self._time()
-        self.stats["decode_steps"] += 1
-        self.stats["spec_steps"] += 1
-        self._record_occupancy()
-        for i in range(B):
-            if not self._active[i]:
-                continue
-            s = self._slots[i]
-            emit = accepted_tokens(tokmat[i], sampled[i])
-            out: List[int] = []
-            for tok in emit:  # clamp to the generation budget / eos
-                out.append(tok)
-                if s.request.eos_id is not None \
-                        and tok == s.request.eos_id:
-                    break
-                if len(s.generated) + len(out) >= s.request.max_new_tokens:
-                    break
-            self._draws[i] += len(out)  # one draw per consumed emission
-            for tok in out:
-                _metrics.observe("apex_serve_inter_token_seconds",
-                                 now - s.token_times[-1],
-                                 help="previous token -> this token",
-                                 lane=s.request.lane)
-                s.generated.append(tok)
-                s.token_times.append(now)
-            s.proposer.extend(out)
-            self.stats["spec_emitted"] += len(out)
-            _metrics.inc("apex_serve_spec_emitted_total", len(out),
-                         help="tokens emitted by verify steps")
-            self._tokens[i] = out[-1]
-            self._positions[i] += len(out)
-            if (len(s.generated) >= s.request.max_new_tokens
-                    or (s.request.eos_id is not None
-                        and out[-1] == s.request.eos_id)):
-                self._evict(i)
+        # the verify span is ended by hand so the spec ACCEPT counts —
+        # known only after the host accepts per slot — ride its attrs
+        verify_attrs = (dict(decode_step=self.stats["decode_steps"],
+                             active=int(self._active.sum()),
+                             draft_len=W - 1,
+                             trace_ids=self._active_trace_ids())
+                        if _tracing.enabled() else {})
+        verify_span = _tracing.span("serve.verify_step", **verify_attrs)
+        emitted_before = self.stats["spec_emitted"]
+        try:
+            self.pools, sampled = self._call(
+                "_verify", self.params, self.pools,
+                jnp.asarray(tokmat), jnp.asarray(self._positions),
+                jnp.asarray(self._active), jnp.asarray(self._page_tables),
+                jnp.asarray(seeds))
+            sampled = np.asarray(sampled)
+            now = self._time()
+            self.stats["decode_steps"] += 1
+            self.stats["spec_steps"] += 1
+            self._record_occupancy()
+            for i in range(B):
+                if not self._active[i]:
+                    continue
+                s = self._slots[i]
+                emit = accepted_tokens(tokmat[i], sampled[i])
+                out: List[int] = []
+                for tok in emit:  # clamp to the generation budget / eos
+                    out.append(tok)
+                    if s.request.eos_id is not None \
+                            and tok == s.request.eos_id:
+                        break
+                    if len(s.generated) + len(out) \
+                            >= s.request.max_new_tokens:
+                        break
+                self._draws[i] += len(out)  # one draw per emission
+                for tok in out:
+                    _metrics.observe(
+                        "apex_serve_inter_token_seconds",
+                        now - s.token_times[-1],
+                        help="previous token -> this token",
+                        exemplar={"trace_id": s.request.trace_id,
+                                  "rid": s.request.rid},
+                        lane=s.request.lane)
+                    if self._anomaly is not None:
+                        self._anomaly.observe("inter_token",
+                                              now - s.token_times[-1],
+                                              lane=s.request.lane)
+                    s.generated.append(tok)
+                    s.token_times.append(now)
+                s.proposer.extend(out)
+                self.stats["spec_emitted"] += len(out)
+                _metrics.inc("apex_serve_spec_emitted_total", len(out),
+                             help="tokens emitted by verify steps")
+                self._tokens[i] = out[-1]
+                self._positions[i] += len(out)
+                if (len(s.generated) >= s.request.max_new_tokens
+                        or (s.request.eos_id is not None
+                            and out[-1] == s.request.eos_id)):
+                    self._evict(i)
+        except BaseException:
+            verify_span.set(error=True)
+            raise
+        finally:
+            # the accept loop can raise too — the span must never leak
+            # open (it would render as a phantom wedged verify step in
+            # every later export and flight-recorder dump)
+            verify_span.end(
+                emitted=self.stats["spec_emitted"] - emitted_before)
 
     def run_until_drained(self, max_steps: int = 10_000) -> List[Completion]:
         """Drive ``step()`` until queues and slots are empty (the

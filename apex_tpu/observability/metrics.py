@@ -169,12 +169,15 @@ class _BoundGauge:
 
 
 class _HistState:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * (n_buckets + 1)  # +inf bucket
         self.sum = 0.0
         self.count = 0
+        #: recent exemplar records ({"value", "ts", **ids}), bounded —
+        #: drained by snapshot_jsonl so each appears in ONE snapshot
+        self.exemplars: List[dict] = []
 
 
 class Histogram(_Metric):
@@ -192,11 +195,35 @@ class Histogram(_Metric):
     def _new_child(self):
         return _HistState(len(self.buckets))
 
+    #: exemplars kept per histogram child between snapshots
+    MAX_EXEMPLARS = 16
+
     def labels(self, **labels) -> "_BoundHistogram":
         return _BoundHistogram(self, self._child(labels))
 
-    def observe(self, v: float, **labels) -> None:
-        self.labels(**labels).observe(v)
+    def observe(self, v: float, exemplar: Optional[dict] = None,
+                **labels) -> None:
+        """Record ``v``; ``exemplar`` (e.g. ``{"trace_id": ..., "rid":
+        ...}``) attaches join-key identity to this OTHERWISE-ANONYMOUS
+        sample — a p99 outlier in the exported series becomes joinable
+        to its request's trace spans.  Exemplars ride the JSONL export
+        (``<name>_exemplar`` lines, drained per snapshot); the
+        Prometheus 0.0.4 text format has no exemplar syntax, so the
+        .prom export carries only the histogram itself."""
+        self.labels(**labels).observe(v, exemplar=exemplar)
+
+    def drain_exemplars(self) -> List[Tuple[dict, dict]]:
+        """``(labels, exemplar)`` pairs recorded since the last drain
+        (the JSONL snapshot's feed); clears the rings."""
+        with self._lock:
+            items = list(self._children.items())
+            out = []
+            for key, child in items:
+                if child.exemplars:
+                    labels = dict(zip(self.labelnames, key))
+                    out.extend((labels, ex) for ex in child.exemplars)
+                    child.exemplars = []
+        return out
 
     def _expand(self, labels, child: _HistState):
         cum = 0
@@ -212,13 +239,25 @@ class _BoundHistogram:
     def __init__(self, metric: Histogram, key: Tuple):
         self._m, self._key = metric, key
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[dict] = None) -> None:
         v = float(v)
         m = self._m
         with m._lock:
             st: _HistState = m._children[self._key]
             st.sum += v
             st.count += 1
+            if exemplar is not None:
+                # recency ring, but the window MAX survives eviction:
+                # the p99 outlier is the sample worth joining, and a
+                # single end-of-run drain (serve_gpt.py) must still
+                # hold it after hundreds of ordinary samples
+                exs = st.exemplars
+                if len(exs) >= Histogram.MAX_EXEMPLARS:
+                    mx = max(range(len(exs)),
+                             key=lambda i: exs[i]["value"])
+                    del exs[1 if mx == 0 else 0]
+                exs.append(
+                    {"value": v, "ts": round(time.time(), 3), **exemplar})
             for i, le in enumerate(m.buckets):
                 if v <= le:
                     st.counts[i] += 1
@@ -328,6 +367,18 @@ class MetricsRegistry:
                     "metric": name, "type": m.kind,
                     "labels": labels, "value": value, **extra,
                 }, sort_keys=True, default=str))
+            if isinstance(m, Histogram):
+                # exemplars: the identity (trace id, request id) of
+                # individual samples — one line each, drained so a
+                # sample's identity rides exactly one snapshot.  This
+                # is what makes a p99 outlier in the series JOINABLE
+                # to its request's trace spans.
+                for labels, ex in m.drain_exemplars():
+                    lines.append(json.dumps({
+                        "ts": ts, "rank": rank, **ctx,
+                        "metric": f"{m.name}_exemplar", "type": "exemplar",
+                        "labels": labels, **ex, **extra,
+                    }, sort_keys=True, default=str))
         if lines:
             with open(path, "a") as f:
                 f.write("\n".join(lines) + "\n")
@@ -439,9 +490,10 @@ def set_gauge(name: str, value: float, help: str = "", **labels) -> None:
 
 
 def observe(name: str, value: float, help: str = "",
-            buckets: Sequence[float] = DEFAULT_BUCKETS, **labels) -> None:
+            buckets: Sequence[float] = DEFAULT_BUCKETS,
+            exemplar: Optional[dict] = None, **labels) -> None:
     _best_effort(
         lambda: get_metrics().histogram(
             name, help, tuple(sorted(labels)),
-            buckets=buckets).observe(value, **labels),
+            buckets=buckets).observe(value, exemplar=exemplar, **labels),
         name)

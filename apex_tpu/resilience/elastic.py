@@ -48,6 +48,7 @@ from typing import Any, Dict, Mapping, NamedTuple, Optional
 
 import numpy as np
 
+from apex_tpu.observability import flightrec as _flightrec
 from apex_tpu.observability import metrics as _metrics
 from apex_tpu.utils.logging import get_logger, log_structured
 
@@ -260,6 +261,14 @@ class StepWatchdog:
             log_structured(_logger, logging.WARNING,
                            "watchdog.on_wedge_failed",
                            error=f"{type(e).__name__}: {e}")
+        # flight-recorder dump AFTER the on_wedge hook (so the hook's
+        # own records — the goodput wedge stamp, the forced anomaly
+        # alert — are IN the dump) and BEFORE the drain (the wedged
+        # thing may be the filesystem the drain is about to wait on).
+        # dump_active is best-effort and a no-op without a recorder.
+        info["flight_dump"] = _flightrec.dump_active(
+            "wedge", wedged_step=step,
+            elapsed_s=info["elapsed_s"], deadline_s=deadline)
         info["drain"] = self._drain_bounded()
         log_structured(_logger, logging.ERROR, "watchdog.exiting",
                        **info)

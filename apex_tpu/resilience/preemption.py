@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Optional
 
+from apex_tpu.observability import flightrec as _flightrec
 from apex_tpu.observability import metrics as _metrics
 from apex_tpu.utils.logging import get_logger, log_structured
 
@@ -120,6 +121,10 @@ class PreemptionHandler:
                            reason=reason)
             _metrics.inc("apex_preemptions_total",
                          help="preemption notices received")
+            # forensics at the NOTICE (not the exit): the grace window
+            # may close before an orderly dump path ever runs (no-op
+            # without an installed recorder)
+            _flightrec.dump_active("preemption", preempt_reason=reason)
         self._event.set()
 
     def simulate(self, reason: str = "simulated (chaos)") -> None:

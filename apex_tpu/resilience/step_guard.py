@@ -28,6 +28,7 @@ from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 
+from apex_tpu.observability import flightrec as _flightrec
 from apex_tpu.observability import metrics as _metrics
 
 __all__ = ["GuardState", "StepGuard", "BadStepBudgetExceeded"]
@@ -90,6 +91,14 @@ class StepGuard:
         if int(state.consecutive_bad) >= self.max_consecutive_bad:
             _metrics.inc("apex_bad_step_budget_aborts_total",
                          help="runs aborted on the consecutive-bad budget")
+            # forensics BEFORE the raise: the abort unwinds to an exit,
+            # and the dump is what names the divergence ramp (best-
+            # effort no-op without an installed recorder)
+            _flightrec.dump_active(
+                "step_guard_abort",
+                consecutive_bad=int(state.consecutive_bad),
+                total_skipped=int(state.total_skipped),
+                guard_step=int(state.step))
             raise BadStepBudgetExceeded(
                 f"{int(state.consecutive_bad)} consecutive non-finite "
                 f"steps (budget {self.max_consecutive_bad}); "

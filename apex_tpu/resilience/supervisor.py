@@ -74,6 +74,8 @@ import sys
 import time
 from typing import Callable, List, Optional, Sequence
 
+from apex_tpu.observability import anomaly as _anomaly
+from apex_tpu.observability import flightrec as _flightrec
 from apex_tpu.observability import metrics as _metrics
 from apex_tpu.resilience.elastic import (
     EXIT_KILLED, EXIT_WEDGED, restart_backoff,
@@ -171,11 +173,13 @@ class Supervisor:
                  seed: int = 0, rng=None, grace_sec: float = 30.0,
                  min_healthy_runtime_sec: float = 300.0,
                  fault_script=None, install_signals: bool = False,
+                 flight_dir=None,
                  spawn_fn: Optional[Callable] = None,
                  sleep_fn: Callable[[float], None] = time.sleep,
                  time_fn: Callable[[], float] = time.monotonic,
                  progress_fn: Optional[Callable[[], int]] = None,
-                 probe_fn: Optional[Callable] = None):
+                 probe_fn: Optional[Callable] = None,
+                 anomaly_fn: Optional[Callable[[], int]] = None):
         if crash_loop_threshold < 1:
             raise ValueError(
                 f"crash_loop_threshold must be >= 1, got "
@@ -203,10 +207,22 @@ class Supervisor:
         self._probe = probe_fn if probe_fn is not None \
             else self._default_probe
         self._install_signals = bool(install_signals)
+        #: where the children's flight-recorder dumps land (the trace
+        #: dir when the drivers trace, else <metrics_dir>/flightrec) —
+        #: the newest readable dump is attached to every restart and
+        #: quarantine record, so each exit-75/137 points at its own
+        #: forensics artifact
+        self.flight_dir = (str(flight_dir) if flight_dir is not None
+                           else _flightrec.default_dir(
+                               metrics_dir=metrics_dir))
+        self._anomaly_fn = anomaly_fn if anomaly_fn is not None \
+            else self._default_anomaly
+        self._anomaly_seen = 0
         # ---- run state (introspectable by tests / postmortems)
         self.attempt = 0            # child launches so far
         self.restarts = 0           # relaunches after a failure
         self.quarantined: List[str] = []
+        self.flight_dumps: List[Optional[str]] = []
         self.backoffs: List[float] = []
         self._streak = 0            # consecutive no-progress failures
         self._last_progress = 0
@@ -266,6 +282,23 @@ class Supervisor:
         from apex_tpu.io.checkpoint import probe_checkpoint_dir
 
         return probe_checkpoint_dir(self.checkpoint_dir)
+
+    def _default_anomaly(self) -> int:
+        """Total alerts the children's anomaly monitors persisted under
+        the metrics dir — falling back to the flight/trace dir, where
+        the drivers persist when only ``--trace-dir`` is set — recent
+        only (a week-old regression record must not keep lengthening
+        today's backoff)."""
+        d = self.metrics_dir if self.metrics_dir is not None \
+            else self.flight_dir
+        if d is None:
+            return 0
+        return _anomaly.recent_alert_count(d, max_age_sec=3600.0)
+
+    def _latest_flight_dump(self) -> Optional[str]:
+        if self.flight_dir is None:
+            return None
+        return _flightrec.latest_dump_path(self.flight_dir)
 
     # ------------------------------------------------------ signals
     def _on_sigterm(self, signum, frame):  # pragma: no cover - signal path
@@ -371,7 +404,8 @@ class Supervisor:
         log_structured(_logger, logging.ERROR, "supervisor.quarantined",
                        run_id=self.run_id, attempt=self.attempt,
                        path=bad.path, quarantined_to=dest,
-                       reason=bad.reason)
+                       reason=bad.reason,
+                       flight_dump=self._latest_flight_dump())
 
     # -------------------------------------------------------- backoff
     def _backoff_delay(self, exit_code: int, progress: int) -> float:
@@ -379,6 +413,25 @@ class Supervisor:
                                 base=self.backoff_base,
                                 cap=self.backoff_cap, seed=self.seed,
                                 rng=self.rng)
+        alerts = self._safe_anomaly()
+        if alerts > self._anomaly_seen:
+            # the dead child's anomaly monitor recorded NEW regressions
+            # (step-time ramp, SLO burn) before it died: the fault was
+            # building, not transient — double the cool-down once per
+            # batch of fresh alerts (the goodput-adaptive leg of the
+            # backoff, same logic as the wedge-repeat lengthening)
+            delay *= 2.0
+            log_structured(_logger, logging.WARNING,
+                           "supervisor.backoff_lengthened",
+                           run_id=self.run_id, attempt=self.attempt,
+                           reason="anomaly_alerts",
+                           new_alerts=alerts - self._anomaly_seen,
+                           delay_s=round(delay, 3))
+        # track DOWN as well as up: summaries age out of the recent-
+        # alert window, and a stale high watermark would silently eat
+        # the next batch of fresh alerts (healthy-for-an-hour server,
+        # then a real ramp)
+        self._anomaly_seen = alerts
         if exit_code == EXIT_WEDGED:
             if self._wedge_progress == progress:
                 # the SAME point in the run wedged again: the goodput
@@ -415,6 +468,9 @@ class Supervisor:
 
     def _run(self) -> int:
         self._last_progress = self._safe_progress()
+        # baseline, not zero: anomaly summaries a PREVIOUS run left in
+        # the same metrics dir must not double THIS run's first backoff
+        self._anomaly_seen = self._safe_anomaly()
         while True:
             if self._stop_requested:
                 # SIGTERM landed before this (first or next) spawn —
@@ -487,13 +543,21 @@ class Supervisor:
                 return self._finish(rc, "restart budget exhausted")
             delay = self._backoff_delay(rc, progress)
             self.backoffs.append(delay)
+            # the child's own flight recorder dumped on its way out
+            # (watchdog wedge, budget abort) or left its periodically
+            # republished checkpoint (hard kill): the restart record
+            # carries the path, so every exit-75/137 names its own
+            # forensics artifact
+            flight = self._latest_flight_dump()
+            self.flight_dumps.append(flight)
             _metrics.observe("apex_supervisor_backoff_seconds", delay,
                              help="pre-restart backoff delays")
             log_structured(_logger, logging.WARNING,
                            "supervisor.restarting", run_id=self.run_id,
                            attempt=self.attempt, exit_code=rc,
                            delay_s=round(delay, 3), progress=progress,
-                           no_progress_failures=self._streak)
+                           no_progress_failures=self._streak,
+                           flight_dump=flight)
             self._sleep(delay)
             if self._stop_requested:
                 # SIGTERM landed during the backoff sleep: no child to
@@ -508,6 +572,17 @@ class Supervisor:
                          exit_code=str(rc))
             self.restarts += 1
             self.attempt += 1
+
+    def _safe_anomaly(self) -> int:
+        try:
+            return int(self._anomaly_fn())
+        except Exception as e:  # noqa: BLE001 — a broken alert probe
+            # must degrade to "nothing new", not kill the machine
+            log_structured(_logger, logging.WARNING,
+                           "supervisor.anomaly_read_failed",
+                           run_id=self.run_id, attempt=self.attempt,
+                           error=f"{type(e).__name__}: {e}")
+            return self._anomaly_seen
 
     def _safe_progress(self) -> int:
         try:
@@ -566,6 +641,9 @@ def run_supervised_cli(args, argv=None, **overrides) -> int:
     kw = dict(
         checkpoint_dir=getattr(args, "checkpoint", None),
         metrics_dir=getattr(args, "metrics_dir", None),
+        flight_dir=_flightrec.default_dir(
+            metrics_dir=getattr(args, "metrics_dir", None),
+            trace_dir=getattr(args, "trace_dir", None)),
         run_id=getattr(args, "run_id", "run"),
         max_restarts=args.max_restarts,
         crash_loop_threshold=args.crash_loop_threshold,

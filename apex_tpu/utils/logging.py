@@ -57,11 +57,21 @@ def log_structured(logger: logging.Logger, level: int, event: str,
     set a step-correlation context
     (:func:`apex_tpu.observability.set_step_context`), every record
     additionally carries ``(run_id, step)`` so it joins against metrics
-    points and xprof ranges."""
+    points and xprof ranges.  When a flight recorder is installed
+    (:func:`apex_tpu.observability.flightrec.install`), every record is
+    ALSO appended to its bounded event ring — the postmortem dump then
+    holds the last N structured events without any per-call-site
+    wiring."""
     try:
         from apex_tpu.observability.correlation import step_context
 
         fields = {**step_context(), **fields}
+    except ImportError:  # pragma: no cover — torn installs only
+        pass
+    try:
+        from apex_tpu.observability.flightrec import observe_event
+
+        observe_event(event, fields)  # no-op without an installed recorder
     except ImportError:  # pragma: no cover — torn installs only
         pass
     try:

@@ -1128,7 +1128,7 @@ def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
     n_v2 = min(4, max(streams))
     rng = np.random.RandomState(seed + 1)
 
-    def mk_sched(n, extra_pages=0, **dk):
+    def mk_sched(n, extra_pages=0, anomaly=None, **dk):
         per = pages_needed(prompt_len + max_new + dk.get("draft_len", 0),
                            page_size)
         dcfg = DecodeConfig(
@@ -1140,7 +1140,8 @@ def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
             max_batch=n, max_prompt_len=prompt_len,
             temperature=0.0, top_k=0, attn_impl=attn,
             sample_impl="xla" if _SMOKE else "auto", base_seed=seed, **dk)
-        return ContinuousBatchingScheduler(params, cfg, dcfg)
+        return ContinuousBatchingScheduler(params, cfg, dcfg,
+                                           anomaly=anomaly)
 
     def timed_drain(sched):
         t0 = time.perf_counter()
@@ -1211,10 +1212,17 @@ def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
     }
 
     # chunked_prefill: prompts past the padded limit admit as chunks,
-    # two lanes mixed — per-lane TTFT is the SLO evidence
+    # two lanes mixed — per-lane TTFT is the SLO evidence, and an
+    # anomaly monitor scores every TTFT/inter-token sample per lane so
+    # the lane claim carries its ALERT counts, not just percentiles
+    # (zero alerts on a healthy closed-loop run is the expected row)
+    from apex_tpu.observability import AnomalyMonitor
+
+    lane_mon = AnomalyMonitor(min_points=8)
     chunked = mk_sched(n_v2, prefill_chunk=page_size * 2,
                        extra_pages=n_v2 * pages_needed(prompt_len * 2,
-                                                       page_size))
+                                                       page_size),
+                       anomaly=lane_mon)
     for r in range(n_v2):
         plen = prompt_len * 2 if r % 2 == 0 else max(2, prompt_len // 2)
         chunked.submit(Request(
@@ -1228,6 +1236,8 @@ def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
         "chunk_steps": chunked.stats["chunk_steps"],
         "preemptions": chunked.stats["preemptions"],
         "lanes": lane_ttft(done_c),
+        "anomaly_alerts_by_lane": lane_mon.counts_by("lane"),
+        "anomaly_alerts_total": sum(lane_mon.counts().values()),
         "tokens_per_sec": round(
             sum(len(c.tokens) for c in done_c) / max(dt_c, 1e-9), 2),
     }
@@ -1263,6 +1273,37 @@ def _record_section(name, result) -> None:
         _progress(f"section sidecar write failed: {e}")
 
 
+def _section_span(name):
+    """A ``bench.section.<name>`` span when --trace-dir armed the
+    process tracer (no-op singleton otherwise): each section renders as
+    one block in the exported Perfetto timeline, wedges included — the
+    timed-out section is the trace's OPEN span."""
+    try:
+        from apex_tpu.observability.tracing import span
+
+        return span(f"bench.section.{name}")
+    except ImportError:  # pragma: no cover — torn installs only
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def _export_trace(trace_dir):
+    """Write the Perfetto trace (+ spans JSONL) under ``trace_dir``;
+    best-effort, called once at the end of a traced run."""
+    if not trace_dir:
+        return
+    try:
+        from apex_tpu.observability import tracing
+
+        exp = tracing.export_run(trace_dir, "bench")
+        if exp is None:
+            return
+        _progress(f"trace: {exp['chrome']} ({exp['events']} events)")
+    except Exception as e:  # noqa: BLE001 — the trace is evidence, not
+        _progress(f"trace export failed: {e}")  # the bench contract
+
+
 def _try(name, fn, *args, section_budget=600.0, **kw):
     """One failed sub-bench must not zero the whole audited output.
 
@@ -1291,7 +1332,8 @@ def _try(name, fn, *args, section_budget=600.0, **kw):
             monkey = active_monkey()
             if monkey is not None:  # chaos harness: injectable wedge
                 monkey.maybe_wedge(f"bench.{name}")
-            box["r"] = fn(*args, **kw)
+            with _section_span(name):
+                box["r"] = fn(*args, **kw)
         except Exception as e:  # noqa: BLE001 — record and continue
             box["e"] = f"{type(e).__name__}: {e}"
 
@@ -1392,8 +1434,9 @@ def _try_subprocess(name, section_budget=600.0, cmd=None):
                "--child-section", name,
                "--resnet-variant", _RESNET_VARIANT]
     try:
-        proc = subprocess.run(cmd, timeout=budget, capture_output=True,
-                              text=True)
+        with _section_span(name):
+            proc = subprocess.run(cmd, timeout=budget,
+                                  capture_output=True, text=True)
     except subprocess.TimeoutExpired:
         r = {"error": f"timeout after {budget:.0f}s (child killed; "
                       f"later sections still run)"}
@@ -1550,7 +1593,8 @@ def _smoke_main(only=None) -> int:
     for name, fn in sections.items():
         t0 = time.perf_counter()
         try:
-            fn()
+            with _section_span(name):
+                fn()
         except Exception as e:  # noqa: BLE001 — the report IS the product
             report[name] = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
             failures.append(name)
@@ -1728,6 +1772,12 @@ def main():
              "tiny first either way, so the section banks a number even "
              "when the full ResNet-50 compile wedges")
     ap.add_argument(
+        "--trace-dir", default=None,
+        help="emit a Perfetto-loadable Chrome trace of the run "
+             "(bench.section.<name> span per section, wedges show as "
+             "open spans) plus a spans JSONL under this directory "
+             "(apex_tpu.observability.tracing)")
+    ap.add_argument(
         "--smoke", action="store_true",
         help="trace+compile+single-run a small config of EVERY section "
              "on the host platform, no timing — the tier-1 bitrot check "
@@ -1740,9 +1790,16 @@ def main():
     cli = ap.parse_args()
     global _RESNET_VARIANT
     _RESNET_VARIANT = cli.resnet_variant
+    if cli.trace_dir:
+        os.makedirs(cli.trace_dir, exist_ok=True)
+        from apex_tpu.observability import tracing as _tracing
+
+        _tracing.configure()
     if cli.smoke:
-        raise SystemExit(_smoke_main(
-            only=set(cli.smoke_only.split(",")) if cli.smoke_only else None))
+        rc = _smoke_main(
+            only=set(cli.smoke_only.split(",")) if cli.smoke_only else None)
+        _export_trace(cli.trace_dir)
+        raise SystemExit(rc)
     if cli.child_section:
         _child_section_main(cli.child_section)
         return
@@ -1897,6 +1954,7 @@ def main():
             out["device"] = f"unavailable: {e}"
     else:
         out["device"] = "wedged (section timeout)"
+    _export_trace(cli.trace_dir)
     print(json.dumps(out), flush=True)
     if _DEVICE_WEDGED:
         # a hung compile thread blocks the jax client's atexit teardown;

@@ -37,6 +37,7 @@ Runs out of the box on the virtual CPU mesh (synthetic data):
 """
 
 import argparse
+import os
 import sys
 import time
 from pathlib import Path
@@ -135,6 +136,21 @@ def parse_args():
                    help="correlation id stamped on structured logs, "
                         "metrics points, and xprof trace spans (join key "
                         "is (run_id, step))")
+    p.add_argument("--trace-dir", default=None,
+                   help="host-side distributed tracing + crash forensics "
+                        "(apex_tpu.observability.tracing/flightrec): "
+                        "spans wrap the loop's host phases (data wait, "
+                        "step dispatch, telemetry fetch, checkpoint "
+                        "save/restore) — never the compiled step itself "
+                        "(tracing on/off is pinned to identical "
+                        "lowerings and bitwise loss) — and export as "
+                        "trace_<run-id>_<pid>.json (Perfetto/"
+                        "chrome://tracing loadable) plus spans JSONL; a "
+                        "flight recorder ring of recent spans/events/"
+                        "telemetry windows dumps atomically here on "
+                        "watchdog wedge, StepGuard abort, and preemption")
+    p.add_argument("--trace-capacity", type=int, default=4096,
+                   help="finished-span ring size (oldest dropped)")
     p.add_argument("--auto-resume", action="store_true",
                    help="preemption-safe mode (needs --checkpoint): resume "
                         "from the newest VALID checkpoint in the dir if one "
@@ -262,12 +278,39 @@ def main():
     # snapshot) and a goodput accountant attributes checkpoint/restore/
     # restart/wedge wall time across elastic restarts.
     from apex_tpu import observability as obs
-    from apex_tpu.observability import stepstats
+    from apex_tpu.observability import flightrec, stepstats, tracing
+    from apex_tpu.observability.tracing import span
 
     obs.set_step_context(run_id=args.run_id, step=0)
     fetcher = stepstats.AsyncFetcher()
-    telemetry = stepstats.StepTelemetry() if args.metrics_dir else None
+    # telemetry windows drive MORE than the metrics files: the harvest
+    # cadence is also the flight recorder's rolling republish (the
+    # hard-kill dump) and the step-time/throughput anomaly detectors —
+    # so a --trace-dir-only run builds StepStats too
+    telemetry = (stepstats.StepTelemetry()
+                 if (args.metrics_dir or args.trace_dir) else None)
     registry = obs.get_metrics()
+    # Tracing + crash forensics (--trace-dir): a host-side span per loop
+    # phase, exported Perfetto-loadable at exit; the flight recorder
+    # subscribes to the tracer and to every log_structured event, and
+    # dumps on wedge/abort/preemption (the watchdog and StepGuard call
+    # flightrec.dump_active themselves — installing the recorder is the
+    # only wiring the driver owes).  The anomaly monitor watches step
+    # time and window throughput whenever any observability sink is on.
+    tracer = None
+    if args.trace_dir:
+        Path(args.trace_dir).mkdir(parents=True, exist_ok=True)
+        tracer = tracing.configure(capacity=args.trace_capacity)
+    flight_dir = flightrec.default_dir(metrics_dir=args.metrics_dir,
+                                       trace_dir=args.trace_dir)
+    recorder = None
+    if flight_dir is not None:
+        recorder = flightrec.install(
+            flightrec.FlightRecorder(flight_dir, run_id=args.run_id))
+        if tracer is not None:
+            recorder.attach(tracer)
+    anomaly = (obs.AnomalyMonitor()
+               if (args.metrics_dir or args.trace_dir) else None)
     # multi-process: metrics files are per-rank (rank labels alone can't
     # save a last-writer-wins file clobber on a shared FS), and the
     # goodput accountant runs on process 0 ONLY — every rank shares one
@@ -285,6 +328,36 @@ def main():
     metrics_jsonl = (Path(args.metrics_dir) / f"metrics{rank_sfx}.jsonl"
                      if args.metrics_dir else None)
 
+    #: wall time of the previous StepStats harvest — the window-level
+    #: step-time series the anomaly detector watches when tracing is
+    #: off (the harvest follows the ASYNC fetch's completed copy, the
+    #: allowed timing seam; per-dispatch host timing would be the
+    #: APX112 lie)
+    last_window_wall = [time.time(), 0]
+    #: checkpoint-save seconds since the last drain — deducted from the
+    #: window samples below (a 30s save is not step time: scoring it
+    #: would fire a false step-time alert and double the supervisor's
+    #: next backoff on a perfectly healthy run)
+    excluded_wall = [0.0]
+
+    def observe_window_span(at_step):
+        """One anomaly sample per DRAIN batch, not per window: wall
+        time since the previous drain over the steps it covered.  When
+        the host runs ahead, two windows can materialize in a single
+        ``fetcher.ready()`` batch sharing one arrival time — per-window
+        dts would read 2x-actual for the first and ~0 for the second,
+        firing false step-time/throughput alerts."""
+        now, prev_step = time.time(), last_window_wall[1]
+        dt = now - last_window_wall[0] - excluded_wall[0]
+        excluded_wall[0] = 0.0
+        if anomaly is not None and at_step > prev_step > 0 and dt > 0:
+            w_steps = at_step - prev_step
+            anomaly.observe("step_time", dt / w_steps)
+            anomaly.observe(
+                "tokens_per_sec",
+                w_steps * args.global_batch * args.seq / max(dt, 1e-9))
+        last_window_wall[0], last_window_wall[1] = now, at_step
+
     def emit_harvested(kind, at_step, tree):
         """Print/record one harvested async fetch (host numpy values —
         the loop never touches device scalars)."""
@@ -295,7 +368,12 @@ def main():
                   flush=True)
         else:  # a StepStats window
             s = stepstats.StepTelemetry.emit(registry, tree)
-            registry.snapshot_jsonl(metrics_jsonl, window_end_step=at_step)
+            if recorder is not None:
+                recorder.record_stats(at_step, s)
+                recorder.checkpoint()  # republish the rolling recording
+            if metrics_jsonl is not None:
+                registry.snapshot_jsonl(metrics_jsonl,
+                                        window_end_step=at_step)
             if acct is not None:
                 acct.heartbeat()
             print(f"telemetry[{at_step}]: loss_mean={s['loss_mean']:.4f} "
@@ -309,14 +387,27 @@ def main():
         # builder (not a one-shot) so a kernel compile failure can
         # rebuild the step against the tripped fallback registry.
         if args.pp > 1:
-            return make_pp_train_step(config, optimizer, mesh,
-                                      num_microbatches=args.micro_batches,
-                                      loss_scaler=scaler, donate_state=True,
-                                      telemetry=telemetry)
-        return make_train_step(config, optimizer, mesh, loss_scaler=scaler,
-                               donate_state=True, telemetry=telemetry)
+            built = make_pp_train_step(config, optimizer, mesh,
+                                       num_microbatches=args.micro_batches,
+                                       loss_scaler=scaler,
+                                       donate_state=True,
+                                       telemetry=telemetry)
+        else:
+            built = make_train_step(config, optimizer, mesh,
+                                    loss_scaler=scaler,
+                                    donate_state=True, telemetry=telemetry)
+        # dispatch-span wrapper: lives entirely OUTSIDE jit (delegates
+        # lower/attrs), so the compiled program and loss/params are
+        # byte/bitwise identical with tracing on or off — the
+        # TestTracingTrainStep lowered pin + test_tracing parity band
+        return tracing.TracedStep(built, name="train.step.dispatch")
 
     step = build_step()
+    # one marker per (bucket, hop) of the ZeRO sync plan: the trace's
+    # wire-plan track (dispatch-span duration ÷ hop bytes bounds the
+    # achieved per-hop bandwidth); no-op when tracing is off or the
+    # optimizer has no bucket plan
+    tracing.emit_sync_plan(optimizer)
 
     # Corpus: a memmapped token file (--data, the real-pretraining path:
     # the OS pages in only the rows each batch touches) or a synthetic
@@ -505,6 +596,11 @@ def main():
         # goodput: restore (incl. any elastic reshard) is attributable
         # downtime, not productive time
         acct.add_segment("restore", time.time() - t_restore)
+    if tracer is not None and resume_dir and start_step:
+        # retro-emit (both endpoints known): the restore/reshard phase
+        # as its own track in the trace
+        tracer.emit("train.checkpoint_restore", t_restore,
+                    time.time() - t_restore, resumed_step=start_step)
 
     mb_size = args.global_batch  # sampler yields global batches here
 
@@ -563,15 +659,41 @@ def main():
     # step watchdog: a wedged step (hung collective, dead tunnel) gets
     # one structured log, a bounded drain of the async queue, and the
     # distinct exit 75 so a supervisor restarts with backoff
+    def on_wedge(info):
+        """Watchdog pre-exit hook (best-effort, each piece its own
+        job): force the step-time anomaly alert (the wedged dispatch
+        never returns, so no ordinary observation will ever see it),
+        persist the anomaly record + a final metrics snapshot so the
+        counter increment survives the os._exit, and stamp the goodput
+        session wedged.  The watchdog itself dumps the flight recorder
+        right AFTER this hook — so the alert is IN the dump."""
+        for piece in (
+            (lambda: (anomaly.wedge(info.get("elapsed_s"),
+                                    step=info.get("step")),
+                      anomaly.persist(args.metrics_dir or args.trace_dir)))
+                if anomaly is not None else None,
+            (lambda: registry.snapshot_jsonl(metrics_jsonl, wedged=True))
+                if metrics_jsonl is not None else None,
+            (lambda: tracing.export_run(args.trace_dir, args.run_id,
+                                        tracer))
+                if tracer is not None else None,
+            # goodput: stamp the session wedged BEFORE os._exit so the
+            # report can attribute the lost tail per cause
+            (lambda: acct.finalize("wedge")) if acct is not None else None,
+        ):
+            if piece is None:
+                continue
+            try:
+                piece()
+            except Exception:  # noqa: BLE001 — one broken sink must not
+                pass           # rob the others (the watchdog still exits)
+
     watchdog = None
     if args.watchdog_secs is not None:
         watchdog = resilience.StepWatchdog(
             args.watchdog_secs, checkpointer=ckpt, preemption=pre,
             first_deadline_sec=args.watchdog_compile_grace,
-            # goodput: stamp the session wedged BEFORE os._exit so the
-            # report can attribute the lost tail per cause
-            on_wedge=((lambda info: acct.finalize("wedge"))
-                      if acct is not None else None))
+            on_wedge=on_wedge)
         watchdog.start()
     # the controller's on_step drives both from here on
     run_ctl.watchdog = watchdog
@@ -593,10 +715,15 @@ def main():
         return bool(np.max(flags))
 
     def save_at(tree, step_no):
-        if acct is None:
-            return _save_at(tree, step_no)
-        with acct.attribute("checkpoint"):
-            return _save_at(tree, step_no)
+        t0 = time.time()
+        try:
+            with span("train.checkpoint_save", save_step=step_no):
+                if acct is None:
+                    return _save_at(tree, step_no)
+                with acct.attribute("checkpoint"):
+                    return _save_at(tree, step_no)
+        finally:
+            excluded_wall[0] += time.time() - t0
 
     def _save_at(tree, step_no):
         if multiproc:
@@ -706,7 +833,8 @@ def main():
         run_ctl.on_step(i, deadline=(args.watchdog_compile_grace
                                      if i == start_step else None))
         obs.set_step_context(step=i)
-        batch = next(prefetch)
+        with span("train.data_wait"):
+            batch = next(prefetch)
         tokens = jnp.asarray(batch[:, :-1])
         targets = jnp.asarray(batch[:, 1:])
         out = run_step(tokens, targets)
@@ -738,8 +866,14 @@ def main():
             fetcher.put("stats", i + 1, stats._asdict())
             stats = telemetry.init_like(stats)
             window_steps = 0
-        for kind, at_step, tree in fetcher.ready():
-            emit_harvested(kind, at_step, tree)
+        harvested = fetcher.ready()
+        if harvested:
+            with span("train.telemetry_fetch", harvested=len(harvested)):
+                for kind, at_step, tree in harvested:
+                    emit_harvested(kind, at_step, tree)
+                batch_stats = [s for k, s, _ in harvested if k == "stats"]
+                if batch_stats:
+                    observe_window_span(batch_stats[-1])
         if ckpt and (i + 1) % args.save_every == 0:
             save_at(ckpt_tree(params, state, i + 1, scaler_state), i + 1)
             last_saved = i + 1
@@ -760,8 +894,12 @@ def main():
     # flight (blocking is correct here — the run is over)
     if telemetry is not None and stats is not None and window_steps > 0:
         fetcher.put("stats", start_step + done, stats._asdict())
-    for kind, at_step, tree in fetcher.flush():
+    flushed = fetcher.flush()
+    for kind, at_step, tree in flushed:
         emit_harvested(kind, at_step, tree)
+    tail_stats = [s for k, s, _ in flushed if k == "stats"]
+    if tail_stats:
+        observe_window_span(tail_stats[-1])
     if ckpt:
         t_close = time.time()
         ckpt.close()
@@ -788,6 +926,16 @@ def main():
         print("goodput: " + " ".join(
             f"{k}={v:.1%}" for k, v in sorted(report["fractions"].items())),
             flush=True)
+    if anomaly is not None:
+        anomaly.persist(args.metrics_dir or args.trace_dir)
+        counts = anomaly.counts()
+        if counts:
+            print("anomalies: " + " ".join(
+                f"{k}={v}" for k, v in sorted(counts.items())), flush=True)
+    if tracer is not None:
+        exp = tracing.export_run(args.trace_dir, args.run_id, tracer)
+        print(f"trace: {args.trace_dir} ({exp['events']} events, "
+              f"{exp['dropped']} dropped)", flush=True)
     dt = time.time() - t0
     print(f"{done} steps in {dt:.1f}s "
           f"({args.global_batch * args.seq * done / dt:.0f} tokens/s)")

@@ -117,6 +117,17 @@ def build_args():
                         "metrics.prom (apex_tpu.observability)")
     p.add_argument("--run-id", default="serve",
                    help="correlation id on metrics points and trace spans")
+    p.add_argument("--trace-dir", default=None,
+                   help="host-side request tracing + crash forensics: "
+                        "per-request spans (admission wait -> prefill "
+                        "chunks -> decode/verify steps, spec accept "
+                        "counts, split by lane; every span carries the "
+                        "request's trace_id — the same id the TTFT/"
+                        "inter-token histogram exemplars carry, so a "
+                        "p99 outlier joins to its spans) exported as a "
+                        "Perfetto-loadable trace_<run-id>_<pid>.json; "
+                        "a flight recorder ring dumps here on a wedged "
+                        "decode step")
     p.add_argument("--watchdog-secs", type=float, default=None,
                    help="serving step watchdog: a decode step exceeding "
                         "this many seconds (dead tunnel, wedged "
@@ -282,11 +293,30 @@ def main(argv=None):
         ngram_min=args.ngram_min, prefill_chunk=args.prefill_chunk,
         prefix_sharing=args.prefix_sharing,
     )
-    from apex_tpu.observability import get_metrics, set_step_context
+    from apex_tpu.observability import (
+        AnomalyMonitor, get_metrics, set_step_context,
+    )
+    from apex_tpu.observability import flightrec, tracing
     from apex_tpu.resilience import ChaosMonkey, ChaosPlan, StepWatchdog
 
     set_step_context(run_id=args.run_id, step=0)
     registry = get_metrics()  # the scheduler's gauges/histograms land here
+    tracer = None
+    if args.trace_dir:
+        Path(args.trace_dir).mkdir(parents=True, exist_ok=True)
+        tracer = tracing.configure()
+    flight_dir = flightrec.default_dir(metrics_dir=args.metrics_dir,
+                                       trace_dir=args.trace_dir)
+    if flight_dir is not None:
+        rec = flightrec.install(
+            flightrec.FlightRecorder(flight_dir, run_id=args.run_id))
+        if tracer is not None:
+            rec.attach(tracer)
+    # per-lane SLO burn: the scheduler scores every TTFT/inter-token
+    # sample; alert counts ride the report so a lane claim carries its
+    # alert evidence
+    anomaly = (AnomalyMonitor()
+               if (args.metrics_dir or args.trace_dir) else None)
 
     # wedged-decode-step watchdog: heartbeats ride scheduler.step(); a
     # wedge logs the queued/in-flight request ids and exits 75 for the
@@ -304,7 +334,8 @@ def main(argv=None):
             wedge_step_seconds=args.chaos_wedge_secs))
 
     sched = ContinuousBatchingScheduler(params, config, dcfg,
-                                        watchdog=watchdog)
+                                        watchdog=watchdog,
+                                        anomaly=anomaly)
     reqs, arrivals = make_requests(args, rng)
 
     t0 = time.monotonic()
@@ -335,6 +366,14 @@ def main(argv=None):
         registry.snapshot_jsonl(mdir / "metrics.jsonl")
         (mdir / "metrics.prom").write_text(registry.prometheus_text())
         out["metrics_dir"] = str(mdir)
+    if anomaly is not None:
+        anomaly.persist(args.metrics_dir or args.trace_dir)
+        # per-lane alert counts: the SLO-lane evidence column
+        out["anomaly"] = {"counts": anomaly.counts(),
+                          "by_lane": anomaly.counts_by("lane")}
+    if tracer is not None:
+        out["trace_file"] = tracing.export_run(
+            args.trace_dir, args.run_id, tracer)["chrome"]
 
     if args.smoke:
         assert len(completions) == args.requests, (

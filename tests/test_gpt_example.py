@@ -581,3 +581,142 @@ def test_serve_gpt_smoke_contract():
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     assert rec["smoke"] is True and rec["decode_compiles"] == 1
     assert rec["stats"]["evicted"] >= 3
+
+
+def test_forensics_wedge_leaves_correlated_artifacts(tmp_path):
+    """The ISSUE 14 acceptance run: ONE supervised `--zero
+    --auto-resume --trace-dir` invocation under a scripted chaos
+    gauntlet (attempt 0's step wedges -> watchdog rc 75; attempt 1
+    hard-killed rc 137; attempt 2 finishes) leaves the full forensics
+    chain, all correlated by (run_id, step):
+
+    (a) a flight-recorder dump whose `wedged_step` names the wedged
+        step and whose span ring ends at exactly its predecessor (the
+        chaos wedge stalls inside the top-of-iteration hook, so the
+        last completed dispatch is step wedged-1; the stuck-OPEN-span
+        shape of a wedged dispatch is pinned in-process by
+        tests/test_tracing.py::TestDumpTriggers),
+    (b) an `apex_anomaly_step_time_total` increment (the watchdog's
+        forced step-time alert) persisted in the anomaly summary and
+        the metrics JSONL,
+    (c) a Perfetto-loadable Chrome trace carrying the same
+        (run_id, step)-stamped spans,
+    and the supervisor's restart records attach the newest dump path —
+    the hard-kill attempt included (nothing ran at ITS death; the
+    attached artifact is the latest on disk)."""
+    import json
+    import subprocess as sp
+
+    ck, md, td = tmp_path / "ck", tmp_path / "metrics", tmp_path / "trace"
+    script = tmp_path / "faults.json"
+    script.write_text(json.dumps({
+        "0": {"args": ["--watchdog-secs", "10", "--chaos-wedge-step", "3",
+                       "--chaos-wedge-secs", "300"]},
+        "1": {"args": ["--chaos-kill-at-step", "5"]},
+    }))
+    r = sp.run(
+        [sys.executable, str(REPO / "examples/gpt/pretrain_gpt.py"),
+         "--supervise", "--tp", "2", "--zero", "--auto-resume",
+         "--steps", "6", "--save-every", "2", "--checkpoint", str(ck),
+         "--metrics-dir", str(md), "--trace-dir", str(td),
+         "--telemetry-every", "2", "--run-id", "fr1",
+         "--fault-script", str(script), "--max-restarts", "8",
+         "--backoff-base", "0.05", "--backoff-cap", "0.2"],
+        capture_output=True, text=True, timeout=600, env=_env(_devs(4)),
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\n{r.stderr[-3000:]}"
+    assert "watchdog.step_wedged" in r.stderr
+    assert "chaos.host_killed" in r.stderr
+
+    # (a) the flight-recorder dump names the wedged step...
+    from apex_tpu.observability import flightrec
+
+    dumps = sorted(td.glob("flightrec_dump_*.json"))
+    assert len(dumps) == 1, [p.name for p in dumps]
+    dump = flightrec.load_dump(dumps[0])
+    assert dump["reason"] == "wedge"
+    assert dump["run_id"] == "fr1"
+    wedged_step = dump["wedged_step"]
+    assert wedged_step == 3  # the chaos plan's step, by name
+    # the wedge stalls the top-of-iteration hook BEFORE the step
+    # context advances: the dump's correlation and its last completed
+    # dispatch span both sit at exactly wedged_step - 1 — the ring
+    # SHOWS where the run stopped
+    assert dump["step"] == wedged_step - 1
+    dispatch_steps = [s["attrs"].get("step") for s in dump["spans"]
+                      if s["name"] == "train.step.dispatch"]
+    assert dispatch_steps and dispatch_steps[-1] == wedged_step - 1
+    assert all(s["attrs"].get("run_id") == "fr1"
+               for s in dump["spans"])
+    assert any(s["name"] == "train.data_wait" for s in dump["spans"])
+    assert any(e["event"] == "watchdog.step_wedged"
+               for e in dump["events"])
+
+    # (b) the anomaly counter incremented and survived the os._exit
+    # (every attempt persists a pid-suffixed summary at exit; exactly
+    # one — the wedged attempt's — carries the forced wedge alert)
+    summaries = [json.loads(p.read_text())
+                 for p in md.glob("anomaly_*.json")]
+    wedged = [s for s in summaries
+              if any(a.get("wedge") for a in s["alerts"])]
+    assert len(wedged) == 1, [s["counts"] for s in summaries]
+    summary = wedged[0]
+    assert summary["counts"].get("step_time", 0) >= 1
+    assert summary["run_id"] == "fr1"
+    wedge_alerts = [a for a in summary["alerts"] if a.get("wedge")]
+    assert wedge_alerts and wedge_alerts[0]["step"] == wedged_step
+    metrics_pts = [json.loads(l)
+                   for l in (md / "metrics.jsonl").read_text().splitlines()]
+    counter = [p for p in metrics_pts
+               if p["metric"] == "apex_anomaly_step_time_total"]
+    assert counter and counter[-1]["value"] >= 1
+
+    # (c) a Perfetto-loadable trace from the wedged attempt, same join:
+    # its dispatch track also ends at the wedge boundary
+    traces = sorted(td.glob("trace_fr1_*.json"))
+    assert traces, "no trace files exported"
+    boundary_hits = []
+    for p in traces:
+        doc = json.loads(p.read_text())
+        assert doc["schema"] == "apex_tpu_trace_v1"
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(
+            doc["traceEvents"][0])
+        steps = [e["args"]["step"] for e in doc["traceEvents"]
+                 if e["name"] == "train.step.dispatch"
+                 and e["args"].get("run_id") == "fr1"]
+        if steps and max(steps) == wedged_step - 1:
+            boundary_hits.append(p.name)
+    assert boundary_hits, "no trace ends at the wedge boundary"
+
+    # the supervisor attached a dump path to EVERY restart record
+    # (wedge AND hard kill), and the job still reached the target
+    restarting = [l for l in r.stderr.splitlines()
+                  if "supervisor.restarting" in l]
+    assert len(restarting) == 2
+    for line in restarting:
+        assert '"flight_dump": "' in line and "flightrec" in line, line
+    assert "step 6:" in r.stdout or "6 steps" in r.stdout
+
+
+def test_trace_dir_only_run_keeps_the_forensics_loop_alive(tmp_path):
+    """`--trace-dir` WITHOUT `--metrics-dir` still drives the full
+    forensics loop: telemetry windows are harvested (they are the
+    flight recorder's republish cadence and the anomaly detectors'
+    feed, not just the metrics files' source), so the rolling
+    flightrec_<pid>.json — the hard-kill (137) dump — exists, the
+    anomaly summary persists, and the Perfetto trace exports."""
+    import json
+
+    td = tmp_path / "t"
+    out = _run(["--tp", "2", "--steps", "4", "--trace-dir", str(td),
+                "--telemetry-every", "2", "--run-id", "tonly"],
+               extra_env=_devs(4))
+    assert "telemetry[" in out  # windows really harvested
+    rolling = list(td.glob("flightrec_[0-9]*.json"))
+    assert len(rolling) == 1, sorted(p.name for p in td.iterdir())
+    rec = json.loads(rolling[0].read_text())
+    assert rec["schema"] == "apex_tpu_flightrec_v1"
+    assert rec["run_id"] == "tonly"
+    assert any(s["name"] == "train.step.dispatch" for s in rec["spans"])
+    assert list(td.glob("anomaly_*.json"))
+    assert list(td.glob("trace_tonly_*.json"))

@@ -714,3 +714,106 @@ class TestDecodeStep:
         lowc = chunk.lower(*cargs)
         lw.assert_no_host_transfer(lowc)
         lw.assert_donation_covers(lowc, cargs[1], compiled=True)
+
+
+# ------------------------------------------------------------------ tracing
+class TestTracingTrainStep:
+    """ISSUE 14's zero-overhead pins: the ``TracedStep`` dispatch
+    wrapper lives entirely OUTSIDE jit, so a traced step's lowering is
+    byte-identical to the bare step's — same collective counts/dtypes,
+    zero host transfers — with a tracer ACTIVE while lowering (the
+    bitwise loss/params side rides tests/test_tracing.py).  A wrapper
+    change that sneaks host work (a callback, an id tag) into the
+    compiled program fails here."""
+
+    KINDS = ("all_reduce", "reduce_scatter", "all_gather",
+             "collective_permute", "all_to_all")
+
+    def _pair(self, build):
+        """(lowering under an active tracer via TracedStep, bare
+        lowering) for one step builder."""
+        from apex_tpu.observability import tracing
+
+        step, args = build()
+        with tracing.TracingScope():
+            traced = tracing.TracedStep(step, name="train.step.dispatch")
+            low_on = traced.lower(*args)
+        low_off = step.lower(*args)
+        return low_on, low_off
+
+    def _builders(self, devices8):
+        def replicated():
+            params = init_params(CFG, jax.random.PRNGKey(0))
+            opt = FusedAdam(lr=1e-2)
+            state = opt.init(params)
+            sspec = AdamState(step=P(), exp_avg=param_specs(CFG),
+                              exp_avg_sq=param_specs(CFG), master=None)
+            step = make_train_step(CFG, opt, _mesh(devices8),
+                                   donate_state=True,
+                                   opt_state_spec=sspec,
+                                   clip_grad_norm=1.0)
+            tokens, targets = _data()
+            return step, (params, state, tokens, targets)
+
+        def zero_clip():
+            params = init_params(CFG, jax.random.PRNGKey(0))
+            opt = DistributedFusedAdam(lr=1e-2, axis_name="dp",
+                                       bucket_cap_mb=TINY_CAP_MB)
+            state = opt.init(params, world_size=DP)
+            step = make_train_step(CFG, opt, _mesh(devices8),
+                                   donate_state=True, clip_grad_norm=1.0)
+            tokens, targets = _data()
+            return step, (params, state, tokens, targets)
+
+        def hier_int8():
+            params = init_params(CFG, jax.random.PRNGKey(0))
+            opt = DistributedFusedAdam(lr=1e-2, dp_axes=HIER_AXES,
+                                       bucket_cap_mb=TINY_CAP_MB,
+                                       grad_sync_dtype="int8")
+            state = opt.init(params, world_size=4,
+                             axis_sizes={"dp_out": 2, "dp_in": 2,
+                                         "tp": 1})
+            step = make_train_step(CFG, opt, _hier_mesh(devices8),
+                                   dp_axis=HIER_AXES, donate_state=True)
+            rng = np.random.RandomState(0)
+            tokens = jnp.asarray(rng.randint(0, CFG.vocab_size,
+                                             size=(4, 16)))
+            return step, (params, state, tokens,
+                          jnp.roll(tokens, -1, axis=1))
+
+        return {"replicated": replicated, "zero_clip": zero_clip,
+                "hier_int8": hier_int8}
+
+    @pytest.mark.parametrize("variant",
+                             ["replicated", "zero_clip", "hier_int8"])
+    def test_lowering_is_byte_identical(self, devices8, variant):
+        low_on, low_off = self._pair(self._builders(devices8)[variant])
+        assert low_on.as_text() == low_off.as_text()
+
+    @pytest.mark.parametrize("variant",
+                             ["replicated", "zero_clip", "hier_int8"])
+    def test_same_collective_counts_zero_host_transfers(self, devices8,
+                                                        variant):
+        low_on, low_off = self._pair(self._builders(devices8)[variant])
+        on, off = low_on.as_text(), low_off.as_text()
+        for kind in self.KINDS:
+            n_on = lw.count_collectives(on, kind, minimum=0)
+            assert n_on == lw.count_collectives(off, kind, minimum=0), (
+                f"tracing changed {kind} count")
+        lw.assert_no_host_transfer(low_on)
+
+    def test_wire_dtype_survives_the_wrapper(self, devices8):
+        """The int8 two-hop wire is untouched by tracing: per bucket,
+        one i8 reduce-scatter on each hop under the traced lowering."""
+        from apex_tpu.observability import tracing
+
+        build = self._builders(devices8)["hier_int8"]
+        step, args = build()
+        with tracing.TracingScope():
+            low = tracing.TracedStep(step).lower(*args)
+        mesh = _hier_mesh(devices8)
+        txt = low.as_text()
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_in",),
+                                  mesh, minimum=1, dtype="i8")
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_out",),
+                                  mesh, minimum=1, dtype="i8")

@@ -35,7 +35,9 @@ from apex_tpu.analysis.rules_collectives import (
     UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
-from apex_tpu.analysis.rules_host_sync import BlockingHostSyncInStepLoop
+from apex_tpu.analysis.rules_host_sync import (
+    BlockingHostSyncInStepLoop, UnseamedDispatchTiming,
+)
 from apex_tpu.analysis.rules_inference import KvPoolScatterBypassesSeam
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_resilience import (
@@ -2454,6 +2456,174 @@ class TestBlockingHostSyncInStepLoop:
                     print(float(p))
             """, tmp_path, DEFAULT_RULES)
         assert "APX108" in rule_ids(got)
+
+
+# ------------------------------------ APX112 unseamed dispatch timing
+class TestUnseamedDispatchTiming:
+    """APX112: a wall-clock delta spanning a proven step dispatch with
+    no block_until_ready/host-read/async-fetch seam — async dispatch
+    makes such timings enqueue measurements, not step times."""
+
+    def test_positive_delta_around_dispatch(self, tmp_path):
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: p)
+            def bench(p):
+                t0 = time.perf_counter()
+                p = step(p)
+                dt = time.perf_counter() - t0
+                return dt
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert rule_ids(got) == ["APX112"]
+        assert "enqueue" in got[0].message
+
+    def test_positive_two_stamp_spelling_and_from_import(self, tmp_path):
+        """t1 = perf_counter(); dt = t1 - t0 — the second stamp, not
+        the subtraction, is the read that lies."""
+        got = run("""
+            from time import perf_counter
+            from apex_tpu.models.gpt import make_train_step
+            step = make_train_step(1, 2, 3)
+            def bench(p, s, t, y):
+                t0 = perf_counter()
+                p, s, loss = step(p, s, t, y)
+                t1 = perf_counter()
+                print(float(loss))  # AFTER t1: does not unlie it
+                dt = t1 - t0
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert rule_ids(got) == ["APX112"]
+
+    def test_positive_dispatch_loop_between_stamps(self, tmp_path):
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: p)
+            def bench(p, iters):
+                t0 = time.time()
+                for _ in range(iters):
+                    p = step(p)
+                dt = time.time() - t0
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert rule_ids(got) == ["APX112"]
+
+    def test_positive_warmup_seam_does_not_acquit_timed_loop(self,
+                                                            tmp_path):
+        """A seam after the WARMUP dispatch must not acquit the timed
+        loop's own (later, unseamed) dispatches."""
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: p)
+            def bench(p, iters):
+                t0 = time.perf_counter()
+                p = step(p)                 # warmup
+                jax.block_until_ready(p)    # seam covers ONLY warmup
+                for _ in range(iters):
+                    p = step(p)             # the timed dispatches
+                dt = time.perf_counter() - t0
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert rule_ids(got) == ["APX112"]
+
+    def test_negative_rebound_stamp_is_data_not_timing(self, tmp_path):
+        """Reusing a stamp name for NON-clock data invalidates the
+        stamp: the later delta is arithmetic, not a dispatch timing —
+        flagging it would turn the gate red on clean code."""
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: p)
+            def bench(p, offsets):
+                t0 = time.time()
+                p = step(p)
+                jax.block_until_ready(p)
+                warm = time.time() - t0     # properly seamed
+                t0 = offsets[0]             # name reused for DATA
+                p = step(p)
+                shifted = time.time() - t0  # data math, not timing
+                return warm, shifted
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert got == []
+
+    def test_negative_block_until_ready_seam(self, tmp_path):
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: p)
+            def bench(p, iters):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    p = step(p)
+                jax.block_until_ready(p)
+                dt = time.perf_counter() - t0
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert got == []
+
+    def test_negative_host_read_and_local_seam_wrapper(self, tmp_path):
+        """float(loss) is a sync; so is calling a local def that wraps
+        block_until_ready (the bench.py `block(tree)` idiom)."""
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: (p, p.sum()))
+
+            def block(tree):
+                for x in jax.tree.leaves(tree):
+                    jax.block_until_ready(x)
+
+            def bench(p, iters):
+                t0 = time.perf_counter()
+                p, loss = step(p)
+                host = float(loss)
+                dt1 = time.perf_counter() - t0
+                t2 = time.perf_counter()
+                p, loss = step(p)
+                block(loss)
+                dt2 = time.perf_counter() - t2
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert got == []
+
+    def test_negative_no_dispatch_between_stamps(self, tmp_path):
+        """Deltas around host work, or taken before the dispatch, and
+        unproven callees between stamps are all trusted."""
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: p)
+            def bench(p, mystery):
+                t0 = time.time()
+                q = mystery(p)
+                setup = time.time() - t0
+                p = step(p)
+                t1 = time.time()
+                host_only = sum(range(100))
+                dt = time.time() - t1
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert got == []
+
+    def test_negative_nonclock_subtraction_names(self, tmp_path):
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: p)
+            def bench(p, a, b):
+                t0 = a  # not a clock read
+                p = step(p)
+                dt = b - t0
+            """, tmp_path, [UnseamedDispatchTiming()])
+        assert got == []
+
+    def test_rides_default_rules(self, tmp_path):
+        got = run("""
+            import time
+            import jax
+            step = jax.jit(lambda p: p)
+            def bench(p):
+                t0 = time.time()
+                p = step(p)
+                return time.time() - t0
+            """, tmp_path, DEFAULT_RULES)
+        assert "APX112" in rule_ids(got)
 
 
 # ------------------------------------------------- the repo-wide rider
