@@ -1,0 +1,43 @@
+# CI surface for apex_tpu — `make ci` is what .github/workflows/ci.yml
+# runs, and what a laptop runs before pushing.  Three gates:
+#
+#   make test       tier-1 (quick) pytest suite on the 8-virtual-device
+#                   CPU platform — ROADMAP.md's canonical invocation
+#   make analyze    the static analyzer, ONE scan doing both jobs:
+#                   writes the SARIF document for code scanning
+#                   (analysis.sarif — written before the exit code, so
+#                   the upload has content exactly when there ARE
+#                   findings) and fails on findings or stale
+#                   suppressions (--check-baseline), with the
+#                   human-readable rule-id summary on stderr
+#   make bench-gate the perf-regression gate: benchmarks/bench_compare.py
+#                   diffs the two newest BENCH_*.json rounds' headline
+#                   columns (no-op when fewer than two rounds exist —
+#                   chip benches don't run in CPU CI)
+#
+# See docs/static_analysis.md for analyzer details and the baseline
+# contract.
+
+PYTHON ?= python
+JOBS   ?= 1
+
+.PHONY: ci test analyze bench-gate
+
+ci: analyze test bench-gate
+
+test:
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+analyze:
+	$(PYTHON) -m apex_tpu.analysis apex_tpu bench.py \
+	  --format sarif --check-baseline --jobs $(JOBS) > analysis.sarif
+
+bench-gate:
+	@n=$$(ls BENCH_r*.json 2>/dev/null | wc -l); \
+	if [ "$$n" -lt 2 ]; then \
+	  echo "bench-gate: $$n BENCH_r*.json round(s) found — need two, skipping"; \
+	else \
+	  $(PYTHON) benchmarks/bench_compare.py; \
+	fi

@@ -25,6 +25,14 @@ machine-checked invariants):
   a registered-axis collective reachable only from ``jit``/``pjit``
   (no axis bound), or under a ``shard_map`` nest that binds only OTHER
   axes.
+- **APX206/207/208** sharding-annotation consistency
+  (``rules_sharding`` — the GSPMD tier): a ``PartitionSpec`` axis no
+  reaching mesh binds (a ``with_sharding_constraint`` from a STALE
+  mesh object compiles and silently replicates; a typo'd axis against
+  the annotation's own mesh raises only when the TPU-gated builder
+  first runs — on the chip), a spec provably longer than the annotated
+  array's rank, and a donated jit argument whose in/out shardings can
+  never alias (XLA drops the donation with only a UserWarning).
 - **APX301/302** Mosaic dtype-dependent tiling contracts for Pallas
   block shapes (``rules_tiling``) — the ``_ceil_block(..., 8)``-on-bf16
   class.
@@ -90,6 +98,10 @@ from apex_tpu.analysis.rules_collectives import (
     UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
+from apex_tpu.analysis.rules_sharding import (
+    DonatedShardingMismatch, ShardingSpecAxisUnbound,
+    ShardingSpecRankMismatch,
+)
 from apex_tpu.analysis.rules_host_sync import (
     BlockingHostSyncInStepLoop, UnseamedDispatchTiming,
 )
@@ -131,6 +143,9 @@ def default_rules(vmem_budget_bytes=None):
         CollectiveAxisUnboundUnderJit(),
         CollectiveAxisOutsideShardMapNest(),
         CollectiveTupleAxisUnbound(),
+        ShardingSpecAxisUnbound(),
+        ShardingSpecRankMismatch(),
+        DonatedShardingMismatch(),
         BlockShapeTilingViolation(),
         BlockSpecIndexMapArity(),
         HardCodedSublaneAlignment(),

@@ -65,8 +65,24 @@ def main(argv=None) -> int:
                          "justification of 'TODO' that the loader "
                          "REJECTS — the refresh is mechanical, the "
                          "review is not skippable")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="additionally FAIL (exit 1) when any baseline "
+                         "entry no longer suppresses a finding — stale "
+                         "suppressions rot silently otherwise (an entry "
+                         "whose code was fixed keeps matching the next "
+                         "unrelated finding that drifts into its "
+                         "substring)")
     ap.add_argument("--format", choices=("text", "json", "sarif"),
                     default="text")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parallelize the per-file parse/index pass "
+                         "across N worker processes (module linking and "
+                         "rule checks stay single-pass; results are "
+                         "identical to --jobs 1)")
+    ap.add_argument("--timing", action="store_true",
+                    help="print per-rule wall time (plus the shared "
+                         "<load>/<link> phases) to stderr, slowest "
+                         "first")
     ap.add_argument("--axes", default=None,
                     help="comma-separated collective-axis registry "
                          "override (default: *_AXIS constants parsed "
@@ -93,7 +109,13 @@ def main(argv=None) -> int:
     rules = default_rules(
         vmem_budget_bytes=None if args.vmem_budget_mib is None
         else int(args.vmem_budget_mib * 2 ** 20))
-    findings = analyze_paths(paths, rules, registry)
+    timings = {} if args.timing else None
+    findings = analyze_paths(paths, rules, registry, jobs=args.jobs,
+                             timings=timings)
+    if timings is not None:
+        for name, secs in sorted(timings.items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"timing: {name:10s} {secs:8.3f}s", file=sys.stderr)
 
     entries = []
     baseline_path = args.baseline or _find_default_baseline(paths)
@@ -122,6 +144,20 @@ def main(argv=None) -> int:
 
     if args.format == "sarif":
         print(json.dumps(sarif.render(kept, suppressed, rules), indent=2))
+        if kept:
+            # the red-CI-log summary: the SARIF document is for the
+            # editor/code-scanning upload, not for the human reading
+            # the failed job — name the damage on stderr too
+            by_rule: dict = {}
+            for f in kept:
+                by_rule.setdefault(f.rule, 0)
+                by_rule[f.rule] += 1
+            rules_s = ", ".join(f"{r} x{n}" if n > 1 else r
+                                for r, n in sorted(by_rule.items()))
+            print(f"{len(kept)} finding(s) [{rules_s}], "
+                  f"{len(suppressed)} baselined, {len(stale)} stale "
+                  f"baseline entr(ies) — full detail in the SARIF "
+                  f"document above", file=sys.stderr)
     elif args.format == "json":
         print(json.dumps({
             "findings": [f.to_json() for f in kept],
@@ -140,6 +176,13 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         print(f"{len(kept)} finding(s), {len(suppressed)} baselined, "
               f"{len(stale)} stale baseline entr(ies)", file=sys.stderr)
+    if args.check_baseline and stale:
+        for e in stale:
+            print(f"error: stale baseline entry ({e.rule} {e.path} "
+                  f"{e.symbol}) suppresses nothing — the code it "
+                  f"covered was fixed; remove the entry "
+                  f"(--check-baseline)", file=sys.stderr)
+        return 1
     return 1 if kept else 0
 
 

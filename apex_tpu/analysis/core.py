@@ -549,16 +549,59 @@ def _link_cross_module(ctxs: Dict[str, Optional["ModuleContext"]]) -> None:
                     changed = True
 
 
+def _load_module_task(args):
+    """Process-pool worker: parse one file AND build its traced index
+    and axis-scope index (the per-file fixpoints are the expensive
+    half of a scan) so ``--jobs N`` parallelizes real work, not just
+    ``ast.parse``.  Top-level so it pickles under the spawn start
+    method."""
+    path, display, registry, module_name, is_package = args
+    loaded = _load_module(path, display, set(registry),
+                          module_name=module_name, is_package=is_package)
+    if not isinstance(loaded, Finding):
+        from apex_tpu.analysis import dataflow
+
+        dataflow.scope_index(loaded)
+    return loaded
+
+
+def _load_all(tasks, jobs: int):
+    """The per-file parse/index pass, serial or process-parallel.  The
+    parallel path degrades to serial on ANY pool failure (a module
+    whose AST defeats pickling, a sandbox without multiprocessing) —
+    ``--jobs`` may never change results, only wall time."""
+    if jobs <= 1 or len(tasks) <= 1:
+        return [_load_module_task(t) for t in tasks]
+    try:
+        from concurrent.futures import ProcessPoolExecutor
+
+        with ProcessPoolExecutor(max_workers=jobs) as pool:
+            return list(pool.map(_load_module_task, tasks))
+    except Exception:
+        return [_load_module_task(t) for t in tasks]
+
+
 def analyze_paths(paths: Iterable[str], rules: Iterable[Rule],
                   axis_registry: Optional[Set[str]] = None,
-                  rel_to: Optional[str] = None) -> List[Finding]:
+                  rel_to: Optional[str] = None, jobs: int = 1,
+                  timings: Optional[Dict[str, float]] = None
+                  ) -> List[Finding]:
     """Run every rule over every ``*.py`` under ``paths``; findings are
     sorted by (path, line, rule) for stable output and baselines.
 
     Unlike :func:`analyze_file`, this multi-file entry point links the
     per-module traced indexes across modules first (import-resolved
     call-graph reachability), so trace-time hazards in helpers reached
-    only from another module's jitted code are still flagged."""
+    only from another module's jitted code are still flagged.
+
+    ``jobs``: parallelize the per-file parse + index build across N
+    worker processes (the module linking and rule checks stay
+    single-pass in this process — they need the full module set).
+    ``timings``: pass a dict to collect per-rule wall seconds (the
+    CLI's ``--timing``); keys are rule ids plus ``"<load>"`` and
+    ``"<link>"`` for the two shared phases."""
+    import time as _time
+
     paths = list(paths)
     registry = axis_registry if axis_registry is not None \
         else discover_axis_registry(paths)
@@ -566,32 +609,44 @@ def analyze_paths(paths: Iterable[str], rules: Iterable[Rule],
     findings: List[Finding] = []
     ctxs: Dict[str, Optional[ModuleContext]] = {}
     ordered: List[ModuleContext] = []
+    tasks = []
     for root in paths:
         for f in _find_files([root]):
             display = os.path.relpath(f, rel_to) if rel_to else f
-            loaded = _load_module(
-                f, display, registry, module_name=_module_name_for(f, root),
-                is_package=os.path.basename(f) == "__init__.py")
-            if isinstance(loaded, Finding):
-                findings.append(loaded)
-                continue
-            if loaded.module_name in ctxs:
-                # two scanned files claim one dotted name (e.g. utils.py
-                # under two bare roots): linking through the name would
-                # plant seeds in whichever file happened to win — mark
-                # ambiguous and never link through it
-                ctxs[loaded.module_name] = None
-            else:
-                ctxs[loaded.module_name] = loaded
-            ordered.append(loaded)
+            tasks.append((f, display, tuple(sorted(registry)),
+                          _module_name_for(f, root),
+                          os.path.basename(f) == "__init__.py"))
+    t0 = _time.monotonic()
+    for loaded in _load_all(tasks, jobs):
+        if isinstance(loaded, Finding):
+            findings.append(loaded)
+            continue
+        if loaded.module_name in ctxs:
+            # two scanned files claim one dotted name (e.g. utils.py
+            # under two bare roots): linking through the name would
+            # plant seeds in whichever file happened to win — mark
+            # ambiguous and never link through it
+            ctxs[loaded.module_name] = None
+        else:
+            ctxs[loaded.module_name] = loaded
+        ordered.append(loaded)
+    if timings is not None:
+        timings["<load>"] = _time.monotonic() - t0
+    t0 = _time.monotonic()
     _link_cross_module(ctxs)
     # the axis-scope dataflow runs its own cross-module fixpoint so the
     # collective rules see shard_map wrappers that live in other files
     # (imported here, not at module top: dataflow imports core)
     from apex_tpu.analysis import dataflow
     dataflow.link_axis_scopes(ctxs)
-    for ctx in ordered:
-        for rule in rules:
+    if timings is not None:
+        timings["<link>"] = _time.monotonic() - t0
+    for rule in rules:
+        t0 = _time.monotonic()
+        for ctx in ordered:
             findings.extend(rule.check(ctx))
+        if timings is not None:
+            timings[rule.rule_id] = timings.get(rule.rule_id, 0.0) \
+                + _time.monotonic() - t0
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings

@@ -62,11 +62,24 @@ class Scope:
     additional axes *may* be bound (a dynamic mesh / non-literal
     axis_name somewhere in the nest) — rules must stay quiet.
     ``shard_map``: a shard_map/pmap/xmap participates in the nest (the
-    APX203-vs-204 discriminator)."""
+    APX203-vs-204 discriminator).
+
+    ``mesh_axes``/``mesh_unknown``: the GSPMD half (the sharding tier,
+    APX206): the axis names of the mesh the enclosing ``jit``'s
+    ``in_shardings=``/``out_shardings=`` annotations are built on, when
+    every ``NamedSharding`` there resolves to a static mesh — the
+    "reaching mesh" a ``with_sharding_constraint`` inside the traced
+    function must agree with.  ``None`` = no mesh information on this
+    path (an unannotated jit); ``mesh_unknown`` = some annotation's
+    mesh could not be read statically — rules must stay quiet.  jit
+    still binds NO collective axes (``axes`` stays empty): mesh_axes
+    name what XLA *shards over*, not what ``lax.psum`` may name."""
 
     axes: FrozenSet[str] = frozenset()
     unknown: bool = False
     shard_map: bool = False
+    mesh_axes: Optional[FrozenSet[str]] = None
+    mesh_unknown: bool = False
 
     def binds(self, axis: str) -> bool:
         return axis in self.axes or self.unknown
@@ -227,6 +240,38 @@ class AxisScopeIndex:
         cur |= scopes
         return len(cur) != before
 
+    def _jit_mesh(self, call: Optional[ast.Call]
+                  ) -> Tuple[Optional[FrozenSet[str]], bool]:
+        """``(mesh_axes, unknown)`` of one jit call's sharding
+        annotations: the union of the axis names of every
+        ``NamedSharding(mesh, ...)`` mesh in its ``in_shardings=``/
+        ``out_shardings=`` kwargs, resolved through the local value
+        aliases.  ``(None, False)`` when the call carries no sharding
+        annotations at all (an unannotated jit has no mesh opinion);
+        ``unknown`` when some annotation's mesh is out of static
+        reach."""
+        if call is None:
+            return None, False
+        axes: Set[str] = set()
+        saw = False
+        unknown = False
+        for kw in call.keywords:
+            if kw.arg not in ("in_shardings", "out_shardings"):
+                continue
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Call) \
+                        and last_name(sub.func) == "NamedSharding":
+                    saw = True
+                    mesh = sub.args[0] if sub.args else _kwarg(sub, "mesh")
+                    m = _mesh_axes(mesh, self._value_aliases)
+                    if m is None:
+                        unknown = True
+                    else:
+                        axes |= m
+        if not saw:
+            return None, False
+        return frozenset(axes), unknown
+
     def _extend(self, entry: str, call: ast.Call,
                 base: Optional[Set[Scope]]) -> Set[Scope]:
         """Scopes the function-valued arguments of this entry call run
@@ -236,7 +281,13 @@ class AxisScopeIndex:
         a neutral combinator (scan/pallas_call/grad/...) an UNKNOWN
         context (its caller is outside this pass's reach)."""
         if entry in _JIT_ROOTS:
-            return set(base) if base else {Scope()}
+            maxes, munk = self._jit_mesh(call)
+            srcs = base or {Scope()}
+            if maxes is None and not munk:
+                return set(srcs)
+            # the innermost annotated jit's mesh wins over an outer one
+            return {dataclasses.replace(s, mesh_axes=maxes,
+                                        mesh_unknown=munk) for s in srcs}
         binding = None
         smap = False
         if entry in _BINDING_ROOTS:
@@ -247,8 +298,12 @@ class AxisScopeIndex:
         if binding is not None:
             axes, unk = binding
             srcs = base or {Scope()}
-            return {Scope(s.axes | axes, s.unknown or unk,
-                          s.shard_map or smap) for s in srcs}
+            # replace, not positional rebuild: the mesh_axes half must
+            # survive a vmap(axis_name=...) nested under an annotated
+            # jit, or APX206 goes quiet on that path
+            return {dataclasses.replace(
+                s, axes=s.axes | axes, unknown=s.unknown or unk,
+                shard_map=s.shard_map or smap) for s in srcs}
         return set(base) if base else {Scope(unknown=True)}
 
     def _base(self, node: ast.AST) -> Optional[Set[Scope]]:
@@ -273,7 +328,9 @@ class AxisScopeIndex:
                         and inner_call.args:
                     name = last_name(inner_call.args[0])
                 if name in _JIT_ROOTS:
-                    self._add(qn, {Scope()})
+                    maxes, munk = self._jit_mesh(inner_call)
+                    self._add(qn, {Scope(mesh_axes=maxes,
+                                         mesh_unknown=munk)})
                 elif name in _BINDING_ROOTS:
                     axes, unk = _binding_axes(
                         name, inner_call or ast.Call(
@@ -507,6 +564,94 @@ def link_axis_scopes(ctxs: Dict[str, Optional[ModuleContext]]) -> None:
                     continue
                 if scope_index(target).mark_external(attr, set(ss)):
                     changed = True
+
+
+# ------------------------------------------------------- sharding literals
+def value_aliases(ctx: ModuleContext) -> Dict[str, ast.AST]:
+    """The module's last-wins single-target value-alias map (``mesh =
+    Mesh(...)``), shared with the axis-scope index — the one alias
+    resolution the sharding rules and the scope pass must agree on."""
+    return scope_index(ctx)._value_aliases
+
+
+def mesh_axes_of(node: Optional[ast.AST],
+                 aliases: Dict[str, ast.AST]) -> Optional[FrozenSet[str]]:
+    """Public face of :func:`_mesh_axes`: the full axis-name set of a
+    mesh expression (a ``Mesh``/``AbstractMesh``/``make_mesh`` call, or
+    a Name assigned one), or None when it cannot be read statically."""
+    return _mesh_axes(node, aliases)
+
+
+def resolve_spec(node: Optional[ast.AST],
+                 aliases: Dict[str, ast.AST]) -> Optional[ast.Call]:
+    """The ``P(...)``/``PartitionSpec(...)`` call a spec expression
+    denotes: the call itself, or a Name resolved through one
+    last-wins alias hop; None for anything else (a computed spec tree,
+    a parameter — trusted, same contract as the dtype lattice)."""
+    if isinstance(node, ast.Name):
+        node = aliases.get(node.id)
+    if isinstance(node, ast.Call) \
+            and last_name(node.func) in ("P", "PartitionSpec"):
+        return node
+    return None
+
+
+def spec_axis_literals(spec: ast.Call) -> List[Tuple[ast.AST, str]]:
+    """(node, axis-name) per string literal in one P(...) call's
+    positional entries — handles ``P("dp")``, ``P(None, "tp")`` and
+    the tuple entry ``P(("dp_out", "dp_in"))``."""
+    out: List[Tuple[ast.AST, str]] = []
+    for arg in spec.args:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                out.append((sub, sub.value))
+    return out
+
+
+def spec_rank(spec: ast.Call) -> int:
+    """Number of array dimensions one P(...) call constrains — its
+    positional-entry count (each entry maps to one dim, None/str/tuple
+    alike)."""
+    return len(spec.args)
+
+
+#: array-creating callables whose shape argument position we know
+_CREATION_SHAPE_ARG = {
+    "zeros": 0, "ones": 0, "empty": 0, "full": 0,
+    "normal": 1, "uniform": 1, "truncated_normal": 1,
+}
+
+
+def creation_rank(node: Optional[ast.AST],
+                  aliases: Dict[str, ast.AST]) -> Optional[int]:
+    """The rank of an array expression, when it is (or aliases to, one
+    hop) a creation call with a LITERAL shape tuple — ``jnp.zeros((8,
+    128))``, ``jax.random.normal(key, (4, 4))``.  None otherwise: the
+    annotated value's rank is out of static reach and APX207 must stay
+    quiet."""
+    if isinstance(node, ast.Name):
+        node = aliases.get(node.id)
+    if not isinstance(node, ast.Call):
+        return None
+    name = last_name(node.func)
+    pos = _CREATION_SHAPE_ARG.get(name)
+    if pos is None:
+        return None
+    shape = node.args[pos] if len(node.args) > pos else _kwarg(node, "shape")
+    if isinstance(shape, ast.Constant) and isinstance(shape.value, int):
+        # scalar shapes are rank 1 for the zeros/ones family ONLY: the
+        # position-1 names collide with numpy's random signatures
+        # (np.random.normal(loc, SCALE, size) puts the scale where
+        # jax.random.normal puts the shape), and claiming rank 1 there
+        # is a confirmed false positive — tuple shapes disambiguate
+        return 1 if pos == 0 else None
+    dims = literal_dims(shape, aliases)
+    if dims is not None:
+        return len(dims)
+    # a tuple shape whose dims are dynamic still has a static RANK
+    if isinstance(shape, (ast.Tuple, ast.List)):
+        return len(shape.elts)
+    return None
 
 
 # ------------------------------------------------------------ dtype lattice

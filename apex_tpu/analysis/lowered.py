@@ -44,6 +44,8 @@ __all__ = [
     "assert_no_recompile", "assert_no_whole_tree_concat",
     "assert_donation_covers", "donated_buffer_count",
     "host_transfer_sites",
+    "arg_shardings", "sharding_of", "assert_sharding",
+    "spmd_collective_sites", "assert_spmd_collectives",
 ]
 
 #: collective ops that carry a reduction REGION in StableHLO — their
@@ -365,6 +367,243 @@ def assert_no_recompile(fn, calls: Sequence = (), *,
         + ("the function was never called" if n == 0 else
            "shape-polymorphic retraces happened before this check"))
     return results
+
+
+# ---------------------------------------------------------- GSPMD tier
+# Checkers for the jit+NamedSharding step path (``make_train_step(
+# spmd="auto")``): the LOWERING carries the program's sharding INTENT
+# as ``mhlo.sharding`` attributes on the entry arguments, and the
+# COMPILED module carries the collectives XLA's SPMD partitioner
+# actually placed (the lowering of a GSPMD program has none — they
+# only exist after partitioning).
+
+def arg_shardings(artifact) -> List[dict]:
+    """Per flattened entry argument of the lowering's ``@main``, in
+    order: ``{"type": "8x16xf32", "sharding": str|None}`` — the MLIR
+    tensor type and the ``mhlo.sharding`` HloSharding string (None for
+    an unannotated argument)."""
+    txt = hlo_text(artifact)
+    m = re.search(r'func\.func\s+(?:public\s+)?@main\((.*?)\)\s*->', txt,
+                  re.S)
+    if m is None:
+        raise ValueError("no @main function signature in the lowering "
+                         "text — not a jax lowering artifact?")
+    out = []
+    # one %argN per entry: `%arg0: tensor<8x16xf32> {attrs...}`
+    for am in re.finditer(
+            r'%arg\d+:\s*tensor<([^>]*)>\s*(\{.*?\})?(?:,|$)',
+            m.group(1), re.S):
+        attrs = am.group(2) or ""
+        sm = re.search(r'mhlo\.sharding\s*=\s*"([^"]*)"', attrs)
+        out.append({"type": am.group(1),
+                    "sharding": sm.group(1) if sm else None})
+    return out
+
+
+def _flat_arg_index(lowered, argpath) -> int:
+    """Flattened entry-argument index of ``argpath``: an int passes
+    through; a sequence of pytree keys (leading element = positional
+    argnum) resolves through the lowering's ``in_tree`` — e.g.
+    ``(0, "layers", "wq")`` for ``params["layers"]["wq"]`` of
+    ``step.lower(params, ...)``.  The path must land on ONE leaf."""
+    if isinstance(argpath, int):
+        return argpath
+    import jax.tree_util as jtu
+
+    tree = lowered.in_tree
+    args, _kwargs = jtu.tree_unflatten(tree, list(range(tree.num_leaves)))
+    node = args
+    for key in argpath:
+        node = node[key]
+    leaves = jtu.tree_leaves(node)
+    if len(leaves) != 1:
+        raise ValueError(
+            f"argpath {argpath!r} names a subtree of {len(leaves)} "
+            f"leaves — point it at one array (add the remaining keys)")
+    return leaves[0]
+
+
+def _aligned_sites(lowered) -> List[dict]:
+    """:func:`arg_shardings` with the leaf alignment VERIFIED: the
+    tensor-entry count must equal the lowering's pytree leaf count, or
+    the flat-index mapping would silently read a neighboring
+    argument's sharding (non-tensor entries — ``!stablehlo.token``
+    from ordered effects — are skipped by the parser, which keeps
+    alignment on current jax; this check makes any future drift loud
+    instead of wrong)."""
+    sites = arg_shardings(lowered)
+    tree = getattr(lowered, "in_tree", None)
+    if tree is not None and len(sites) != tree.num_leaves:
+        raise ValueError(
+            f"lowering has {len(sites)} tensor entry argument(s) but "
+            f"the call's pytree has {tree.num_leaves} leaves — the "
+            f"@main signature carries arguments this parser cannot "
+            f"align (a token/effect arg lowered as a tensor?); the "
+            f"argpath -> argument mapping would be unreliable")
+    return sites
+
+
+def sharding_of(lowered, argpath) -> Optional[str]:
+    """The ``mhlo.sharding`` string the lowering records for one entry
+    argument (see :func:`_flat_arg_index` for ``argpath``), or None
+    when the argument carries no annotation."""
+    sites = _aligned_sites(lowered)
+    i = _flat_arg_index(lowered, argpath)
+    if not 0 <= i < len(sites):
+        raise IndexError(f"flat arg index {i} out of range "
+                         f"({len(sites)} entry arguments)")
+    return sites[i]["sharding"]
+
+
+#: MLIR element type -> jnp dtype name, for re-lowering an argument's
+#: aval when computing the EXPECTED sharding attribute
+_MLIR_DTYPES = {
+    "f64": "float64", "f32": "float32", "f16": "float16",
+    "bf16": "bfloat16", "i64": "int64", "i32": "int32", "i16": "int16",
+    "i8": "int8", "ui8": "uint8", "ui32": "uint32", "i1": "bool",
+    "f8E4M3FN": "float8_e4m3fn", "f8E5M2": "float8_e5m2",
+}
+
+
+def _aval_of_type(mlir_type: str):
+    """shape/dtype ShapeDtypeStruct of one ``8x16xf32`` MLIR tensor
+    type."""
+    parts = mlir_type.split("x")
+    dims, dt = parts[:-1], parts[-1]
+    if dt not in _MLIR_DTYPES:
+        raise ValueError(f"unrecognized MLIR element type {dt!r} in "
+                         f"tensor<{mlir_type}> — extend _MLIR_DTYPES")
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(tuple(int(d) for d in dims),
+                                getattr(jnp, _MLIR_DTYPES[dt]))
+
+
+def assert_sharding(lowered, argpath, mesh, spec) -> None:
+    """The annotation pin: the lowering's ``mhlo.sharding`` for
+    ``argpath`` must equal what ``NamedSharding(mesh, spec)`` lowers to
+    on that argument's shape — computed by lowering a one-argument
+    identity with that in_sharding and reading ITS attribute, so the
+    expectation self-calibrates to the running jax's HloSharding
+    spelling instead of hard-coding it."""
+    from jax.sharding import NamedSharding
+
+    sites = _aligned_sites(lowered)
+    i = _flat_arg_index(lowered, argpath)
+    got = sites[i]["sharding"]
+    s = NamedSharding(mesh, spec)
+    aval = _aval_of_type(sites[i]["type"])
+    ref = jax.jit(lambda x: x, in_shardings=s).lower(
+        jax.ShapeDtypeStruct(aval.shape, aval.dtype, sharding=s))
+    want = arg_shardings(ref)[0]["sharding"]
+    assert got == want, (
+        f"entry arg {argpath!r} (flat #{i}, tensor<{sites[i]['type']}>) "
+        f"lowered with sharding {got!r} but NamedSharding(mesh, "
+        f"{spec}) lowers to {want!r} — the step's annotation drifted "
+        f"from the intended layout")
+
+
+def _compiled_text(artifact) -> str:
+    """The post-SPMD-partitioning HLO text: a str passes through, a
+    ``Lowered`` is compiled (collectives only exist after
+    partitioning), anything else with ``as_text()`` is rendered."""
+    if isinstance(artifact, str):
+        return artifact
+    if hasattr(artifact, "compile"):
+        return artifact.compile().as_text()
+    return hlo_text(artifact)
+
+
+def _parse_hlo_groups(attr: str) -> Optional[List[List[int]]]:
+    """Compiled-HLO ``replica_groups`` in either spelling: the literal
+    ``{{0,1},{2,3}}`` or the iota ``[4,2]<=[8]`` /
+    ``[2,4]<=[4,2]T(1,0)`` form."""
+    attr = attr.strip()
+    if attr.startswith("{"):
+        groups = re.findall(r'\{([\d,\s]*)\}', attr)
+        try:
+            return [[int(x) for x in g.split(",") if x.strip()]
+                    for g in groups]
+        except ValueError:
+            return None
+    m = re.match(r'\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?', attr)
+    if m is None:
+        return None
+    import numpy as np
+
+    n_groups, group_size = int(m.group(1)), int(m.group(2))
+    dims = [int(d) for d in m.group(3).split(",")]
+    ids = np.arange(int(np.prod(dims))).reshape(dims)
+    if m.group(4):
+        ids = ids.transpose([int(p) for p in m.group(4).split(",")])
+    return ids.reshape(n_groups, group_size).tolist()
+
+
+def spmd_collective_sites(artifact, kind: str) -> List[dict]:
+    """Every ``kind`` collective the SPMD partitioner placed in the
+    compiled module, in program order, as ``{"dtype": str|None,
+    "replica_groups": [[int, ...], ...]|None}``.  ``kind`` uses the
+    underscore spelling (``all_reduce``); compiled HLO prints dashes
+    and may split async pairs — only the ``-start``/plain op counts,
+    never the ``-done``."""
+    txt = _compiled_text(artifact)
+    dashed = kind.replace("_", "-")
+    sites = []
+    for m in re.finditer(
+            r'=\s*\(?([a-zA-Z0-9]+)\[[^\]]*\][^=\n]*?\s'
+            + re.escape(dashed) + r'(?:-start)?\(', txt):
+        line_end = txt.find("\n", m.end())
+        window = txt[m.end(): line_end if line_end != -1 else len(txt)]
+        gm = re.search(r'replica_groups=(\{\{[^}]*(?:\},\{[^}]*)*\}\}|'
+                       r'\[[^\]]+\]<=\[[^\]]+\](?:T\([\d,]+\))?)', window)
+        sites.append({
+            "dtype": m.group(1),
+            "replica_groups": _parse_hlo_groups(gm.group(1)) if gm else None,
+        })
+    return sites
+
+
+def assert_spmd_collectives(artifact, kind: str, axes=None, mesh=None, *,
+                            minimum: Optional[int] = None,
+                            maximum: Optional[int] = None,
+                            dtype: Optional[str] = None) -> int:
+    """The GSPMD program's collective-structure pin: count the ``kind``
+    collectives XLA's partitioner placed (in the COMPILED module — a
+    jit+NamedSharding lowering contains none), optionally filtered to
+    the ones whose ``replica_groups`` equal a collective over exactly
+    ``axes`` of ``mesh`` (the per-axis filtering of
+    :func:`assert_collective_axes`, on the compiled-HLO spellings),
+    with ``minimum``/``maximum`` bounds and an optional all-sites
+    ``dtype`` pin.  Returns the matched count."""
+    sites = spmd_collective_sites(artifact, kind)
+    label = kind
+    if axes is not None:
+        if mesh is None:
+            raise ValueError("axes= filtering needs mesh= (the groups "
+                             "are computed from the mesh layout)")
+        want = _groups_key(mesh_axis_groups(mesh, axes))
+        sites = [s for s in sites
+                 if _groups_key(s["replica_groups"]) == want]
+        label = (f"{kind} over axes "
+                 f"{tuple(axes) if not isinstance(axes, str) else (axes,)}")
+    n = len(sites)
+    if minimum is not None:
+        assert n >= minimum, (
+            f"expected >= {minimum} partitioner-placed {label} "
+            f"collective(s) in the compiled module, found {n} — the "
+            f"sharding annotations no longer induce the sync they "
+            f"were written for (silent replication?)")
+    if maximum is not None:
+        assert n <= maximum, (
+            f"expected <= {maximum} partitioner-placed {label} "
+            f"collective(s) in the compiled module, found {n} — the "
+            f"annotations induce extra data movement (a resharding "
+            f"crept into the step)")
+    if dtype is not None:
+        bad = [s["dtype"] for s in sites if s["dtype"] != dtype]
+        assert not bad, (
+            f"every matched {label} must run in {dtype}, found {bad}")
+    return n
 
 
 def donated_buffer_count(artifact) -> int:

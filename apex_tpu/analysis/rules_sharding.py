@@ -1,0 +1,315 @@
+"""Sharding-annotation consistency rules — the GSPMD half of the
+collective-axis family.
+
+The collective rules (APX201-205) police ``lax.psum``-style EXPLICIT
+collectives against what ``shard_map`` binds.  This module polices the
+ANNOTATIONS the GSPMD-native path (``gpt.make_train_step(spmd="auto")``,
+``jit`` + ``NamedSharding``) is built from — where the failure modes are
+nastier, because nothing has to fail at all:
+
+- A ``with_sharding_constraint`` whose ``NamedSharding`` was built on a
+  DIFFERENT mesh object than the jit's ``in_shardings`` compiles and
+  runs with zero exceptions — XLA logs an "involuntary full
+  rematerialization" to stderr and silently re-lays the tensor out
+  (reproduced on jax 0.4.37; pinned live in
+  tests/test_lowered_invariants.py::TestShardingRuleProof).  The stale
+  prod-mesh annotation in a CI-mesh step is exactly one refactor away.
+- A typo'd axis inside a ``NamedSharding`` against its OWN mesh raises
+  — but at annotation-construction time, which for TPU-gated step
+  builders (mesh built from ``jax.devices()`` on the chip) is on the
+  chip, after CPU CI passed: the APX203 deferral story.
+- A donated jit argument whose in/out shardings provably differ keeps
+  compiling: XLA drops the donation with a ``UserWarning`` and the step
+  silently re-inflates by the donated bytes.
+
+Three rules, same quiet-on-unknown contract as the rest of the
+dataflow tier: only literal ``P(...)`` specs (one last-wins alias hop,
+:func:`dataflow.resolve_spec`) and statically-resolvable meshes
+(:func:`dataflow.mesh_axes_of`) are judged; everything else is the
+threading pattern the rules exist to push code toward.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+from apex_tpu.analysis import dataflow
+from apex_tpu.analysis.core import Finding, ModuleContext, Rule, last_name
+from apex_tpu.analysis.dataflow import _kwarg
+from apex_tpu.analysis.rules_donation import _literal_argnums
+
+__all__ = [
+    "ShardingSpecAxisUnbound", "ShardingSpecRankMismatch",
+    "DonatedShardingMismatch",
+]
+
+#: call sites whose second argument (or ``shardings=``) annotates the
+#: first: the reaching-mesh check applies inside traced code
+_CONSTRAINT_FNS = {"with_sharding_constraint"}
+
+
+def _named_sharding_calls(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and last_name(node.func) == "NamedSharding":
+            yield node
+
+
+def _ns_parts(call: ast.Call):
+    """(mesh_expr, spec_expr) of one NamedSharding call."""
+    mesh = call.args[0] if call.args else _kwarg(call, "mesh")
+    spec = call.args[1] if len(call.args) > 1 else _kwarg(call, "spec")
+    return mesh, spec
+
+
+def _constraint_calls(ctx: ModuleContext):
+    """(call, value_expr, sharding_expr) per with_sharding_constraint."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and last_name(node.func) in _CONSTRAINT_FNS:
+            value = node.args[0] if node.args else _kwarg(node, "x")
+            shardings = node.args[1] if len(node.args) > 1 \
+                else _kwarg(node, "shardings")
+            yield node, value, shardings
+
+
+def _spec_of_annotation(node: Optional[ast.AST],
+                        aliases) -> Tuple[Optional[ast.Call],
+                                          Optional[FrozenSet[str]]]:
+    """``(P_call, own_mesh_axes)`` of one annotation expression: a bare
+    ``P(...)`` (or alias), or a ``NamedSharding(mesh, P(...))`` whose
+    own mesh's axes are returned when statically resolvable."""
+    if isinstance(node, ast.Call) and last_name(node.func) == "NamedSharding":
+        mesh, spec = _ns_parts(node)
+        return dataflow.resolve_spec(spec, aliases), \
+            dataflow.mesh_axes_of(mesh, aliases)
+    return dataflow.resolve_spec(node, aliases), None
+
+
+def _reaching_mesh(ctx: ModuleContext,
+                   call: ast.Call) -> Optional[FrozenSet[str]]:
+    """The mesh-axis set provably reaching an annotation site through
+    the enclosing jit's ``in_shardings``/``out_shardings`` — None
+    unless EVERY reaching scope carries resolved mesh information (one
+    unannotated or unresolvable path silences the check; shard_map
+    paths have their own axis semantics and silence it too)."""
+    scopes = dataflow.scopes_at(ctx, call)
+    if not scopes:
+        return None
+    axes: set = set()
+    for s in scopes:
+        if s.mesh_axes is None or s.mesh_unknown or s.shard_map \
+                or s.unknown:
+            return None
+        axes |= s.mesh_axes
+    return frozenset(axes)
+
+
+class ShardingSpecAxisUnbound(Rule):
+    """APX206: a ``PartitionSpec`` names an axis no reaching mesh binds.
+
+    Two precision tiers, one finding per hazard:
+
+    - Self-inconsistent: the axis is not on the ``NamedSharding``'s OWN
+      (statically resolved) mesh — raises, but only when the annotation
+      is constructed, which for TPU-gated builders is on the chip.
+    - Silently replicating: the annotation is self-consistent, but the
+      mesh reaching the ``with_sharding_constraint`` through the
+      enclosing jit's ``in_shardings`` binds none of its axes — a stale
+      mesh object from another config.  jit compiles and runs WITHOUT
+      ERROR; XLA rematerializes/replicates and the "sharded" program
+      quietly stops being sharded (reproduced on jax 0.4.37).
+    """
+
+    rule_id = "APX206"
+    severity = "error"
+    fix_hint = ("build the annotation from the SAME mesh the step's "
+                "in_shardings use (thread the mesh/sharding in as an "
+                "argument), or add the axis to that mesh — an axis no "
+                "reaching mesh binds either dies at first trace on the "
+                "chip or silently replicates")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        aliases = dataflow.value_aliases(ctx)
+        flagged: set = set()
+        for call in _named_sharding_calls(ctx):
+            mesh, spec_expr = _ns_parts(call)
+            axes = dataflow.mesh_axes_of(mesh, aliases)
+            spec = dataflow.resolve_spec(spec_expr, aliases)
+            if axes is None or spec is None:
+                continue
+            for node, name in dataflow.spec_axis_literals(spec):
+                if name not in axes:
+                    flagged.add(id(node))
+                    known = ", ".join(sorted(axes)) or "(none)"
+                    yield self.finding(
+                        ctx, node,
+                        f"PartitionSpec names axis {name!r} but its own "
+                        f"mesh binds only {{{known}}}: NamedSharding "
+                        f"construction raises — at annotation-build "
+                        f"time, which for a TPU-gated step builder is "
+                        f"on the chip, after CPU CI passed")
+        for call, _value, annot in _constraint_calls(ctx):
+            spec, own_axes = _spec_of_annotation(annot, aliases)
+            if spec is None:
+                continue
+            reaching = _reaching_mesh(ctx, call)
+            if reaching is None:
+                continue
+            for node, name in dataflow.spec_axis_literals(spec):
+                if id(node) in flagged:
+                    continue  # the self-inconsistency finding above
+                if own_axes is not None and name not in own_axes:
+                    continue  # ditto (NamedSharding loop owns it)
+                if name not in reaching:
+                    known = ", ".join(sorted(reaching)) or "(none)"
+                    yield self.finding(
+                        ctx, node,
+                        f"with_sharding_constraint names axis {name!r} "
+                        f"but the mesh reaching this jit (its "
+                        f"in_shardings/out_shardings) binds only "
+                        f"{{{known}}}: the annotation is from ANOTHER "
+                        f"mesh — jit compiles without error and XLA "
+                        f"silently rematerializes/replicates, so the "
+                        f"'sharded' tensor quietly is not")
+
+
+class ShardingSpecRankMismatch(Rule):
+    """APX207: a spec with provably more entries than the annotated
+    array has dimensions.
+
+    ``with_sharding_constraint(jnp.zeros((8, 128)), P("dp", None,
+    "tp"))`` is a trace-time error — deferred, as ever, to whenever
+    that code path first traces, which for TPU-gated branches is the
+    chip.  Ranks resolve through the same one-hop value-alias lattice
+    as block shapes (``dataflow.creation_rank``): only arrays created
+    by a local ``zeros/ones/empty/full/normal/...`` with a literal (or
+    locally-aliased) shape are judged.
+    """
+
+    rule_id = "APX207"
+    severity = "error"
+    fix_hint = ("drop the extra spec entries (a PartitionSpec may name "
+                "at most one entry per array dimension; shorter specs "
+                "are legal and leave trailing dims replicated)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        aliases = dataflow.value_aliases(ctx)
+        sites = list(_constraint_calls(ctx))
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and last_name(node.func) == "device_put":
+                value = node.args[0] if node.args else _kwarg(node, "x")
+                annot = node.args[1] if len(node.args) > 1 \
+                    else _kwarg(node, "device")
+                sites.append((node, value, annot))
+        for call, value, annot in sites:
+            spec, _own = _spec_of_annotation(annot, aliases)
+            if spec is None:
+                continue
+            rank = dataflow.creation_rank(value, aliases)
+            if rank is None:
+                continue
+            n = dataflow.spec_rank(spec)
+            if n > rank:
+                yield self.finding(
+                    ctx, spec,
+                    f"PartitionSpec constrains {n} dimensions but the "
+                    f"annotated array is rank {rank}: a spec longer "
+                    f"than the array's rank fails at trace time — on "
+                    f"the chip, for TPU-gated paths (the spec probably "
+                    f"belongs to a different tensor after a refactor)")
+
+
+def _normalized_spec(entry: Optional[ast.AST],
+                     aliases) -> Optional[Tuple]:
+    """A comparable identity for one sharding annotation: the tuple of
+    its P entries (None / axis name / tuple of axis names) with
+    trailing Nones stripped, or None when anything is unresolvable."""
+    spec, _own = _spec_of_annotation(entry, aliases)
+    if spec is None:
+        return None
+    out: List = []
+    for arg in spec.args:
+        if isinstance(arg, ast.Constant) and arg.value is None:
+            out.append(None)
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append(arg.value)
+        elif isinstance(arg, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in arg.elts):
+            out.append(tuple(e.value for e in arg.elts))
+        else:
+            return None
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+class DonatedShardingMismatch(Rule):
+    """APX208: a donated jit argument whose in-sharding provably
+    differs from EVERY out-sharding.
+
+    Donation lets XLA alias the input buffer to an output of matching
+    layout; when the annotated shardings can never match, XLA DROPS the
+    donation with nothing but a ``UserWarning`` ("Some donated buffers
+    were not usable") and the step's peak memory silently re-inflates
+    by the donated bytes — the failure mode
+    ``analysis.lowered.assert_donation_covers`` catches at compile
+    time, moved to the source.  Only fully-literal spec tuples are
+    compared (both sides); any unresolvable entry silences the call.
+    """
+
+    rule_id = "APX208"
+    severity = "warning"
+    fix_hint = ("give the donated argument an out_sharding it can "
+                "alias (same PartitionSpec on the matching output), or "
+                "drop it from donate_argnums — a donation XLA cannot "
+                "use buys nothing and hides the real peak memory")
+
+    @staticmethod
+    def _is_jit_call(call: ast.Call) -> bool:
+        """``jax.jit(...)`` directly, or the decorator spelling
+        ``functools.partial(jax.jit, donate_argnums=..., ...)`` — the
+        kwargs live on the partial call either way."""
+        if last_name(call.func) == "jit":
+            return True
+        return (last_name(call.func) == "partial" and call.args
+                and last_name(call.args[0]) == "jit")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        aliases = dataflow.value_aliases(ctx)
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call) or not self._is_jit_call(call):
+                continue
+            donate = _kwarg(call, "donate_argnums")
+            ins = _kwarg(call, "in_shardings")
+            outs = _kwarg(call, "out_shardings")
+            if donate is None or ins is None or outs is None:
+                continue
+            nums = _literal_argnums(donate)
+            if not nums:
+                continue
+            in_entries = list(ins.elts) \
+                if isinstance(ins, (ast.Tuple, ast.List)) else [ins]
+            out_entries = list(outs.elts) \
+                if isinstance(outs, (ast.Tuple, ast.List)) else [outs]
+            out_specs = [_normalized_spec(e, aliases) for e in out_entries]
+            if any(s is None for s in out_specs):
+                continue  # an unknowable output may alias anything
+            for pos in sorted(nums):
+                if pos >= len(in_entries):
+                    continue
+                ispec = _normalized_spec(in_entries[pos], aliases)
+                if ispec is None:
+                    continue
+                if ispec not in out_specs:
+                    yield self.finding(
+                        ctx, in_entries[pos],
+                        f"argument {pos} is donated but its in_sharding "
+                        f"P{ispec!r} matches none of the out_shardings "
+                        f"{out_specs!r}: XLA drops the donation with "
+                        f"only a UserWarning, and the step's peak "
+                        f"memory silently re-inflates by the donated "
+                        f"buffer")

@@ -32,10 +32,35 @@ def _canon_shape(normalized_shape):
     return tuple(int(s) for s in normalized_shape)
 
 
-def _rows_view(x, normalized_shape):
-    n = int(np.prod(normalized_shape))
-    lead = x.shape[: x.ndim - len(normalized_shape)]
-    return x.reshape((-1, n)), lead, n
+def _lead_sum(t, dims):
+    """Sum ``t`` over its lead (non-normalized) axes as a dot_general
+    with a ones vector rather than a ``reduce``: a reduce's summation
+    order is a fusion-context choice, but a dot's is fixed by the dot
+    kernel — so the dw/db sums associate identically in the shard_map
+    program and its GSPMD-partitioned twin (the weight-grad dots
+    already match bitwise between the two; this puts the LN param
+    grads on the same footing)."""
+    lead_axes = tuple(range(t.ndim - len(dims)))
+    ones = jnp.ones(tuple(t.shape[a] for a in lead_axes), jnp.float32)
+    return jax.lax.dot_general(
+        ones, t, ((lead_axes, lead_axes), ((), ())))
+
+
+def _norm_dims(x, normalized_shape):
+    """(reduce axes, lead shape, row length) of ``x`` under
+    ``normalized_shape``.  The jnp implementations reduce over these
+    AXES instead of flattening to ``(rows, n)``: a reshape that fuses a
+    sharded leading dim (the batch of an ``(S, B, H)`` activation)
+    forces GSPMD to all-gather and re-associate the dw/db row sums,
+    which breaks bitwise parity between the ``spmd="auto"`` train step
+    and the shard_map oracle.  Axis-based reductions keep the partial
+    sum per device + one all-reduce — the same association shard_map
+    spells by hand.  (The Pallas kernels still take the ``(rows, n)``
+    view; that reshape lives at their call seam only.)"""
+    k = len(normalized_shape)
+    dims = tuple(range(x.ndim - k, x.ndim))
+    lead = x.shape[: x.ndim - k]
+    return dims, lead, int(np.prod(normalized_shape))
 
 
 def manual_rms_norm(x, normalized_shape, weight, eps):
@@ -56,17 +81,24 @@ def _layer_norm(x, weight, bias, normalized_shape, eps, memory_efficient):
 
 
 def _ln_fwd_impl(x, weight, bias, normalized_shape, eps):
-    x2, lead, n = _rows_view(x, normalized_shape)
+    """Returns ``(out, mean, invvar)`` with the stats in LEAD shape
+    (``x.shape`` minus the normalized trailing dims)."""
+    dims, lead, n = _norm_dims(x, normalized_shape)
     from apex_tpu.ops.layer_norm_pallas import layer_norm_fwd_pallas, pallas_available
 
     def pallas_impl():
+        x2 = x.reshape((-1, n))
         w = weight.reshape(n) if weight is not None else None
         b = bias.reshape(n) if bias is not None else None
         y, mean, rstd = layer_norm_fwd_pallas(x2, w, b, eps)
-        return y.reshape(x.shape), mean[:, 0], rstd[:, 0]
+        return y.reshape(x.shape), mean[:, 0].reshape(lead), \
+            rstd[:, 0].reshape(lead)
 
     def jnp_impl():
-        xf = x2.astype(jnp.float32)
+        # the (rows, n) view here is deliberate — see _norm_dims: the
+        # row-stat math is per-row either way, but the 2D view is the
+        # one whose shard_map and GSPMD compilations agree bitwise
+        xf = x.reshape((-1, n)).astype(jnp.float32)
         mean = jnp.mean(xf, axis=1, keepdims=True)
         var = jnp.mean(jnp.square(xf - mean), axis=1, keepdims=True)
         invvar = jax.lax.rsqrt(var + eps)
@@ -77,9 +109,9 @@ def _ln_fwd_impl(x, weight, bias, normalized_shape, eps):
         if bias is not None:
             y = y + bias.reshape(1, n).astype(jnp.float32)
         out = y.astype(x.dtype).reshape(x.shape)
-        return out, mean[:, 0], invvar[:, 0]
+        return out, mean[:, 0].reshape(lead), invvar[:, 0].reshape(lead)
 
-    if pallas_available(x2, n):
+    if pallas_available(x, n):
         # no registry_engaged gate (here or in the bwd): both impls are
         # collective-free per-row math, so a per-process degrade cannot
         # desync a pod's collective programs, and there is no forced-
@@ -101,16 +133,18 @@ def _ln_fwd(x, weight, bias, normalized_shape, eps, memory_efficient):
 
 def _ln_bwd(normalized_shape, eps, memory_efficient, res, g):
     saved, mean, invvar, weight, bias = res
-    g2, lead, n = _rows_view(g, normalized_shape)
+    dims, lead, n = _norm_dims(g, normalized_shape)
 
     from apex_tpu.ops.layer_norm_pallas import layer_norm_bwd_pallas, pallas_available
 
-    if not memory_efficient and pallas_available(g2, n):
+    if not memory_efficient and pallas_available(g, n):
         def pallas_impl():
             x2 = saved.reshape((-1, n))
+            g2 = g.reshape((-1, n))
             w = weight.reshape(n) if weight is not None else None
             dx, dw_p, db_p = layer_norm_bwd_pallas(
-                x2, w, g2, mean[:, None], invvar[:, None], with_bias=bias is not None
+                x2, w, g2, mean.reshape((-1, 1)), invvar.reshape((-1, 1)),
+                with_bias=bias is not None
             )
             dx = dx.reshape(g.shape).astype(g.dtype)
             dw = dw_p.sum(0).reshape(weight.shape).astype(weight.dtype) if weight is not None else None
@@ -121,48 +155,48 @@ def _ln_bwd(normalized_shape, eps, memory_efficient, res, g):
 
         return get_registry().call(
             "layer_norm", pallas_impl,
-            lambda: _ln_bwd_jnp(saved, mean, invvar, weight, bias, g2, g,
-                                n, memory_efficient))
+            lambda: _ln_bwd_jnp(saved, mean, invvar, weight, bias, g,
+                                dims, memory_efficient))
 
-    return _ln_bwd_jnp(saved, mean, invvar, weight, bias, g2, g, n,
+    return _ln_bwd_jnp(saved, mean, invvar, weight, bias, g, dims,
                        memory_efficient)
 
 
-def _ln_bwd_jnp(saved, mean, invvar, weight, bias, g2, g, n,
+def _ln_bwd_jnp(saved, mean, invvar, weight, bias, g, dims,
                 memory_efficient):
     """The jnp composite backward — the specification the Pallas kernel
-    is checked against, and the registry's fallback when it trips."""
-    gf = g2.astype(jnp.float32)
-    inv = invvar[:, None]
+    is checked against, and the registry's fallback when it trips.
+    Axis-based (see :func:`_norm_dims`): the dw/db row sums reduce over
+    the LEAD axes in place, so a sharded batch dim stays sharded."""
+    gf = g.astype(jnp.float32)
+    inv = jnp.expand_dims(invvar, dims)
+    norm_shape = tuple(g.shape[a] for a in dims)
+    wf = weight.reshape(norm_shape).astype(jnp.float32) \
+        if weight is not None else None
 
     if memory_efficient:
-        yf = saved.reshape((-1, n)).astype(jnp.float32)
+        yf = saved.astype(jnp.float32)
         if bias is not None:
-            yf = yf - bias.reshape(1, n).astype(jnp.float32)
-        if weight is not None:
-            xhat = yf / weight.reshape(1, n).astype(jnp.float32)
-        else:
-            xhat = yf
+            yf = yf - bias.reshape(norm_shape).astype(jnp.float32)
+        xhat = yf / wf if wf is not None else yf
     else:
-        xf = saved.reshape((-1, n)).astype(jnp.float32)
-        xhat = (xf - mean[:, None]) * inv
+        xf = saved.astype(jnp.float32)
+        xhat = (xf - jnp.expand_dims(mean, dims)) * inv
 
-    if weight is not None:
-        gw = gf * weight.reshape(1, n).astype(jnp.float32)
-    else:
-        gw = gf
+    gw = gf * wf if wf is not None else gf
 
-    m1 = jnp.mean(gw, axis=1, keepdims=True)
-    m2 = jnp.mean(gw * xhat, axis=1, keepdims=True)
+    m1 = jnp.mean(gw, axis=dims, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=dims, keepdims=True)
     dx = (gw - m1 - xhat * m2) * inv
-    dx = dx.astype(g.dtype).reshape(g.shape)
+    dx = dx.astype(g.dtype)
 
     if weight is not None:
-        dw = jnp.sum(gf * xhat, axis=0).reshape(weight.shape).astype(weight.dtype)
+        dw = _lead_sum(gf * xhat, dims) \
+            .reshape(weight.shape).astype(weight.dtype)
     else:
         dw = None
     if bias is not None:
-        db = jnp.sum(gf, axis=0).reshape(bias.shape).astype(bias.dtype)
+        db = _lead_sum(gf, dims).reshape(bias.shape).astype(bias.dtype)
     else:
         db = None
     return dx, dw, db
@@ -179,31 +213,34 @@ def _rms_norm(x, weight, normalized_shape, eps, memory_efficient):
 
 
 def _rms_fwd_impl(x, weight, normalized_shape, eps):
-    x2, lead, n = _rows_view(x, normalized_shape)
+    """Returns ``(out, invvar)`` with the stats in LEAD shape."""
+    dims, lead, n = _norm_dims(x, normalized_shape)
     from apex_tpu.ops.layer_norm_pallas import layer_norm_fwd_pallas, pallas_available
 
     def pallas_impl():
+        x2 = x.reshape((-1, n))
         w = weight.reshape(n) if weight is not None else None
         y, _, rstd = layer_norm_fwd_pallas(x2, w, None, eps, rms=True)
-        return y.reshape(x.shape), rstd[:, 0]
+        return y.reshape(x.shape), rstd[:, 0].reshape(lead)
 
-    if pallas_available(x2, n):
+    if pallas_available(x, n):
         from apex_tpu.resilience.fallback import get_registry
 
         return get_registry().call(
             "layer_norm", pallas_impl,
-            lambda: _rms_fwd_jnp(x, x2, weight, n, eps))
-    return _rms_fwd_jnp(x, x2, weight, n, eps)
+            lambda: _rms_fwd_jnp(x, weight, dims, lead, eps))
+    return _rms_fwd_jnp(x, weight, dims, lead, eps)
 
 
-def _rms_fwd_jnp(x, x2, weight, n, eps):
-    xf = x2.astype(jnp.float32)
-    var = jnp.mean(jnp.square(xf), axis=1, keepdims=True)
+def _rms_fwd_jnp(x, weight, dims, lead, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=dims, keepdims=True)
     invvar = jax.lax.rsqrt(var + eps)
     y = xf * invvar
     if weight is not None:
-        y = y * weight.reshape(1, n).astype(jnp.float32)
-    return y.astype(x.dtype).reshape(x.shape), invvar[:, 0]
+        norm_shape = tuple(x.shape[a] for a in dims)
+        y = y * weight.reshape(norm_shape).astype(jnp.float32)
+    return y.astype(x.dtype), invvar.reshape(lead)
 
 
 def _rms_fwd(x, weight, normalized_shape, eps, memory_efficient):
@@ -214,16 +251,18 @@ def _rms_fwd(x, weight, normalized_shape, eps, memory_efficient):
 
 def _rms_bwd(normalized_shape, eps, memory_efficient, res, g):
     saved, invvar, weight = res
-    g2, lead, n = _rows_view(g, normalized_shape)
+    dims, lead, n = _norm_dims(g, normalized_shape)
 
     from apex_tpu.ops.layer_norm_pallas import layer_norm_bwd_pallas, pallas_available
 
-    if not memory_efficient and pallas_available(g2, n):
+    if not memory_efficient and pallas_available(g, n):
         def pallas_impl():
             x2 = saved.reshape((-1, n))
+            g2 = g.reshape((-1, n))
+            inv2 = invvar.reshape((-1, 1))
             w = weight.reshape(n) if weight is not None else None
             dx, dw_p, _ = layer_norm_bwd_pallas(
-                x2, w, g2, jnp.zeros_like(invvar)[:, None], invvar[:, None],
+                x2, w, g2, jnp.zeros_like(inv2), inv2,
                 rms=True, with_bias=False,
             )
             dx = dx.reshape(g.shape).astype(g.dtype)
@@ -234,29 +273,33 @@ def _rms_bwd(normalized_shape, eps, memory_efficient, res, g):
 
         return get_registry().call(
             "layer_norm", pallas_impl,
-            lambda: _rms_bwd_jnp(saved, invvar, weight, g2, g, n,
+            lambda: _rms_bwd_jnp(saved, invvar, weight, g, dims,
                                  memory_efficient))
 
-    return _rms_bwd_jnp(saved, invvar, weight, g2, g, n, memory_efficient)
+    return _rms_bwd_jnp(saved, invvar, weight, g, dims, memory_efficient)
 
 
-def _rms_bwd_jnp(saved, invvar, weight, g2, g, n, memory_efficient):
-    gf = g2.astype(jnp.float32)
-    inv = invvar[:, None]
+def _rms_bwd_jnp(saved, invvar, weight, g, dims, memory_efficient):
+    gf = g.astype(jnp.float32)
+    inv = jnp.expand_dims(invvar, dims)
+    norm_shape = tuple(g.shape[a] for a in dims)
+    wf = weight.reshape(norm_shape).astype(jnp.float32) \
+        if weight is not None else None
 
     if memory_efficient:
-        yf = saved.reshape((-1, n)).astype(jnp.float32)
-        xhat = yf / weight.reshape(1, n).astype(jnp.float32) if weight is not None else yf
+        yf = saved.astype(jnp.float32)
+        xhat = yf / wf if wf is not None else yf
     else:
-        xhat = saved.reshape((-1, n)).astype(jnp.float32) * inv
+        xhat = saved.astype(jnp.float32) * inv
 
-    gw = gf * weight.reshape(1, n).astype(jnp.float32) if weight is not None else gf
-    m2 = jnp.mean(gw * xhat, axis=1, keepdims=True)
+    gw = gf * wf if wf is not None else gf
+    m2 = jnp.mean(gw * xhat, axis=dims, keepdims=True)
     dx = (gw - xhat * m2) * inv
-    dx = dx.astype(g.dtype).reshape(g.shape)
+    dx = dx.astype(g.dtype)
 
     if weight is not None:
-        dw = jnp.sum(gf * xhat, axis=0).reshape(weight.shape).astype(weight.dtype)
+        dw = _lead_sum(gf * xhat, dims) \
+            .reshape(weight.shape).astype(weight.dtype)
     else:
         dw = None
     return dx, dw

@@ -9,7 +9,7 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.models.gpt import GPTConfig, gpt_forward, gpt_loss, init_params, param_specs
-from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers import FusedAdam, FusedSGD
 
 CFG = GPTConfig(
     vocab_size=64,
@@ -300,3 +300,106 @@ class TestGroupedQueryAttention:
         )
         with pytest.raises(ValueError, match="num_query_groups"):
             f(params, batch)
+
+
+# ------------------------------------------------- GSPMD step parity
+class TestGspmdStepParity:
+    """ISSUE 15's numerics acceptance: ``make_train_step(spmd="auto")``
+    (jit + NamedSharding, XLA-placed collectives) against the
+    shard_map oracle on the dp and dp×tp meshes, fp32.
+
+    What is pinned and why: per-step LOSSES are bitwise-equal at dp=4
+    and within one float32 ulp at dp=2×tp=2 (first step only — the
+    residual of compiler-chosen fusion order in the tp forward).
+    PARAMS track to a few gradient ulps; strict param-bitwise between
+    the two programs is not achievable even in principle — the tied
+    embedding's two grad contributions (lookup scatter + head dot) are
+    all-reduced SEPARATELY by the SPMD partitioner but summed before
+    the single pmean in the shard_map program, a summation-association
+    difference no source spelling removes (every other leaf matches
+    bitwise at dp=4 after normalization.fused_layer_norm's _lead_sum
+    fix).  SGD's linear update bounds the drift at gradient scale
+    (~4e-9); Adam's rsqrt amplifies it to the measured ~5e-5."""
+
+    STEPS = 5
+
+    def _trajectory(self, mesh, spmd, make_opt, sspec):
+        from apex_tpu.models.gpt import make_train_step
+
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        opt = make_opt()
+        state = opt.init(params)
+        step = make_train_step(CFG, opt, mesh, opt_state_spec=sspec,
+                               spmd=spmd)
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(8, 16)))
+        targets = jnp.roll(tokens, -1, axis=1)
+        losses = []
+        for _ in range(self.STEPS):
+            params, state, loss = step(params, state, tokens, targets)
+            losses.append(float(loss))
+        return losses, params
+
+    @staticmethod
+    def _adam_sspec():
+        from apex_tpu.optimizers.fused_adam import AdamState
+
+        specs = param_specs(CFG)
+        return AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs,
+                         master=None)
+
+    @staticmethod
+    def _sgd_sspec():
+        from apex_tpu.optimizers.fused_sgd import SGDState
+
+        return SGDState(step=P(), momentum_buffer=param_specs(CFG),
+                        master=None)
+
+    def _compare(self, mesh, make_opt, sspec, loss_atol, param_atol,
+                 bitwise_losses):
+        lo, po = self._trajectory(mesh, "shard_map", make_opt, sspec)
+        lg, pg = self._trajectory(mesh, "auto", make_opt, sspec)
+        if bitwise_losses:
+            assert lo == lg, f"losses diverged: {lo} vs {lg}"
+        else:
+            for i, (a, b) in enumerate(zip(lo, lg)):
+                assert abs(a - b) <= loss_atol, \
+                    f"step {i}: |{a} - {b}| > {loss_atol}"
+        for (ka, a), b in zip(
+                jax.tree_util.tree_flatten_with_path(po)[0],
+                jax.tree_util.tree_leaves(pg)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=0, atol=param_atol,
+                err_msg=f"{jax.tree_util.keystr(ka)}")
+
+    def test_dp4_adam_loss_bitwise(self, devices8):
+        """The headline pin: 5 Adam steps, every loss bitwise-equal
+        fp32 to the shard_map oracle at dp=4."""
+        mesh = Mesh(np.array(devices8[:4]).reshape(4, 1), ("dp", "tp"))
+        self._compare(mesh, lambda: FusedAdam(lr=1e-2),
+                      self._adam_sspec(), loss_atol=0.0,
+                      param_atol=5e-4, bitwise_losses=True)
+
+    def test_dp4_sgd_params_tight(self, devices8):
+        """SGD's linear update keeps params at gradient-ulp distance
+        (measured 3.7e-9 over 5 steps) — the strongest param pin the
+        embed-tie association allows."""
+        mesh = Mesh(np.array(devices8[:4]).reshape(4, 1), ("dp", "tp"))
+        self._compare(mesh, lambda: FusedSGD(lr=1e-2),
+                      self._sgd_sspec(), loss_atol=0.0,
+                      param_atol=1e-7, bitwise_losses=True)
+
+    def test_dp2_tp2_sgd(self, devices8):
+        """dp=2 × tp=2: losses within one fp32 ulp per step (measured:
+        only step 1 differs, by exactly one ulp), params at
+        gradient-ulp distance."""
+        mesh = Mesh(np.array(devices8[:4]).reshape(2, 2), ("dp", "tp"))
+        self._compare(mesh, lambda: FusedSGD(lr=1e-2),
+                      self._sgd_sspec(), loss_atol=1.5e-6,
+                      param_atol=1e-6, bitwise_losses=False)
+
+    def test_dp2_tp2_adam(self, devices8):
+        mesh = Mesh(np.array(devices8[:4]).reshape(2, 2), ("dp", "tp"))
+        self._compare(mesh, lambda: FusedAdam(lr=1e-2),
+                      self._adam_sspec(), loss_atol=1.5e-6,
+                      param_atol=5e-4, bitwise_losses=False)

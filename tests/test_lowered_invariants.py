@@ -716,6 +716,148 @@ class TestDecodeStep:
         lw.assert_donation_covers(lowc, cargs[1], compiled=True)
 
 
+# ------------------------------------------------------------- GSPMD step
+class TestGspmdTrainStep:
+    """ISSUE 15's pins on ``make_train_step(spmd="auto")``: the
+    annotations really reach the lowering (``assert_sharding``), the
+    SPMD partitioner places exactly the sync the shard_map program
+    spells by hand (``assert_spmd_collectives`` — the collectives only
+    exist in the COMPILED module), donation survives compilation, and
+    the optimizer runs its per-leaf path (no whole-tree bucket concat —
+    the packed-bucket route was observed MIS-PARTITIONED under GSPMD:
+    zeroed pack segments for tp-sharded stacked leaves)."""
+
+    @pytest.fixture(scope="class")
+    def gspmd(self, devices8):
+        mesh = Mesh(np.array(devices8).reshape(4, 2), ("dp", "tp"))
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        sspec = AdamState(step=P(), exp_avg=param_specs(CFG),
+                          exp_avg_sq=param_specs(CFG), master=None)
+        step = make_train_step(CFG, opt, mesh, opt_state_spec=sspec,
+                               donate_state=True, spmd="auto")
+        tokens, targets = _data()
+        low = step.lower(params, state, tokens, targets)
+        return mesh, low, low.compile().as_text(), params, state
+
+    def test_param_and_data_annotations_reach_the_lowering(self, gspmd):
+        """Column/row/vocab-parallel param layouts and the dp batch
+        shard, pinned at the mhlo.sharding attrs via argpath — a spec
+        drift (the APX206 class, runtime-side) fails here."""
+        mesh, low, _txt, _p, _s = gspmd
+        lw.assert_sharding(low, (0, "embed"), mesh, P("tp", None))
+        lw.assert_sharding(low, (0, "layers", "wq"), mesh,
+                           P(None, "tp", None))
+        lw.assert_sharding(low, (0, "layers", "wo"), mesh,
+                           P(None, None, "tp"))
+        lw.assert_sharding(low, (0, "layers", "ln1_scale"), mesh,
+                           P(None, None))
+        lw.assert_sharding(low, (2,), mesh, P("dp", None))   # tokens
+        # optimizer state mirrors the param sharding (AdamState.exp_avg)
+        lw.assert_sharding(low, (1, 1, "layers", "wq"), mesh,
+                           P(None, "tp", None))
+
+    def test_partitioner_places_dp_and_tp_sync(self, gspmd):
+        """The GSPMD analog of the shard_map program's collective
+        structure: a dp-group all-reduce (the grad pmean) and tp-group
+        all-reduces (the Megatron f/g collectives) exist; nothing
+        lowered to a reduce-scatter (no ZeRO here), and no collective
+        spans the WHOLE mesh as one group (dp and tp sync stay
+        separate, as in the hand-written program)."""
+        mesh, _low, txt, _p, _s = gspmd
+        lw.assert_spmd_collectives(txt, "all_reduce", ("dp",), mesh,
+                                   minimum=1, dtype="f32")
+        lw.assert_spmd_collectives(txt, "all_reduce", ("tp",), mesh,
+                                   minimum=1)
+        lw.assert_spmd_collectives(txt, "reduce_scatter", maximum=0)
+
+    def test_donation_survives_spmd_compilation(self, gspmd):
+        """donate_state=True must alias params AND optimizer state
+        through the PARTITIONED executable — the APX208 hazard
+        (sharding-mismatched donation) is exactly a silent drop here."""
+        _mesh, low, _txt, params, state = gspmd
+        lw.assert_donation_covers(low, params, state, compiled=True)
+
+    def test_optimizer_runs_per_leaf_no_whole_tree_concat(self, gspmd):
+        """The engine's bucket pack (one flat concat of every leaf)
+        must NOT appear: under GSPMD it both forces all-gathers and
+        was observed miscompiled (zeroed segments).  The per-leaf
+        route's lowering has no tree-sized concatenate."""
+        _mesh, low, _txt, params, _state = gspmd
+        total = sum(int(np.prod(p.shape))
+                    for p in jax.tree_util.tree_leaves(params))
+        lw.assert_no_whole_tree_concat(low.as_text(), total)
+
+    def test_rejects_explicit_collective_features(self, devices8):
+        mesh = Mesh(np.array(devices8).reshape(4, 2), ("dp", "tp"))
+        opt = FusedAdam(lr=1e-2)
+        with pytest.raises(NotImplementedError, match="telemetry"):
+            from apex_tpu.observability import StepTelemetry
+
+            make_train_step(CFG, opt, mesh, spmd="auto",
+                            telemetry=StepTelemetry())
+        with pytest.raises(NotImplementedError, match="ZeRO"):
+            make_train_step(CFG, DistributedFusedAdam(lr=1e-2,
+                                                      axis_name="dp"),
+                            mesh, spmd="auto")
+        with pytest.raises(NotImplementedError, match="hierarchical"):
+            make_train_step(CFG, opt, mesh, spmd="auto",
+                            dp_axis=("dp", "tp"))
+        with pytest.raises(ValueError, match="spmd"):
+            make_train_step(CFG, opt, mesh, spmd="gspmd")
+
+
+class TestShardingRuleProof:
+    """The live half of APX206's silent-replication claim: the exact
+    two-mesh program the analyzer flags COMPILES AND RUNS with zero
+    exceptions on real jax — XLA rematerializes and quietly drops the
+    intended layout.  If a jax upgrade starts raising here, the rule's
+    message (and docs/static_analysis.md) should be re-verified."""
+
+    SRC = """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh_ci = Mesh(devs, ("dp",))
+        mesh_prod = Mesh(devs2, ("dp", "tp"))
+
+        def f(x):
+            return jax.lax.with_sharding_constraint(
+                x * 2, NamedSharding(mesh_prod, P(None, "tp")))
+
+        step = jax.jit(f, in_shardings=NamedSharding(mesh_ci, P("dp")))
+    """
+
+    def test_jit_compiles_and_runs_the_flagged_program(self, devices8):
+        from jax.sharding import NamedSharding
+
+        devs = np.array(devices8[:4])
+        mesh_ci = Mesh(devs, ("dp",))
+        mesh_prod = Mesh(devs.reshape(2, 2), ("dp", "tp"))
+
+        def f(x):
+            return jax.lax.with_sharding_constraint(
+                x * 2, NamedSharding(mesh_prod, P(None, "tp")))
+
+        step = jax.jit(f, in_shardings=NamedSharding(mesh_ci, P("dp")))
+        out = step(jnp.ones((8, 8)))     # no exception: the silent class
+        np.testing.assert_array_equal(np.asarray(out), 2.0)
+
+    def test_analyzer_flags_the_same_source(self, tmp_path):
+        import textwrap
+
+        from apex_tpu.analysis import analyze_file
+        from apex_tpu.analysis.rules_sharding import ShardingSpecAxisUnbound
+
+        p = tmp_path / "silent.py"
+        p.write_text(textwrap.dedent(self.SRC))
+        got = analyze_file(str(p), [ShardingSpecAxisUnbound()],
+                           {"dp", "tp"})
+        assert [f.rule for f in got] == ["APX206"]
+        assert "silently rematerializes" in got[0].message
+
+
 # ------------------------------------------------------------------ tracing
 class TestTracingTrainStep:
     """ISSUE 14's zero-overhead pins: the ``TracedStep`` dispatch

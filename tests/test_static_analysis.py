@@ -35,6 +35,11 @@ from apex_tpu.analysis.rules_collectives import (
     UnknownCollectiveAxis,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
+from apex_tpu.analysis.rules_sharding import (
+    DonatedShardingMismatch,
+    ShardingSpecAxisUnbound,
+    ShardingSpecRankMismatch,
+)
 from apex_tpu.analysis.rules_host_sync import (
     BlockingHostSyncInStepLoop, UnseamedDispatchTiming,
 )
@@ -1018,6 +1023,268 @@ class TestCollectiveTupleAxisUnbound:
         apx205 = [f for f in got if f.rule == "APX205"][0]
         assert "'dp_in'" in apx205.message
         assert "dp_outer_typo" in apx205.message  # context, not a dup
+
+
+# ----------------------------- APX206 sharding-annotation axis unbound
+class TestShardingSpecAxisUnbound:
+    """APX206: the GSPMD tier of the axis family — PartitionSpec axes
+    vs the mesh that actually reaches the annotation."""
+
+    def test_positive_typo_against_own_mesh(self, tmp_path):
+        """The one-character-typo class on the annotation side: 'dq'
+        is not on the NamedSharding's own mesh — raises at annotation
+        construction, which for a TPU-gated builder is on the chip."""
+        got = run("""
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+            spec = NamedSharding(mesh, P("dq", None))
+            """, tmp_path, [ShardingSpecAxisUnbound()])
+        assert rule_ids(got) == ["APX206"]
+        assert "'dq'" in got[0].message
+        assert "dp, tp" in got[0].message
+
+    def test_positive_stale_mesh_constraint_under_annotated_jit(
+            self, tmp_path):
+        """The SILENT-replication class (the fixture
+        tests/test_lowered_invariants.py::TestShardingRuleProof runs
+        live: jit compiles and runs with zero exceptions): the
+        with_sharding_constraint's NamedSharding is self-consistent,
+        but it was built on a STALE prod mesh — the mesh reaching this
+        jit (its in_shardings) binds only 'dp'."""
+        got = run("""
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh_ci = Mesh(devs, ("dp",))
+            mesh_prod = Mesh(devs2, ("dp", "tp"))
+
+            def f(x):
+                return jax.lax.with_sharding_constraint(
+                    x * 2, NamedSharding(mesh_prod, P(None, "tp")))
+
+            step = jax.jit(f, in_shardings=NamedSharding(mesh_ci, P("dp")))
+            """, tmp_path, [ShardingSpecAxisUnbound()])
+        assert rule_ids(got) == ["APX206"]
+        assert "silently rematerializes" in got[0].message
+
+    def test_positive_bare_spec_constraint_off_the_reaching_mesh(
+            self, tmp_path):
+        got = run("""
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp",))
+
+            @functools.partial(jax.jit,
+                               in_shardings=NamedSharding(mesh, P("dp")))
+            def f(x):
+                return jax.lax.with_sharding_constraint(x, P("model"))
+            """, tmp_path, [ShardingSpecAxisUnbound()])
+        assert rule_ids(got) == ["APX206"]
+        assert "'model'" in got[0].message
+
+    def test_negative_bound_axes_and_dynamic_meshes(self, tmp_path):
+        """Bound axes pass; a mesh (or spec) out of static reach —
+        the threading pattern — stays quiet."""
+        got = run("""
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+            ok = NamedSharding(mesh, P("dp", None, "tp"))
+
+            def make(m, spec):
+                return NamedSharding(m, spec)
+
+            def f(x):
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P("dp")))
+
+            step = jax.jit(f, in_shardings=NamedSharding(mesh, P("dp")))
+            """, tmp_path, [ShardingSpecAxisUnbound()])
+        assert got == []
+
+    def test_negative_unannotated_jit_has_no_mesh_opinion(self, tmp_path):
+        """A wsc under a PLAIN jit (no in_shardings) follows the
+        ambient device context the analyzer cannot see — quiet."""
+        got = run("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            @jax.jit
+            def f(x):
+                return jax.lax.with_sharding_constraint(x, P("dp"))
+            """, tmp_path, [ShardingSpecAxisUnbound()])
+        assert got == []
+
+    def test_rides_default_rules(self, tmp_path):
+        got = run("""
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+            s = NamedSharding(mesh, P("dq"))
+            """, tmp_path, DEFAULT_RULES)
+        assert "APX206" in rule_ids(got)
+
+
+# ------------------------------------ APX207 spec rank vs array rank
+class TestShardingSpecRankMismatch:
+    def test_positive_constraint_longer_than_creation_rank(self, tmp_path):
+        """The refactor wound: the tensor lost a dim, the annotation
+        kept it — a trace-time error deferred to the chip for
+        TPU-gated paths."""
+        got = run("""
+            import jax, jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            x = jnp.zeros((8, 128))
+            y = jax.lax.with_sharding_constraint(x, P("dp", None, "tp"))
+            """, tmp_path, [ShardingSpecRankMismatch()])
+        assert rule_ids(got) == ["APX207"]
+        assert "3 dimensions" in got[0].message
+        assert "rank 2" in got[0].message
+
+    def test_positive_device_put_and_aliased_dims(self, tmp_path):
+        """device_put sites count too, and dims thread through the
+        one-hop local lattice (`bn = 8`)."""
+        got = run("""
+            import jax, jax.numpy as jnp
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+            bn = 8
+            x = jnp.ones((bn, 128))
+            y = jax.device_put(x, NamedSharding(mesh, P("dp", "tp", None)))
+            """, tmp_path, [ShardingSpecRankMismatch()])
+        assert rule_ids(got) == ["APX207"]
+
+    def test_negative_numpy_random_signature_not_conflated(self, tmp_path):
+        """Review finding: np.random.normal(loc, SCALE, size) puts a
+        scalar where jax.random.normal puts the shape — claiming the
+        array is rank 1 there was a confirmed false positive.  Scalar
+        shapes only count for the zeros/ones (position-0) family."""
+        got = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+            x = np.random.normal(0, 1, (8, 128))
+            y = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+            """, tmp_path, [ShardingSpecRankMismatch()])
+        assert got == []
+
+    def test_negative_shorter_spec_and_unknown_ranks(self, tmp_path):
+        """Shorter specs are legal (trailing dims replicate); arrays
+        whose rank is out of static reach are trusted."""
+        got = run("""
+            import jax, jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            x = jnp.zeros((8, 128, 4))
+            ok = jax.lax.with_sharding_constraint(x, P("dp"))
+            exact = jax.lax.with_sharding_constraint(x, P("dp", None, "tp"))
+            dyn = jax.lax.with_sharding_constraint(load(), P("a", "b", "c"))
+            """, tmp_path, [ShardingSpecRankMismatch()])
+        assert got == []
+
+    def test_rides_default_rules(self, tmp_path):
+        got = run("""
+            import jax, jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            x = jnp.zeros((16,))
+            y = jax.lax.with_sharding_constraint(x, P("dp", "tp"))
+            """, tmp_path, DEFAULT_RULES)
+        assert "APX207" in rule_ids(got)
+
+
+# -------------------------- APX208 donated in/out sharding mismatch
+class TestDonatedShardingMismatch:
+    def test_positive_donated_arg_can_never_alias(self, tmp_path):
+        """The silent-drop class: in P('dp', None) matches no output
+        sharding, so XLA keeps the input AND the output alive — a
+        UserWarning nobody reads in CI logs."""
+        got = run("""
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+            step = jax.jit(f, donate_argnums=(0,),
+                           in_shardings=(NamedSharding(mesh, P("dp", None)),
+                                         NamedSharding(mesh, P())),
+                           out_shardings=(NamedSharding(mesh, P(None, "tp")),))
+            """, tmp_path, [DonatedShardingMismatch()])
+        assert rule_ids(got) == ["APX208"]
+        assert "argument 0 is donated" in got[0].message
+
+    def test_positive_partial_jit_decorator_spelling(self, tmp_path):
+        """Review finding: the ``@functools.partial(jax.jit, ...)``
+        decorator spelling carries the same three kwargs on the
+        partial call — the most common step-builder shape must not
+        dodge the rule."""
+        got = run("""
+            import functools
+
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+
+            @functools.partial(
+                jax.jit, donate_argnums=(0,),
+                in_shardings=(NamedSharding(mesh, P("dp", None)),),
+                out_shardings=(NamedSharding(mesh, P(None, "tp")),))
+            def step(state):
+                return state * 2
+            """, tmp_path, [DonatedShardingMismatch()])
+        assert rule_ids(got) == ["APX208"]
+
+    def test_negative_matching_modulo_trailing_nones(self, tmp_path):
+        """P('dp') and P('dp', None) are the SAME sharding — trailing
+        Nones replicate; flagging them was a false positive waiting to
+        happen.  Undonated args and unresolvable specs stay quiet."""
+        got = run("""
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+            ok = jax.jit(f, donate_argnums=(0,),
+                         in_shardings=(NamedSharding(mesh, P("dp", None)),),
+                         out_shardings=(NamedSharding(mesh, P("dp")),))
+            free = jax.jit(f, donate_argnums=(0,),
+                           in_shardings=(NamedSharding(mesh, P("dp")),),
+                           out_shardings=(make_out_spec(),))
+            undonated = jax.jit(f,
+                                in_shardings=(NamedSharding(mesh, P("dp")),),
+                                out_shardings=(NamedSharding(mesh, P("tp")),))
+            """, tmp_path, [DonatedShardingMismatch()])
+        assert got == []
+
+    def test_negative_no_out_shardings_means_xla_chooses(self, tmp_path):
+        got = run("""
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp",))
+            step = jax.jit(f, donate_argnums=(0,),
+                           in_shardings=(NamedSharding(mesh, P("dp")),))
+            """, tmp_path, [DonatedShardingMismatch()])
+        assert got == []
+
+    def test_rides_default_rules(self, tmp_path):
+        got = run("""
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(devs, ("dp", "tp"))
+            step = jax.jit(f, donate_argnums=(0,),
+                           in_shardings=(NamedSharding(mesh, P("dp")),),
+                           out_shardings=(NamedSharding(mesh, P("tp")),))
+            """, tmp_path, DEFAULT_RULES)
+        assert "APX208" in rule_ids(got)
 
 
 # ------------------------------- APX303 scratch/accumulator dtype vs dot
@@ -2678,3 +2945,148 @@ class TestRepoIsClean:
             timeout=600)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "baselined" in r.stderr
+
+
+# ------------------------------------------------ rule-hygiene meta-lint
+class TestRuleHygieneMetaLint:
+    """Every registered APX rule must ship documented and fixtured:
+    a docs/static_analysis.md table row, and a Test<RuleClass> class
+    here with at least one test_positive* and one test_negative*
+    method.  The next rule someone lands undocumented or untested
+    fails THIS test, not a review comment."""
+
+    def _rule_classes(self):
+        return {type(r).__name__: r.rule_id for r in DEFAULT_RULES}
+
+    def test_every_rule_has_a_docs_row(self):
+        docs = (REPO / "docs" / "static_analysis.md").read_text()
+        import re as _re
+
+        documented = set(_re.findall(r"^\|\s*(APX\d+)\s*\|", docs,
+                                     _re.M))
+        missing = {rid for rid in self._rule_classes().values()
+                   if rid not in documented}
+        assert not missing, (
+            f"rules with no docs/static_analysis.md table row: "
+            f"{sorted(missing)} — add the row (what it catches / why "
+            f"it only fails on the chip)")
+
+    def test_every_rule_has_positive_and_negative_fixtures(self):
+        import ast as _ast
+
+        tree = _ast.parse(Path(__file__).read_text())
+        classes = {
+            n.name: [m.name for m in n.body
+                     if isinstance(m, _ast.FunctionDef)]
+            for n in tree.body if isinstance(n, _ast.ClassDef)
+        }
+        problems = []
+        for cls, rid in self._rule_classes().items():
+            test_cls = f"Test{cls}"
+            methods = classes.get(test_cls)
+            if methods is None:
+                problems.append(f"{rid}: no {test_cls} class")
+                continue
+            if not any(m.startswith("test_positive") for m in methods):
+                problems.append(f"{rid}: {test_cls} has no "
+                                f"test_positive* fixture")
+            if not any(m.startswith("test_negative") for m in methods):
+                problems.append(f"{rid}: {test_cls} has no "
+                                f"test_negative* fixture")
+        assert not problems, "\n".join(problems)
+
+
+# ------------------------------------------- CLI performance and hygiene
+class TestCliPerformanceAndHygiene:
+    def test_repo_scan_stays_fast(self):
+        """The analyzer rides tier-1 AND pre-commit: the full repo scan
+        must stay interactive.  Measured ~8 s CPU on this 1-core box;
+        the 30 s budget is ~4x headroom while still catching an
+        accidentally-quadratic rule or fixpoint.  CPU time, not wall
+        time: this box's wall-clock tests false-fire under CPU
+        contention (the gpt_example watchdog class), and the hazard
+        this test guards is algorithmic, not scheduling."""
+        import time
+
+        paths = [str(REPO / "apex_tpu"), str(REPO / "bench.py")]
+        t0 = time.process_time()
+        analyze_paths(paths, DEFAULT_RULES, rel_to=str(REPO))
+        dt = time.process_time() - t0
+        assert dt < 30.0, f"repo scan took {dt:.1f}s CPU (budget 30s)"
+
+    def test_jobs_results_identical(self):
+        """--jobs may change wall time, never findings: the parallel
+        parse/index pass over a real subtree must produce byte-equal
+        findings to the serial one."""
+        paths = [str(REPO / "apex_tpu" / "ops"), str(REPO / "bench.py")]
+        serial = analyze_paths(paths, DEFAULT_RULES, rel_to=str(REPO))
+        parallel = analyze_paths(paths, DEFAULT_RULES, rel_to=str(REPO),
+                                 jobs=2)
+        assert [f.to_json() for f in serial] \
+            == [f.to_json() for f in parallel]
+
+    def test_timing_collects_per_rule_walltime(self):
+        timings = {}
+        analyze_paths([str(REPO / "apex_tpu" / "analysis")],
+                      DEFAULT_RULES, timings=timings)
+        assert "<load>" in timings and "<link>" in timings
+        ids = {r.rule_id for r in DEFAULT_RULES}
+        assert ids <= set(timings), ids - set(timings)
+        assert all(v >= 0 for v in timings.values())
+
+    def test_cli_check_baseline_fails_on_stale_entry(self, tmp_path):
+        """--check-baseline turns a stale suppression into exit 1 —
+        without it the note on stderr scrolls past and the entry rots
+        (matching the next unrelated finding that drifts into its
+        substring)."""
+        import os
+
+        (tmp_path / "mod.py").write_text("import os\n")
+        (tmp_path / "analysis_baseline.json").write_text(json.dumps({
+            "entries": [{"rule": "APX101", "path": "never.py",
+                         "symbol": "*", "contains": "",
+                         "justification": "covers deleted code"}]}))
+        env = dict(os.environ, PYTHONPATH=str(REPO))
+        base = [sys.executable, "-m", "apex_tpu.analysis", "mod.py"]
+        clean = subprocess.run(base, cwd=str(tmp_path), env=env,
+                               capture_output=True, text=True, timeout=120)
+        assert clean.returncode == 0, clean.stderr
+        checked = subprocess.run(base + ["--check-baseline"],
+                                 cwd=str(tmp_path), env=env,
+                                 capture_output=True, text=True,
+                                 timeout=120)
+        assert checked.returncode == 1
+        assert "stale baseline entry" in checked.stderr
+        assert "--check-baseline" in checked.stderr
+
+    def test_cli_sarif_failure_prints_human_summary(self, tmp_path):
+        """The red-CI-log fix: --format sarif on a failing tree must
+        name the findings count and rule ids on stderr, not just dump
+        the SARIF document."""
+        import os
+
+        (tmp_path / "bad.py").write_text(textwrap.dedent("""
+            import jax, os
+
+            @jax.jit
+            def f(x):
+                return x if os.environ.get("FLAG") else -x
+            """))
+        env = dict(os.environ, PYTHONPATH=str(REPO))
+        r = subprocess.run(
+            [sys.executable, "-m", "apex_tpu.analysis", "bad.py",
+             "--no-baseline", "--format", "sarif"],
+            cwd=str(tmp_path), env=env, capture_output=True, text=True,
+            timeout=120)
+        assert r.returncode == 1
+        assert "APX101" in r.stderr and "finding(s)" in r.stderr
+        doc = json.loads(r.stdout)   # the SARIF document stays valid
+        assert doc["runs"][0]["results"]
+
+    def test_repo_scan_has_no_stale_baseline_via_cli_flag(self):
+        """The repo-level --check-baseline run the CI target uses."""
+        r = subprocess.run(
+            [sys.executable, "-m", "apex_tpu.analysis", "apex_tpu",
+             "bench.py", "--check-baseline"],
+            cwd=str(REPO), capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr
