@@ -9,7 +9,9 @@
 #                   the upload has content exactly when there ARE
 #                   findings) and fails on findings or stale
 #                   suppressions (--check-baseline), with the
-#                   human-readable rule-id summary on stderr
+#                   human-readable rule-id summary on stderr; the
+#                   per-rule timing JSON (analysis_timing.json) rides
+#                   along so CI can attribute a slow scan to a rule
 #   make bench-gate the perf-regression gate: benchmarks/bench_compare.py
 #                   diffs the two newest BENCH_*.json rounds' headline
 #                   columns (no-op when fewer than two rounds exist —
@@ -19,7 +21,7 @@
 # contract.
 
 PYTHON ?= python
-JOBS   ?= 1
+JOBS   ?= 2
 
 .PHONY: ci test analyze bench-gate
 
@@ -32,7 +34,8 @@ test:
 
 analyze:
 	$(PYTHON) -m apex_tpu.analysis apex_tpu bench.py \
-	  --format sarif --check-baseline --jobs $(JOBS) > analysis.sarif
+	  --format sarif --check-baseline --jobs $(JOBS) \
+	  --timing-json analysis_timing.json > analysis.sarif
 
 bench-gate:
 	@n=$$(ls BENCH_r*.json 2>/dev/null | wc -l); \

@@ -25,6 +25,16 @@ machine-checked invariants):
   a registered-axis collective reachable only from ``jit``/``pjit``
   (no axis bound), or under a ``shard_map`` nest that binds only OTHER
   axes.
+- **APX209/210/211** multi-process divergence (``rules_divergence`` +
+  the ``dataflow`` host-divergence taint lattice): a rank-divergent
+  predicate (``process_index``, env/hostname/clock/RNG/filesystem
+  reads, per-rank branches) guarding the launch of a collective-
+  bearing traced step (a static pod-deadlock proof), a rank-divergent
+  value baked into a jit static arg / ``Mesh`` / bucket plan
+  (divergent compiled programs), and rank-divergent engine/fallback
+  dispatch in multi-process-aware code (the ``registry_engaged``
+  class, generalized).  Acquittal seam:
+  ``apex_tpu.resilience.uniformity.assert_uniform``.
 - **APX206/207/208** sharding-annotation consistency
   (``rules_sharding`` — the GSPMD tier): a ``PartitionSpec`` axis no
   reaching mesh binds (a ``with_sharding_constraint`` from a STALE
@@ -97,6 +107,10 @@ from apex_tpu.analysis.rules_collectives import (
     CollectiveOutsideSpmdContext, CollectiveTupleAxisUnbound,
     UnknownCollectiveAxis,
 )
+from apex_tpu.analysis.rules_divergence import (
+    TaintedEngineDispatchDivergence, TaintedPredicateGuardsCollective,
+    TaintedValueShapesCompiledProgram,
+)
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_sharding import (
     DonatedShardingMismatch, ShardingSpecAxisUnbound,
@@ -146,6 +160,9 @@ def default_rules(vmem_budget_bytes=None):
         ShardingSpecAxisUnbound(),
         ShardingSpecRankMismatch(),
         DonatedShardingMismatch(),
+        TaintedPredicateGuardsCollective(),
+        TaintedValueShapesCompiledProgram(),
+        TaintedEngineDispatchDivergence(),
         BlockShapeTilingViolation(),
         BlockSpecIndexMapArity(),
         HardCodedSublaneAlignment(),

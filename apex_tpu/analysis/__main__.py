@@ -79,10 +79,25 @@ def main(argv=None) -> int:
                          "across N worker processes (module linking and "
                          "rule checks stay single-pass; results are "
                          "identical to --jobs 1)")
+    ap.add_argument("--only-rules", default=None, metavar="IDS",
+                    help="comma-separated rule ids to run (e.g. "
+                         "APX209,APX210) — everything else is skipped; "
+                         "unknown ids are a usage error, not a silent "
+                         "no-op scan")
+    ap.add_argument("--skip-rules", default=None, metavar="IDS",
+                    help="comma-separated rule ids to skip; combines "
+                         "with --only-rules (skip wins)")
     ap.add_argument("--timing", action="store_true",
                     help="print per-rule wall time (plus the shared "
                          "<load>/<link> phases) to stderr, slowest "
-                         "first")
+                         "first, then a per-family rollup (trace/io "
+                         "APX1xx, distributed APX2xx, kernel APX3xx, "
+                         "numerics APX4xx)")
+    ap.add_argument("--timing-json", default=None, metavar="FILE",
+                    help="also write the raw timings dict (rule id -> "
+                         "seconds, plus <load>/<link>) as JSON to FILE "
+                         "— the CI artifact next to the SARIF "
+                         "(implies --timing collection)")
     ap.add_argument("--axes", default=None,
                     help="comma-separated collective-axis registry "
                          "override (default: *_AXIS constants parsed "
@@ -109,13 +124,48 @@ def main(argv=None) -> int:
     rules = default_rules(
         vmem_budget_bytes=None if args.vmem_budget_mib is None
         else int(args.vmem_budget_mib * 2 ** 20))
-    timings = {} if args.timing else None
+    known = {r.rule_id for r in rules}
+
+    def _rule_ids(flag, value):
+        ids = [x.strip() for x in value.split(",") if x.strip()]
+        unknown = sorted(set(ids) - known)
+        if unknown:
+            ap.error(f"{flag}: unknown rule id(s) {unknown} — "
+                     f"available: {', '.join(sorted(known))}")
+        return set(ids)
+
+    if args.only_rules is not None:
+        rules = tuple(r for r in rules
+                      if r.rule_id in _rule_ids("--only-rules",
+                                                args.only_rules))
+    if args.skip_rules is not None:
+        rules = tuple(r for r in rules
+                      if r.rule_id not in _rule_ids("--skip-rules",
+                                                    args.skip_rules))
+    if not rules:
+        ap.error("--only-rules/--skip-rules left nothing to run")
+    timings = {} if (args.timing or args.timing_json) else None
     findings = analyze_paths(paths, rules, registry, jobs=args.jobs,
                              timings=timings)
-    if timings is not None:
+    if args.timing and timings is not None:
         for name, secs in sorted(timings.items(),
                                  key=lambda kv: -kv[1]):
             print(f"timing: {name:10s} {secs:8.3f}s", file=sys.stderr)
+        families = {"APX1": "trace/io", "APX2": "distributed",
+                    "APX3": "kernel", "APX4": "numerics"}
+        rollup: dict = {}
+        for name, secs in timings.items():
+            fam = families.get(name[:4],
+                               "shared" if name.startswith("<") else
+                               "other")
+            rollup[fam] = rollup.get(fam, 0.0) + secs
+        for fam, secs in sorted(rollup.items(), key=lambda kv: -kv[1]):
+            print(f"timing: family {fam:12s} {secs:8.3f}s",
+                  file=sys.stderr)
+    if args.timing_json and timings is not None:
+        with open(args.timing_json, "w") as fh:
+            json.dump(dict(sorted(timings.items())), fh, indent=2)
+            fh.write("\n")
 
     entries = []
     baseline_path = args.baseline or _find_default_baseline(paths)

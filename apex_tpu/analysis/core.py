@@ -562,6 +562,7 @@ def _load_module_task(args):
         from apex_tpu.analysis import dataflow
 
         dataflow.scope_index(loaded)
+        dataflow.taint_index(loaded)
     return loaded
 
 
@@ -639,6 +640,9 @@ def analyze_paths(paths: Iterable[str], rules: Iterable[Rule],
     # (imported here, not at module top: dataflow imports core)
     from apex_tpu.analysis import dataflow
     dataflow.link_axis_scopes(ctxs)
+    # ... and the host-divergence taint lattice runs ITS cross-module
+    # fixpoint (imported taint-returning helpers, taint cycles)
+    dataflow.link_taint(ctxs)
     if timings is not None:
         timings["<link>"] = _time.monotonic() - t0
     for rule in rules:

@@ -793,3 +793,340 @@ def literal_dims(shape_node: Optional[ast.AST],
         else:
             return None
     return out
+
+
+# ------------------------------------------------------------ taint lattice
+#: Host-divergence taint sources (the divergence tier, APX209–211):
+#: dotted-suffix call patterns whose RESULT can differ across the
+#: processes of one pod — per-process identity, environment, clocks,
+#: host RNG, filesystem state.  Matched like
+#: ``rules_trace._HAZARD_CALLS`` (``d == suffix`` or
+#: ``d.endswith("." + suffix)``), so ``jax.process_index`` and a bare
+#: ``process_index`` both hit.  ``process_count`` is on the list
+#: deliberately: its VALUE is uniform, but code branching on it
+#: ("am I multi-process?") is per-topology dispatch — exactly the
+#: registry_engaged class APX211 exists to gate behind the uniformity
+#: seam.
+_TAINT_CALLS: Dict[str, str] = {
+    "process_index": "per-process rank (process_index)",
+    "process_count": "process topology (process_count)",
+    "gethostname": "hostname (gethostname)",
+    "platform.node": "hostname (platform.node)",
+    "os.uname": "host identity (os.uname)",
+    "getpid": "process id (getpid)",
+    "getenv": "environment variable",
+    "environ.get": "environment variable",
+    "time.time": "wall clock",
+    "time.monotonic": "wall clock",
+    "time.perf_counter": "wall clock",
+    "time.time_ns": "wall clock",
+    "datetime.now": "wall clock",
+    "datetime.utcnow": "wall clock",
+    "os.listdir": "filesystem state (os.listdir)",
+    "os.scandir": "filesystem state (os.scandir)",
+    "glob.glob": "filesystem state (glob.glob)",
+    "os.stat": "filesystem state (os.stat)",
+    "path.exists": "filesystem state (os.path.exists)",
+    "open": "filesystem read (open)",
+    "read_text": "filesystem read (read_text)",
+    "read_bytes": "filesystem read (read_bytes)",
+}
+
+#: Host-RNG module prefixes — same set APX101 treats as trace-time
+#: hazards; here they are divergence sources (each process seeds its
+#: own generator).
+_TAINT_RANDOM_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+class TaintIndex:
+    """Per-module host-divergence taint: which expressions carry a
+    value that can DIFFER across the processes of one pod.
+
+    Built like the dtype lattice, not the scope index: per-scope
+    line-ordered assignment *events* replayed at each query line, so
+
+    - a straight-line rebind to a clean value CLEARS taint (the
+      shadowed-rebind acquittal: claiming taint after ``rank = 0`` is
+      a false positive waiting to happen);
+    - an assignment nested under ``if``/``while``/``for``/``try`` only
+      JOINS (taint wins, clean does not clear): the other branch may
+      have left the tainted value in place;
+    - an assignment lexically under an ``if``/``while`` whose test is
+      tainted becomes tainted itself (control dependence — the
+      "per-rank branches" source).
+
+    ``tainted_returns`` (qualname → reason) is the module-local
+    fixpoint over ``return`` statements; :func:`link_taint` runs the
+    import-resolved cross-module fixpoint on top, planting
+    ``external_calls`` spellings (monotone — a taint CYCLE between two
+    modules converges because entries are only ever added).
+
+    The quiet-on-unknown contract holds throughout: a name this pass
+    cannot see assigned (parameters, attributes, comprehension
+    targets) is clean — threading a value in as an argument is exactly
+    the blessed pattern."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        # share the scope index's name→function alias map so
+        # ``g = partial(f, ...)`` resolves the same way everywhere
+        self._fn_aliases = scope_index(ctx)._fn_aliases
+        # and its name→value map, for the aliases the fn map skips:
+        # ``who = partial(jax.process_index)`` (Attribute target)
+        self._value_aliases = scope_index(ctx)._value_aliases
+        #: qualname -> reason: functions whose RETURN value is tainted
+        self.tainted_returns: Dict[str, str] = {}
+        #: call spelling (bare or dotted) -> reason, planted by
+        #: :func:`link_taint` from other modules' tainted returns
+        self.external_calls: Dict[str, str] = {}
+        # innermost-enclosing-function -> its single-target Name
+        # assignments in source order (None = module scope)
+        self._scope_assigns: Dict[Optional[ast.AST], List[ast.Assign]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                owner = ctx.enclosing_function(node)
+                self._scope_assigns.setdefault(owner, []).append(node)
+        for assigns in self._scope_assigns.values():
+            assigns.sort(key=lambda n: (n.lineno, n.col_offset))
+        # event caches are generation-stamped: any growth of
+        # tainted_returns/external_calls invalidates every replayed env
+        self._gen = 0
+        self._event_gen = -1
+        self._events_cache: Dict[Optional[ast.AST], list] = {}
+        self._fixpoint()
+
+    def size(self) -> int:
+        return len(self.tainted_returns) + len(self.external_calls)
+
+    # ----------------------------------------------------------- fixpoint
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for qn, info in self.ctx.functions.items():
+                if qn in self.tainted_returns:
+                    continue
+                node = info.node
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) and sub.value is not None \
+                            and self.ctx.enclosing_function(sub) is node:
+                        r = self.taint_of(sub.value)
+                        if r is not None:
+                            self.tainted_returns[qn] = r
+                            self._gen += 1
+                            changed = True
+                            break
+
+    def mark_external(self, spelling: str, reason: str) -> bool:
+        """Record an imported callable as taint-returning (planted by
+        :func:`link_taint`) and re-run the local fixpoint; True if new."""
+        if spelling in self.external_calls:
+            return False
+        self.external_calls[spelling] = reason
+        self._gen += 1
+        self._fixpoint()
+        return True
+
+    # ------------------------------------------------------------- events
+    def _events(self, owner: Optional[ast.AST]) -> list:
+        """``(lineno, name, reason|None, conditional)`` per single-target
+        assignment of one scope, in source order.  Built incrementally:
+        evaluating event *i*'s value replays exactly the prefix of
+        earlier events, so chains resolve without a second fixpoint."""
+        if self._event_gen != self._gen:
+            self._events_cache.clear()
+            self._event_gen = self._gen
+        ev = self._events_cache.get(owner)
+        if ev is None:
+            ev = self._events_cache[owner] = []
+            for node in self._scope_assigns.get(owner, []):
+                name = node.targets[0].id
+                reason = self.taint_of(node.value)
+                conds = self._cond_ancestors(node, owner)
+                if reason is None:
+                    for c in conds:
+                        if isinstance(c, (ast.If, ast.While)):
+                            t = self.taint_of(c.test)
+                            if t is not None:
+                                reason = ("assigned under a "
+                                          f"rank-divergent branch ({t})")
+                                break
+                ev.append((node.lineno, name, reason, bool(conds)))
+        return ev
+
+    def _cond_ancestors(self, node: ast.AST,
+                        owner: Optional[ast.AST]) -> List[ast.AST]:
+        out = []
+        cur = self.ctx.parent(node)
+        while cur is not None and cur is not owner:
+            if isinstance(cur, (ast.If, ast.While, ast.For, ast.Try)):
+                out.append(cur)
+            cur = self.ctx.parent(cur)
+        return out
+
+    def _env_at(self, owner: Optional[ast.AST],
+                line: int) -> Dict[str, Optional[str]]:
+        env: Dict[str, Optional[str]] = {}
+        for ln, name, reason, cond in self._events(owner):
+            if ln >= line:
+                break
+            if reason is not None:
+                env[name] = reason
+            elif cond:
+                # conditional clean assignment JOINS: the other branch
+                # may have left a tainted value in place
+                env.setdefault(name, None)
+            else:
+                env[name] = None
+        return env
+
+    @staticmethod
+    def _param_names(fn: ast.AST) -> FrozenSet[str]:
+        a = fn.args
+        names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+        return frozenset(names)
+
+    def _lookup(self, node: ast.Name) -> Optional[str]:
+        owner = self.ctx.enclosing_function(node)
+        line = node.lineno
+        while True:
+            if owner is not None and node.id in self._param_names(owner):
+                return None  # parameters shadow; threaded-in args are clean
+            env = self._env_at(owner, line)
+            if node.id in env:
+                return env[node.id]
+            if owner is None:
+                return None
+            owner = self.ctx.enclosing_function(owner)
+
+    # -------------------------------------------------------------- query
+    def taint_of(self, expr: ast.AST) -> Optional[str]:
+        """The host-divergence reason carried by ``expr``, or None.
+        Any tainted subterm taints the whole expression; lambda bodies
+        are opaque values (defining one evaluates nothing)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            r = self._node_taint(node)
+            if r is not None:
+                return r
+            if not isinstance(node, ast.Lambda):
+                stack.extend(ast.iter_child_nodes(node))
+        return None
+
+    def _node_taint(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            for suffix, what in _TAINT_CALLS.items():
+                if d == suffix or d.endswith("." + suffix):
+                    return f"{what}: {d}(...)"
+            if any(d.startswith(p) for p in _TAINT_RANDOM_PREFIXES):
+                return f"host RNG: {d}(...)"
+            return self._call_taint(node, d)
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            d = dotted_name(node.value) or ""
+            if d in ("os.environ", "environ"):
+                return "environment variable: os.environ[...]"
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            return self._lookup(node)
+        return None
+
+    def _call_taint(self, node: ast.Call, d: str) -> Optional[str]:
+        """Taint of a call's RETURN value: module-local functions via
+        ``tainted_returns`` (through the shared ``partial``/name alias
+        map), imported ones via the linker-planted ``external_calls``."""
+        name = last_name(node.func)
+        if name is None and isinstance(node.func, ast.Call) \
+                and _is_partial(node.func) and node.func.args:
+            # partial(f, ...)(...) called inline
+            name = last_name(node.func.args[0])
+            d = dotted_name(node.func.args[0]) or (name or "")
+        if name is None:
+            return None
+        base = self._fn_aliases.get(name, name)
+        if base == name:
+            val = self._value_aliases.get(name)
+            if isinstance(val, ast.Call) and _is_partial(val) and val.args:
+                base = dotted_name(val.args[0]) or name
+        if base != name:
+            # the alias resolved to a DIRECT taint source spelled out
+            # (who = functools.partial(jax.process_index); who())
+            for suffix, what in _TAINT_CALLS.items():
+                if base == suffix or base.endswith("." + suffix):
+                    return f"{what}: {base}(...)"
+            if base.startswith(_TAINT_RANDOM_PREFIXES):
+                return f"host RNG: {base}(...)"
+        scope = self.ctx.enclosing_qualname(node)
+        scope = "" if scope == "<module>" else scope
+        resolved = self.ctx.resolve_function(base, scope)
+        if resolved is not None:
+            r = self.tainted_returns.get(resolved)
+            if r is not None:
+                return f"return of {resolved} ({r})"
+            return None
+        for spelling in (d, base):
+            r = self.external_calls.get(spelling)
+            if r is not None:
+                return f"return of {r}"
+        return None
+
+
+def taint_index(ctx: ModuleContext) -> TaintIndex:
+    """The (cached) taint index of one module.  For multi-file runs,
+    :func:`link_taint` must run first so imported taint-returning
+    helpers are linked in (same contract as the traced and axis-scope
+    indexes)."""
+    idx = getattr(ctx, "_taint_index", None)
+    if idx is None:
+        idx = TaintIndex(ctx)
+        ctx._taint_index = idx
+    return idx
+
+
+def taint_reason(ctx: ModuleContext, expr: ast.AST) -> Optional[str]:
+    """Why ``expr``'s value can differ across the processes of one pod,
+    or None — the divergence rules' one query."""
+    return taint_index(ctx).taint_of(expr)
+
+
+def link_taint(ctxs: Dict[str, Optional[ModuleContext]]) -> None:
+    """Cross-module taint fixpoint, mirroring :func:`link_axis_scopes`:
+    a function imported from a module whose taint index proved its
+    return rank-divergent taints every call spelling here.  Monotone —
+    spellings are only ever added — so a taint cycle between modules
+    converges instead of oscillating.  Ambiguous module names (None
+    entries) are never linked through."""
+    live = [c for c in ctxs.values() if c is not None]
+    for c in live:
+        taint_index(c)
+    changed = True
+    while changed:
+        changed = False
+        for c in live:
+            idx = taint_index(c)
+            for local, (mod, attr) in c.from_imports.items():
+                if not mod:
+                    continue
+                src = ctxs.get(mod)
+                if src is None or src is c:
+                    continue
+                r = taint_index(src).tainted_returns.get(attr)
+                if r is not None and idx.mark_external(
+                        local, f"{mod}.{attr} ({r})"):
+                    changed = True
+            for alias, mod in c.import_aliases.items():
+                src = ctxs.get(mod)
+                if src is None or src is c:
+                    continue
+                for qn, r in list(taint_index(src).tainted_returns.items()):
+                    if "." in qn:
+                        continue
+                    if idx.mark_external(f"{alias}.{qn}",
+                                         f"{mod}.{qn} ({r})"):
+                        changed = True

@@ -39,9 +39,10 @@ import jax
 
 __all__ = [
     "hlo_text", "count_collectives", "operand_dtypes",
-    "collective_sites", "mesh_axis_groups", "assert_collective_axes",
-    "assert_collective_dtype", "assert_no_host_transfer",
-    "assert_no_recompile", "assert_no_whole_tree_concat",
+    "collective_sites", "collective_schedule", "mesh_axis_groups",
+    "assert_collective_axes", "assert_collective_dtype",
+    "assert_no_host_transfer", "assert_no_recompile",
+    "assert_no_whole_tree_concat", "assert_same_collective_schedule",
     "assert_donation_covers", "donated_buffer_count",
     "host_transfer_sites",
     "arg_shardings", "sharding_of", "assert_sharding",
@@ -155,6 +156,149 @@ def _groups_key(groups) -> Optional[frozenset]:
     if groups is None:
         return None
     return frozenset(frozenset(g) for g in groups)
+
+
+#: the cross-device ops a schedule tracks, in one program-order scan.
+#: ``reduce`` (local) is deliberately absent; ``collective_permute``
+#: and ``collective_broadcast`` carry no replica_groups — their groups
+#: entry is None and the kind/dtype/shape still pin the sequence.
+_SCHEDULE_KINDS = (
+    "all_gather", "all_reduce", "all_to_all", "collective_broadcast",
+    "collective_permute", "reduce_scatter",
+)
+
+
+def collective_schedule(artifact, mesh=None) -> List[dict]:
+    """The ordered cross-device communication sequence of a lowering:
+    one entry per collective op in program order, each
+    ``{"kind", "dtype", "shape", "groups"}`` — ``shape`` is the first
+    operand's dims tuple (None when unparseable), ``groups`` the
+    order-insensitive :func:`_groups_key` of its replica groups.
+
+    Two processes that lower DIFFERENT schedules for the same step
+    deadlock the pod: each rank blocks in its own next collective,
+    device-side, with no error.  This is the thing
+    ``assert_same_collective_schedule`` pins and the APX209/210/211
+    divergence rules prove statically.
+
+    With ``mesh=`` given, each entry also carries ``"axes"`` — the
+    mesh-axis subset whose :func:`mesh_axis_groups` partition equals
+    the op's groups (None when no subset matches, e.g. GSPMD-chosen
+    groupings that cross axis boundaries)."""
+    txt = hlo_text(artifact)
+    axis_of = None
+    if mesh is not None:
+        import itertools
+
+        names = list(mesh.axis_names)
+        axis_of = {}
+        for r in range(1, len(names) + 1):
+            for combo in itertools.combinations(names, r):
+                key = _groups_key(mesh_axis_groups(mesh, combo))
+                axis_of.setdefault(key, combo)
+    occurrences = []
+    for kind in _SCHEDULE_KINDS:
+        # StableHLO/MHLO dotted spelling (jit/shard_map lowerings)
+        for m in re.finditer(
+                r'"?(?:stablehlo|mhlo)\.' + re.escape(kind) + r'\b', txt):
+            occurrences.append((m.start(), kind, "mlir", m))
+        # compiled-HLO dashed spelling (post-SPMD-partitioning modules;
+        # only the plain/-start op, never the async -done — same rule
+        # as spmd_collective_sites)
+        dashed = kind.replace("_", "-")
+        for m in re.finditer(
+                r'=\s*\(?([a-zA-Z0-9]+)\[([0-9,]*)\][^=\n]*?\s'
+                + re.escape(dashed) + r'(?:-start)?\(', txt):
+            occurrences.append((m.start(), kind, "hlo", m))
+    occurrences.sort(key=lambda o: o[0])
+    schedule = []
+    for pos, kind, form, m in occurrences:
+        dtype = shape = None
+        if form == "mlir":
+            window = txt[pos:pos + _ATTR_WINDOW]
+            if kind in _REGION_OPS:
+                tm = re.search(r'\}\)\s*:\s*\(tensor<([0-9a-zA-Z_x]*)>',
+                               window, re.S)
+            else:
+                tm = re.search(r':\s*\(tensor<([0-9a-zA-Z_x]*)>', window)
+            if tm is not None:
+                parts = tm.group(1).split("x")
+                dtype = parts[-1] or None
+                try:
+                    shape = tuple(int(d) for d in parts[:-1])
+                except ValueError:
+                    shape = None
+            groups = _parse_replica_groups(window)
+        else:
+            dtype = m.group(1)
+            try:
+                shape = tuple(int(d) for d in m.group(2).split(",")
+                              if d.strip())
+            except ValueError:
+                shape = None
+            line_end = txt.find("\n", m.end())
+            window = txt[m.end():
+                         line_end if line_end != -1 else len(txt)]
+            gm = re.search(
+                r'replica_groups=(\{\{[^}]*(?:\},\{[^}]*)*\}\}|'
+                r'\[[^\]]+\]<=\[[^\]]+\](?:T\([\d,]+\))?)', window)
+            groups = _parse_hlo_groups(gm.group(1)) if gm else None
+        entry = {
+            "kind": kind,
+            "dtype": dtype,
+            "shape": shape,
+            "groups": _groups_key(groups),
+        }
+        if axis_of is not None:
+            entry["axes"] = axis_of.get(entry["groups"])
+        schedule.append(entry)
+    return schedule
+
+
+def _schedule_entry_str(entry: dict) -> str:
+    groups = entry["groups"]
+    g = "-" if groups is None else \
+        "|".join(",".join(str(i) for i in sorted(grp))
+                 for grp in sorted(groups, key=min))
+    axes = entry.get("axes")
+    over = f" over {axes}" if axes else ""
+    return (f"{entry['kind']}<{'x'.join(map(str, entry['shape'] or ()))}"
+            f"x{entry['dtype']}> groups=[{g}]{over}")
+
+
+def assert_same_collective_schedule(*artifacts, labels=None,
+                                    mesh=None) -> List[List[dict]]:
+    """Assert every lowering emits the IDENTICAL ordered collective
+    sequence (kind, dtype, shape, replica groups, position by
+    position).  This is the single-process proof of multi-process
+    safety: rank-specialized variants of one step that lower different
+    schedules WILL wedge a real pod, and this assertion names the
+    first diverging op instead.  Returns the schedules (first is the
+    reference)."""
+    if len(artifacts) < 2:
+        raise ValueError("need at least two lowerings to compare")
+    if labels is None:
+        labels = [f"variant[{i}]" for i in range(len(artifacts))]
+    labels = list(labels)
+    if len(labels) != len(artifacts):
+        raise ValueError(f"{len(labels)} labels for "
+                         f"{len(artifacts)} lowerings")
+    schedules = [collective_schedule(a, mesh=mesh) for a in artifacts]
+    ref, ref_label = schedules[0], labels[0]
+    for label, sched in zip(labels[1:], schedules[1:]):
+        for i, (a, b) in enumerate(zip(ref, sched)):
+            assert a == b, (
+                f"collective schedules diverge at op {i}: "
+                f"{ref_label} lowers {_schedule_entry_str(a)}, "
+                f"{label} lowers {_schedule_entry_str(b)} — on a pod "
+                f"these ranks block in different collectives and the "
+                f"step wedges device-side with no error")
+        assert len(ref) == len(sched), (
+            f"collective schedules diverge in length: {ref_label} "
+            f"lowers {len(ref)} collective(s), {label} lowers "
+            f"{len(sched)} — the longer program blocks in a "
+            f"collective its peers never enter")
+    return schedules
 
 
 def count_collectives(artifact, kind: str, *,

@@ -1,0 +1,448 @@
+"""Multi-process divergence rules (the ``registry_engaged`` class,
+scaled into an analyzer tier).
+
+One pod, N processes, ONE SPMD program: every host must lower the same
+computation in the same order, or the first mismatched collective
+wedges every device with no error — the deadlock the watchdog can only
+report after the fact.  The repo has hit this class three times by
+hand (the per-process kernel degrade ``registry_engaged`` disengages,
+rank-gated goodput accounting, per-rank elastic paths); these rules
+prove it absent statically, driven by the host-divergence taint
+lattice (``dataflow.taint_reason``: ``process_index``/
+``process_count``, env/hostname/clock/RNG/filesystem reads, and
+values assigned under rank-divergent branches).
+
+- **APX209**: a rank-divergent predicate guards the LAUNCH of a traced
+  computation that reaches a registered-axis collective — the static
+  deadlock proof: processes where the predicate differs skip the
+  launch while their peers block in the collective forever.  Quiet
+  when both branches launch the SAME traced functions (a uniform
+  program with divergent inputs is fine).
+- **APX210**: a rank-divergent value flows into something that SHAPES
+  the compiled program — a jit static argument, ``Mesh`` construction,
+  or a bucketing/sync plan — so peers compile DIFFERENT programs from
+  identical source; the divergence surfaces as a wedge or a sharding
+  mismatch, never at the sink.
+- **APX211**: a rank-divergent predicate gates engine/fallback/kernel
+  dispatch in a module that is multi-process aware (mentions
+  ``process_count``) — the generalized ``registry_engaged`` invariant:
+  a per-process impl choice lowers divergent collective programs
+  across the pod.
+
+Acquittal seam (all three rules): a call to ``assert_uniform``/
+``check_uniform``/``register_uniform``
+(:mod:`apex_tpu.resilience.uniformity`) in the enclosing function pins
+the decision to the runtime uniformity contract — the divergence is
+then detected loudly at startup/cadence instead of wedging, which is
+exactly the remediation these rules' fix hints prescribe.
+
+Known limits (documented, deliberate): launch reachability is
+module-local (a collective hidden behind an import stays quiet —
+cross-module taint is linked, cross-module CALL GRAPHS for the
+collective walk are not); the early-return spelling (``if rank: return``
+before an unconditional launch) is control divergence this pass does
+not model.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from apex_tpu.analysis import dataflow
+from apex_tpu.analysis.core import (
+    TRACE_ENTRYPOINTS, Finding, ModuleContext, Rule, dotted_name,
+    last_name,
+)
+from apex_tpu.analysis.rules_collectives import _COLLECTIVES, _axis_literals
+
+#: Calls that pin a host decision to the runtime uniformity contract —
+#: seeing one in the enclosing function acquits the divergence rules.
+_UNIFORMITY_SEAMS = {"assert_uniform", "check_uniform", "register_uniform"}
+
+#: jit spellings whose static args shape the compiled program.
+_JIT_NAMES = {"jit", "pjit"}
+
+#: Bucketing/sync plan builders whose inputs shape the collective
+#: program (``contrib.optimizers``: per-bucket reduce-scatters).
+_PLAN_BUILDERS = {"plan_of", "plan_of_shapes", "hierarchical_plan"}
+
+#: Keyword names that size a plan wherever they appear — a divergent
+#: cap/world splits buckets differently on one rank.
+_PLAN_SHAPE_KWARGS = {"cap_bytes", "bucket_cap_mb", "world_size",
+                      "shard_pad"}
+
+#: Engine/impl dispatch markers for APX211 (lowercased substring match
+#: on the dispatched callable's dotted name).
+_DISPATCH_MARKERS = ("engine", "fallback", "kernel", "impl", "pallas")
+
+
+def _acquitted(ctx: ModuleContext, node: ast.AST) -> bool:
+    scope = ctx.enclosing_function(node) or ctx.tree
+    for sub in ast.walk(scope):
+        if isinstance(sub, ast.Call) \
+                and last_name(sub.func) in _UNIFORMITY_SEAMS:
+            return True
+    return False
+
+
+def _reaches_registered_collective(ctx: ModuleContext, qn: str,
+                                   seen: Set[str]) -> bool:
+    """Module-local transitive walk: does ``qn``'s body (or a local
+    callee's) invoke a collective over a registered axis literal?"""
+    if qn in seen:
+        return False
+    seen.add(qn)
+    info = ctx.functions.get(qn)
+    if info is None:
+        return False
+    for sub in ast.walk(info.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = last_name(sub.func)
+        if name in _COLLECTIVES:
+            for _node, lit in _axis_literals(sub, _COLLECTIVES[name]):
+                if lit in ctx.axis_registry:
+                    return True
+            continue
+        if name is None:
+            continue
+        resolved = ctx.resolve_function(name, qn)
+        if resolved is not None \
+                and _reaches_registered_collective(ctx, resolved, seen):
+            return True
+    return False
+
+
+def _traced_target(ctx: ModuleContext, call: ast.Call) -> Optional[str]:
+    """The traced function a host call site launches: a direct call to
+    a traced def, ``jit(f)(...)``/``shard_map(f, ...)(...)`` inline, or
+    a name value-aliased to such an entry call (``step = jit(f)``)."""
+    func = call.func
+    if isinstance(func, ast.Call) \
+            and last_name(func.func) in TRACE_ENTRYPOINTS and func.args:
+        func = func.args[0]
+    name = last_name(func) if func is not None else None
+    if name is None:
+        return None
+    val = dataflow.value_aliases(ctx).get(name)
+    if isinstance(val, ast.Call) \
+            and last_name(val.func) in TRACE_ENTRYPOINTS and val.args:
+        inner = last_name(val.args[0])
+        if inner is not None:
+            name = inner
+    scope = ctx.enclosing_qualname(call)
+    scope = "" if scope == "<module>" else scope
+    idx = dataflow.scope_index(ctx)
+    qn = ctx.resolve_function(idx._fn_aliases.get(name, name), scope)
+    if qn is None or qn not in ctx.traced:
+        return None
+    return qn
+
+
+def _collective_launches(ctx: ModuleContext,
+                         stmts: List[ast.stmt]) -> Dict[str, ast.Call]:
+    """traced-qualname -> first launching call, for launches under the
+    given statements that reach a registered-axis collective."""
+    out: Dict[str, ast.Call] = {}
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            qn = _traced_target(ctx, sub)
+            if qn is not None and qn not in out \
+                    and _reaches_registered_collective(ctx, qn, set()):
+                out[qn] = sub
+    return out
+
+
+def _terminates(stmts: List[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _following_stmts(ctx: ModuleContext, node: ast.If) -> List[ast.stmt]:
+    parent = ctx.parent(node)
+    for field in ("body", "orelse", "finalbody"):
+        stmts = getattr(parent, field, None)
+        if isinstance(stmts, list) and node in stmts:
+            i = stmts.index(node)
+            return stmts[i + 1:]
+    return []
+
+
+def _divergent_launch(ctx: ModuleContext,
+                      node: ast.If) -> Optional[Tuple[str, ast.Call]]:
+    """The (qualname, call) a divergent branch launches: the taken and
+    not-taken paths' collective-launch SETS differ, so one rank's
+    program contains a collective its peer's does not.  A branch that
+    does not terminate (return/raise/continue/break) falls through to
+    the statements after the If, so ``if p: return step(x)`` followed
+    by ``return step(y)`` compares {step} against {step} — a uniform
+    program with divergent inputs — and stays quiet.  Launch COUNTS
+    are not compared (a documented limit): the sets are by traced
+    qualname."""
+    body = _collective_launches(ctx, node.body)
+    orelse = _collective_launches(ctx, node.orelse)
+    following = _collective_launches(ctx, _following_stmts(ctx, node))
+    taken = dict(body) if _terminates(node.body) \
+        else {**following, **body}
+    not_taken = dict(orelse) if node.orelse and _terminates(node.orelse) \
+        else {**following, **orelse}
+    if set(taken) == set(not_taken):
+        return None
+    only = {qn: c for qn, c in taken.items() if qn not in not_taken} \
+        or {qn: c for qn, c in not_taken.items() if qn not in taken}
+    qn = sorted(only)[0]
+    return qn, only[qn]
+
+
+class TaintedPredicateGuardsCollective(Rule):
+    """APX209: a rank-divergent predicate guards the launch of a traced
+    computation that reaches a registered-axis collective — the static
+    pod-deadlock proof.
+
+    ``if jax.process_index() == 0: step(batch)`` launches the
+    collective-bearing step on ONE process; its peers' devices block in
+    the matching all-reduce forever, with no error, no timeout, no
+    stack — the exact wedge the flight recorder can only describe
+    post-mortem.  Host code only: inside a trace the predicate is a
+    traced value and ``lax.cond`` territory.  Quiet when both branches
+    launch the same traced functions, when the predicate is uniform,
+    or when the enclosing function pins the decision through
+    ``assert_uniform``."""
+
+    rule_id = "APX209"
+    severity = "error"
+    fix_hint = ("launch the step on every process and branch on a "
+                "traced value inside it (lax.cond), or pin the host "
+                "decision through apex_tpu.resilience.uniformity."
+                "assert_uniform so divergence fails loudly at the seam "
+                "instead of wedging in the collective")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.If):
+                continue
+            if ctx.traced_reason(node) is not None:
+                continue
+            reason = dataflow.taint_reason(ctx, node.test)
+            if reason is None:
+                continue
+            hit = _divergent_launch(ctx, node)
+            if hit is None or _acquitted(ctx, node):
+                continue
+            qn, _call = hit
+            yield self.finding(
+                ctx, node.test,
+                f"rank-divergent predicate ({reason}) guards the "
+                f"launch of traced `{qn}`, which lowers a "
+                f"registered-axis collective: processes where the "
+                f"predicate differs skip the launch while their peers "
+                f"block in the collective — the pod wedges "
+                f"device-side with no error")
+
+
+class TaintedValueShapesCompiledProgram(Rule):
+    """APX210: a rank-divergent value flows into something that shapes
+    the compiled program — a jit static argument, ``Mesh``
+    construction, or a bucketing/sync plan.
+
+    A static arg is baked into the jaxpr: two processes tracing with
+    different values compile DIFFERENT programs from identical source,
+    and the divergence surfaces as mismatched collective schedules (a
+    wedge) or a sharding error far from this line.  Same story for a
+    mesh built from per-rank state and for bucket plans whose
+    cap/world differs across ranks (per-bucket reduce-scatters change
+    COUNT)."""
+
+    rule_id = "APX210"
+    severity = "error"
+    fix_hint = ("derive the value from replicated config (the same "
+                "literal on every process), or gate it through "
+                "apex_tpu.resilience.uniformity.assert_uniform so a "
+                "divergent rank fails loudly before compiling a "
+                "different program")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for arg_node, sink, reason in self._sink_hits(ctx, node):
+                if _acquitted(ctx, node):
+                    continue
+                yield self.finding(
+                    ctx, arg_node,
+                    f"rank-divergent value ({reason}) flows into "
+                    f"{sink}: each process bakes its own value into "
+                    f"the compiled program, so peers lower DIFFERENT "
+                    f"programs from identical source — the mismatch "
+                    f"surfaces as a pod wedge or sharding error, "
+                    f"never here")
+
+    # ------------------------------------------------------------- sinks
+    def _sink_hits(self, ctx: ModuleContext, call: ast.Call):
+        name = last_name(call.func)
+        values = list(call.args) + [kw.value for kw in call.keywords]
+        if name in dataflow._MESH_CTORS:
+            for v in values:
+                r = dataflow.taint_reason(ctx, v)
+                if r is not None:
+                    yield v, f"`{name}(...)` mesh construction", r
+                    return
+        if name in _PLAN_BUILDERS:
+            for v in values:
+                r = dataflow.taint_reason(ctx, v)
+                if r is not None:
+                    yield v, f"the `{name}(...)` bucket/sync plan", r
+                    return
+        else:
+            for kw in call.keywords:
+                if kw.arg in _PLAN_SHAPE_KWARGS:
+                    r = dataflow.taint_reason(ctx, kw.value)
+                    if r is not None:
+                        yield (kw.value,
+                               f"plan shape argument `{kw.arg}=`", r)
+                        return
+        spec = self._static_spec(ctx, call)
+        if spec is not None:
+            nums, names = spec
+            for pos in nums:
+                if pos < len(call.args):
+                    r = dataflow.taint_reason(ctx, call.args[pos])
+                    if r is not None:
+                        yield (call.args[pos],
+                               f"jit static argument {pos}", r)
+                        return
+            for kw in call.keywords:
+                if kw.arg in names:
+                    r = dataflow.taint_reason(ctx, kw.value)
+                    if r is not None:
+                        yield (kw.value,
+                               f"jit static argument `{kw.arg}=`", r)
+                        return
+
+    def _static_spec(self, ctx: ModuleContext, call: ast.Call
+                     ) -> Optional[Tuple[List[int], List[str]]]:
+        """(static_argnums, static_argnames) of the jit the called
+        object was built by — inline ``jit(f, static_argnums=...)(..)``,
+        a value alias (``step = jit(f, ...)``), or a
+        ``@jit``/``@partial(jit, ...)`` decorator on the callee."""
+        jit_call = None
+        func = call.func
+        if isinstance(func, ast.Call) \
+                and last_name(func.func) in _JIT_NAMES:
+            jit_call = func
+        elif isinstance(func, ast.Name):
+            val = dataflow.value_aliases(ctx).get(func.id)
+            if isinstance(val, ast.Call) \
+                    and last_name(val.func) in _JIT_NAMES:
+                jit_call = val
+            else:
+                scope = ctx.enclosing_qualname(call)
+                scope = "" if scope == "<module>" else scope
+                qn = ctx.resolve_function(func.id, scope)
+                info = ctx.functions.get(qn) if qn else None
+                for dec in getattr(getattr(info, "node", None),
+                                   "decorator_list", []):
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    tgt = last_name(dec.func)
+                    if tgt in _JIT_NAMES or (
+                            tgt == "partial" and dec.args
+                            and last_name(dec.args[0]) in _JIT_NAMES):
+                        jit_call = dec
+        if jit_call is None:
+            return None
+        nums: List[int] = []
+        names: List[str] = []
+        for kw in jit_call.keywords:
+            if kw.arg == "static_argnums":
+                nums = _int_literals(kw.value)
+            elif kw.arg == "static_argnames":
+                names = _str_literals(kw.value)
+        if not nums and not names:
+            return None
+        return nums, names
+
+
+def _int_literals(node: ast.AST) -> List[int]:
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, int) \
+                and not isinstance(sub.value, bool):
+            out.append(sub.value)
+    return out
+
+
+def _str_literals(node: ast.AST) -> List[str]:
+    return [sub.value for sub in ast.walk(node)
+            if isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)]
+
+
+class TaintedEngineDispatchDivergence(Rule):
+    """APX211: a rank-divergent predicate gates engine/fallback/kernel
+    dispatch in a multi-process-aware module — the ``registry_engaged``
+    invariant, generalized.
+
+    A per-process impl choice (env var, clock, rank, filesystem probe)
+    lowers one host's fallback program against its peers' kernel
+    program; when either side carries collectives the pod wedges, and
+    even collective-free divergence silently breaks every A/B
+    comparison across the fleet.  Scoped to modules that mention
+    ``process_count`` (the multi-process-reachable heuristic: code
+    that never thinks about process topology gets APX101's trace-time
+    verdict instead); APX209 owns the If when the divergent branch
+    itself launches a collective."""
+
+    rule_id = "APX211"
+    severity = "error"
+    fix_hint = ("thread the impl choice through replicated config "
+                "(the registry_engaged pattern: disengage per-process "
+                "degradation when process_count() > 1), or pin it "
+                "through apex_tpu.resilience.uniformity.assert_uniform "
+                "so one divergent rank fails loudly at the seam")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.mentions("process_count"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.If):
+                continue
+            if ctx.traced_reason(node) is not None:
+                continue
+            reason = dataflow.taint_reason(ctx, node.test)
+            if reason is None:
+                continue
+            if _divergent_launch(ctx, node) is not None:
+                continue  # APX209 owns the collective-launch shape
+            site = self._dispatch_site(node.body) \
+                or self._dispatch_site(node.orelse)
+            if site is None or _acquitted(ctx, node):
+                continue
+            _sub, label = site
+            yield self.finding(
+                ctx, node.test,
+                f"rank-divergent predicate ({reason}) gates dispatch "
+                f"of `{label}` in a multi-process-aware module: each "
+                f"process picks its own impl, so peers lower "
+                f"divergent SPMD programs — mismatched collective "
+                f"schedules wedge the pod device-side")
+
+    @staticmethod
+    def _dispatch_site(stmts: List[ast.stmt]
+                       ) -> Optional[Tuple[ast.AST, str]]:
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                d = None
+                if isinstance(sub, ast.Call):
+                    d = dotted_name(sub.func)
+                elif isinstance(sub, ast.Return) and sub.value is not None:
+                    d = dotted_name(sub.value)
+                if d is None:
+                    continue
+                low = d.lower()
+                if any(m in low for m in _DISPATCH_MARKERS):
+                    return sub, d
+        return None

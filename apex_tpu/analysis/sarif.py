@@ -12,6 +12,7 @@ stderr summary draws.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, Iterable, List, Sequence
 
 from apex_tpu.analysis.core import Finding, Rule
@@ -39,6 +40,16 @@ def _rule_descriptor(rule_id: str, rules: Sequence[Rule]) -> dict:
     return {"id": rule_id, "shortDescription": {"text": rule_id}}
 
 
+def _fingerprint(f: Finding) -> str:
+    """Line-independent identity of a finding: rule + path + enclosing
+    symbol + message.  Code scanning matches results across commits by
+    ``partialFingerprints`` — keying on the LINE would re-open every
+    alert whenever an unrelated edit above the finding shifts it."""
+    path = f.path.replace("\\", "/")
+    blob = f"{f.rule}:{path}:{f.symbol}:{f.message}"
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
 def _result(f: Finding, rule_index: Dict[str, int],
             suppressed: bool) -> dict:
     out = {
@@ -63,6 +74,9 @@ def _result(f: Finding, rule_index: Dict[str, int],
                 "kind": "function",
             }],
         }],
+        "partialFingerprints": {
+            "apexContextHash/v1": _fingerprint(f),
+        },
     }
     if suppressed:
         out["suppressions"] = [{

@@ -356,7 +356,32 @@ class ZeroOptimizerBase:
         self._param_spec_leaves = (
             treedef.flatten_up_to(param_specs) if param_specs is not None
             else None)
+        # record-only uniformity seam: the bucket plan IS the step's
+        # collective schedule (one reduce_scatter/all_gather pair per
+        # bucket), so a per-process plan difference — divergent
+        # cap_bytes from env, divergent world, divergent leaf shapes —
+        # wedges the pod; check_uniform() names this tag instead
+        from apex_tpu.resilience.uniformity import assert_uniform
+        assert_uniform("zero.bucket_plan", self.plan_fingerprint())
         return self._plan
+
+    def plan_fingerprint(self) -> dict:
+        """The rank-uniformity identity of the sharding layout: every
+        input that shapes the lowered collective schedule (bucket
+        count/sizes/dtypes, the dp world, the hierarchical split) in a
+        digestable dict — what ``assert_uniform('zero.bucket_plan')``
+        records and what tests pin across processes."""
+        plan = self._require_plan()
+        hier = self._hier_plan
+        return {
+            "world": self._world,
+            "cap_bytes": self._cap_bytes,
+            "model_mult": self._model_mult,
+            "hier": None if hier is None else
+                [list(hier.shard_axes), hier.outer_size, hier.inner_size],
+            "buckets": [[b.dtype, b.size, b.total, len(b.leaves)]
+                        for b in plan.buckets],
+        }
 
     def _zero_slot(self, dtype=jnp.float32) -> Tuple[jnp.ndarray, ...]:
         """One zeroed state slot: a flat (model_mult · bucket_total,)

@@ -92,6 +92,7 @@ from apex_tpu.models.gpt import GPTConfig
 from apex_tpu.observability import metrics as _metrics
 from apex_tpu.observability import tracing as _tracing
 from apex_tpu.resilience.chaos import active_monkey
+from apex_tpu.resilience.uniformity import assert_uniform
 from apex_tpu.utils.logging import get_logger, log_structured
 
 __all__ = ["LANES", "Completion", "ContinuousBatchingScheduler", "Request"]
@@ -223,6 +224,15 @@ class ContinuousBatchingScheduler:
             "spec_steps": 0, "spec_emitted": 0,
         }
         self._rebuilt_once = False
+        # record-only uniformity seam: the serve config shapes every
+        # compiled step (static batch/page shapes, lane layout) — in a
+        # future multi-host serving topology a per-process difference
+        # here is a divergent program, so record it where
+        # check_uniform() can compare it across processes by name
+        assert_uniform("serve.scheduler_config", {
+            "decode": dataclasses.asdict(dcfg),
+            "model": dataclasses.asdict(config),
+        })
         #: true submit wall-time per queued rid (Completion.submit_time
         #: is the ADMIT time for driver compatibility; the metrics
         #: histograms — admission wait, TTFT — need the real submit)

@@ -25,6 +25,13 @@ first-class pillar of a pre-training stack):
   trips a crash-loop circuit breaker after K no-progress failures,
   and quarantines a corrupt newest checkpoint so one bad save never
   crash-loops a job to death (``pretrain_gpt.py --supervise``).
+- :mod:`~apex_tpu.resilience.uniformity` — the runtime divergence
+  seam: rank-shaping decisions (registry engagement, ZeRO bucket
+  plans, serve config) are recorded via ``assert_uniform`` and
+  compared across processes at explicit ``check_uniform`` points, so
+  one divergent rank raises a named ``UniformityError`` instead of
+  wedging the pod device-side (the runtime tier of the APX209–211
+  static rules).
 - :mod:`~apex_tpu.resilience.chaos` — deterministic fault injection
   (NaN grads, kernel-launch failures, preemptions, wedges, per-rank
   host kills, slow/failing checkpoint I/O, supervisor-level fault
@@ -76,6 +83,15 @@ from apex_tpu.resilience.supervisor import (
     Supervisor,
     strip_supervisor_argv,
 )
+from apex_tpu.resilience.uniformity import (
+    UniformityError,
+    UniformityMonitor,
+    assert_uniform,
+    check_uniform,
+    install_gather,
+    register_uniform,
+    uniform_digest,
+)
 
 __all__ = [
     "BadStepBudgetExceeded",
@@ -97,10 +113,16 @@ __all__ = [
     "Supervisor",
     "SupervisorFault",
     "SupervisorFaultScript",
+    "UniformityError",
+    "UniformityMonitor",
     "active_monkey",
+    "assert_uniform",
+    "check_uniform",
     "corrupt_newest_checkpoint",
     "get_registry",
+    "install_gather",
     "load_rng_tracker_state_dict",
+    "register_uniform",
     "registry_engaged",
     "restart_backoff",
     "restore_elastic_checkpoint",
@@ -108,4 +130,5 @@ __all__ = [
     "save_elastic_checkpoint",
     "strip_supervisor_argv",
     "trip_from_exception",
+    "uniform_digest",
 ]

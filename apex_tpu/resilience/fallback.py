@@ -229,10 +229,19 @@ def registry_engaged(forced: bool) -> bool:
     import jax
 
     from apex_tpu.resilience.chaos import active_monkey
+    from apex_tpu.resilience.uniformity import assert_uniform
 
     if jax.process_count() > 1:
-        return False
-    return (not forced) or active_monkey() is not None
+        engaged = False
+    else:
+        engaged = (not forced) or active_monkey() is not None
+    # record-only (no collective): every process must reach the same
+    # engagement decision — a per-process degrade lowers mismatched
+    # collective programs; check_uniform() surfaces the divergence as
+    # a named error before the pod can wedge on it
+    assert_uniform(f"kernel_registry.engaged/forced={bool(forced)}",
+                   engaged)
+    return engaged
 
 
 def trip_from_exception(exc: BaseException) -> List[str]:

@@ -1,0 +1,246 @@
+"""Runtime uniformity seam — turn cross-process divergence into a
+named error instead of a device-side deadlock.
+
+The failure class
+-----------------
+A multi-process run is one SPMD program launched N times.  Every
+decision that shapes the compiled program — does the kernel registry
+engage, which bucket plan does ZeRO build, which engine does a
+dispatch site pick — must come out IDENTICAL on every process: a
+single divergent rank lowers a different collective sequence, and the
+pod wedges device-side with no error (each rank blocks in its own
+next collective, forever; see
+``apex_tpu.analysis.lowered.assert_same_collective_schedule`` for the
+single-process lowering-level proof and APX209/210/211 in
+``apex_tpu.analysis`` for the static tier).
+
+This module is the RUNTIME tier of that defense: call sites record
+the decisions they make, and an explicit check point compares them
+across processes and raises :class:`UniformityError` naming the
+divergent tag — a loud, attributable host-side crash the supervisor
+can restart from, instead of a silent wedge the watchdog has to
+shoot.
+
+The contract
+------------
+- :func:`assert_uniform(tag, value) <assert_uniform>` is
+  **record-by-default**: it digests ``value``, stores it under
+  ``tag``, and returns.  It performs NO collective — call counts
+  themselves diverge in exactly the buggy runs this seam exists to
+  catch, and a per-call collective would wedge on the first
+  divergence it was meant to report.
+- :func:`check_uniform` is the explicit synchronization point: it
+  gathers every process's recorded decisions (one bounded allgather)
+  and raises on the first tag whose digests differ — including tags
+  some processes recorded and others never reached.  Call it at a
+  naturally-synchronous cadence: after init, after a plan build,
+  every N steps (:class:`UniformityMonitor`).
+- ``gather=`` / :func:`install_gather` inject the transport: tests
+  (and the chaos harness) pass a fake gather returning divergent
+  per-rank views to prove the failure mode single-process; real runs
+  default to a ``jax.experimental.multihost_utils`` allgather, which
+  degrades to a local no-op when ``process_count() == 1``.
+
+The static analyzer treats a call to :func:`assert_uniform` /
+:func:`check_uniform` / :func:`register_uniform` in a function as the
+acquittal seam for its divergence rules: the code is saying "this
+decision is rank-dependent ON PURPOSE, and here is where it gets
+checked".
+"""
+
+import hashlib
+import json
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from apex_tpu.utils.logging import get_logger, log_structured
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "UniformityError", "UniformityMonitor", "assert_uniform",
+    "check_uniform", "install_gather", "recorded_decisions",
+    "register_uniform", "reset_uniformity", "uniform_digest",
+]
+
+
+class UniformityError(RuntimeError):
+    """A cross-process decision diverged.  ``tag`` names the decision;
+    ``views`` is the per-process digest list that disagreed."""
+
+    def __init__(self, tag: str, views: List[Optional[str]]):
+        self.tag = tag
+        self.views = list(views)
+        per_rank = ", ".join(
+            f"process {i}: {v if v is not None else '<never recorded>'}"
+            for i, v in enumerate(views))
+        super().__init__(
+            f"cross-process divergence on decision '{tag}': {per_rank} "
+            f"— on a real pod this lowers different collective "
+            f"schedules and wedges every host device-side; fix the "
+            f"decision to be rank-uniform (thread it in as data) or "
+            f"broadcast it from process 0 before use")
+
+
+def uniform_digest(value) -> str:
+    """Canonical short digest of a decision value: JSON with sorted
+    keys (sets sorted, unknown types via ``repr``), sha256, 16 hex
+    chars.  Stable across processes for equal logical values — the
+    thing :func:`assert_uniform` records and compares."""
+    def _default(obj):
+        if isinstance(obj, (set, frozenset)):
+            return sorted(obj, key=repr)
+        if isinstance(obj, bytes):
+            return obj.hex()
+        if hasattr(obj, "tolist"):        # numpy scalars/arrays
+            return obj.tolist()
+        return repr(obj)
+
+    blob = json.dumps(value, sort_keys=True, default=_default)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+_lock = threading.Lock()
+_DECISIONS: Dict[str, str] = {}
+_PROVIDERS: Dict[str, Callable[[], object]] = {}
+_GATHER: Optional[Callable[[Dict[str, str]], List[Dict[str, str]]]] = None
+
+
+def install_gather(fn) -> Optional[Callable]:
+    """Install a transport for :func:`check_uniform`: a callable
+    mapping this process's ``{tag: digest}`` payload to the list of
+    every process's payload (index = process).  Pass None to restore
+    the default (multihost allgather / single-process no-op).
+    Returns the previously installed gather — the chaos harness and
+    the tests use this seam to inject divergent per-rank views
+    without a real multi-process run."""
+    global _GATHER
+    with _lock:
+        prev, _GATHER = _GATHER, fn
+    return prev
+
+
+def reset_uniformity() -> None:
+    """Clear recorded decisions, providers, and any installed gather
+    (test isolation)."""
+    global _GATHER
+    with _lock:
+        _DECISIONS.clear()
+        _PROVIDERS.clear()
+        _GATHER = None
+
+
+def recorded_decisions() -> Dict[str, str]:
+    """Snapshot of this process's recorded ``{tag: digest}`` map."""
+    with _lock:
+        return dict(_DECISIONS)
+
+
+def assert_uniform(tag: str, value, *, gather=None) -> str:
+    """Record a decision that must be identical on every process.
+
+    Digests ``value`` and stores it under ``tag`` (last write wins —
+    re-deciding is fine as long as every process re-decides the same
+    way).  Performs NO collective: divergent runs diverge in call
+    counts too, and a per-call gather would wedge exactly when it
+    mattered.  The comparison happens at :func:`check_uniform`.
+
+    ``gather=`` forces an eager check of just this tag through the
+    given transport — the test/chaos spelling.  Returns the digest."""
+    digest = uniform_digest(value)
+    with _lock:
+        _DECISIONS[tag] = digest
+        g = gather if gather is not None else _GATHER
+    if g is not None:
+        _compare({tag: digest}, g({tag: digest}))
+    return digest
+
+
+def register_uniform(tag: str, provider: Callable[[], object]) -> None:
+    """Register a zero-arg provider evaluated at every
+    :func:`check_uniform` — for decisions best re-read at check time
+    (registry status, plan fingerprints) rather than recorded once."""
+    with _lock:
+        _PROVIDERS[tag] = provider
+
+
+def _default_gather(payload: Dict[str, str]) -> List[Dict[str, str]]:
+    import jax
+
+    if jax.process_count() <= 1:
+        return [dict(payload)]
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    # fixed-width wire format: json blob, length-prefixed, padded —
+    # process_allgather needs one static shape on every process.
+    cap = 1 << 16
+    blob = json.dumps(payload, sort_keys=True).encode()
+    if len(blob) > cap - 8:
+        raise ValueError(
+            f"uniformity payload {len(blob)}B exceeds the {cap}B "
+            f"gather frame — too many tags; check more often")
+    frame = np.zeros((cap,), np.uint8)
+    frame[:8] = np.frombuffer(
+        len(blob).to_bytes(8, "little"), np.uint8)
+    frame[8:8 + len(blob)] = np.frombuffer(blob, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(frame))
+    views = []
+    for row in gathered.reshape(-1, cap):
+        n = int.from_bytes(bytes(row[:8]), "little")
+        views.append(json.loads(bytes(row[8:8 + n]).decode()))
+    return views
+
+
+def _compare(local: Dict[str, str],
+             views: List[Dict[str, str]]) -> None:
+    tags = sorted({t for v in views for t in v})
+    for tag in tags:
+        per_rank = [v.get(tag) for v in views]
+        if len(set(per_rank)) > 1:
+            log_structured(logger, logging.ERROR,
+                           "uniformity_divergence", tag=tag,
+                           views=per_rank)
+            raise UniformityError(tag, per_rank)
+
+
+def check_uniform(*, gather=None) -> Dict[str, str]:
+    """The synchronization point: evaluate registered providers,
+    gather every process's recorded decisions (one bounded
+    allgather), and raise :class:`UniformityError` on the first tag
+    whose digests differ across processes — including tags only SOME
+    processes recorded, which is the divergent-call-count shape a
+    per-call check could never report.  Single-process (and no
+    installed gather): compares a single view, i.e. a no-op.
+    Returns this process's ``{tag: digest}`` payload."""
+    with _lock:
+        providers = dict(_PROVIDERS)
+    for tag, provider in providers.items():
+        assert_uniform(tag, provider())
+    with _lock:
+        payload = dict(_DECISIONS)
+        g = gather if gather is not None else _GATHER
+    views = (g or _default_gather)(payload)
+    _compare(payload, views)
+    return payload
+
+
+class UniformityMonitor:
+    """Cadenced :func:`check_uniform`: ``on_step(step)`` checks every
+    ``every_n_steps``-th step — a naturally-synchronous point, since
+    every process runs the same step loop.  The step index itself is
+    recorded, so a rank that slipped a step fails the check by
+    construction."""
+
+    def __init__(self, every_n_steps: int = 100, *, gather=None):
+        if every_n_steps < 1:
+            raise ValueError("every_n_steps must be >= 1")
+        self.every_n_steps = int(every_n_steps)
+        self._gather = gather
+
+    def on_step(self, step: int) -> Optional[Dict[str, str]]:
+        if step % self.every_n_steps != 0:
+            return None
+        assert_uniform("uniformity.monitor_step", int(step))
+        return check_uniform(gather=self._gather)

@@ -959,3 +959,178 @@ class TestTracingTrainStep:
                                   mesh, minimum=1, dtype="i8")
         lw.assert_collective_axes(txt, "reduce_scatter", ("dp_out",),
                                   mesh, minimum=1, dtype="i8")
+
+# ------------------------------------------------------- schedule pins
+class TestCollectiveSchedule:
+    """``collective_schedule`` / ``assert_same_collective_schedule``
+    pins (ISSUE 16): the ORDERED cross-device communication sequence —
+    kind, dtype, shape, replica groups, position by position — of
+    every production step family, asserted identical across two
+    independent builds.  Two processes that lower different schedules
+    for the same step wedge a pod device-side; this is the
+    single-process, lowering-level spelling of that contract (the
+    runtime spelling is ``resilience.uniformity``, the static one
+    APX209–211)."""
+
+    def test_flat_zero_schedule_pinned_across_builds(self, devices8):
+        low1, opt, _p, _s = _zero_lowering(devices8)
+        low2, _opt2, _p2, _s2 = _zero_lowering(devices8)
+        scheds = lw.assert_same_collective_schedule(
+            low1.as_text(), low2.as_text(), mesh=_mesh(devices8),
+            labels=["build 1", "build 2"])
+        n = len(opt._plan.buckets)
+        kinds = [e["kind"] for e in scheds[0]]
+        assert kinds.count("reduce_scatter") == n
+        assert kinds.count("all_gather") >= n
+        # every grad scatter rides the dp axis at the fp32 wire
+        for e in scheds[0]:
+            if e["kind"] == "reduce_scatter":
+                assert e["axes"] == ("dp",) and e["dtype"] == "f32"
+
+    def test_hierarchical_zero_schedule_pinned(self, devices8):
+        low1, opt, _p, _s = _hier_lowering(devices8)
+        low2, _opt2, _p2, _s2 = _hier_lowering(devices8)
+        scheds = lw.assert_same_collective_schedule(
+            low1.as_text(), low2.as_text(), mesh=_hier_mesh(devices8))
+        hops = [e["axes"] for e in scheds[0]
+                if e["kind"] == "reduce_scatter"]
+        # both hops present, in a fixed interleaving across builds
+        assert ("dp_in",) in hops and ("dp_out",) in hops
+
+    def test_quantized_zero_schedule_pins_the_i8_wire(self, devices8):
+        low1, opt, _p, _s = _zero_lowering(devices8,
+                                           grad_sync_dtype="int8")
+        low2, _opt2, _p2, _s2 = _zero_lowering(devices8,
+                                               grad_sync_dtype="int8")
+        scheds = lw.assert_same_collective_schedule(
+            low1.as_text(), low2.as_text(), mesh=_mesh(devices8))
+        rs_dtypes = {e["dtype"] for e in scheds[0]
+                     if e["kind"] == "reduce_scatter"}
+        assert "i8" in rs_dtypes, (
+            "the compressed wire must appear in the schedule as i8 "
+            "reduce-scatters")
+
+    def test_gspmd_auto_schedule_pinned_across_compiles(self, devices8):
+        """GSPMD's collectives exist only in the COMPILED module; two
+        compiles of the same auto-sharded step must place the identical
+        sequence (the partitioner is deterministic — a schedule drift
+        here is a jax upgrade changing sync placement under us)."""
+        mesh = Mesh(np.array(devices8).reshape(4, 2), ("dp", "tp"))
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        sspec = AdamState(step=P(), exp_avg=param_specs(CFG),
+                          exp_avg_sq=param_specs(CFG), master=None)
+        step = make_train_step(CFG, opt, mesh, opt_state_spec=sspec,
+                               donate_state=True, spmd="auto")
+        tokens, targets = _data()
+        low = step.lower(params, state, tokens, targets)
+        txt1 = low.compile().as_text()
+        txt2 = step.lower(params, state, tokens,
+                          targets).compile().as_text()
+        scheds = lw.assert_same_collective_schedule(txt1, txt2)
+        assert any(e["kind"] == "all_reduce" for e in scheds[0]), (
+            "the partitioned module must carry the dp/tp all-reduces")
+
+    def test_decode_and_verify_schedules_pinned(self):
+        """Single-host serving steps lower a fixed (here: empty)
+        collective schedule — a collective appearing in the decode or
+        verify lowering is a topology change the scheduler's
+        single-process page bookkeeping is not built for."""
+        import dataclasses as dc
+
+        cfg, dcfg, params, pools, make_step, _ = TestDecodeStep._build()
+        step = make_step(cfg, dcfg)
+        B, Pg = dcfg.max_batch, dcfg.cache.pages_per_seq
+        dargs = (params, pools, jnp.zeros((B,), jnp.int32),
+                 jnp.zeros((B,), jnp.int32), jnp.zeros((B,), bool),
+                 jnp.zeros((B, Pg), jnp.int32),
+                 jnp.zeros((B,), jnp.uint32))
+        low1 = step.lower(*dargs)
+        low2 = make_step(cfg, dcfg).lower(*dargs)
+        scheds = lw.assert_same_collective_schedule(
+            low1.as_text(), low2.as_text(),
+            labels=["decode build 1", "decode build 2"])
+        assert scheds[0] == []
+        from apex_tpu.inference.decode import make_verify_step
+
+        vcfg = dc.replace(dcfg, draft_len=2)
+        W = vcfg.draft_len + 1
+        vargs = (params, pools, jnp.zeros((B, W), jnp.int32),
+                 jnp.zeros((B,), jnp.int32), jnp.zeros((B,), bool),
+                 jnp.zeros((B, Pg), jnp.int32),
+                 jnp.zeros((B, W), jnp.uint32))
+        vlow1 = make_verify_step(cfg, vcfg).lower(*vargs)
+        vlow2 = make_verify_step(cfg, vcfg).lower(*vargs)
+        vscheds = lw.assert_same_collective_schedule(
+            vlow1.as_text(), vlow2.as_text())
+        assert vscheds[0] == []
+
+
+class TestDivergenceRuleProof:
+    """The live half of APX209's deadlock claim, provable on one
+    process: rank-specialize the SAME step the way the flagged code
+    would at runtime (rank 0 takes the branch, rank 1 does not), lower
+    both variants, and show their collective schedules diverge — on a
+    pod those two programs block in different collectives forever.
+    The analyzer flags the source; the lowering mismatch is the
+    ground truth it predicts."""
+
+    SRC = """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def grad_sync(g):
+            return jax.lax.psum(g, "dp")
+
+        step = shard_map(grad_sync, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))
+
+        def maybe_probe(x):
+            if jax.process_index() == 0:
+                return step(x)
+            return x
+    """
+
+    def test_analyzer_flags_the_rank_gated_launch(self, tmp_path):
+        import textwrap
+
+        from apex_tpu.analysis import analyze_file
+        from apex_tpu.analysis.rules_divergence import (
+            TaintedPredicateGuardsCollective,
+        )
+
+        p = tmp_path / "gated.py"
+        p.write_text(textwrap.dedent(self.SRC))
+        got = analyze_file(str(p), [TaintedPredicateGuardsCollective()],
+                           {"dp"})
+        assert [f.rule for f in got] == ["APX209"]
+        assert "wedges" in got[0].message
+
+    def test_rank_specialized_variants_lower_divergent_schedules(
+            self, devices8):
+        """What each process would actually lower under the flagged
+        ``if``: rank 0's trace launches the psum, rank 1's skips it.
+        ``assert_same_collective_schedule`` names the divergence — the
+        proof the static rule's deadlock claim rests on."""
+        from jax.experimental.shard_map import shard_map
+
+        mesh = Mesh(np.array(devices8).reshape(DP), ("dp",))
+        sync = shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                         in_specs=P("dp"), out_specs=P(None))
+
+        def as_rank(rank):
+            def maybe_probe(x):
+                return sync(x) if rank == 0 else x * 1.0
+            return jax.jit(maybe_probe).lower(
+                jnp.ones((DP, 4), jnp.float32))
+
+        rank0, rank1 = as_rank(0), as_rank(1)
+        with pytest.raises(AssertionError, match="diverge"):
+            lw.assert_same_collective_schedule(
+                rank0.as_text(), rank1.as_text(),
+                labels=["process 0", "process 1"])
+        # and the uniform spelling passes: both ranks launching is fine
+        lw.assert_same_collective_schedule(rank0.as_text(),
+                                           as_rank(0).as_text())

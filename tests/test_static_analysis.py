@@ -27,12 +27,18 @@ from apex_tpu.analysis import (
     load_baseline,
     write_baseline,
 )
+from apex_tpu.analysis import sarif
 from apex_tpu.analysis.rules_collectives import (
     CollectiveAxisOutsideShardMapNest,
     CollectiveAxisUnboundUnderJit,
     CollectiveOutsideSpmdContext,
     CollectiveTupleAxisUnbound,
     UnknownCollectiveAxis,
+)
+from apex_tpu.analysis.rules_divergence import (
+    TaintedEngineDispatchDivergence,
+    TaintedPredicateGuardsCollective,
+    TaintedValueShapesCompiledProgram,
 )
 from apex_tpu.analysis.rules_donation import DonatedBufferReuse
 from apex_tpu.analysis.rules_sharding import (
@@ -3000,12 +3006,15 @@ class TestRuleHygieneMetaLint:
 class TestCliPerformanceAndHygiene:
     def test_repo_scan_stays_fast(self):
         """The analyzer rides tier-1 AND pre-commit: the full repo scan
-        must stay interactive.  Measured ~8 s CPU on this 1-core box;
-        the 30 s budget is ~4x headroom while still catching an
-        accidentally-quadratic rule or fixpoint.  CPU time, not wall
-        time: this box's wall-clock tests false-fire under CPU
-        contention (the gpt_example watchdog class), and the hazard
-        this test guards is algorithmic, not scheduling."""
+        must stay interactive.  Measured ~9 s CPU on this 1-core box
+        WITH the divergence tier (the taint lattice adds its per-module
+        event replay and the link_taint cross-module fixpoint — ~1 s
+        over the pre-APX209 scan); the 30 s budget is ~3x headroom
+        while still catching an accidentally-quadratic rule or
+        fixpoint.  CPU time, not wall time: this box's wall-clock
+        tests false-fire under CPU contention (the gpt_example
+        watchdog class), and the hazard this test guards is
+        algorithmic, not scheduling."""
         import time
 
         paths = [str(REPO / "apex_tpu"), str(REPO / "bench.py")]
@@ -3090,3 +3099,420 @@ class TestCliPerformanceAndHygiene:
              "bench.py", "--check-baseline"],
             cwd=str(REPO), capture_output=True, text=True, timeout=600)
         assert r.returncode == 0, r.stdout + r.stderr
+
+
+# --------------------------------------- APX209 rank-gated collective launch
+#: the shared scaffolding of the divergence fixtures: a registered-axis
+#: collective inside a shard_map step
+_STEP_PRELUDE = textwrap.dedent("""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def grad_sync(g):
+        return jax.lax.psum(g, "dp")
+
+    step = shard_map(grad_sync, mesh=mesh, in_specs=P("dp"),
+                     out_specs=P("dp"))
+""")
+
+
+def run_div(src, tmp_path, rules, axes=AXES):
+    """``run`` with the shard_map step prelude prepended (both parts
+    dedented independently — the fixture bodies sit at test-method
+    indentation, the prelude at module level)."""
+    return run(_STEP_PRELUDE + textwrap.dedent(src), tmp_path, rules,
+               axes)
+
+
+class TestTaintedPredicateGuardsCollective:
+    def test_positive_rank_zero_probe(self, tmp_path):
+        """The canonical bug: only rank 0 launches the collective-
+        bearing step — its peers block in the psum forever."""
+        got = run_div("""
+            def maybe_probe(x):
+                if jax.process_index() == 0:
+                    return step(x)
+                return x
+            """, tmp_path, [TaintedPredicateGuardsCollective()])
+        assert rule_ids(got) == ["APX209"]
+        assert "wedges" in got[0].message
+        assert "process_index" in got[0].message
+
+    def test_positive_taint_through_partial_and_conditional_join(
+            self, tmp_path):
+        """The value survives a functools.partial alias AND a
+        conditional clean rebind (the branch may not execute, so the
+        taint only joins — it never clears)."""
+        got = run_div("""
+            import functools
+
+            who = functools.partial(jax.process_index)
+
+            def maybe_probe(x, flag):
+                r = who()
+                if flag:
+                    r = 0
+                if r == 0:
+                    return step(x)
+                return x
+            """, tmp_path, [TaintedPredicateGuardsCollective()])
+        assert rule_ids(got) == ["APX209"]
+
+    def test_negative_both_branches_launch(self, tmp_path):
+        """Branching on rank is fine when EVERY path launches the same
+        traced step — per-rank logging around a uniform launch."""
+        got = run_div("""
+            def maybe_probe(x):
+                if jax.process_index() == 0:
+                    return step(x * 2)
+                return step(x)
+            """, tmp_path, [TaintedPredicateGuardsCollective()])
+        assert got == []
+
+    def test_negative_straight_line_rebind_clears(self, tmp_path):
+        """An unconditional clean rebind kills the taint — the value
+        the predicate reads no longer depends on the rank."""
+        got = run_div("""
+            def maybe_probe(x):
+                rank = jax.process_index()
+                rank = 0
+                if rank == 0:
+                    return step(x)
+                return x
+            """, tmp_path, [TaintedPredicateGuardsCollective()])
+        assert got == []
+
+    def test_negative_acquitted_by_uniformity_seam(self, tmp_path):
+        """A function that routes the decision through the runtime
+        uniformity seam has DECLARED the divergence risk — the runtime
+        tier owns it from there."""
+        got = run_div("""
+            from apex_tpu.resilience.uniformity import assert_uniform
+
+            def maybe_probe(x):
+                probe = jax.process_index() == 0
+                assert_uniform("probe.rank0", bool(probe))
+                if probe:
+                    return step(x)
+                return x
+            """, tmp_path, [TaintedPredicateGuardsCollective()])
+        assert got == []
+
+
+# ------------------------------------------- APX210 tainted compiled shapes
+class TestTaintedValueShapesCompiledProgram:
+    def test_positive_rank_into_jit_static_arg(self, tmp_path):
+        got = run("""
+            import jax
+
+            def f(x, variant):
+                return x * variant
+
+            step = jax.jit(f, static_argnums=(1,))
+
+            def launch(x):
+                return step(x, jax.process_index())
+            """, tmp_path, [TaintedValueShapesCompiledProgram()])
+        assert rule_ids(got) == ["APX210"]
+        assert "static argument" in got[0].message
+
+    def test_positive_env_into_mesh_construction(self, tmp_path):
+        got = run("""
+            import os
+            import jax
+            from jax.sharding import Mesh
+
+            def build():
+                n = int(os.getenv("APEX_DP", "8"))
+                return Mesh(jax.devices()[:n], ("dp",))
+            """, tmp_path, [TaintedValueShapesCompiledProgram()])
+        assert rule_ids(got) == ["APX210"]
+        assert "mesh construction" in got[0].message
+
+    def test_positive_env_into_bucket_plan_shape(self, tmp_path):
+        got = run("""
+            import os
+            from apex_tpu.optimizers import bucketing
+
+            def build(treedef, shapes):
+                cap = int(os.getenv("APEX_CAP", "0")) or None
+                return bucketing.plan_of_shapes(treedef, shapes,
+                                                cap_bytes=cap)
+            """, tmp_path, [TaintedValueShapesCompiledProgram()])
+        assert rule_ids(got) == ["APX210"]
+        assert "plan" in got[0].message
+
+    def test_negative_threaded_config_is_clean(self, tmp_path):
+        """Parameters are always clean: threading the value IN is the
+        blessed pattern the fix hint prescribes."""
+        got = run("""
+            import jax
+            from jax.sharding import Mesh
+
+            def build(n, cap_bytes):
+                return Mesh(jax.devices()[:n], ("dp",))
+
+            def launch(step, x, variant):
+                return step(x, variant)
+            """, tmp_path, [TaintedValueShapesCompiledProgram()])
+        assert got == []
+
+
+# --------------------------------------- APX211 rank-divergent dispatch
+class TestTaintedEngineDispatchDivergence:
+    def test_positive_env_gated_kernel_impl(self, tmp_path):
+        got = run("""
+            import os
+            import jax
+
+            def n_shards():
+                return jax.process_count()
+
+            def forward(x):
+                impl = os.getenv("APEX_ATTN", "auto")
+                if impl == "pallas":
+                    return pallas_attention(x)
+                return xla_attention(x)
+            """, tmp_path, [TaintedEngineDispatchDivergence()])
+        assert rule_ids(got) == ["APX211"]
+        assert "divergent SPMD programs" in got[0].message
+
+    def test_negative_module_without_multiprocess_reach(self, tmp_path):
+        """No mention of process_count: nothing scopes this module
+        into multi-process reachability — single-host env dispatch is
+        the supported configuration surface."""
+        got = run("""
+            import os
+
+            def forward(x):
+                impl = os.getenv("APEX_ATTN", "auto")
+                if impl == "pallas":
+                    return pallas_attention(x)
+                return xla_attention(x)
+            """, tmp_path, [TaintedEngineDispatchDivergence()])
+        assert got == []
+
+    def test_negative_acquitted_by_uniformity_seam(self, tmp_path):
+        got = run("""
+            import os
+            import jax
+            from apex_tpu.resilience.uniformity import assert_uniform
+
+            def n_shards():
+                return jax.process_count()
+
+            def forward(x):
+                impl = os.getenv("APEX_ATTN", "auto")
+                assert_uniform("attn.impl", impl)
+                if impl == "pallas":
+                    return pallas_attention(x)
+                return xla_attention(x)
+            """, tmp_path, [TaintedEngineDispatchDivergence()])
+        assert got == []
+
+    def test_negative_registry_engaged_shape_stays_quiet(self, tmp_path):
+        """The fail-fast spelling the repo itself uses: branch on the
+        topology, return a constant — no dispatch in the branch."""
+        got = run("""
+            import jax
+
+            def registry_engaged(forced):
+                if jax.process_count() > 1:
+                    return False
+                return not forced
+            """, tmp_path, [TaintedEngineDispatchDivergence()])
+        assert got == []
+
+
+# ------------------------------------------------ taint-lattice edge cases
+class TestTaintLatticeEdgeCases:
+    """The dataflow semantics the three rules rest on, probed directly
+    through rule behavior: event ordering, aliasing, and the
+    cross-module fixpoint (including cycles)."""
+
+    def test_shadowed_rebind_inside_nested_function_is_clean(
+            self, tmp_path):
+        """A parameter shadows an outer tainted name — parameters are
+        always clean, even when the caller passes rank in."""
+        got = run_div("""
+            rank = jax.process_index()
+
+            def probe(rank, x):
+                if rank == 0:
+                    return step(x)
+                return x
+            """, tmp_path, [TaintedPredicateGuardsCollective()])
+        assert got == []
+
+    def test_outer_tainted_name_reaches_nested_function(self, tmp_path):
+        """...but WITHOUT the shadowing parameter, the module-level
+        tainted binding flows in through the enclosing scope."""
+        got = run_div("""
+            rank = jax.process_index()
+
+            def probe(x):
+                if rank == 0:
+                    return step(x)
+                return x
+            """, tmp_path, [TaintedPredicateGuardsCollective()])
+        assert rule_ids(got) == ["APX209"]
+
+    def test_partial_of_clean_function_is_clean(self, tmp_path):
+        got = run_div("""
+            import functools
+
+            def fixed():
+                return 0
+
+            who = functools.partial(fixed)
+
+            def probe(x):
+                if who() == 0:
+                    return step(x)
+                return x
+            """, tmp_path, [TaintedPredicateGuardsCollective()])
+        assert got == []
+
+    def test_cross_module_taint_cycle_converges_and_flags(self, tmp_path):
+        """Two modules whose taint-returning helpers call ACROSS the
+        module boundary in a cycle: the link_taint fixpoint must
+        terminate and still carry process_index's taint around the
+        loop into the guarded launch."""
+        from apex_tpu.analysis import analyze_paths
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "ident.py").write_text(textwrap.dedent("""
+            import jax
+
+            from pkg.roles import role_of
+
+            def rank():
+                return jax.process_index()
+
+            def rank_or_role(named):
+                if named:
+                    return role_of()
+                return rank()
+            """))
+        (pkg / "roles.py").write_text(_STEP_PRELUDE + textwrap.dedent("""
+            from pkg.ident import rank_or_role
+
+            def role_of():
+                return rank_or_role(False)
+
+            def probe(x):
+                if role_of() == 0:
+                    return step(x)
+                return x
+            """))
+        got = analyze_paths([str(pkg)],
+                            [TaintedPredicateGuardsCollective()], {"dp"})
+        assert rule_ids(got) == ["APX209"]
+        assert got[0].path.endswith("roles.py")
+
+
+# ---------------------------------------- CLI: --only-rules / --skip-rules
+class TestCliRuleSelection:
+    FIXTURE = textwrap.dedent("""
+        import os
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x if os.environ.get("FLAG") else -x
+        """)
+
+    def _run_cli(self, args, cwd):
+        import os as _os
+
+        env = dict(_os.environ, PYTHONPATH=str(REPO))
+        return subprocess.run(
+            [sys.executable, "-m", "apex_tpu.analysis", *args],
+            cwd=str(cwd), env=env, capture_output=True, text=True,
+            timeout=600)
+
+    def test_only_rules_scopes_the_run(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        r = self._run_cli(["mod.py", "--no-baseline",
+                           "--only-rules", "APX101"], tmp_path)
+        assert r.returncode == 1 and "APX101" in r.stdout
+        # scoped AWAY from the finding's rule: clean exit
+        r = self._run_cli(["mod.py", "--no-baseline",
+                           "--only-rules", "APX104"], tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_skip_rules_drops_the_finding(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        r = self._run_cli(["mod.py", "--no-baseline",
+                           "--skip-rules", "APX101"], tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_unknown_rule_id_is_a_usage_error(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        for flag in ("--only-rules", "--skip-rules"):
+            r = self._run_cli(["mod.py", flag, "APX999"], tmp_path)
+            assert r.returncode == 2
+            assert "unknown rule id" in r.stderr
+
+    def test_selecting_everything_away_is_an_error(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        r = self._run_cli(["mod.py", "--only-rules", "APX101",
+                           "--skip-rules", "APX101"], tmp_path)
+        assert r.returncode == 2
+        assert "nothing to run" in r.stderr
+
+    def test_timing_json_artifact_and_family_rollup(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        out = tmp_path / "timing.json"
+        r = self._run_cli(["mod.py", "--no-baseline", "--timing",
+                           "--timing-json", str(out)], tmp_path)
+        assert r.returncode == 1
+        timings = json.loads(out.read_text())
+        assert "<load>" in timings and "<link>" in timings
+        assert "APX101" in timings
+        assert "timing: family" in r.stderr
+        assert "distributed" in r.stderr
+
+
+# ----------------------------------------------- SARIF partialFingerprints
+class TestSarifPartialFingerprints:
+    SRC = textwrap.dedent("""
+        import os
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x if os.environ.get("FLAG") else -x
+        """)
+
+    def _fingerprints(self, tmp_path, src, name):
+        p = tmp_path / name
+        p.write_text(src)
+        got = analyze_file(str(p), [TraceTimeHostStateRead()], set())
+        log = sarif.render(got, [], [TraceTimeHostStateRead()])
+        return [(r["partialFingerprints"]["apexContextHash/v1"],
+                 r["locations"][0]["physicalLocation"]["region"]
+                  ["startLine"]) for r in log["runs"][0]["results"]]
+
+    def test_fingerprint_survives_line_shift(self, tmp_path):
+        """The round-trip code scanning depends on: shifting a finding
+        down the file (the every-commit event) keeps its fingerprint —
+        keying on the line would re-open the alert each time."""
+        base = self._fingerprints(tmp_path, self.SRC, "a.py")
+        shifted = self._fingerprints(
+            tmp_path, "\n# padding\n# padding\n\n" + self.SRC, "a.py")
+        (fp1, line1), (fp2, line2) = base[0], shifted[0]
+        assert line2 > line1          # the finding really moved
+        assert fp1 == fp2             # ...and the identity did not
+
+    def test_distinct_findings_get_distinct_fingerprints(self, tmp_path):
+        fps = self._fingerprints(tmp_path, self.SRC + textwrap.dedent("""
+            @jax.jit
+            def g(x):
+                return x if os.environ.get("OTHER") else -x
+            """), "b.py")
+        assert len(fps) == 2
+        assert fps[0][0] != fps[1][0]

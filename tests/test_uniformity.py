@@ -1,0 +1,230 @@
+"""The runtime uniformity seam (ISSUE 16 tier 3): cross-process
+divergence fails LOUDLY through ``resilience.uniformity`` with a named
+tag — never the device-side wedge the APX209–211 static rules and the
+``assert_same_collective_schedule`` lowering pin prove statically.
+
+Real multi-process runs don't exist on the CPU test mesh, so the
+transport is injected: a fake gather returns the per-rank views a pod
+would produce, including the one-divergent-rank and the
+rank-never-recorded (divergent call count) shapes.  That injection
+seam — ``gather=`` / ``install_gather`` — is the same one the chaos
+harness uses.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu.resilience import uniformity as U
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    U.reset_uniformity()
+    yield
+    U.reset_uniformity()
+
+
+def _pod_view(n_ranks, mutate=None):
+    """A gather returning ``n_ranks`` copies of the local payload,
+    with ``mutate(rank_payload, rank)`` applied to each."""
+    def gather(payload):
+        views = [dict(payload) for _ in range(n_ranks)]
+        if mutate is not None:
+            for rank, view in enumerate(views):
+                mutate(view, rank)
+        return views
+    return gather
+
+
+class TestUniformDigest:
+    def test_key_order_insensitive(self):
+        assert U.uniform_digest({"a": 1, "b": [2, 3]}) == \
+            U.uniform_digest({"b": [2, 3], "a": 1})
+
+    def test_distinct_values_distinct_digests(self):
+        assert U.uniform_digest({"cap": 1 << 20}) != \
+            U.uniform_digest({"cap": 1 << 21})
+
+    def test_sets_numpy_bytes_canonicalize(self):
+        assert U.uniform_digest({1, 2, 3}) == U.uniform_digest({3, 2, 1})
+        assert U.uniform_digest(np.int64(7)) == U.uniform_digest(7)
+        U.uniform_digest(b"\x00\xff")            # doesn't raise
+        U.uniform_digest(jnp.float32(1.5))       # jax scalars too
+
+
+class TestAssertUniform:
+    def test_record_only_no_transport_touched(self):
+        """The contract that keeps divergent runs from wedging INSIDE
+        the seam: with no gather installed, assert_uniform performs no
+        communication at all — it just records."""
+        d = U.assert_uniform("zero.bucket_plan", {"world": 8})
+        assert U.recorded_decisions() == {"zero.bucket_plan": d}
+
+    def test_rerecording_same_decision_is_fine(self):
+        d1 = U.assert_uniform("t", [1, 2])
+        d2 = U.assert_uniform("t", [1, 2])
+        assert d1 == d2
+
+    def test_eager_gather_raises_named_error(self):
+        def gather(payload):
+            return [dict(payload), {"t": "divergent-digest"}]
+        with pytest.raises(U.UniformityError) as ei:
+            U.assert_uniform("t", 5, gather=gather)
+        assert ei.value.tag == "t"
+
+
+class TestCheckUniform:
+    def test_single_process_is_a_noop(self):
+        U.assert_uniform("t", 1)
+        payload = U.check_uniform()       # default gather, 1 process
+        assert "t" in payload
+
+    def test_installed_gather_is_the_transport(self):
+        U.assert_uniform("t", 1)
+        calls = []
+
+        def gather(payload):
+            calls.append(payload)
+            return [payload]
+
+        prev = U.install_gather(gather)
+        assert prev is None
+        U.check_uniform()
+        assert calls and "t" in calls[0]
+        U.install_gather(None)
+
+    def test_provider_evaluated_at_check_time(self):
+        state = {"plan": [4, 4]}
+        U.register_uniform("zero.bucket_plan", lambda: state["plan"])
+        p1 = U.check_uniform(gather=_pod_view(2))
+        state["plan"] = [8, 8]
+        p2 = U.check_uniform(gather=_pod_view(2))
+        assert p1["zero.bucket_plan"] != p2["zero.bucket_plan"]
+
+    def test_error_names_the_tag_and_all_views(self):
+        U.assert_uniform("serve.scheduler_config", {"max_batch": 3})
+        U.assert_uniform("zero.bucket_plan", {"world": 8})
+
+        def mutate(view, rank):
+            if rank == 2:
+                view["zero.bucket_plan"] = "0000000000000000"
+
+        with pytest.raises(U.UniformityError) as ei:
+            U.check_uniform(gather=_pod_view(4, mutate))
+        err = ei.value
+        assert err.tag == "zero.bucket_plan"
+        assert len(err.views) == 4
+        assert "process 2" in str(err) and "wedge" in str(err)
+
+    def test_divergent_call_count_shape_is_caught(self):
+        """A rank that never REACHED the decision (the classic
+        if-process_index-skips-the-call bug) shows as <never
+        recorded> — the shape a per-call collective could only wedge
+        on, and the reason assert_uniform is record-by-default."""
+        U.assert_uniform("kernel_registry.engaged/forced=False", True)
+
+        def mutate(view, rank):
+            if rank == 1:
+                view.clear()
+
+        with pytest.raises(U.UniformityError) as ei:
+            U.check_uniform(gather=_pod_view(2, mutate))
+        assert "never recorded" in str(ei.value)
+
+
+class TestChaosOneRankDiverges:
+    """The headline chaos test: provoke exactly one divergent rank in
+    each retrofitted decision and require the loud, named failure."""
+
+    def test_one_rank_kernel_degrade_fails_loudly(self):
+        from apex_tpu.resilience.fallback import registry_engaged
+
+        engaged = registry_engaged(False)     # the real seam records
+        tag = "kernel_registry.engaged/forced=False"
+        assert tag in U.recorded_decisions()
+
+        def mutate(view, rank):
+            if rank == 3:                     # rank 3's kernel tripped
+                view[tag] = U.uniform_digest(not engaged)
+
+        with pytest.raises(U.UniformityError) as ei:
+            U.check_uniform(gather=_pod_view(4, mutate))
+        assert ei.value.tag == tag
+
+    def test_one_rank_divergent_bucket_plan_fails_loudly(self):
+        import jax
+
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+        params = {"w": jnp.ones((64, 8), jnp.float32),
+                  "b": jnp.ones((8,), jnp.float32)}
+        opt = DistributedFusedAdam(lr=1e-2, axis_name="dp",
+                                   bucket_cap_mb=1.0)
+        opt.init(params, world_size=2)
+        tag = "zero.bucket_plan"
+        local = U.recorded_decisions()[tag]
+        assert local == U.uniform_digest(opt.plan_fingerprint())
+
+        # rank 1 read a different bucket cap from its environment —
+        # the exact APX210 hazard, caught at the seam instead
+        divergent = U.uniform_digest(
+            dict(opt.plan_fingerprint(), cap_bytes=123))
+
+        def mutate(view, rank):
+            if rank == 1:
+                view[tag] = divergent
+
+        with pytest.raises(U.UniformityError) as ei:
+            U.check_uniform(gather=_pod_view(2, mutate))
+        assert ei.value.tag == tag
+
+    def test_identical_ranks_pass_the_same_check(self):
+        from apex_tpu.resilience.fallback import registry_engaged
+
+        registry_engaged(False)
+        payload = U.check_uniform(gather=_pod_view(4))
+        assert payload == U.recorded_decisions()
+
+    def test_monitor_checks_on_cadence_and_records_the_step(self):
+        mon = U.UniformityMonitor(every_n_steps=10,
+                                  gather=_pod_view(2))
+        assert mon.on_step(5) is None
+        payload = mon.on_step(10)
+        assert payload is not None and "uniformity.monitor_step" in payload
+
+        # a rank that slipped a step diverges on the step tag itself
+        def mutate(view, rank):
+            if rank == 1:
+                view["uniformity.monitor_step"] = U.uniform_digest(19)
+
+        slipped = U.UniformityMonitor(every_n_steps=10,
+                                      gather=_pod_view(2, mutate))
+        with pytest.raises(U.UniformityError) as ei:
+            slipped.on_step(20)
+        assert ei.value.tag == "uniformity.monitor_step"
+
+
+class TestSchedulerRecordsItsConfig:
+    def test_scheduler_init_records_serve_config(self):
+        import jax
+
+        from apex_tpu.inference import (
+            ContinuousBatchingScheduler, DecodeConfig, KVCacheConfig,
+        )
+        from apex_tpu.models.gpt import GPTConfig, init_params
+
+        cfg = GPTConfig(
+            vocab_size=61, hidden_size=32, num_layers=2,
+            num_attention_heads=4, max_seq_len=128,
+            position_embedding_type="rope",
+            compute_dtype=jnp.float32, checkpoint_layers=False)
+        dcfg = DecodeConfig(
+            cache=KVCacheConfig(num_pages=16, page_size=4,
+                                pages_per_seq=8, dtype=jnp.float32),
+            max_batch=2, max_prompt_len=8, temperature=0.0,
+            attn_impl="xla", sample_impl="xla")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        ContinuousBatchingScheduler(params, cfg, dcfg)
+        assert "serve.scheduler_config" in U.recorded_decisions()
