@@ -1,21 +1,28 @@
 # CI surface for apex_tpu — `make ci` is what .github/workflows/ci.yml
-# runs, and what a laptop runs before pushing.  Three gates:
+# runs, and what a laptop runs before pushing.  Four gates:
 #
-#   make test       tier-1 (quick) pytest suite on the 8-virtual-device
-#                   CPU platform — ROADMAP.md's canonical invocation
-#   make analyze    the static analyzer, ONE scan doing both jobs:
-#                   writes the SARIF document for code scanning
-#                   (analysis.sarif — written before the exit code, so
-#                   the upload has content exactly when there ARE
-#                   findings) and fails on findings or stale
-#                   suppressions (--check-baseline), with the
-#                   human-readable rule-id summary on stderr; the
-#                   per-rule timing JSON (analysis_timing.json) rides
-#                   along so CI can attribute a slow scan to a rule
-#   make bench-gate the perf-regression gate: benchmarks/bench_compare.py
-#                   diffs the two newest BENCH_*.json rounds' headline
-#                   columns (no-op when fewer than two rounds exist —
-#                   chip benches don't run in CPU CI)
+#   make test        tier-1 (quick) pytest suite on the 8-virtual-device
+#                    CPU platform — ROADMAP.md's canonical invocation
+#   make analyze     the static analyzer, ONE scan doing both jobs:
+#                    writes the SARIF document for code scanning
+#                    (analysis.sarif — written before the exit code, so
+#                    the upload has content exactly when there ARE
+#                    findings) and fails on findings or stale
+#                    suppressions (--check-baseline), with the
+#                    human-readable rule-id summary on stderr; the
+#                    per-rule timing JSON (analysis_timing.json) rides
+#                    along so CI can attribute a slow scan to a rule
+#   make fleet-smoke the serving-resilience gate: bench.py's smoke
+#                    serve_gpt124 section, whose fleet mode runs a
+#                    2-replica frontend, chaos-kills one replica
+#                    mid-run, and asserts dropped_requests == 0 with
+#                    greedy streams bitwise the unkilled single-replica
+#                    run (plus the spec/prefix/chunked serving modes the
+#                    section always covered)
+#   make bench-gate  the perf-regression gate: benchmarks/bench_compare.py
+#                    diffs the two newest BENCH_*.json rounds' headline
+#                    columns (no-op when fewer than two rounds exist —
+#                    chip benches don't run in CPU CI)
 #
 # See docs/static_analysis.md for analyzer details and the baseline
 # contract.
@@ -23,9 +30,9 @@
 PYTHON ?= python
 JOBS   ?= 2
 
-.PHONY: ci test analyze bench-gate
+.PHONY: ci test analyze fleet-smoke bench-gate
 
-ci: analyze test bench-gate
+ci: analyze test fleet-smoke bench-gate
 
 test:
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q \
@@ -36,6 +43,10 @@ analyze:
 	$(PYTHON) -m apex_tpu.analysis apex_tpu bench.py \
 	  --format sarif --check-baseline --jobs $(JOBS) \
 	  --timing-json analysis_timing.json > analysis.sarif
+
+fleet-smoke:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu \
+	  $(PYTHON) bench.py --smoke --smoke-only serve_gpt124
 
 bench-gate:
 	@n=$$(ls BENCH_r*.json 2>/dev/null | wc -l); \

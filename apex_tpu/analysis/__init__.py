@@ -19,6 +19,12 @@ machine-checked invariants):
   ``pass``/``...`` inside resilience/io/inference modules — no
   re-raise, no ``log_structured``, no metrics record, so the failure
   is invisible to the supervisor and the postmortem.
+- **APX113** retry without backoff (``rules_resilience``): a
+  ``while True:`` in the same recovery-path modules whose ``try``
+  swallows the failure and re-attempts with no sleep/backoff/wait
+  anywhere in the loop — a persistent fault becomes a busy-spin
+  against the dependency that needs room to recover (the serving
+  fleet's typed ``Overloaded.retry_after_s`` is the paced spelling).
 - **APX201/202** collective-axis consistency against the
   ``parallel_state.py`` mesh registry (``rules_collectives``).
 - **APX203/204/205** axis-scope dataflow (``dataflow`` + ``rules_collectives``):
@@ -122,7 +128,7 @@ from apex_tpu.analysis.rules_host_sync import (
 from apex_tpu.analysis.rules_inference import KvPoolScatterBypassesSeam
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_resilience import (
-    SwallowedExceptionInRecoveryPath,
+    RetryWithoutBackoff, SwallowedExceptionInRecoveryPath,
 )
 from apex_tpu.analysis.rules_precision import (
     Fp32ConstantInBf16Path, KvCacheReadDtypeMismatch,
@@ -150,6 +156,7 @@ def default_rules(vmem_budget_bytes=None):
         DonatedBufferReuse(),
         NonAtomicCheckpointWrite(),
         SwallowedExceptionInRecoveryPath(),
+        RetryWithoutBackoff(),
         BlockingHostSyncInStepLoop(),
         UnseamedDispatchTiming(),
         UnknownCollectiveAxis(),

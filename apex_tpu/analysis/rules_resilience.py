@@ -16,6 +16,15 @@ recovery path reports: re-raise, ``log_structured`` (the greppable
   other statement (a ``return`` default, a log call, a counter bump, a
   flag set) are trusted: the rule targets the zero-information
   swallow, not defensive defaults.
+- APX113: a hot retry loop in the same modules — ``while True:`` (any
+  truthy-constant test) wrapping a ``try`` whose handlers neither
+  re-raise, ``break``, nor ``return``, with NO backoff anywhere in the
+  loop (no call whose name mentions sleep/backoff/wait/delay/jitter).
+  That shape spins at CPU speed against whatever is failing — a dead
+  coordinator, a wedged replica, a full disk — turning one fault into
+  a busy-wait that starves the very recovery it is waiting for.  The
+  fleet/elastic convention is a typed ``Overloaded``-style retry-after
+  or an explicit ``time.sleep``/backoff between attempts.
 """
 
 from __future__ import annotations
@@ -27,7 +36,7 @@ from typing import Iterator
 
 from apex_tpu.analysis.core import Finding, ModuleContext, Rule
 
-__all__ = ["SwallowedExceptionInRecoveryPath"]
+__all__ = ["RetryWithoutBackoff", "SwallowedExceptionInRecoveryPath"]
 
 #: Directory components that mark a module as recovery-path code: the
 #: fault-handling runtime, the checkpoint/restore layer, and the
@@ -79,3 +88,92 @@ class SwallowedExceptionInRecoveryPath(Rule):
                 f"except block swallows {caught} with a do-nothing body "
                 f"in a recovery-path module ({os.path.basename(ctx.path)})"
                 " — no re-raise, no log_structured, no metrics record")
+
+
+#: Call-name fragments that count as pacing the loop: an explicit
+#: sleep/backoff helper, a blocking wait with a timeout
+#: (``child.wait(timeout=...)``, ``event.wait(...)``), or jittered
+#: delay computation.  Substring match on the called name, lowercased —
+#: ``time.sleep``, ``_backoff_s``, ``child.wait`` all acquit.
+_PACING_TOKENS = ("sleep", "backoff", "wait", "delay", "jitter")
+
+#: Blocking primitives that also pace a loop, matched by EXACT call
+#: name with no positional arguments: ``q.get()`` (queue dequeue),
+#: ``lock.acquire()``, ``thread.join()`` all park the thread until
+#: something external happens — a worker loop built on one is not a
+#: busy-spin.  The no-positional-args restriction keeps ``dict.get(k)``
+#: from acquitting anything.
+_BLOCKING_CALLS = frozenset({"get", "acquire", "join"})
+
+
+def _is_truthy_const(test: ast.expr) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _call_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id.lower()
+    if isinstance(fn, ast.Attribute):
+        return fn.attr.lower()
+    return ""
+
+
+def _loop_is_paced(node: ast.While) -> bool:
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = _call_name(sub)
+        if any(tok in name for tok in _PACING_TOKENS):
+            return True
+        if name in _BLOCKING_CALLS and not sub.args:
+            return True
+    return False
+
+
+def _handler_escapes(handler: ast.ExceptHandler) -> bool:
+    """Does the handler leave the loop (``raise``/``break``/``return``)
+    instead of swallowing and re-iterating?"""
+    return any(isinstance(sub, (ast.Raise, ast.Break, ast.Return))
+               for sub in ast.walk(handler))
+
+
+class RetryWithoutBackoff(Rule):
+    """APX113: an unpaced hot retry loop in a recovery-path module —
+    ``while True:`` around a ``try`` that swallows the failure and
+    immediately re-attempts, with no sleep/backoff/wait anywhere in the
+    loop.  Against a persistent fault (dead coordinator, wedged
+    replica, full disk) this busy-spins, hammering the failing
+    dependency exactly when it needs room to recover."""
+
+    rule_id = "APX113"
+    severity = "error"
+    fix_hint = ("pace the retry: time.sleep a (jittered, capped) "
+                "backoff between attempts, honor the typed retry-after "
+                "(fleet.Overloaded.retry_after_s is that signal), or "
+                "escape the loop (re-raise / break / return) after a "
+                "bounded attempt budget — resilience.elastic's "
+                "supervisor and io's retry helpers show both shapes")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        dirs = re.split(r"[\\/]", ctx.path)[:-1]
+        if not _RECOVERY_DIRS.intersection(dirs):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.While) \
+                    or not _is_truthy_const(node.test):
+                continue
+            if _loop_is_paced(node):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Try) or not sub.handlers:
+                    continue
+                if any(_handler_escapes(h) for h in sub.handlers):
+                    continue
+                yield self.finding(
+                    ctx, sub,
+                    f"unpaced retry: `while True:` re-attempts after a "
+                    f"swallowed exception with no sleep/backoff/wait in "
+                    f"the loop ({os.path.basename(ctx.path)}) — a "
+                    f"persistent fault becomes a busy-spin against the "
+                    f"failing dependency")

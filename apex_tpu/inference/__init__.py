@@ -23,7 +23,11 @@ A paged-KV decode engine with continuous batching:
   the batched verify step (bitwise the non-speculative stream);
 - :mod:`~apex_tpu.inference.prefix` — prefix sharing: a refcounted
   rolling token-hash trie deduping identical prompt-prefix pages,
-  copy-on-write before the first divergent write.
+  copy-on-write before the first divergent write;
+- :mod:`~apex_tpu.inference.fleet` — the fault-tolerant multi-replica
+  frontend: replica health state machine, replay-on-failure from the
+  wedge manifest / request journal (greedy streams stay bitwise the
+  unkilled run), prefix-affinity routing, and graceful brownout.
 
 See docs/inference.md for the architecture and knob table, and
 ``examples/gpt/serve_gpt.py`` for the load-generator driver.
@@ -37,18 +41,24 @@ from apex_tpu.inference.kv_cache import (
     GARBAGE_PAGE, KVCacheConfig, PageAllocator, alloc_pools, copy_page,
     pages_needed, write_decode_kv, write_prompt_kv,
 )
+from apex_tpu.inference.fleet import (
+    FleetCompletion, FleetFrontend, LocalReplica, Overloaded, Router,
+    RouterConfig,
+)
 from apex_tpu.inference.prefix import PrefixCache, PrefixMatch
 from apex_tpu.inference.scheduler import (
-    LANES, Completion, ContinuousBatchingScheduler, Request,
+    LANES, Completion, ContinuousBatchingScheduler, ManifestEntry,
+    Request,
 )
 from apex_tpu.inference.spec import NGramProposer, accepted_tokens
 
 __all__ = [
     "Completion", "ContinuousBatchingScheduler", "DecodeConfig",
-    "GARBAGE_PAGE", "KVCacheConfig", "LANES", "NGramProposer",
-    "PageAllocator", "PrefixCache", "PrefixMatch", "Request",
-    "accepted_tokens", "alloc_pools", "copy_page", "make_decode_step",
-    "make_prefill", "make_prefill_chunk", "make_sample_head",
-    "make_verify_step", "pages_needed", "write_decode_kv",
-    "write_prompt_kv",
+    "FleetCompletion", "FleetFrontend", "GARBAGE_PAGE", "KVCacheConfig",
+    "LANES", "LocalReplica", "ManifestEntry", "NGramProposer",
+    "Overloaded", "PageAllocator", "PrefixCache", "PrefixMatch",
+    "Request", "Router", "RouterConfig", "accepted_tokens",
+    "alloc_pools", "copy_page", "make_decode_step", "make_prefill",
+    "make_prefill_chunk", "make_sample_head", "make_verify_step",
+    "pages_needed", "write_decode_kv", "write_prompt_kv",
 ]

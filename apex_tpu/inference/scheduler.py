@@ -95,7 +95,8 @@ from apex_tpu.resilience.chaos import active_monkey
 from apex_tpu.resilience.uniformity import assert_uniform
 from apex_tpu.utils.logging import get_logger, log_structured
 
-__all__ = ["LANES", "Completion", "ContinuousBatchingScheduler", "Request"]
+__all__ = ["LANES", "Completion", "ContinuousBatchingScheduler",
+           "ManifestEntry", "Request"]
 
 _logger = get_logger("apex_tpu.inference")
 
@@ -140,6 +141,29 @@ class Completion:
     token_times: List[float]
     lane: str = "interactive"
     preemptions: int = 0
+    trace_id: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ManifestEntry:
+    """One unfinished request in a :meth:`drain_manifest` snapshot —
+    everything a frontend needs to RESUBMIT it elsewhere and splice the
+    continuation into the caller's stream: the ORIGINAL prompt (not the
+    current continuation leg's), every token already emitted across all
+    legs (``emitted`` — the splice point), and the tokens still owed
+    (``remaining``).  The replay request is
+    ``Request(rid, prompt + emitted, remaining, eos_id, lane,
+    trace_id)`` — prefix sharing makes the re-prefill cheap on a
+    replica that has served the prompt, and monotonic per-slot draw
+    seeds make the resubmission seed-safe."""
+
+    rid: int
+    lane: str
+    phase: str                     # "queued" | "in_flight"
+    prompt: List[int]              # original prompt (all legs)
+    emitted: List[int]             # tokens already emitted, in order
+    remaining: int                 # new tokens still owed
+    eos_id: Optional[int] = None
     trace_id: Optional[str] = None
 
 
@@ -224,6 +248,7 @@ class ContinuousBatchingScheduler:
             "spec_steps": 0, "spec_emitted": 0,
         }
         self._rebuilt_once = False
+        self._draining = False
         # record-only uniformity seam: the serve config shapes every
         # compiled step (static batch/page shapes, lane layout) — in a
         # future multi-host serving topology a per-process difference
@@ -258,25 +283,62 @@ class ContinuousBatchingScheduler:
             watchdog.on_wedge = hook
         self._build_steps()
 
+    def drain_manifest(self) -> List["ManifestEntry"]:
+        """Snapshot of every unfinished request — queued (both lanes)
+        then in-flight, each with the tokens already emitted across all
+        its legs — structured for a frontend to resubmit elsewhere and
+        SPLICE (emit only ``total[len(already_streamed):]``) rather
+        than regenerate.  Non-destructive and lock-free: list() copies
+        of the queues/slots make it racy-but-safe from the watchdog
+        thread (the decode thread is by definition wedged when it runs
+        there), and cheap enough for a frontend to poll per step."""
+        out: List[ManifestEntry] = []
+        for req in list(self.queue) + list(self.be_queue):
+            c = self._carry.get(req.rid)
+            out.append(ManifestEntry(
+                rid=req.rid, lane=req.lane, phase="queued",
+                prompt=list(c.prompt) if c is not None
+                else list(req.prompt),
+                emitted=list(c.tokens) if c is not None else [],
+                remaining=req.max_new_tokens, eos_id=req.eos_id,
+                trace_id=req.trace_id))
+        for s in list(self._slots):
+            if s is None:
+                continue
+            req = s.request
+            c = self._carry.get(req.rid)
+            gen = list(s.generated)
+            out.append(ManifestEntry(
+                rid=req.rid, lane=req.lane, phase="in_flight",
+                prompt=list(c.prompt) if c is not None
+                else list(req.prompt),
+                emitted=(list(c.tokens) if c is not None else []) + gen,
+                remaining=req.max_new_tokens - len(gen),
+                eos_id=req.eos_id, trace_id=req.trace_id))
+        return out
+
     def _on_wedge(self, info) -> None:
-        """Watchdog pre-exit hook: one structured record naming every
-        queued and in-flight request id — the requeue manifest a
-        frontend replays after the supervisor restarts the engine —
-        plus the wedge counter.  Runs on the watchdog thread; reads of
-        the slot arrays are racy-but-safe (the decode thread is by
-        definition wedged)."""
-        queued = [r.rid for r in list(self.queue)] \
-            + [r.rid for r in list(self.be_queue)]
-        inflight = [s.request.rid for s in self._slots if s is not None]
-        # EVERY id, untruncated: this record IS the requeue manifest —
-        # a frontend replaying it cannot recover ids a cap dropped.
-        # One long line once per process death is the cheap side of
-        # that trade (the wedge exits the process right after this).
+        """Watchdog pre-exit hook: one structured record carrying the
+        full :meth:`drain_manifest` — rids, lanes, AND the tokens each
+        in-flight request already emitted, so the frontend replaying it
+        can resubmit the unfinished tail and splice the continuation
+        instead of regenerating from scratch — plus the wedge counter.
+        Runs on the watchdog thread; reads of the slot arrays are
+        racy-but-safe (the decode thread is by definition wedged)."""
+        manifest = self.drain_manifest()
+        queued = [m.rid for m in manifest if m.phase == "queued"]
+        inflight = [m.rid for m in manifest if m.phase == "in_flight"]
+        # EVERY entry, untruncated: this record IS the requeue manifest
+        # — a frontend replaying it cannot recover ids (or emitted
+        # tokens) a cap dropped.  One long line once per process death
+        # is the cheap side of that trade (the wedge exits the process
+        # right after this).
         log_structured(
             _logger, logging.ERROR, "serve.step_wedged",
             decode_step=self.stats["decode_steps"],
             queued_rids=queued, inflight_rids=inflight,
             queued=len(queued), inflight=len(inflight),
+            manifest=[dataclasses.asdict(m) for m in manifest],
             elapsed_s=info.get("elapsed_s"))
         _metrics.inc("apex_serve_wedges_total",
                      help="decode steps the watchdog declared wedged")
@@ -370,6 +432,10 @@ class ContinuousBatchingScheduler:
         """Queue a request (FIFO within its lane).  Requests that can
         NEVER fit the static shapes fail here, loudly, instead of
         wedging the queue head forever."""
+        if self._draining:
+            raise RuntimeError(
+                "scheduler is draining (begin_drain) — submit to "
+                "another replica")
         if request.lane not in LANES:
             raise ValueError(
                 f"unknown lane {request.lane!r}; lanes are {LANES}")
@@ -407,6 +473,54 @@ class ContinuousBatchingScheduler:
         (self.queue if request.lane == "interactive"
          else self.be_queue).append(request)
         self._record_occupancy()
+
+    def cancel(self, rid: int) -> Optional[Request]:
+        """Remove a still-QUEUED request (either lane) and return it;
+        None when ``rid`` is resident or unknown — a decoding sequence
+        is not cancellable mid-step, the caller suppresses its output
+        instead (the frontend's hedge-loser path)."""
+        for q in (self.queue, self.be_queue):
+            for req in q:
+                if req.rid == rid:
+                    q.remove(req)
+                    self._submit_times.pop(rid, None)
+                    self._carry.pop(rid, None)
+                    _metrics.inc("apex_serve_cancelled_total",
+                                 help="queued requests cancelled "
+                                      "before admission")
+                    self._record_occupancy()
+                    return req
+        return None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drained(self) -> bool:
+        """True once a draining scheduler has no residents left — the
+        planned-restart point where killing the replica drops nothing."""
+        return self._draining and all(s is None for s in self._slots)
+
+    def begin_drain(self) -> List[ManifestEntry]:
+        """Planned-restart entry: stop admitting (``submit`` raises,
+        ``_admit`` is a no-op), hand back the queued requests as a
+        manifest (they would otherwise wait forever), and let the
+        residents finish through the ordinary step/evict path.  The
+        caller re-routes the returned entries and polls :meth:`drained`
+        before recycling the process."""
+        self._draining = True
+        manifest = [m for m in self.drain_manifest()
+                    if m.phase == "queued"]
+        for m in manifest:
+            self._submit_times.pop(m.rid, None)
+            self._carry.pop(m.rid, None)
+        self.queue.clear()
+        self.be_queue.clear()
+        log_structured(
+            _logger, logging.INFO, "serve.drain_begun",
+            requeued=len(manifest), residents=self.num_active)
+        self._record_occupancy()
+        return manifest
 
     def _epoch(self, mono: float) -> float:
         """Epoch timestamp of the monotonic instant ``mono`` (the
@@ -447,6 +561,8 @@ class ContinuousBatchingScheduler:
         return total, match, total - match.num_full
 
     def _admit(self) -> int:
+        if self._draining:
+            return 0
         admitted = self._admit_from(self.queue, can_preempt=True)
         if not self.queue:
             # best-effort fills leftover capacity only while no

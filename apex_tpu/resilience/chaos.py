@@ -47,8 +47,8 @@ from apex_tpu.utils.logging import get_logger, log_structured
 
 __all__ = [
     "ChaosHostKilled", "ChaosIOError", "ChaosKernelFailure", "ChaosPlan",
-    "ChaosMonkey", "SupervisorFault", "SupervisorFaultScript",
-    "active_monkey", "check_io", "check_kernel",
+    "ChaosMonkey", "ChaosReplicaKilled", "SupervisorFault",
+    "SupervisorFaultScript", "active_monkey", "check_io", "check_kernel",
     "corrupt_newest_checkpoint",
 ]
 
@@ -75,6 +75,25 @@ class ChaosHostKilled(SystemExit):
     def __str__(self):
         return (f"injected hard kill of host rank {self.rank} at step "
                 f"{self.step} (exit {self.code})")
+
+
+class ChaosReplicaKilled(SystemExit):
+    """Injected stand-in for one serving replica of N dying hard
+    (SIGKILL, OOM, host loss): no drain, no manifest, no exit handler —
+    the fleet fault the frontend's request journal exists to replay
+    from.  A ``SystemExit`` subclass for the same reason as
+    :class:`ChaosHostKilled`; the carried code is
+    :data:`~apex_tpu.resilience.elastic.EXIT_KILLED` (137)."""
+
+    def __init__(self, replica_id: str, step: int, code: int):
+        super().__init__(code)
+        self.replica_id = str(replica_id)
+        self.step = int(step)
+
+    def __str__(self):
+        return (f"injected hard kill of serving replica "
+                f"{self.replica_id!r} at replica step {self.step} "
+                f"(exit {self.code})")
 
 
 class ChaosIOError(OSError):
@@ -113,6 +132,20 @@ class ChaosPlan:
     "filesystem" recovers; ``io_delay_seconds``: site -> seconds each
     operation stalls first (slow disk).  Both ride
     :func:`check_io` inside ``io.checkpoint``'s retry loop.
+
+    Serving-fleet faults (``inference.fleet`` — per-replica, keyed on
+    the replica's OWN step count so a 2-replica plan kills exactly one
+    mid-stream):
+
+    ``kill_replica_at``: replica id -> replica step at which that
+    replica dies HARD (:meth:`ChaosMonkey.maybe_kill_replica` raises
+    :class:`ChaosReplicaKilled` — no drain, no manifest; the frontend
+    must replay from its own journal, exit-137 shape).
+    ``wedge_replica_at``: replica id -> replica step at which that
+    replica's decode step wedges (:meth:`ChaosMonkey
+    .maybe_wedge_replica` returns True once) — the exit-75 shape: the
+    watchdog path emits the ``serve.step_wedged`` manifest and the
+    frontend replays THAT.
     """
 
     nan_grad_steps: FrozenSet[int] = frozenset()
@@ -130,6 +163,10 @@ class ChaosPlan:
     io_failures: Mapping[str, int] = dataclasses.field(default_factory=dict)
     io_delay_seconds: Mapping[str, float] = dataclasses.field(
         default_factory=dict)
+    kill_replica_at: Mapping[str, int] = dataclasses.field(
+        default_factory=dict)
+    wedge_replica_at: Mapping[str, int] = dataclasses.field(
+        default_factory=dict)
 
     @staticmethod
     def make(nan_grad_steps: Iterable[int] = (),
@@ -143,7 +180,9 @@ class ChaosPlan:
              wedge_collective_at_step: Optional[int] = None,
              wedge_collective_seconds: float = 0.0,
              io_failures: Optional[Mapping[str, int]] = None,
-             io_delay_seconds: Optional[Mapping[str, float]] = None
+             io_delay_seconds: Optional[Mapping[str, float]] = None,
+             kill_replica_at: Optional[Mapping[str, int]] = None,
+             wedge_replica_at: Optional[Mapping[str, int]] = None
              ) -> "ChaosPlan":
         return ChaosPlan(
             nan_grad_steps=frozenset(int(s) for s in nan_grad_steps),
@@ -158,6 +197,10 @@ class ChaosPlan:
             wedge_collective_seconds=float(wedge_collective_seconds),
             io_failures=dict(io_failures or {}),
             io_delay_seconds=dict(io_delay_seconds or {}),
+            kill_replica_at={str(r): int(s)
+                             for r, s in (kill_replica_at or {}).items()},
+            wedge_replica_at={str(r): int(s)
+                              for r, s in (wedge_replica_at or {}).items()},
         )
 
 
@@ -264,6 +307,36 @@ class ChaosMonkey:
                            step=int(step), seconds=secs)
             time.sleep(secs)
         return secs
+
+    # ---------------------------------------------- serving-fleet faults
+    def maybe_kill_replica(self, replica_id: str, step: int) -> None:
+        """Deliver the planned HARD death of serving replica
+        ``replica_id`` at ITS step ``step``: raises
+        :class:`ChaosReplicaKilled` (a ``SystemExit``, exit 137) — no
+        drain, no wedge manifest, so the only replay source is the
+        frontend's own request journal."""
+        planned = self.plan.kill_replica_at.get(str(replica_id))
+        if planned is None or int(step) != int(planned):
+            return
+        self._count(f"kill_replica:{replica_id}")
+        from apex_tpu.resilience.elastic import EXIT_KILLED
+
+        log_structured(_logger, logging.WARNING, "chaos.replica_killed",
+                       replica=str(replica_id), step=int(step))
+        raise ChaosReplicaKilled(str(replica_id), int(step), EXIT_KILLED)
+
+    def maybe_wedge_replica(self, replica_id: str, step: int) -> bool:
+        """True exactly once, at the planned (replica, step): the
+        replica's decode dispatch has wedged (dead tunnel shape) — the
+        caller runs the watchdog path (``serve.step_wedged`` manifest,
+        exit 75) instead of sleeping a real watchdog out."""
+        planned = self.plan.wedge_replica_at.get(str(replica_id))
+        if planned is None or int(step) != int(planned):
+            return False
+        self._count(f"wedge_replica:{replica_id}")
+        log_structured(_logger, logging.WARNING, "chaos.replica_wedged",
+                       replica=str(replica_id), step=int(step))
+        return True
 
     def collective_wedge_callback(self, step, rank) -> None:
         """In-step host callback (see ``models/gpt.py``): sleep on

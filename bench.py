@@ -1241,6 +1241,80 @@ def bench_serve_gpt124(streams=(1, 8, 32), layers=12, hidden=768, heads=12,
         "tokens_per_sec": round(
             sum(len(c.tokens) for c in done_c) / max(dt_c, 1e-9), 2),
     }
+
+    # fleet: the resilience row — a 2-replica frontend with one replica
+    # chaos-killed mid-run.  The contract this measures is absorption:
+    # dropped_requests MUST be 0 and the greedy streams MUST be bitwise
+    # the unkilled single-replica run (replay splices the journal's
+    # emitted tokens and regenerates only the tail); the reported cost
+    # is the caller-visible stall (max inter-token gap on replayed
+    # streams) and the replay count.
+    from apex_tpu.inference.fleet import (
+        FleetFrontend, LocalReplica, RouterConfig,
+    )
+    from apex_tpu.resilience.chaos import ChaosMonkey, ChaosPlan
+
+    def mk_fleet_sched(n):
+        # max_prompt_len covers the CONTINUATION leg's prompt
+        # (original prompt + already-emitted tokens)
+        per = pages_needed(prompt_len + 2 * max_new, page_size)
+        dcfg = DecodeConfig(
+            cache=KVCacheConfig(
+                num_pages=1 + n * per, page_size=page_size,
+                pages_per_seq=per,
+                dtype=jnp.float32 if _SMOKE else jnp.bfloat16),
+            max_batch=n, max_prompt_len=prompt_len + max_new,
+            temperature=0.0, top_k=0, attn_impl=attn,
+            sample_impl="xla" if _SMOKE else "auto", base_seed=seed)
+        return ContinuousBatchingScheduler(params, cfg, dcfg)
+
+    n_fleet_req = 2 * n_v2
+    fleet_reqs = []
+    for rid in range(n_fleet_req):
+        plen = int(rng.randint(max(2, prompt_len // 2), prompt_len + 1))
+        fleet_reqs.append(Request(
+            rid=rid, prompt=rng.randint(0, vocab, size=plen).tolist(),
+            max_new_tokens=max_new))
+    single = mk_fleet_sched(n_v2)
+    for r in fleet_reqs:
+        single.submit(Request(r.rid, list(r.prompt), r.max_new_tokens))
+    want = {c.rid: list(c.tokens) for c in single.run_until_drained()}
+
+    monkey = ChaosMonkey(ChaosPlan.make(kill_replica_at={"r0": 3}))
+    with monkey.active():
+        fe = FleetFrontend(
+            [LocalReplica(f"r{i}", lambda n=n_v2: mk_fleet_sched(n))
+             for i in range(2)],
+            config=RouterConfig(hedge_after_s=0.0,
+                                be_shed_queue_depth=10 ** 6,
+                                reject_queue_depth=10 ** 6,
+                                affinity_min_tokens=10 ** 6)).start()
+        t0 = time.perf_counter()
+        for r in fleet_reqs:
+            fe.submit(Request(r.rid, list(r.prompt), r.max_new_tokens))
+        done_f = fe.run_until_drained()
+        dt_f = time.perf_counter() - t0
+    dropped = n_fleet_req - len(done_f)
+    assert dropped == 0, f"fleet dropped {dropped} request(s)"
+    rids_f = [c.rid for c in done_f]
+    assert len(rids_f) == len(set(rids_f)), "duplicate fleet completion"
+    assert {c.rid: list(c.tokens) for c in done_f} == want, \
+        "fleet streams diverged from the unkilled single-replica run"
+    assert fe.stats["replica_deaths"] == 1 and fe.stats["replays"] >= 1
+    stalls = [float(np.max(np.diff(c.token_times))) for c in done_f
+              if c.replays and len(c.token_times) > 1]
+    out["fleet"] = {
+        "replicas": 2, "requests": n_fleet_req,
+        "dropped_requests": dropped,
+        "bitwise_vs_single_replica": True,
+        "killed_replica": "r0", "kill_at_replica_step": 3,
+        "replays": fe.stats["replays"],
+        "replica_restarts": fe.stats["restarts"],
+        "tokens_per_sec": round(
+            sum(len(c.tokens) for c in done_f) / max(dt_f, 1e-9), 2),
+        "replay_stall_ms_max": (round(1e3 * max(stalls), 3)
+                                if stalls else None),
+    }
     return out
 
 

@@ -117,6 +117,15 @@ def build_args():
                         "metrics.prom (apex_tpu.observability)")
     p.add_argument("--run-id", default="serve",
                    help="correlation id on metrics points and trace spans")
+    p.add_argument("--replica-id", default=None,
+                   help="this process's fleet replica id (the frontend's "
+                        "roster name, e.g. r0).  Suffixes every "
+                        "observability artifact — metrics_<id>.jsonl/"
+                        ".prom, and <id> folded into --run-id for trace/"
+                        "flight-recorder file names — so N replica "
+                        "processes can share one --metrics-dir/"
+                        "--trace-dir without clobbering each other "
+                        "(the per-rank suffix convention, serving-side)")
     p.add_argument("--trace-dir", default=None,
                    help="host-side request tracing + crash forensics: "
                         "per-request spans (admission wait -> prefill "
@@ -299,6 +308,14 @@ def main(argv=None):
     from apex_tpu.observability import flightrec, tracing
     from apex_tpu.resilience import ChaosMonkey, ChaosPlan, StepWatchdog
 
+    # fleet-replica suffixing: N replica processes share one sink dir;
+    # each writes metrics_<replica>.jsonl/.prom and folds the replica
+    # id into the run id (trace + flight-recorder file names derive
+    # from it) — same convention as pretrain's per-rank `_rank{p}`
+    rep_sfx = f"_{args.replica_id}" if args.replica_id else ""
+    if args.replica_id:
+        args.run_id = f"{args.run_id}_{args.replica_id}"
+
     set_step_context(run_id=args.run_id, step=0)
     registry = get_metrics()  # the scheduler's gauges/histograms land here
     tracer = None
@@ -363,8 +380,9 @@ def main(argv=None):
     if args.metrics_dir:
         mdir = Path(args.metrics_dir)
         mdir.mkdir(parents=True, exist_ok=True)
-        registry.snapshot_jsonl(mdir / "metrics.jsonl")
-        (mdir / "metrics.prom").write_text(registry.prometheus_text())
+        registry.snapshot_jsonl(mdir / f"metrics{rep_sfx}.jsonl")
+        (mdir / f"metrics{rep_sfx}.prom").write_text(
+            registry.prometheus_text())
         out["metrics_dir"] = str(mdir)
     if anomaly is not None:
         anomaly.persist(args.metrics_dir or args.trace_dir)

@@ -1,0 +1,613 @@
+"""Fleet chaos matrix: replica failure must be invisible to callers.
+
+The load-bearing contracts (ISSUE 17 acceptance):
+
+- **Kill (exit-137 shape)**: SIGKILL one replica of a 2-replica fleet
+  mid-stream — every submitted request still completes, with greedy
+  token streams BITWISE the unkilled single-replica run, zero dropped
+  requests, zero duplicate emissions.  The journal is the only replay
+  source (a hard kill leaves no manifest).
+- **Wedge (exit-75 shape)**: wedge one replica's decode step — the
+  ``serve.step_wedged`` manifest path replays (the manifest carries
+  tokens the frontend never polled, spliced not regenerated), and the
+  request's ONE trace id joins its spans across both replicas.
+- **Brownout**: overload sheds best-effort admissions (typed
+  ``Overloaded`` with retry-after) BEFORE the interactive lane's TTFT
+  is touched, pinned via the per-lane serve histograms.
+- **Drain-then-restart**: a planned restart re-routes the drained
+  replica's queue, finishes its residents in place, and rejects ZERO
+  admissions end to end.
+- **Hedge**: an interactive straggler gets exactly one hedged retry;
+  first token wins, the loser is cancelled/suppressed — no duplicate
+  completion, stream unchanged.
+- **Uniformity**: the fleet config registers in the PR 16 seam; a
+  fleet whose processes disagree about one replica's scheduler config
+  fails ``check_uniform`` loudly with the ``serve.fleet_config`` tag.
+
+Plus the scheduler-seam satellites: ``drain_manifest()`` structure
+(emitted tokens included — the splice contract), ``cancel()``, and
+``begin_drain()``.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.inference import (
+    ContinuousBatchingScheduler, DecodeConfig, KVCacheConfig, Request,
+)
+from apex_tpu.inference.fleet import (
+    FleetFrontend, LocalReplica, Overloaded, Router, RouterConfig,
+)
+from apex_tpu.models.gpt import GPTConfig, init_params
+from apex_tpu.observability import MetricsScope
+from apex_tpu.observability import tracing
+from apex_tpu.resilience import uniformity as U
+from apex_tpu.resilience.chaos import ChaosMonkey, ChaosPlan
+
+VOCAB = 61
+
+
+@pytest.fixture(autouse=True)
+def _isolated_seams():
+    """Fleet frontends register a ``serve.fleet_config`` provider in
+    the process-global uniformity seam, and some tests install a
+    tracer — both must not leak across tests."""
+    U.reset_uniformity()
+    yield
+    U.reset_uniformity()
+    tracing.disable()
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        vocab_size=VOCAB, hidden_size=32, num_layers=2,
+        num_attention_heads=4, max_seq_len=128,
+        position_embedding_type="rope", compute_dtype=jnp.float32,
+        checkpoint_layers=False,
+    )
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _sched(params, cfg, *, num_pages=40, page_size=4, pages_per_seq=16,
+           max_batch=2, max_prompt=16, seed=0, time_fn=None, **dk):
+    dcfg = DecodeConfig(
+        cache=KVCacheConfig(num_pages=num_pages, page_size=page_size,
+                            pages_per_seq=pages_per_seq,
+                            dtype=jnp.float32),
+        max_batch=max_batch, max_prompt_len=max_prompt,
+        temperature=0.0, top_k=0, attn_impl="xla", sample_impl="xla",
+        sample_dot_dtype=jnp.float32, base_seed=seed, **dk)
+    kw = {} if time_fn is None else {"time_fn": time_fn}
+    return ContinuousBatchingScheduler(params, cfg, dcfg, **kw)
+
+
+def _fleet(params, cfg, *, n=2, config=None, time_fn=None,
+           auto_restart=True, **dk):
+    """A started n-replica fleet over one shared model (the replicas
+    of one deployment serve the same weights — the bitwise-parity
+    contract depends on it)."""
+    reps = [LocalReplica(
+        f"r{i}",
+        (lambda params=params, cfg=cfg, dk=dk, tf=time_fn:
+         _sched(params, cfg, time_fn=tf, **dk)),
+        **({} if time_fn is None else {"time_fn": time_fn}))
+        for i in range(n)]
+    kw = {} if time_fn is None else {"time_fn": time_fn}
+    fe = FleetFrontend(
+        reps,
+        config=config or RouterConfig(hedge_after_s=0.0,
+                                      reject_queue_depth=10_000,
+                                      be_shed_queue_depth=10_000),
+        auto_restart=auto_restart, **kw)
+    return fe.start()
+
+
+def _requests(rng, n, max_new=6, lane="interactive"):
+    return [Request(i, rng.randint(0, VOCAB, size=10).tolist(), max_new,
+                    lane=lane) for i in range(n)]
+
+
+def _baseline(params, cfg, requests):
+    """The unkilled single-replica greedy streams — the bitwise bar."""
+    sched = _sched(params, cfg, max_batch=max(4, len(requests)),
+                   num_pages=120, pages_per_seq=16)
+    for r in requests:
+        sched.submit(Request(r.rid, list(r.prompt), r.max_new_tokens,
+                             eos_id=r.eos_id, lane=r.lane))
+    return {c.rid: tuple(c.tokens) for c in sched.run_until_drained()}
+
+
+class _LogSink(logging.Handler):
+    """Collects ``apex_tpu.inference`` records (the module logger
+    writes to a stream captured at import, so pytest's capture
+    fixtures miss it)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lines = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+    def __enter__(self):
+        logging.getLogger("apex_tpu.inference").addHandler(self)
+        return self
+
+    def __exit__(self, *exc):
+        logging.getLogger("apex_tpu.inference").removeHandler(self)
+
+    @property
+    def text(self) -> str:
+        return "\n".join(self.lines)
+
+
+class _Clock:
+    """Manually-advanced clock shared by schedulers, replicas, and the
+    frontend — hedge deadlines become deterministic."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ------------------------------------------------- scheduler-seam units
+class TestDrainManifest:
+    def test_manifest_includes_emitted_tokens_and_lanes(self, model):
+        """The satellite bugfix: the manifest is structured (not just a
+        log line) and carries each in-flight request's already-emitted
+        tokens, so replay can SPLICE rather than regenerate."""
+        cfg, params = model
+        rng = np.random.RandomState(0)
+        sched = _sched(params, cfg, max_batch=1)
+        reqs = [Request(0, rng.randint(0, VOCAB, size=8).tolist(), 6),
+                Request(1, rng.randint(0, VOCAB, size=8).tolist(), 6),
+                Request(2, rng.randint(0, VOCAB, size=8).tolist(), 6,
+                        lane="best_effort")]
+        for r in reqs:
+            sched.submit(r)
+        sched.step()  # admit+prefill rid 0, decode one token
+        manifest = {m.rid: m for m in sched.drain_manifest()}
+        assert set(manifest) == {0, 1, 2}
+        m0 = manifest[0]
+        assert m0.phase == "in_flight" and m0.lane == "interactive"
+        assert m0.emitted == sched._slots[0].generated
+        assert len(m0.emitted) >= 1, "prefill's first token must show"
+        assert m0.remaining == 6 - len(m0.emitted)
+        assert m0.prompt == list(reqs[0].prompt)
+        assert m0.trace_id is not None
+        for rid in (1, 2):
+            assert manifest[rid].phase == "queued"
+            assert manifest[rid].emitted == []
+            assert manifest[rid].remaining == 6
+        assert manifest[2].lane == "best_effort"
+
+    def test_wedge_log_carries_manifest_and_rid_fields(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(1)
+        sched = _sched(params, cfg, max_batch=1)
+        sched.submit(Request(0, rng.randint(0, VOCAB, size=8).tolist(),
+                             6))
+        sched.submit(Request(1, rng.randint(0, VOCAB, size=8).tolist(),
+                             6))
+        sched.step()
+        with _LogSink() as sink:
+            sched._on_wedge({"elapsed_s": 1.5})
+        assert "serve.step_wedged" in sink.text
+        assert "queued_rids" in sink.text   # watchdog/test-compat field
+        assert "manifest" in sink.text
+        assert "emitted" in sink.text
+
+    def test_cancel_queued_not_resident(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(2)
+        sched = _sched(params, cfg, max_batch=1)
+        sched.submit(Request(0, rng.randint(0, VOCAB, size=8).tolist(),
+                             6))
+        sched.submit(Request(1, rng.randint(0, VOCAB, size=8).tolist(),
+                             6))
+        sched.step()
+        got = sched.cancel(1)
+        assert got is not None and got.rid == 1
+        assert not sched.queue
+        assert sched.cancel(0) is None, "residents are not cancellable"
+        assert sched.cancel(99) is None
+        done = sched.run_until_drained()
+        assert [c.rid for c in done] == [0]
+
+    def test_begin_drain_stops_admission_finishes_residents(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(3)
+        sched = _sched(params, cfg, max_batch=1)
+        for i in range(3):
+            sched.submit(Request(
+                i, rng.randint(0, VOCAB, size=8).tolist(), 5))
+        sched.step()
+        handed_back = sched.begin_drain()
+        assert sorted(m.rid for m in handed_back) == [1, 2]
+        assert all(m.phase == "queued" for m in handed_back)
+        assert not sched.queue and not sched.be_queue
+        with pytest.raises(RuntimeError, match="draining"):
+            sched.submit(Request(
+                9, rng.randint(0, VOCAB, size=8).tolist(), 5))
+        for _ in range(50):
+            if sched.drained():
+                break
+            sched.step()
+        assert sched.drained()
+        assert [c.rid for c in sched.completed] == [0]
+        assert len(sched.completed[0].tokens) == 5
+
+
+# --------------------------------------------------- kill (exit-137 shape)
+class TestKillReplay:
+    def test_kill_one_replica_mid_stream_bitwise_parity(self, model):
+        """The headline acceptance: SIGKILL one of two replicas while
+        its residents stream — every request completes, greedy streams
+        bitwise the unkilled single-replica run, zero drops, zero
+        duplicates."""
+        cfg, params = model
+        rng = np.random.RandomState(7)
+        reqs = _requests(rng, 6, max_new=6)
+        want = _baseline(params, cfg, reqs)
+
+        monkey = ChaosMonkey(ChaosPlan.make(
+            kill_replica_at={"r0": 3}))
+        with monkey.active():
+            fe = _fleet(params, cfg, n=2)
+            for r in reqs:
+                fe.submit(Request(r.rid, list(r.prompt),
+                                  r.max_new_tokens, lane=r.lane))
+            done = fe.run_until_drained()
+
+        assert monkey.injected.get("kill_replica:r0") == 1
+        assert fe.stats["replica_deaths"] == 1
+        assert fe.stats["replays"] >= 1, \
+            "the killed replica held work; replay must have fired"
+        rids = [c.rid for c in done]
+        assert sorted(rids) == sorted(want), "dropped request(s)"
+        assert len(rids) == len(set(rids)), "duplicate completion(s)"
+        for c in done:
+            assert tuple(c.tokens) == want[c.rid], (
+                f"rid {c.rid}: fleet stream diverged from the unkilled "
+                f"run (replays={c.replays})")
+            assert len(c.token_times) == len(c.tokens)
+        assert any(c.replays >= 1 for c in done)
+        # the dead replica came back (auto-restart supervisor role)
+        assert fe.replicas["r0"].state == "serving"
+        assert fe.replicas["r0"].restarts == 1
+
+    def test_direct_kill_api(self, model):
+        """`kill()` (no chaos plan) is the test/driver seam: same
+        journal-replay path, discovered on the next frontend step."""
+        cfg, params = model
+        rng = np.random.RandomState(8)
+        reqs = _requests(rng, 3, max_new=5)
+        want = _baseline(params, cfg, reqs)
+        fe = _fleet(params, cfg, n=2, auto_restart=False)
+        for r in reqs:
+            fe.submit(Request(r.rid, list(r.prompt), r.max_new_tokens),
+                      replica_id="r0")
+        fe.step()
+        fe.replicas["r0"].kill()
+        # dead replicas don't raise from step(); the journal holds the
+        # orphaned work — reroute it explicitly via the frontend seam
+        fe._on_replica_dead(fe.replicas["r0"], None, "kill")
+        done = fe.run_until_drained()
+        assert {c.rid: tuple(c.tokens) for c in done} == want
+        assert all(c.replica_id == "r1" for c in done)
+
+
+# -------------------------------------------------- wedge (exit-75 shape)
+class TestWedgeManifestReplay:
+    def test_wedge_replays_manifest_and_trace_ids_join(self, model):
+        """The second headline acceptance: a wedged replica's
+        ``serve.step_wedged`` manifest drives the replay, and each
+        replayed request's ONE trace id joins its spans across both
+        replicas (prefill on the wedged one, prefill+request on the
+        survivor, the fleet.replay span naming both)."""
+        cfg, params = model
+        rng = np.random.RandomState(9)
+        reqs = _requests(rng, 4, max_new=6)
+        want = _baseline(params, cfg, reqs)
+
+        tracer = tracing.configure(capacity=8192)
+        monkey = ChaosMonkey(ChaosPlan.make(
+            wedge_replica_at={"r0": 3}))
+        with MetricsScope() as reg, monkey.active(), _LogSink() as sink:
+            fe = _fleet(params, cfg, n=2)
+            for r in reqs:
+                fe.submit(Request(r.rid, list(r.prompt),
+                                  r.max_new_tokens, lane=r.lane))
+            done = fe.run_until_drained()
+
+        assert monkey.injected.get("wedge_replica:r0") == 1
+        assert "serve.step_wedged" in sink.text
+        assert {c.rid: tuple(c.tokens) for c in done} == want
+        replays = [m for m in reg.metrics()
+                   if m.name == "apex_fleet_replays_total"]
+        assert replays and replays[0].value(cause="wedge") >= 1, \
+            "the wedge manifest path must drive these replays"
+
+        spans = tracer.spans()
+        replay_spans = [s for s in spans if s["name"] == "fleet.replay"]
+        assert replay_spans, "no fleet.replay span emitted"
+        for rs in replay_spans:
+            tid = rs["attrs"]["trace_id"]
+            assert rs["attrs"]["cause"] == "wedge"
+            assert rs["attrs"]["from_replica"] == "r0"
+            assert rs["attrs"]["to_replica"] == "r1"
+            joined = [s["name"] for s in spans
+                      if s["attrs"].get("trace_id") == tid]
+            # leg 1's prefill ran on r0, leg 2's prefill AND the
+            # whole-lifetime serve.request on r1 — one id joins them
+            assert joined.count("serve.prefill") >= 2, joined
+            assert "serve.request" in joined, joined
+
+    def test_wedge_splices_tokens_the_frontend_never_polled(self, model):
+        """The manifest is richer than the journal: tokens generated
+        between the last poll and the wedge ride the manifest into the
+        journal (spliced), so the continuation budget shrinks — replay
+        does not regenerate them."""
+        cfg, params = model
+        rng = np.random.RandomState(10)
+        reqs = _requests(rng, 2, max_new=8)
+        want = _baseline(params, cfg, reqs)
+        monkey = ChaosMonkey(ChaosPlan.make(
+            wedge_replica_at={"r0": 4}))
+        with monkey.active():
+            fe = _fleet(params, cfg, n=2)
+            for r in reqs:
+                fe.submit(Request(r.rid, list(r.prompt),
+                                  r.max_new_tokens),
+                          replica_id="r0")
+            done = fe.run_until_drained()
+        assert {c.rid: tuple(c.tokens) for c in done} == want
+        replayed = [c for c in done if c.replays]
+        assert replayed, "everything was pinned to the wedged replica"
+
+
+# ----------------------------------------------------------- brownout
+class TestBrownout:
+    def test_best_effort_sheds_before_interactive_rejects(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(11)
+        fe = _fleet(params, cfg, n=2, max_batch=1,
+                    config=RouterConfig(hedge_after_s=0.0,
+                                        be_shed_queue_depth=2,
+                                        reject_queue_depth=4,
+                                        retry_after_s=0.25,
+                                        affinity_min_tokens=10 ** 6))
+
+        def mk(rid, lane="interactive"):
+            return Request(rid, rng.randint(0, VOCAB, size=8).tolist(),
+                           4, lane=lane)
+
+        with MetricsScope() as reg:
+            fe.submit(mk(0))
+            fe.submit(mk(1))
+            # fleet queued depth is now at the shed rung: best-effort
+            # admissions degrade FIRST, typed and with retry-after
+            with pytest.raises(Overloaded) as shed:
+                fe.submit(mk(100, lane="best_effort"))
+            assert shed.value.reason == "brownout_shed"
+            assert shed.value.retry_after_s == 0.25
+            # the interactive lane still admits at this depth
+            fe.submit(mk(2))
+            fe.submit(mk(3))
+            # ...until the hard rung rejects every lane
+            with pytest.raises(Overloaded) as rej:
+                fe.submit(mk(4))
+            assert rej.value.reason == "overloaded"
+            done = fe.run_until_drained()
+
+        assert sorted(c.rid for c in done) == [0, 1, 2, 3]
+        # pinned via the per-lane histograms: every interactive TTFT
+        # sample landed, and the shed lane never produced one (shed at
+        # admission, not after burning prefill on it)
+        ttft = [m for m in reg.metrics()
+                if m.name == "apex_serve_ttft_seconds"]
+        lanes = {l.get("lane"): v for m in ttft
+                 for name, l, v in m.samples() if name.endswith("_count")}
+        assert lanes.get("interactive") == 4.0, lanes
+        assert "best_effort" not in lanes
+        rejects = [m for m in reg.metrics()
+                   if m.name == "apex_fleet_rejections_total"]
+        assert rejects[0].value(reason="brownout_shed",
+                                lane="best_effort") == 1.0
+        assert rejects[0].value(reason="overloaded",
+                                lane="interactive") == 1.0
+
+
+# ------------------------------------------------- drain-then-restart
+class TestDrainRestart:
+    def test_drain_reroutes_queue_finishes_residents_zero_rejects(
+            self, model):
+        cfg, params = model
+        rng = np.random.RandomState(12)
+        reqs = _requests(rng, 4, max_new=5)
+        want = _baseline(params, cfg, reqs)
+        fe = _fleet(params, cfg, n=2, max_batch=1, auto_restart=False)
+        for i, r in enumerate(reqs):
+            fe.submit(Request(r.rid, list(r.prompt), r.max_new_tokens),
+                      replica_id=f"r{i % 2}")
+        fe.step()  # one resident per replica, one queued behind each
+        r0 = fe.replicas["r0"]
+        moved = fe.drain_replica("r0")
+        assert moved == 1, "r0's queued request must re-route"
+        assert r0.state == "draining"
+        for _ in range(100):
+            if r0.state == "dead":
+                break
+            fe.step()
+        # the frontend retired the drained replica (residents done);
+        # with auto_restart off, the relaunch is ours to drive
+        assert r0.state == "dead"
+        r0.restart()
+        r0.step()
+        assert r0.state == "serving"
+        # post-restart the replica admits again — planned restart done
+        extra = Request(50, rng.randint(0, VOCAB, size=10).tolist(), 4)
+        fe.submit(extra, replica_id="r0")
+        done = fe.run_until_drained()
+        assert fe.stats["rejected"] == 0, \
+            "a planned drain must reject nothing"
+        got = {c.rid: tuple(c.tokens) for c in done}
+        for rid, toks in want.items():
+            assert got[rid] == toks
+        assert 50 in got
+
+
+# ------------------------------------------------------------- hedging
+class TestHedgedRetry:
+    def test_straggler_gets_one_hedge_first_token_wins(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(13)
+        clock = _Clock()
+        fe = _fleet(params, cfg, n=2, max_batch=1, time_fn=clock,
+                    config=RouterConfig(hedge_after_s=0.5,
+                                        reject_queue_depth=10 ** 6,
+                                        be_shed_queue_depth=10 ** 6,
+                                        affinity_min_tokens=10 ** 6))
+        blocker = Request(0, rng.randint(0, VOCAB, size=10).tolist(), 12)
+        target_prompt = rng.randint(0, VOCAB, size=10).tolist()
+        want = _baseline(params, cfg, [Request(1, target_prompt, 4)])
+        fe.submit(blocker, replica_id="r0")
+        fe.step()  # blocker resident on r0 (max_batch=1)
+        # the target starves behind it — queued on r0, no token
+        fe.submit(Request(1, list(target_prompt), 4), replica_id="r0")
+        clock.t += 1.0  # past the hedge deadline, still token-less
+        done = fe.run_until_drained()
+        assert fe.stats["hedges"] == 1
+        by_rid = {c.rid: c for c in done}
+        assert sorted(by_rid) == [0, 1], "zero drops, zero duplicates"
+        tgt = by_rid[1]
+        assert tgt.hedged and tgt.replica_id == "r1", \
+            "the idle replica's hedge leg must win"
+        assert tuple(tgt.tokens) == want[1]
+        # the loser copy was cancelled out of r0's queue, not served
+        assert all(r.sched is None or not any(
+            q and any(req.rid == 1 for req in q)
+            for q in (r.sched.queue, r.sched.be_queue))
+            for r in fe.replicas.values())
+
+    def test_hedge_is_bounded_to_one(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(14)
+        clock = _Clock()
+        fe = _fleet(params, cfg, n=3, max_batch=1, time_fn=clock,
+                    config=RouterConfig(hedge_after_s=0.5,
+                                        reject_queue_depth=10 ** 6,
+                                        be_shed_queue_depth=10 ** 6,
+                                        affinity_min_tokens=10 ** 6))
+        fe.submit(Request(0, rng.randint(0, VOCAB, size=10).tolist(),
+                          10), replica_id="r0")
+        fe.step()
+        fe.submit(Request(1, rng.randint(0, VOCAB, size=10).tolist(),
+                          4), replica_id="r0")
+        clock.t += 1.0
+        fe.step()   # hedge fires once...
+        clock.t += 1.0
+        fe.step()   # ...and never again, even while still waiting
+        assert fe.stats["hedges"] == 1
+        entry = fe.journal.get(1)
+        assert entry.hedged
+        fe.run_until_drained()
+
+
+# ----------------------------------------------------------- uniformity
+class TestFleetUniformity:
+    def test_fleet_config_registers_and_uniform_view_checks(self, model):
+        cfg, params = model
+        fe = _fleet(params, cfg, n=2)
+        # same view on every "process": check passes and records the tag
+        payload = U.check_uniform(
+            gather=lambda p: [dict(p), dict(p)])
+        assert "serve.fleet_config" in payload
+
+    def test_one_divergent_replica_config_fails_loudly(self, model):
+        """The chaos shape: rank 1's r1 was deployed with a different
+        scheduler config (page_size 8 vs 4) — its digest differs, so
+        the fleet view diverges and check_uniform names the tag
+        instead of letting replay splice onto a different compiled
+        program."""
+        cfg, params = model
+        fe = _fleet(params, cfg, n=2, page_size=4)
+        local = fe._uniform_view()
+        divergent = LocalReplica(
+            "r1", lambda: _sched(params, cfg, page_size=8)).start()
+        other = dict(local)
+        other["config_digests"] = dict(local["config_digests"])
+        other["config_digests"]["r1"] = divergent.config_digest
+        assert other != local
+        other_digest = U.uniform_digest(other)
+
+        def gather(payload):
+            return [dict(payload),
+                    {**payload, "serve.fleet_config": other_digest}]
+
+        with pytest.raises(U.UniformityError) as err:
+            U.check_uniform(gather=gather)
+        assert err.value.tag == "serve.fleet_config"
+
+
+# ------------------------------------------------------ routing units
+class TestRouting:
+    def test_prefix_affinity_prefers_warmed_trie(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(15)
+        fe = _fleet(params, cfg, n=2, prefix_sharing=True,
+                    config=RouterConfig(hedge_after_s=0.0,
+                                        affinity_min_tokens=4,
+                                        reject_queue_depth=10 ** 6,
+                                        be_shed_queue_depth=10 ** 6))
+        prompt = rng.randint(0, VOCAB, size=14).tolist()
+        fe.submit(Request(0, list(prompt), 4), replica_id="r0")
+        fe.run_until_drained()
+        router: Router = fe.router
+        reps = list(fe.replicas.values())
+        assert fe.replicas["r0"].prefix_affinity(prompt) >= 4
+        assert fe.replicas["r1"].prefix_affinity(prompt) == 0
+        pick = router.pick(Request(1, list(prompt), 4), reps)
+        assert pick.replica_id == "r0", "affinity must beat id order"
+
+    def test_least_loaded_fallback_and_health_gate(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(16)
+        fe = _fleet(params, cfg, n=2, max_batch=1,
+                    config=RouterConfig(hedge_after_s=0.0,
+                                        affinity_min_tokens=10 ** 6,
+                                        reject_queue_depth=10 ** 6,
+                                        be_shed_queue_depth=10 ** 6))
+        # load r0: a resident plus a queued request
+        fe.submit(Request(0, rng.randint(0, VOCAB, size=8).tolist(),
+                          8), replica_id="r0")
+        fe.step()
+        fe.submit(Request(1, rng.randint(0, VOCAB, size=8).tolist(),
+                          8), replica_id="r0")
+        fresh = Request(2, rng.randint(0, VOCAB, size=8).tolist(), 4)
+        pick = fe.router.pick(fresh, list(fe.replicas.values()))
+        assert pick.replica_id == "r1", "least-loaded must pick idle r1"
+        # health gate: with r1 dead, nothing serving-but-r0 → r0; with
+        # both dead, a typed no-capacity rejection
+        fe.replicas["r1"].kill()
+        pick = fe.router.pick(fresh, list(fe.replicas.values()))
+        assert pick.replica_id == "r0"
+        fe.replicas["r0"].kill()
+        with pytest.raises(Overloaded) as err:
+            fe.router.pick(fresh, list(fe.replicas.values()))
+        assert err.value.reason == "no_serving_replica"
+        fe.run_until_drained.__self__  # fleet left dead deliberately

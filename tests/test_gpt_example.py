@@ -445,6 +445,32 @@ def test_serve_metrics_dir(tmp_path):
     assert counts["apex_serve_completions_total"] == rec["stats"]["evicted"]
 
 
+def test_serve_replica_id_suffixes_artifacts(tmp_path):
+    """serve_gpt.py --replica-id: N replica processes can share one
+    sink dir — metrics land in metrics_<id>.jsonl/.prom and the
+    replica id is folded into the run id (trace file names derive from
+    it), so a fleet's artifacts never clobber each other."""
+    import json
+
+    md, td = tmp_path / "smetrics", tmp_path / "straces"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples/gpt/serve_gpt.py"),
+         "--smoke", "--metrics-dir", str(md), "--trace-dir", str(td),
+         "--replica-id", "r0"],
+        capture_output=True, text=True, timeout=600, env=_env(),
+    )
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert (md / "metrics_r0.prom").exists()
+    assert (md / "metrics_r0.jsonl").exists()
+    assert not (md / "metrics.prom").exists(), \
+        "--replica-id must suffix, not also write the shared name"
+    assert "serve_r0" in rec["trace_file"]
+    recs = [json.loads(l)
+            for l in (md / "metrics_r0.jsonl").read_text().splitlines()]
+    assert all(r_["run_id"] == "serve_r0" for r_ in recs)
+
+
 def test_supervised_gauntlet_one_invocation_survives_all(tmp_path):
     """The ISSUE 11 acceptance run: ONE `pretrain_gpt.py --supervise
     --zero --auto-resume` invocation survives the scripted fault

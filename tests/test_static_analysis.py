@@ -52,7 +52,7 @@ from apex_tpu.analysis.rules_host_sync import (
 from apex_tpu.analysis.rules_inference import KvPoolScatterBypassesSeam
 from apex_tpu.analysis.rules_io import NonAtomicCheckpointWrite
 from apex_tpu.analysis.rules_resilience import (
-    SwallowedExceptionInRecoveryPath,
+    RetryWithoutBackoff, SwallowedExceptionInRecoveryPath,
 )
 from apex_tpu.analysis.rules_precision import (
     KvCacheReadDtypeMismatch,
@@ -629,6 +629,127 @@ class TestSwallowedExceptionInRecoveryPath:
             """
         for subdir in ("examples/gpt", "ops", "observability"):
             assert self._run_scoped(src, tmp_path, subdir) == []
+
+
+# ------------------------------------------ APX113 retry without backoff
+class TestRetryWithoutBackoff:
+    """The busy-spin retry: `while True:` swallowing the failure and
+    immediately re-attempting hammers the failing dependency exactly
+    when it needs room to recover."""
+
+    def _run_scoped(self, src, tmp_path, subdir):
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "fixture.py"
+        p.write_text(textwrap.dedent(src))
+        return analyze_file(str(p), [RetryWithoutBackoff()], set(AXES))
+
+    def test_positive_hot_retry_in_resilience(self, tmp_path):
+        got = self._run_scoped("""
+            def reconnect(coordinator, log):
+                while True:
+                    try:
+                        return coordinator.connect()
+                    except OSError as e:
+                        log.warning("retrying: %s", e)
+            """, tmp_path, "resilience")
+        assert rule_ids(got) == ["APX113"]
+        assert "busy-spin" in got[0].message
+        assert "retry_after_s" in got[0].fix_hint
+
+    def test_positive_while_one_in_inference(self, tmp_path):
+        """`while 1:` is the same loop; logging between attempts is
+        reporting, not pacing."""
+        got = self._run_scoped("""
+            def resubmit(frontend, request):
+                while 1:
+                    try:
+                        frontend.submit(request)
+                        break
+                    except Overloaded:
+                        continue
+            """, tmp_path, "inference")
+        assert rule_ids(got) == ["APX113"]
+
+    def test_negative_sleep_between_attempts(self, tmp_path):
+        got = self._run_scoped("""
+            import time
+
+            def reconnect(coordinator):
+                while True:
+                    try:
+                        return coordinator.connect()
+                    except OSError:
+                        time.sleep(0.5)
+            """, tmp_path, "resilience")
+        assert got == []
+
+    def test_negative_backoff_helper_and_timeout_wait(self, tmp_path):
+        """The supervisor shape: a crash-loop `_backoff_s` helper or a
+        `child.wait(timeout=...)` both pace the loop."""
+        got = self._run_scoped("""
+            def supervise(child, attempt):
+                while True:
+                    try:
+                        child.wait(timeout=0.2)
+                        return child.returncode
+                    except TimeoutError:
+                        attempt += 1
+            """, tmp_path, "resilience")
+        assert got == []
+
+    def test_negative_handler_escapes_loop(self, tmp_path):
+        """A handler that re-raises / breaks / returns is not a retry
+        loop — it gives up instead of spinning."""
+        got = self._run_scoped("""
+            def drain(sched):
+                while True:
+                    try:
+                        sched.step()
+                    except RuntimeError:
+                        raise
+                while True:
+                    try:
+                        sched.step()
+                    except RuntimeError:
+                        break
+            """, tmp_path, "io")
+        assert got == []
+
+    def test_negative_blocking_dequeue_worker(self, tmp_path):
+        """The async-checkpoint worker: the loop parks on a no-arg
+        `q.get()` each iteration — not a busy-spin over the failure."""
+        got = self._run_scoped("""
+            def worker(q, errors):
+                while True:
+                    try:
+                        q.get()()
+                    except OSError as e:
+                        errors.append(e)
+            """, tmp_path, "io")
+        assert got == []
+
+    def test_negative_out_of_scope_and_bounded_for(self, tmp_path):
+        """Outside resilience/io/inference the loop is not this rule's
+        business, and a bounded `for` retry is self-limiting."""
+        src = """
+            def reconnect(coordinator):
+                while True:
+                    try:
+                        return coordinator.connect()
+                    except OSError:
+                        pass
+            """
+        assert self._run_scoped(src, tmp_path, "examples/gpt") == []
+        got = self._run_scoped("""
+            def reconnect(coordinator):
+                for _ in range(3):
+                    try:
+                        return coordinator.connect()
+                    except OSError:
+                        pass
+            """, tmp_path, "resilience")
+        assert got == []
 
 
 # ------------------------------------------- APX201 unknown collective axis
