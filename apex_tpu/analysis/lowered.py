@@ -43,6 +43,7 @@ __all__ = [
     "assert_collective_axes", "assert_collective_dtype",
     "assert_no_host_transfer", "assert_no_recompile",
     "assert_no_whole_tree_concat", "assert_same_collective_schedule",
+    "assert_interleaved", "interleave_gaps",
     "assert_donation_covers", "donated_buffer_count",
     "host_transfer_sites",
     "arg_shardings", "sharding_of", "assert_sharding",
@@ -363,6 +364,156 @@ def assert_collective_axes(artifact, kind: str, axes, mesh, *,
             f"{kind} over axes {axes} must run in {dtype}, found "
             f"{bad} — a hop is not on its wire dtype")
     return n
+
+
+#: the matmul spellings between which interleaving is measured: the
+#: StableHLO/MHLO dotted op and the compiled-HLO ``dot(`` instruction.
+_DOT_PATTERNS = (
+    r'"?(?:stablehlo|mhlo)\.dot_general\b',
+    r'=\s*\(?[a-zA-Z0-9]+\[[0-9,]*\][^=\n]*?\sdot\(',
+)
+
+
+def _dot_events(txt: str) -> List[tuple]:
+    """``(position, weight)`` events for every matmul REACHABLE at a
+    program point, in text order.  Inline ``dot_general`` ops weigh 1
+    at their own position; a ``call @fn`` site weighs the TRANSITIVE
+    dot count of its callee at the call's position — jax outlines
+    ``lax.scan`` bodies (and remat blocks) into private functions, so
+    the backward scan's matmuls are textually out-of-line and only
+    reachable through the ``stablehlo.while`` region's call sites."""
+    raw = sorted(p for pat in _DOT_PATTERNS
+                 for p in (m.start() for m in re.finditer(pat, txt)))
+    starts = [(m.start(), m.group(1)) for m in re.finditer(
+        r'func\.func[^\n]*?@([\w.$-]+)\(', txt)]
+    spans = {}
+    for i, (pos, name) in enumerate(starts):
+        end = starts[i + 1][0] if i + 1 < len(starts) else len(txt)
+        spans[name] = (pos, end)
+    calls = [(m.start(), m.group(1)) for m in re.finditer(
+        r'\bcall\s+@([\w.$-]+)\(', txt)]
+    memo = {}
+
+    def total(fn, trail):
+        if fn in memo:
+            return memo[fn]
+        if fn not in spans or fn in trail:
+            return 0
+        lo, hi = spans[fn]
+        n = sum(1 for p in raw if lo <= p < hi)
+        n += sum(total(callee, trail | {fn}) for cp, callee in calls
+                 if lo <= cp < hi)
+        memo[fn] = n
+        return n
+
+    events = [(p, 1) for p in raw]
+    events += [(cp, total(callee, frozenset()))
+               for cp, callee in calls if total(callee, frozenset())]
+    events.sort()
+    return events
+
+
+def interleave_gaps(artifact, kind: str = "reduce_scatter", *,
+                    axes=None, mesh=None,
+                    dtype: Optional[str] = None) -> List[int]:
+    """How many ``dot_general`` ops sit STRICTLY BETWEEN each pair of
+    consecutive ``kind`` collectives, in program order: a list of
+    ``n_sites - 1`` counts.  ``axes=`` (with ``mesh=``) and ``dtype=``
+    narrow the collectives to one hop / one wire dtype, exactly as in
+    :func:`count_collectives` / :func:`assert_collective_axes` — the
+    dots counted between them are ALL dots, unfiltered, because any
+    matmul between two syncs is compute the scheduler can overlap.
+
+    This is the lowering-level evidence for backward-overlapped grad
+    sync: an unoverlapped step traces every bucket's collective after
+    the whole backward (all gaps 0), an overlapped one issues bucket
+    k's sync before bucket k+1's backward dots (some gap > 0)."""
+    txt = hlo_text(artifact)
+    want = None
+    if axes is not None:
+        if mesh is None:
+            raise ValueError("axes= filtering needs mesh= (the groups "
+                             "are computed from the mesh layout)")
+        want = _groups_key(mesh_axis_groups(mesh, axes))
+    sites = []
+    # StableHLO/MHLO dotted spelling (jit/shard_map lowerings)
+    for m in re.finditer(
+            r'"?(?:stablehlo|mhlo)\.' + re.escape(kind) + r'\b', txt):
+        window = txt[m.start():m.start() + _ATTR_WINDOW]
+        if want is not None and \
+                _groups_key(_parse_replica_groups(window)) != want:
+            continue
+        if dtype is not None:
+            if kind in _REGION_OPS:
+                tm = re.search(r'\}\)\s*:\s*\(tensor<([0-9a-zA-Z_x]*)>',
+                               window, re.S)
+            else:
+                tm = re.search(r':\s*\(tensor<([0-9a-zA-Z_x]*)>', window)
+            if tm is None or tm.group(1).split("x")[-1] != dtype:
+                continue
+        sites.append(m.start())
+    # compiled-HLO dashed spelling (post-SPMD-partitioning modules)
+    dashed = kind.replace("_", "-")
+    for m in re.finditer(
+            r'=\s*\(?([a-zA-Z0-9]+)\[[0-9,]*\][^=\n]*?\s'
+            + re.escape(dashed) + r'(?:-start)?\(', txt):
+        if dtype is not None and m.group(1) != dtype:
+            continue
+        if want is not None:
+            line_end = txt.find("\n", m.end())
+            window = txt[m.end():
+                         line_end if line_end != -1 else len(txt)]
+            gm = re.search(
+                r'replica_groups=(\{\{[^}]*(?:\},\{[^}]*)*\}\}|'
+                r'\[[^\]]+\]<=\[[^\]]+\](?:T\([\d,]+\))?)', window)
+            groups = _parse_hlo_groups(gm.group(1)) if gm else None
+            if _groups_key(groups) != want:
+                continue
+        sites.append(m.start())
+    sites.sort()
+    if len(sites) < 2:
+        raise ValueError(
+            f"interleaving needs at least two {kind} collectives in "
+            f"the lowering to have a between, found {len(sites)} "
+            f"(after axes/dtype filtering)")
+    events = _dot_events(txt)
+    gaps = []
+    for lo, hi in zip(sites, sites[1:]):
+        gaps.append(sum(w for p, w in events if lo < p < hi))
+    return gaps
+
+
+def assert_interleaved(artifact, kind: str = "reduce_scatter", *,
+                       axes=None, mesh=None, dtype: Optional[str] = None,
+                       min_between: int = 1,
+                       gaps: str = "any") -> List[int]:
+    """Pin the compute/communication interleaving shape of a lowering.
+
+    ``gaps="any"`` (the overlapped shape): assert at least one pair of
+    consecutive ``kind`` collectives has >= ``min_between``
+    ``dot_general`` ops between them — backward matmuls run between
+    bucket syncs, so the latency-hiding scheduler CAN overlap them.
+    ``gaps="none"`` (the unoverlapped shape): assert every consecutive
+    pair has ZERO dots between — all collectives trace after the whole
+    backward.  ``gaps="all"`` is deliberately absent: buckets that
+    become ready at the same backward stage legitimately sync
+    back-to-back.  Returns the gap list from :func:`interleave_gaps`."""
+    counts = interleave_gaps(artifact, kind, axes=axes, mesh=mesh,
+                             dtype=dtype)
+    if gaps == "any":
+        assert any(c >= min_between for c in counts), (
+            f"no pair of consecutive {kind} collectives has >= "
+            f"{min_between} dot_general between them (gaps={counts}) — "
+            f"every sync traces after the whole backward, so the "
+            f"scheduler has no compute to hide the collectives behind")
+    elif gaps == "none":
+        assert all(c == 0 for c in counts), (
+            f"found dot_general ops between consecutive {kind} "
+            f"collectives (gaps={counts}) — the unoverlapped step "
+            f"should trace every bucket sync after the whole backward")
+    else:
+        raise ValueError(f'gaps must be "any" or "none", got {gaps!r}')
+    return counts
 
 
 def operand_dtypes(artifact, kind: str) -> List[str]:

@@ -1,63 +1,66 @@
 """Topology-aware hierarchical gradient sync: multi-hop reduce-scatter
-over a ``(fast, slow)`` data-parallel axis split.
+over a ``(slow, ..., fast)`` data-parallel axis split.
 
 Ground paper: "DynamiQ: Accelerating Gradient Synchronization using
 Compressed Multi-hop All-reduce" (PAPERS.md, arXiv 2602.08923) — at pod
 scale the dp world spans interconnects with very different bandwidth
 (ICI within a slice, DCN across slices), and a flat collective pays the
 slow hop at the FULL payload.  The multi-hop form reduces intra-slice
-first on the fast axis, so the cross-slice hop only ever carries the
-already-scattered ``1/dp_inner`` chunk — and, on a compressed wire,
-stays at the compressed dtype by requantizing the partial sums with
-fresh shared scales and feeding the requantization error back into the
-resident error-feedback residual channel (PR 6's machinery, reused).
+first on the fast axis, so each slower hop only ever carries the
+already-scattered chunk — and, on a compressed wire, stays at the
+compressed dtype by requantizing the partial sums with fresh shared
+scales and feeding the requantization error back into the resident
+error-feedback residual channel (PR 6's machinery, reused).
 
 The topology contract a :class:`HierarchicalSyncPlan` describes:
 
-- ``(outer_axis, inner_axis)``: the dp world is the mesh product
-  ``dp_outer x dp_inner``, ``inner`` fast (intra-slice), ``outer`` slow
-  (cross-slice).  Both grad-sync hops run at the same wire dtype (the
-  compressed dtype never widens on the slow hop — that is the point);
-  the per-hop dtypes are recorded on the plan for the wire accounting.
-- **shard ownership is unchanged vs the flat plan**: the two-hop
-  scatter (inner tile ``i``, then outer sub-tile ``o``) lands flat
-  chunk ``r = i * dp_outer + o`` on mesh rank ``(o, i)``, which is
-  exactly the resident shard ``P((..., inner_axis, outer_axis))``
-  assigns that rank.  Bucket totals use the ONE
-  :func:`~apex_tpu.optimizers.bucketing.padded_total` formula with
-  ``shard_pad = dp_outer * dp_inner``, so elastic checkpoints reshard
-  across flat <-> hierarchical worlds with no special case.
+- ``hop_axes``: the dp world is the mesh product of the named axes,
+  ordered SLOW to FAST — the two-level ``(dp_out, dp_in)`` split of
+  PR 12, or the seeded three-level ``(dcn, dp_out, dp_in)`` topology
+  where DCN crosses pods, ``dp_out`` crosses slices, and ``dp_in`` is
+  intra-slice ICI.  Every grad-sync hop runs at the same wire dtype
+  (the compressed dtype never widens on a slow hop — that is the
+  point); the per-hop dtypes are recorded on the plan for the wire
+  accounting.
+- **shard ownership is unchanged vs the flat plan**: the multi-hop
+  scatter (fastest axis first on the full bucket, each slower axis on
+  the shrinking chunk) lands flat chunk
+  ``r = (... (i_fast * s_next + i_next) ...) * s_slow + i_slow`` on the
+  mesh rank with those indices, which is exactly the resident shard
+  ``P((..., fast, ..., slow))`` assigns that rank.  Bucket totals use
+  the ONE :func:`~apex_tpu.optimizers.bucketing.padded_total` formula
+  with ``shard_pad = prod(sizes)``, so elastic checkpoints reshard
+  across flat <-> two-level <-> three-level worlds with no special
+  case.
 - **param sync mirrors in reverse**: all-gather the updated shard over
-  ``outer`` first (the slice-shared shard — cross-slice traffic is
-  ``1/dp_inner`` of the bucket), then over ``inner``.
+  the SLOWEST axis first (cross-pod traffic is the smallest chunk),
+  finishing on the fast axis.
 
-Quantized wire (int8/fp8), per bucket:
+Quantized wire (int8/fp8), per bucket and per hop ``j`` (fast first):
 
-1. hop 1 (fast): shared per-block scales from an amax psum over
-   ``inner`` ONLY, quantize ``h = g/scale + residual``, reduce-scatter
-   the int8/fp8 payload over ``inner``; the hop-1 quantization error
-   ``h - deq(q1)`` covers the full local bucket.
-2. hop 2 (slow): dequantize the received chunk into fp32 partial sums,
-   REQUANTIZE with fresh per-block shared scales (amax psum over
-   ``outer`` ONLY), reduce-scatter over ``outer`` still at the wire
-   dtype; the requantization error ``p - deq(q2)`` covers this rank's
-   ``1/dp_inner`` chunk and is FOLDED into the same residual at the
-   chunk's positions.
+1. shared per-block scales from an amax psum over THIS hop's axis only,
+   quantize the current fp32 partial (``h = g/scale + residual`` on the
+   first hop), reduce-scatter the int8/fp8 payload over the axis;
+2. the hop's quantization error ``cur - deq(q_j)`` covers the current
+   chunk and is FOLDED into the residual at that chunk's positions;
+   dequantize the received shard into fp32 partial sums for the next
+   (slower) hop, which REQUANTIZES with fresh shared scales.
 
-The telescoping identity is preserved exactly: with every rank's new
-residual ``res1 + scatter(res2)``, the transmitted total per step is
-``sum_r h_r - sum_r residual_r`` — what PR 6's error feedback needs —
-so the crafted dyadic-scale test pins the two-hop chain bitwise
-(``tests/test_distributed_optimizers.py``).
+The telescoping identity is preserved exactly at every depth: with each
+rank's new residual carrying every hop's folded error, the transmitted
+total per step is ``sum_r h_r - sum_r residual_r`` — what PR 6's error
+feedback needs — so the crafted dyadic-scale test pins the multi-hop
+chain bitwise (``tests/test_distributed_optimizers.py``).
 
-When two hops LOSE: a second hop adds a second (small) scale psum and a
-second quantization, so for tiny buckets — where the fp32 scale vector
+When extra hops LOSE: each hop adds a (small) scale psum and a fresh
+quantization, so for tiny buckets — where the fp32 scale vector
 (~``4/QBLOCK`` of the payload) and the per-hop latency dominate — or
-for meshes whose interconnect is flat (``dp_inner = 1``), the flat plan
-is the better choice.  The win scales with ``dp_inner``: cross-slice
-bytes drop by exactly ``1/dp_inner`` (scales included — the per-hop
-accounting in :func:`~apex_tpu.contrib.optimizers._quantized_sync
-.grad_sync_bytes` is exact, not a payload approximation).
+for meshes whose interconnect is flat (fast size 1), the flat plan is
+the better choice.  The win scales with the fast sizes: cross-slice
+bytes drop by exactly ``1/dp_in`` and cross-DCN bytes by
+``1/(dp_in * dp_out)`` (scales included — the per-hop accounting in
+:func:`~apex_tpu.contrib.optimizers._quantized_sync.grad_sync_bytes`
+is exact, not a payload approximation).
 """
 
 import dataclasses
@@ -70,6 +73,9 @@ from apex_tpu.contrib.optimizers import _quantized_sync as qs
 
 __all__ = [
     "HierarchicalSyncPlan", "hierarchical_plan",
+    "multi_hop_reduce_scatter", "multi_hop_all_gather",
+    "quantized_multi_hop_reduce_scatter", "quantized_multi_hop_pmean",
+    "quantized_multi_hop_pmean_bucket",
     "two_hop_reduce_scatter", "two_hop_all_gather",
     "quantized_two_hop_reduce_scatter", "quantized_two_hop_pmean",
 ]
@@ -77,188 +83,225 @@ __all__ = [
 
 @dataclasses.dataclass(frozen=True)
 class HierarchicalSyncPlan:
-    """The ``(outer, inner)`` dp split one ZeRO optimizer syncs over.
+    """The ``(slow, ..., fast)`` dp split one ZeRO optimizer syncs over.
 
-    ``outer_axis`` is the SLOW hop (cross-slice, e.g. DCN), ``inner_axis``
-    the FAST hop (intra-slice ICI); sizes are the mesh extents the plan
-    was built for (the traced step re-reads them from the live mesh via
-    ``lax.axis_size`` — a mismatch fails the state-shard check exactly
-    like a flat world mismatch).  ``grad_wire_dtype``/``param_wire_dtype``
-    record the per-hop wire dtypes for the accounting: both grad hops
-    carry the SAME dtype (a compressed wire stays compressed on the slow
-    hop), ``None`` means the per-bucket storage default."""
+    ``hop_axes`` orders the mesh axes SLOW to FAST — two-level
+    ``(dp_out, dp_in)`` (outer cross-slice DCN, inner intra-slice ICI)
+    or three-level ``(dcn, dp_out, dp_in)``; ``hop_sizes`` are the mesh
+    extents the plan was built for (the traced step re-reads them from
+    the live mesh via ``lax.axis_size`` — a mismatch fails the
+    state-shard check exactly like a flat world mismatch).
+    ``grad_wire_dtype``/``param_wire_dtype`` record the per-hop wire
+    dtypes for the accounting: every grad hop carries the SAME dtype (a
+    compressed wire stays compressed on the slow hops), ``None`` means
+    the per-bucket storage default."""
 
-    outer_axis: str
-    inner_axis: str
-    outer_size: int
-    inner_size: int
+    hop_axes: Tuple[str, ...]
+    hop_sizes: Tuple[int, ...]
     grad_wire_dtype: Optional[str] = None
     param_wire_dtype: Optional[str] = None
 
     def __post_init__(self):
-        if self.outer_axis == self.inner_axis:
+        axes, sizes = tuple(self.hop_axes), tuple(self.hop_sizes)
+        object.__setattr__(self, "hop_axes", axes)
+        object.__setattr__(self, "hop_sizes", sizes)
+        if len(set(axes)) != len(axes):
             raise ValueError(
-                f"hierarchical dp axes must be two DISTINCT mesh axes, got "
-                f"({self.outer_axis!r}, {self.inner_axis!r})")
-        if self.outer_size < 1 or self.inner_size < 1:
+                f"hierarchical dp axes must be DISTINCT mesh axes, got "
+                f"{axes!r}")
+        if not 2 <= len(axes) <= 3 or len(sizes) != len(axes):
             raise ValueError(
-                f"axis sizes must be >= 1, got outer={self.outer_size}, "
-                f"inner={self.inner_size}")
+                f"hierarchical dp takes two or three (axis, size) hops, "
+                f"got axes={axes!r} sizes={sizes!r}")
+        if any(s < 1 for s in sizes):
+            raise ValueError(f"axis sizes must be >= 1, got {sizes!r}")
+
+    # ------------------------------------------- two-level spellings
+    @property
+    def outer_axis(self) -> str:
+        """The SLOWEST hop's axis (the two-level ``dp_out``)."""
+        return self.hop_axes[0]
 
     @property
-    def axes(self) -> Tuple[str, str]:
-        """``(outer, inner)`` — the step builder's dp_axis spelling."""
-        return (self.outer_axis, self.inner_axis)
+    def inner_axis(self) -> str:
+        """The FASTEST hop's axis (the two-level ``dp_in``)."""
+        return self.hop_axes[-1]
+
+    @property
+    def outer_size(self) -> int:
+        return self.hop_sizes[0]
+
+    @property
+    def inner_size(self) -> int:
+        return self.hop_sizes[-1]
+
+    @property
+    def axes(self) -> Tuple[str, ...]:
+        """``(slow, ..., fast)`` — the step builder's dp_axis spelling."""
+        return self.hop_axes
 
     @property
     def world(self) -> int:
-        return self.outer_size * self.inner_size
+        w = 1
+        for s in self.hop_sizes:
+            w *= s
+        return w
 
     @property
-    def shard_axes(self) -> Tuple[str, str]:
-        """PartitionSpec order for the resident 1/dp shards: inner-major
-        ``(inner, outer)`` places flat chunk ``i * dp_outer + o`` on mesh
-        rank ``(o, i)`` — the chunk the two-hop scatter delivers there."""
-        return (self.inner_axis, self.outer_axis)
+    def shard_axes(self) -> Tuple[str, ...]:
+        """PartitionSpec order for the resident 1/dp shards: fast-major
+        ``(fast, ..., slow)`` places the flat chunk the multi-hop
+        scatter delivers on exactly the rank that owns it (two-level:
+        chunk ``i * dp_outer + o`` on mesh rank ``(o, i)``)."""
+        return tuple(reversed(self.hop_axes))
 
     def zero_rank(self):
         """This rank's FLAT dp rank (traced): the index of the bucket
-        chunk the two-hop scatter lands here.  Matches the flat plan's
-        chunk-per-rank layout, so checkpoints reshard flat <->
+        chunk the multi-hop scatter lands here.  Matches the flat
+        plan's chunk-per-rank layout, so checkpoints reshard flat <->
         hierarchical through the one ``padded_total`` formula."""
-        i = jax.lax.axis_index(self.inner_axis)
-        o = jax.lax.axis_index(self.outer_axis)
-        return i * jax.lax.axis_size(self.outer_axis) + o
+        rank = None
+        for ax in reversed(self.hop_axes):  # fast -> slow
+            idx = jax.lax.axis_index(ax)
+            if rank is None:
+                rank = idx
+            else:
+                rank = rank * jax.lax.axis_size(ax) + idx
+        return rank
 
-    def traced_sizes(self) -> Tuple[int, int]:
-        """``(outer, inner)`` extents of the LIVE mesh (static ints at
-        trace time inside shard_map)."""
-        return (jax.lax.axis_size(self.outer_axis),
-                jax.lax.axis_size(self.inner_axis))
+    def traced_sizes(self) -> Tuple[int, ...]:
+        """Hop-ordered ``(slow, ..., fast)`` extents of the LIVE mesh
+        (static ints at trace time inside shard_map)."""
+        return tuple(jax.lax.axis_size(ax) for ax in self.hop_axes)
 
 
 def hierarchical_plan(dp_axes, axis_sizes, grad_wire_dtype=None,
                       param_wire_dtype=None) -> HierarchicalSyncPlan:
-    """Build the plan from the optimizer's ``dp_axes=(outer, inner)``
+    """Build the plan from the optimizer's ``dp_axes=(slow, ..., fast)``
     knob plus the ``axis_sizes`` mapping ``init`` already takes."""
     axes = tuple(dp_axes)
-    if len(axes) != 2 or not all(isinstance(a, str) for a in axes):
+    if not (2 <= len(axes) <= 3) or \
+            not all(isinstance(a, str) for a in axes):
         raise ValueError(
-            f"dp_axes must be two mesh axis names (outer, inner), got "
+            f"dp_axes must be two or three mesh axis names ordered slow "
+            f"to fast — (outer, inner) or (dcn, dp_out, dp_in) — got "
             f"{dp_axes!r}")
     missing = [a for a in axes if a not in (axis_sizes or {})]
     if missing:
         raise ValueError(
-            f"hierarchical dp needs axis_sizes for both dp axes; missing "
-            f"{missing} (pass axis_sizes={{{axes[0]!r}: outer, "
-            f"{axes[1]!r}: inner, ...}} to init)")
+            f"hierarchical dp needs axis_sizes for every dp axis; missing "
+            f"{missing} (pass axis_sizes={{axis: size, ...}} covering "
+            f"{axes!r} to init)")
     def _name(dt):
         return None if dt is None else jnp.dtype(dt).name
     return HierarchicalSyncPlan(
-        outer_axis=axes[0], inner_axis=axes[1],
-        outer_size=int(axis_sizes[axes[0]]),
-        inner_size=int(axis_sizes[axes[1]]),
+        hop_axes=axes,
+        hop_sizes=tuple(int(axis_sizes[a]) for a in axes),
         grad_wire_dtype=_name(grad_wire_dtype),
         param_wire_dtype=_name(param_wire_dtype))
 
 
 # ----------------------------------------------------------- wide wire
-def two_hop_reduce_scatter(bucket, plan: HierarchicalSyncPlan):
-    """The unquantized two-hop grad sync of one bucket (already in the
-    wire dtype, fp16 predivide folded by the caller): reduce-scatter
-    intra-slice on the fast axis, then cross-slice on the slow axis —
-    the slow hop moves ``1/dp_inner`` of the bucket.  Returns this
-    rank's flat 1/dp chunk of the dp-wide SUM."""
-    a = jax.lax.psum_scatter(bucket, plan.inner_axis, scatter_dimension=0,
-                             tiled=True)
-    return jax.lax.psum_scatter(a, plan.outer_axis, scatter_dimension=0,
-                                tiled=True)
+def multi_hop_reduce_scatter(bucket, plan: HierarchicalSyncPlan):
+    """The unquantized multi-hop grad sync of one bucket (already in
+    the wire dtype, fp16 predivide folded by the caller): reduce-scatter
+    intra-slice on the fast axis first, then each slower axis on the
+    shrinking chunk — the slowest hop moves ``1/prod(faster sizes)`` of
+    the bucket.  Returns this rank's flat 1/dp chunk of the dp-wide
+    SUM."""
+    for ax in reversed(plan.hop_axes):  # fast -> slow
+        bucket = jax.lax.psum_scatter(bucket, ax, scatter_dimension=0,
+                                      tiled=True)
+    return bucket
 
 
-def two_hop_all_gather(shard, plan: HierarchicalSyncPlan):
-    """The mirrored param sync: gather the updated shard over the SLOW
-    axis first (the slice-shared shard — cross-slice traffic is the
-    ``1/dp_inner`` chunk), then over the fast axis.  Inverts the
-    two-hop scatter's chunk order exactly, so the bucket reassembles in
-    flat layout."""
-    chunk = jax.lax.all_gather(shard, plan.outer_axis, axis=0, tiled=True)
-    return jax.lax.all_gather(chunk, plan.inner_axis, axis=0, tiled=True)
+def multi_hop_all_gather(shard, plan: HierarchicalSyncPlan):
+    """The mirrored param sync: gather the updated shard over the
+    SLOWEST axis first (the pod-shared shard — cross-pod traffic is the
+    smallest chunk), finishing on the fast axis.  Inverts the multi-hop
+    scatter's chunk order exactly, so the bucket reassembles in flat
+    layout."""
+    for ax in plan.hop_axes:  # slow -> fast
+        shard = jax.lax.all_gather(shard, ax, axis=0, tiled=True)
+    return shard
 
 
 # ------------------------------------------------------ quantized wire
 def _check_hier_blocks(n: int, plan: HierarchicalSyncPlan,
                        block: int) -> None:
-    if n % (block * plan.inner_size) or \
-            (n // plan.inner_size) % (block * max(plan.outer_size, 1)):
-        raise ValueError(
-            f"bucket of {n} elements does not split into {block}-element "
-            f"scale blocks per ({plan.outer_size}, {plan.inner_size}) "
-            "hierarchical shard — bucket totals must be padded with "
-            "bucketing.padded_total(shard_pad=dp_outer*dp_inner)")
+    length = n
+    for size in reversed(plan.hop_sizes):  # fast -> slow
+        if length % (block * size):
+            raise ValueError(
+                f"bucket of {n} elements does not split into "
+                f"{block}-element scale blocks per {plan.hop_sizes} "
+                "hierarchical shard — bucket totals must be padded with "
+                "bucketing.padded_total(shard_pad=prod(dp sizes))")
+        length //= size
 
 
-def quantized_two_hop_reduce_scatter(h, plan: HierarchicalSyncPlan,
-                                     spec: qs.QSpec, block: int = qs.QBLOCK):
-    """The compressed two-hop grad sync of one bucket: returns
+def quantized_multi_hop_reduce_scatter(h, plan: HierarchicalSyncPlan,
+                                       spec: qs.QSpec,
+                                       block: int = qs.QBLOCK):
+    """The compressed multi-hop grad sync of one bucket: returns
     ``(sum_shard_f32, residual_f32)`` where ``sum_shard_f32`` is this
-    rank's flat 1/dp chunk of the dp-SUM (to the wire precision of BOTH
-    hops) and ``residual_f32`` is the full-local-bucket error to carry:
-    the hop-1 quantization error everywhere, PLUS the hop-2
-    requantization error folded in at this rank's ``1/dp_inner`` chunk.
+    rank's flat 1/dp chunk of the dp-SUM (to the wire precision of
+    EVERY hop) and ``residual_f32`` is the full-local-bucket error to
+    carry: the hop-1 quantization error everywhere, PLUS each slower
+    hop's REQUANTIZATION error folded in at this rank's shrinking chunk
+    positions.
 
     Summed over ranks the new residuals satisfy
     ``sum_r transmitted = sum_r h_r - sum_r residual_r`` exactly — the
-    same telescoping identity as the flat wire, so the resident
-    error-feedback channel needs no layout change."""
-    outer_sz, inner_sz = plan.traced_sizes()
+    same telescoping identity as the flat wire at any hop depth, so the
+    resident error-feedback channel needs no layout change."""
+    sizes = plan.traced_sizes()  # slow -> fast
     n = h.shape[0]
     _check_hier_blocks(n, plan, block)
 
-    # hop 1 (fast, intra-slice): shared scales from the INNER amax psum
-    s1, b1 = qs.block_scales(h, plan.inner_axis, spec, block)
-    q1 = qs.quantize(h, s1, b1, spec, block)
-    res1 = h - qs.dequantize(q1, s1, block)
-    q1_shard = jax.lax.psum_scatter(q1, plan.inner_axis,
-                                    scatter_dimension=0, tiled=True)
-    i = jax.lax.axis_index(plan.inner_axis)
-    chunk = n // inner_sz
-    nb1 = chunk // block
-    s1_shard = jax.lax.dynamic_slice_in_dim(s1, i * nb1, nb1)
-    # fp32 partial sums of this slice: chunk i of sum_{inner} h
-    p = qs.dequantize(q1_shard, s1_shard, block)
-
-    # hop 2 (slow, cross-slice): REQUANTIZE the partial sums with fresh
-    # shared scales from the OUTER amax psum only, keep the wire dtype
-    s2, b2 = qs.block_scales(p, plan.outer_axis, spec, block)
-    q2 = qs.quantize(p, s2, b2, spec, block)
-    res2 = p - qs.dequantize(q2, s2, block)
-    q2_shard = jax.lax.psum_scatter(q2, plan.outer_axis,
-                                    scatter_dimension=0, tiled=True)
-    o = jax.lax.axis_index(plan.outer_axis)
-    sub = chunk // outer_sz
-    nb2 = sub // block
-    s2_shard = jax.lax.dynamic_slice_in_dim(s2, o * nb2, nb2)
-    g_shard = qs.dequantize(q2_shard, s2_shard, block)
-
-    # fold the requantization error into the residual at this rank's
-    # chunk positions: sum_r residual_r = sum res1 + sum res2, exactly
-    # the error the next step's feedback must replay
-    r1_chunk = jax.lax.dynamic_slice_in_dim(res1, i * chunk, chunk)
-    residual = jax.lax.dynamic_update_slice_in_dim(
-        res1, r1_chunk + res2, i * chunk, 0)
-    return g_shard, residual
+    cur = h            # this hop's fp32 input (partial sums after hop 1)
+    length = n         # its static length
+    off = 0            # this rank's chunk offset within the local bucket
+    residual = None
+    for depth, ax in enumerate(reversed(plan.hop_axes)):  # fast -> slow
+        # shared scales from THIS axis's amax psum only; each slower hop
+        # requantizes the partial sums with fresh scales, keeping the
+        # wire dtype end to end
+        s_j, b_j = qs.block_scales(cur, ax, spec, block)
+        q_j = qs.quantize(cur, s_j, b_j, spec, block)
+        r_j = cur - qs.dequantize(q_j, s_j, block)
+        if residual is None:
+            residual = r_j
+        else:
+            # fold the requantization error into the residual at this
+            # rank's current chunk positions: sum_r residual_r picks up
+            # every hop's error exactly once — the telescoping identity
+            prev = jax.lax.dynamic_slice_in_dim(residual, off, length)
+            residual = jax.lax.dynamic_update_slice_in_dim(
+                residual, prev + r_j, off, 0)
+        q_shard = jax.lax.psum_scatter(q_j, ax, scatter_dimension=0,
+                                       tiled=True)
+        idx = jax.lax.axis_index(ax)
+        length //= sizes[len(sizes) - 1 - depth]
+        nb = length // block
+        s_shard = jax.lax.dynamic_slice_in_dim(s_j, idx * nb, nb)
+        # fp32 partial sums of this chunk: input to the next hop (or the
+        # final dp-sum shard on the last hop)
+        cur = qs.dequantize(q_shard, s_shard, block)
+        off = off + idx * length
+    return cur, residual
 
 
-def quantized_two_hop_pmean(grads, plan: HierarchicalSyncPlan,
-                            spec: qs.QSpec, block: int = qs.QBLOCK):
+def quantized_multi_hop_pmean(grads, plan: HierarchicalSyncPlan,
+                              spec: qs.QSpec, block: int = qs.QBLOCK):
     """Hierarchical quantized gradient all-reduce for the REPLICATED
     data-parallel path (the ``make_train_step(grad_sync_dtype=...)``
-    knob over a ``(dp_out, dp_in)`` mesh): the two-hop reduce-scatter
+    knob over a multi-axis dp mesh): the multi-hop reduce-scatter
     above, then the MIRRORED gathers — every payload hop at the wire
     dtype (the gathered partial sums are bounded by ``qmax`` per hop),
-    plus the small fp32 hop-2 scale gather the dequantize needs (hop-2
-    scales are chunk-local: shared over ``outer``, distinct per
-    ``inner`` rank).
+    plus the small fp32 last-hop scale gather the dequantize needs
+    (last-hop scales are chunk-local: shared over the slowest axis,
+    distinct per faster-axis rank).
 
     Stateless like :func:`~apex_tpu.contrib.optimizers._quantized_sync
     .quantized_pmean`: no optimizer-state channel means no
@@ -266,36 +309,60 @@ def quantized_two_hop_pmean(grads, plan: HierarchicalSyncPlan,
     hierarchical path WITH feedback."""
     from apex_tpu.optimizers import bucketing
 
-    outer_sz, inner_sz = plan.traced_sizes()
-    world = outer_sz * inner_sz
+    sizes = plan.traced_sizes()
+    world = 1
+    for s in sizes:
+        world *= s
     tree_plan = bucketing.plan_of(grads, shard_pad=world)
     leaves = jax.tree.leaves(grads)
-    out = []
-    for b in tree_plan.buckets:
-        h = bucketing.pack_bucket(b, leaves, jnp.float32)
-        _check_hier_blocks(h.shape[0], plan, block)
-        s1, b1 = qs.block_scales(h, plan.inner_axis, spec, block)
-        q1 = qs.quantize(h, s1, b1, spec, block)
-        q1_shard = jax.lax.psum_scatter(q1, plan.inner_axis,
-                                        scatter_dimension=0, tiled=True)
-        i = jax.lax.axis_index(plan.inner_axis)
-        chunk = h.shape[0] // inner_sz
-        nb1 = chunk // block
-        s1_shard = jax.lax.dynamic_slice_in_dim(s1, i * nb1, nb1)
-        p = qs.dequantize(q1_shard, s1_shard, block)
-        s2, b2 = qs.block_scales(p, plan.outer_axis, spec, block)
-        q2 = qs.quantize(p, s2, b2, spec, block)
-        q2_shard = jax.lax.psum_scatter(q2, plan.outer_axis,
-                                        scatter_dimension=0, tiled=True)
-        # mirrored gathers, payload still on the wire dtype; the fp32
-        # hop-2 scale vector rides the fast hop (~4/QBLOCK overhead)
-        q2_chunk = jax.lax.all_gather(q2_shard, plan.outer_axis, axis=0,
-                                      tiled=True)
-        q_full = jax.lax.all_gather(q2_chunk, plan.inner_axis, axis=0,
-                                    tiled=True)
-        s2_full = jax.lax.all_gather(s2, plan.inner_axis, axis=0,
-                                     tiled=True)
-        out.append(qs.dequantize(q_full, s2_full, block) * (1.0 / world))
+    out = [quantized_multi_hop_pmean_bucket(
+        bucketing.pack_bucket(b, leaves, jnp.float32), plan, spec, block)
+        for b in tree_plan.buckets]
     return bucketing.unpack(tree_plan, out)
 
 
+def quantized_multi_hop_pmean_bucket(h, plan: HierarchicalSyncPlan,
+                                     spec: qs.QSpec,
+                                     block: int = qs.QBLOCK):
+    """One packed fp32 bucket's hierarchical quantized all-reduce — the
+    per-bucket body of :func:`quantized_multi_hop_pmean`, exposed so
+    the backward-overlapped train step can sync each bucket as its
+    cotangents materialize."""
+    sizes = plan.traced_sizes()
+    world = 1
+    for s in sizes:
+        world *= s
+    _check_hier_blocks(h.shape[0], plan, block)
+    length = h.shape[0]
+    cur, q_shard, s_last = h, None, None
+    for depth, ax in enumerate(reversed(plan.hop_axes)):  # fast->slow
+        s_j, b_j = qs.block_scales(cur, ax, spec, block)
+        q_j = qs.quantize(cur, s_j, b_j, spec, block)
+        q_shard = jax.lax.psum_scatter(q_j, ax, scatter_dimension=0,
+                                       tiled=True)
+        s_last = s_j
+        if depth + 1 == len(plan.hop_axes):
+            break
+        idx = jax.lax.axis_index(ax)
+        length //= sizes[len(sizes) - 1 - depth]
+        nb = length // block
+        s_shard = jax.lax.dynamic_slice_in_dim(s_j, idx * nb, nb)
+        cur = qs.dequantize(q_shard, s_shard, block)
+    # mirrored gathers, payload still on the wire dtype; the fp32
+    # last-hop scale vector rides the fast hops (~4/QBLOCK overhead)
+    q_full = q_shard
+    for ax in plan.hop_axes:  # slow -> fast
+        q_full = jax.lax.all_gather(q_full, ax, axis=0, tiled=True)
+    s_full = s_last
+    for ax in plan.hop_axes[1:]:  # every axis the scales differ on
+        s_full = jax.lax.all_gather(s_full, ax, axis=0, tiled=True)
+    return qs.dequantize(q_full, s_full, block) * (1.0 / world)
+
+
+# Two-level names, kept as the public spelling PR 12 shipped — they run
+# the generalized multi-hop loops (a two-entry plan lowers the exact
+# same op sequence as the original two-hop code).
+two_hop_reduce_scatter = multi_hop_reduce_scatter
+two_hop_all_gather = multi_hop_all_gather
+quantized_two_hop_reduce_scatter = quantized_multi_hop_reduce_scatter
+quantized_two_hop_pmean = quantized_multi_hop_pmean

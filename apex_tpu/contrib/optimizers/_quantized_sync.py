@@ -56,7 +56,7 @@ from apex_tpu.optimizers import bucketing
 __all__ = [
     "QBLOCK", "QSpec", "qspec_of", "is_quantized", "block_scales",
     "quantize", "dequantize", "quantized_reduce_scatter",
-    "quantized_pmean", "grad_sync_bytes",
+    "quantized_pmean", "quantized_pmean_bucket", "grad_sync_bytes",
 ]
 
 #: Elements per scale block.  Divides every bucket's dp shard: bucket
@@ -194,17 +194,27 @@ def quantized_pmean(grads, axis_name: str, spec: QSpec, world: int,
     cut and accept the looser numerics."""
     plan = bucketing.plan_of(grads, shard_pad=world)
     leaves = jax.tree.leaves(grads)
-    out = []
-    for b in plan.buckets:
-        h = bucketing.pack_bucket(b, leaves, jnp.float32)
-        _check_block(h.shape[0], block, world)
-        scales, bounds = block_scales(h, axis_name, spec, block)
-        q = quantize(h, scales, bounds, spec, block)
-        q_shard = jax.lax.psum_scatter(q, axis_name, scatter_dimension=0,
-                                       tiled=True)
-        q_full = jax.lax.all_gather(q_shard, axis_name, axis=0, tiled=True)
-        out.append(dequantize(q_full, scales, block) * (1.0 / world))
+    out = [quantized_pmean_bucket(bucketing.pack_bucket(b, leaves,
+                                                        jnp.float32),
+                                  axis_name, spec, world, block)
+           for b in plan.buckets]
     return bucketing.unpack(plan, out)
+
+
+def quantized_pmean_bucket(h, axis_name: str, spec: QSpec, world: int,
+                           block: int = QBLOCK) -> jnp.ndarray:
+    """One packed fp32 bucket's quantized all-reduce — the per-bucket
+    body of :func:`quantized_pmean`, exposed on its own so the
+    backward-overlapped train step (``make_train_step(overlap_grad_sync
+    =True)``) can issue each bucket's collective the moment its
+    cotangents materialize instead of after the whole backward."""
+    _check_block(h.shape[0], block, world)
+    scales, bounds = block_scales(h, axis_name, spec, block)
+    q = quantize(h, scales, bounds, spec, block)
+    q_shard = jax.lax.psum_scatter(q, axis_name, scatter_dimension=0,
+                                   tiled=True)
+    q_full = jax.lax.all_gather(q_shard, axis_name, axis=0, tiled=True)
+    return dequantize(q_full, scales, block) * (1.0 / world)
 
 
 def grad_sync_bytes(total: int, sync_dtype, block: int = QBLOCK,
@@ -221,10 +231,12 @@ def grad_sync_bytes(total: int, sync_dtype, block: int = QBLOCK,
       ``total``-element payload in the sync dtype;
     - hierarchical (``hier`` a :class:`~apex_tpu.contrib.optimizers
       ._hierarchical_sync.HierarchicalSyncPlan`): the fast inner hop
-      carries the full bucket, the slow outer hop the ``1/dp_inner``
-      chunk — BOTH at the wire dtype, each with its own per-hop-sized
-      scale vector, so the slow-hop bytes are exactly ``1/dp_inner`` of
-      the flat plan's at equal wire dtype."""
+      carries the full bucket and each slower hop the chunk already
+      scattered by every faster hop — ALL at the wire dtype, each with
+      its own per-hop-sized scale vector, so the slow-hop bytes are
+      exactly ``1/prod(faster sizes)`` of the flat plan's at equal wire
+      dtype (two-level: ``1/dp_inner`` cross-slice; three-level
+      additionally ``1/(dp_in * dp_out)`` cross-DCN)."""
     spec = qspec_of(sync_dtype)
     item = (spec.wire_dtype.itemsize if spec is not None
             else jnp.dtype(sync_dtype).itemsize)
@@ -236,5 +248,9 @@ def grad_sync_bytes(total: int, sync_dtype, block: int = QBLOCK,
 
     if hier is None:
         return {flat_hop: hop(total)}
-    return {hier.inner_axis: hop(total),
-            hier.outer_axis: hop(total // max(hier.inner_size, 1))}
+    out, n = {}, total
+    for axis, size in zip(reversed(hier.hop_axes),
+                          reversed(hier.hop_sizes)):  # fast -> slow
+        out[axis] = hop(n)
+        n //= max(size, 1)
+    return out

@@ -219,27 +219,35 @@ class ZeroOptimizerBase:
         self.lr = lr
         self.weight_decay = weight_decay
         self.axis_name = axis_name
-        # hierarchical (outer, inner) dp split: grad sync becomes the
-        # two-hop reduce-scatter of _hierarchical_sync (intra-slice on
-        # the fast inner axis, cross-slice on the slow outer axis at
-        # the same wire dtype), param sync the mirrored gathers.  The
+        # hierarchical (slow, ..., fast) dp split: grad sync becomes
+        # the multi-hop reduce-scatter of _hierarchical_sync —
+        # intra-slice on the fast inner axis first, each slower axis
+        # (cross-slice dp_out, cross-pod dcn) on the shrinking chunk at
+        # the same wire dtype — param sync the mirrored gathers.  The
         # HierarchicalSyncPlan itself is built at init (it needs the
         # axis sizes); ownership keeps the FLAT chunk-per-rank layout,
-        # so checkpoints reshard flat <-> hierarchical unchanged.
+        # so checkpoints reshard flat <-> two-level <-> three-level
+        # unchanged.
         if dp_axes is not None:
             dp_axes = tuple(dp_axes)
-            if len(dp_axes) != 2 or len(set(dp_axes)) != 2 \
+            if not (2 <= len(dp_axes) <= 3) \
+                    or len(set(dp_axes)) != len(dp_axes) \
                     or not all(isinstance(a, str) for a in dp_axes):
                 raise ValueError(
-                    f"dp_axes must be two distinct mesh axis names "
-                    f"(outer, inner), got {dp_axes!r}")
+                    f"dp_axes must be two or three distinct mesh axis "
+                    f"names ordered slow to fast — (outer, inner) or "
+                    f"(dcn, dp_out, dp_in) — got {dp_axes!r}")
         self.dp_axes = dp_axes
         self._hier_plan: Optional[hs.HierarchicalSyncPlan] = None
         self.grad_average = grad_average
         # per-bucket collectives are independently schedulable by
-        # construction — overlap_grad_sync is the reference's knob for
-        # its side-stream engine and is structural here (recorded for
-        # parity).  overlap_param_sync is real: True gathers the
+        # construction — overlap_grad_sync here is the reference's knob
+        # for its side-stream engine and stays structural (recorded for
+        # parity); the REAL backward-overlap seam is the step builder's
+        # default-off ``make_train_step(overlap_grad_sync=True)``,
+        # which issues each bucket's wire (``bucket_grad_wire``) inside
+        # the backward and hands the engine pre-scattered shards via
+        # ``presynced=``.  overlap_param_sync is real: True gathers the
         # PRE-commit update so the all-gather is not serialized behind
         # the finite vote (per-leaf predicated select afterwards).
         self.overlap_grad_sync = overlap_grad_sync
@@ -321,8 +329,7 @@ class ZeroOptimizerBase:
             if self._hier_plan.world != self._world:
                 raise ValueError(
                     f"dp_axes={self.dp_axes!r} sizes "
-                    f"({self._hier_plan.outer_size}, "
-                    f"{self._hier_plan.inner_size}) multiply to "
+                    f"{self._hier_plan.hop_sizes} multiply to "
                     f"{self._hier_plan.world}, but world_size="
                     f"{self._world}: the hierarchical split must cover "
                     "exactly the flat dp world (same 1/dp shards, same "
@@ -378,7 +385,7 @@ class ZeroOptimizerBase:
             "cap_bytes": self._cap_bytes,
             "model_mult": self._model_mult,
             "hier": None if hier is None else
-                [list(hier.shard_axes), hier.outer_size, hier.inner_size],
+                [list(hier.shard_axes), *hier.hop_sizes],
             "buckets": [[b.dtype, b.size, b.total, len(b.leaves)]
                         for b in plan.buckets],
         }
@@ -571,9 +578,99 @@ class ZeroOptimizerBase:
                     "contract: a narrower residual re-quantizes the "
                     "feedback)")
 
+    def _dp_rank_world(self):
+        """``(rank, world)`` of this shard_map instance on the dp
+        group: flat ``axis_index``/``axis_size``, or the hierarchical
+        Horner rank over the hop axes (fast-major — the SAME global
+        chunk-per-rank layout the flat plan has, at any hop depth)."""
+        hier = self._hier_plan
+        if hier is not None:
+            world = 1
+            for s in hier.traced_sizes():
+                world = world * s
+            return hier.zero_rank(), world
+        ax = self._dp_sync_axes
+        return jax.lax.axis_index(ax), jax.lax.axis_size(ax)
+
+    def bucket_grad_wire(self, b, leaves, scale=None, residual=None):
+        """ONE bucket's gradient wire — the factored per-bucket body of
+        :meth:`_prepare_grads`, public so the backward-overlapped step
+        builders (``make_train_step(overlap_grad_sync=True)``) can
+        issue it INSIDE the backward as soon as this bucket's leaf
+        cotangents materialize, then hand the engine the results via
+        ``_prepare_grads(presynced=...)``.
+
+        ``leaves`` is the flat leaf list in plan order — only the
+        entries named by ``b.leaves[*].leaf_id`` are read, so a caller
+        mid-backward may pass a partially-filled list.  ``residual`` is
+        this bucket's error-feedback state (required exactly when the
+        wire is quantized).  Returns ``(g32_shard, new_residual,
+        pre_wire)`` — the fp32 1/dp shard of the synced grad, and on
+        quantized wires the UNCOMMITTED refreshed residual plus the
+        fp32 pre-quantization bucket for the caller's finite vote
+        (both ``None`` on wide wires).
+
+        The ops and their order inside one bucket are IDENTICAL to the
+        unoverlapped path — overlap only moves whole-bucket wires
+        earlier in the trace, so fp32 results stay bitwise equal."""
+        ax = self._dp_sync_axes
+        hier = self._hier_plan
+        rank, world = self._dp_rank_world()
+        sdt = self._grad_dtype(b)
+        spec = qs.qspec_of(sdt)
+        if spec is not None:
+            # quantized wire: unscale BEFORE quantizing (the residual
+            # must be in loss-scale-free units — a scaler backoff
+            # between steps must not re-weight carried error), add the
+            # residual, quantize against the shared per-block scales,
+            # reduce-scatter int8/fp8
+            if residual is None:
+                raise ValueError(
+                    "bucket_grad_wire on a quantized wire needs this "
+                    "bucket's error-feedback residual (state.residual[bi])")
+            h = self._pack_bucket(
+                leaves, b, jnp.float32,
+                scale=(1.0 / scale) if scale is not None else None)
+            h = h + residual.astype(jnp.float32)
+            if hier is not None:
+                g_sum, res_new = hs.quantized_multi_hop_reduce_scatter(
+                    h, hier, spec)
+            else:
+                g_sum, res_new = qs.quantized_reduce_scatter(
+                    h, ax, spec, rank, world)
+            g32 = g_sum / world if self.grad_average else g_sum
+            return g32, res_new.astype(jnp.dtype(b.dtype)), h
+        # fp16 sync pre-divides (the reference's predivide: the
+        # world-sized sum would overflow fp16's range); fp32/bf16
+        # sync post-divides in fp32 — same association the
+        # replicated path's psum-then-pmean takes, so ZeRO vs
+        # replicated trajectories agree to the grad's own rounding
+        predivide = (self.grad_average
+                     and sdt == jnp.dtype(jnp.float16))
+        bucket = self._pack_bucket(
+            leaves, b, sdt, scale=(1.0 / world) if predivide else None)
+        # ZeRO grad sync: each rank owns 1/dp of the dp-SUM — the
+        # one collective read of this bucket's gradient (plain hops
+        # fast-to-slow on a hierarchical mesh, same wire dtype each)
+        if hier is not None:
+            g_loc = hs.multi_hop_reduce_scatter(bucket, hier)
+        else:
+            g_loc = jax.lax.psum_scatter(bucket, ax,
+                                         scatter_dimension=0,
+                                         tiled=True)
+        g32 = g_loc.astype(jnp.float32)
+        if self.grad_average and not predivide:
+            g32 = g32 / world
+        if scale is not None:
+            # loss-scale unscale AFTER the sync, in fp32: half-dtype
+            # wires carry the scaled grads (no underflow), the math
+            # sees unscaled fp32
+            g32 = g32 * (1.0 / scale)
+        return g32, None, None
+
     def _prepare_grads(self, plan, grads, scale, clip_norm, finite_sync,
                        want_finite, grads_finite, sumsq_reduce,
-                       residuals=None):
+                       residuals=None, presynced=None):
         """The sharded grad read: per-bucket reduce-scatter in
         ``grad_sync_dtype`` (grad-average pre-division folded in — the
         reference's predivide, overflow-safe for large worlds), fp32
@@ -598,78 +695,47 @@ class ZeroOptimizerBase:
         inner axis, then cross-slice on the slow outer axis at the same
         wire dtype — on quantized wires the partial sums requantize
         against fresh outer-shared scales and the requantization error
-        folds into the SAME residual channel."""
+        folds into the SAME residual channel.
+
+        ``presynced=(g_shards, new_residuals, pre_wire)`` is the
+        backward-overlap handoff: the step builder already issued every
+        bucket's wire (:meth:`bucket_grad_wire` inside the backward, in
+        reverse-backward bucket order), so the wire loop is skipped and
+        everything AFTER it — the finite vote, the clip, the telemetry
+        — runs here unchanged on identical values (``grads`` may be
+        ``None`` then)."""
         ax = self._dp_sync_axes
-        hier = self._hier_plan
-        if hier is not None:
-            outer_sz, inner_sz = hier.traced_sizes()
-            world = outer_sz * inner_sz
-            rank = hier.zero_rank()
-        else:
-            world = jax.lax.axis_size(ax)
-            rank = jax.lax.axis_index(ax)
-        leaves = jax.tree.leaves(grads)
-        if len(leaves) != plan.n_leaves:
-            raise ValueError(f"grad tree has {len(leaves)} leaves; plan "
-                             f"expects {plan.n_leaves}")
+        rank, world = self._dp_rank_world()
         self._check_residual_state(plan, residuals)
-        g_shards = []
-        new_residuals = []
-        pre_wire = []  # fp32 pre-quantization buckets, for the vote
-        for bi, b in enumerate(plan.buckets):
-            sdt = self._grad_dtype(b)
-            spec = qs.qspec_of(sdt)
-            if spec is not None:
-                # quantized wire: unscale BEFORE quantizing (the
-                # residual must be in loss-scale-free units — a scaler
-                # backoff between steps must not re-weight carried
-                # error), add the residual, quantize against the
-                # shared per-block scales, reduce-scatter int8/fp8
-                h = self._pack_bucket(
-                    leaves, b, jnp.float32,
-                    scale=(1.0 / scale) if scale is not None else None)
-                h = h + residuals[bi].astype(jnp.float32)
-                if hier is not None:
-                    g_sum, res_new = hs.quantized_two_hop_reduce_scatter(
-                        h, hier, spec)
-                else:
-                    g_sum, res_new = qs.quantized_reduce_scatter(
-                        h, ax, spec, rank, world)
-                g32 = g_sum / world if self.grad_average else g_sum
-                new_residuals.append(res_new.astype(jnp.dtype(b.dtype)))
-                # a non-finite grad quantizes to garbage the wire may
-                # MASK (nan -> int8 is finite): vote on the
-                # pre-quantization values, not just the shards
-                pre_wire.append(h)
+        if presynced is not None:
+            pre_g, pre_res, pre_h = presynced
+            if len(pre_g) != len(plan.buckets):
+                raise ValueError(
+                    f"presynced carries {len(pre_g)} bucket shards; the "
+                    f"plan has {len(plan.buckets)} buckets")
+            g_shards = list(pre_g)
+            new_residuals = [r for r in pre_res if r is not None]
+            pre_wire = [h for h in pre_h if h is not None]
+        else:
+            leaves = jax.tree.leaves(grads)
+            if len(leaves) != plan.n_leaves:
+                raise ValueError(f"grad tree has {len(leaves)} leaves; plan "
+                                 f"expects {plan.n_leaves}")
+            g_shards = []
+            new_residuals = []
+            pre_wire = []  # fp32 pre-quantization buckets, for the vote
+            for bi, b in enumerate(plan.buckets):
+                g32, res_new, h = self.bucket_grad_wire(
+                    b, leaves, scale=scale,
+                    residual=residuals[bi] if self._quantized else None)
                 g_shards.append(g32)
-                continue
-            # fp16 sync pre-divides (the reference's predivide: the
-            # world-sized sum would overflow fp16's range); fp32/bf16
-            # sync post-divides in fp32 — same association the
-            # replicated path's psum-then-pmean takes, so ZeRO vs
-            # replicated trajectories agree to the grad's own rounding
-            predivide = (self.grad_average
-                         and sdt == jnp.dtype(jnp.float16))
-            bucket = self._pack_bucket(
-                leaves, b, sdt, scale=(1.0 / world) if predivide else None)
-            # ZeRO grad sync: each rank owns 1/dp of the dp-SUM — the
-            # one collective read of this bucket's gradient (two plain
-            # hops on a hierarchical mesh, same wire dtype both hops)
-            if hier is not None:
-                g_loc = hs.two_hop_reduce_scatter(bucket, hier)
-            else:
-                g_loc = jax.lax.psum_scatter(bucket, ax,
-                                             scatter_dimension=0,
-                                             tiled=True)
-            g32 = g_loc.astype(jnp.float32)
-            if self.grad_average and not predivide:
-                g32 = g32 / world
-            if scale is not None:
-                # loss-scale unscale AFTER the sync, in fp32: half-dtype
-                # wires carry the scaled grads (no underflow), the math
-                # sees unscaled fp32
-                g32 = g32 * (1.0 / scale)
-            g_shards.append(g32)
+                if res_new is not None:
+                    new_residuals.append(res_new)
+                if h is not None:
+                    # a non-finite grad quantizes to garbage the wire
+                    # may MASK (nan -> int8 is finite): vote on the
+                    # pre-quantization values, not just the shards
+                    pre_wire.append(h)
 
         pred = grads_finite
         if want_finite:
@@ -792,39 +858,43 @@ class ZeroOptimizerBase:
 
     # ------------------------------------------------------- public API
     def update(self, grads, state, params, grads_finite=None, lr=None,
-               clip_norm=None, sumsq_reduce=None):
+               clip_norm=None, sumsq_reduce=None, presynced=None):
         """One ZeRO step inside shard_map.  ``grads`` are this rank's
         LOCAL grads (the optimizer's reduce-scatter IS the dp gradient
         sync); ``grads_finite`` (already agreed across every axis)
         predicates the commit; ``clip_norm`` folds a global-l2 clip
         (torch semantics) into the sharded grad read with
-        ``sumsq_reduce`` supplying the model-axes Σx² agreement."""
+        ``sumsq_reduce`` supplying the model-axes Σx² agreement.
+        ``presynced`` hands over wires already issued inside the
+        backward (:meth:`bucket_grad_wire`); ``grads`` may be None."""
         p, s, _ = self._zero_step(grads, state, params,
                                   grads_finite=grads_finite, lr=lr,
                                   clip_norm=clip_norm,
                                   sumsq_reduce=sumsq_reduce,
-                                  want_finite=False)
+                                  want_finite=False, presynced=presynced)
         return p, s
 
     def update_scaled(self, grads, state, params, scale=None,
                       clip_norm=None, finite_sync=None, lr=None,
-                      sumsq_reduce=None):
+                      sumsq_reduce=None, presynced=None):
         """The fused amp step on the sharded grad read: per-bucket
         reduce-scatter, fp32 unscale of the 1/dp shard, the all-finite
         vote (``finite_sync`` must agree it over the model axes AND
         dp), optional global-l2 clip, predicated commit.  Returns
-        ``(new_params, new_state, all_finite)``."""
+        ``(new_params, new_state, all_finite)``.  ``presynced`` hands
+        over wires already issued inside the backward
+        (:meth:`bucket_grad_wire`); ``grads``/``scale`` consumed there."""
         return self._zero_step(grads, state, params, scale=scale,
                                clip_norm=clip_norm, finite_sync=finite_sync,
                                lr=lr, sumsq_reduce=sumsq_reduce,
-                               want_finite=True)
+                               want_finite=True, presynced=presynced)
 
     def step(self, grads, state, params, **kw):
         return self.update(grads, state, params, **kw)
 
     def _zero_step(self, grads, state, params, grads_finite=None, lr=None,
                    scale=None, clip_norm=None, finite_sync=None,
-                   sumsq_reduce=None, want_finite=False):
+                   sumsq_reduce=None, want_finite=False, presynced=None):
         raise NotImplementedError  # pragma: no cover - abstract
 
     # ----------------------------------------------------- state dicts
@@ -916,11 +986,14 @@ class ZeroOptimizerBase:
             p_item = self._param_dtype(b).itemsize
             if hier is not None:
                 # mirrored gathers: the fast hop reassembles the full
-                # bucket, the slow hop moves the slice-shared 1/inner
-                # chunk across slices
-                add(hier.inner_axis, "param_sync", b.total * p_item)
-                add(hier.outer_axis, "param_sync",
-                    (b.total // max(hier.inner_size, 1)) * p_item)
+                # bucket, each slower hop moves the chunk already
+                # scattered by every faster hop (three-level: the dcn
+                # hop carries exactly 1/(dp_in*dp_out) of the bucket)
+                n = b.total
+                for axis, size in zip(reversed(hier.hop_axes),
+                                      reversed(hier.hop_sizes)):
+                    add(axis, "param_sync", n * p_item)
+                    n //= max(size, 1)
             else:
                 add(self.axis_name, "param_sync", b.total * p_item)
 

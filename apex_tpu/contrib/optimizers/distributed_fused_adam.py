@@ -179,7 +179,8 @@ class DistributedFusedAdam(ZeroOptimizerBase):
     # -------------------------------------------------------------- step
     def _zero_step(self, grads, state: DistributedFusedAdamState, params,
                    grads_finite=None, lr=None, scale=None, clip_norm=None,
-                   finite_sync=None, sumsq_reduce=None, want_finite=False):
+                   finite_sync=None, sumsq_reduce=None, want_finite=False,
+                   presynced=None):
         lr = self.lr if lr is None else lr
         wd = self.weight_decay
         plan = self._plan_of_local(params)
@@ -187,7 +188,8 @@ class DistributedFusedAdam(ZeroOptimizerBase):
 
         g_shards, res_new, pred, rank, world = self._prepare_grads(
             plan, grads, scale, clip_norm, finite_sync, want_finite,
-            grads_finite, sumsq_reduce, residuals=state.residual)
+            grads_finite, sumsq_reduce, residuals=state.residual,
+            presynced=presynced)
         self._check_state_shards(plan, state.exp_avg, world, "exp_avg")
 
         if self.store_param_remainders:

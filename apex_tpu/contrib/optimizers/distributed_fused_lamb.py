@@ -119,7 +119,8 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
 
     def _zero_step(self, grads, state: DistributedFusedLAMBState, params,
                    grads_finite=None, lr=None, scale=None, clip_norm=None,
-                   finite_sync=None, sumsq_reduce=None, want_finite=False):
+                   finite_sync=None, sumsq_reduce=None, want_finite=False,
+                   presynced=None):
         lr = self.lr if lr is None else lr
         wd = self.weight_decay
         plan = self._plan_of_local(params)
@@ -127,7 +128,8 @@ class DistributedFusedLAMB(ZeroOptimizerBase):
 
         g_shards, res_new, pred, rank, world = self._prepare_grads(
             plan, grads, scale, clip_norm, finite_sync, want_finite,
-            grads_finite, sumsq_reduce, residuals=state.residual)
+            grads_finite, sumsq_reduce, residuals=state.residual,
+            presynced=presynced)
         self._check_state_shards(plan, state.exp_avg, world, "exp_avg")
 
         # LAMB's own global grad-norm clip on the dp-AVERAGED grad

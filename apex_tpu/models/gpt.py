@@ -223,7 +223,7 @@ def param_specs(config: GPTConfig, ep_axis: Optional[str] = None):
     return specs
 
 
-def _add_pos_embed(x, params, config: GPTConfig, cp_axis):
+def _add_pos_embed(x, pos_table, config: GPTConfig, cp_axis):
     """Add the learned position table to (S, B, H) activations — the
     LOCAL sequence chunk's rows when the sequence is cp-sharded.  No-op
     under rope (positions enter as q/k rotations in attention)."""
@@ -232,9 +232,9 @@ def _add_pos_embed(x, params, config: GPTConfig, cp_axis):
     S = x.shape[0]
     if cp_axis is not None:
         start = jax.lax.axis_index(cp_axis) * S
-        pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], start, S, axis=0)
+        pos = jax.lax.dynamic_slice_in_dim(pos_table, start, S, axis=0)
     else:
-        pos = params["pos_embed"][:S]
+        pos = pos_table[:S]
     return x + pos[:, None, :]
 
 
@@ -382,6 +382,93 @@ def _layer(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None,
     return x, aux
 
 
+def _embed_segment(embed_w, pos_w, tokens, config: GPTConfig, axis_name,
+                   cp_axis):
+    """Forward segment 1: token lookup + learned positions, cast to the
+    compute dtype, SP scatter.  ``(B, S)`` tokens → ``(S, B, H)``.
+
+    The three ``_*_segment`` functions are the seam the backward-
+    overlapped gradient sync (``make_train_step(overlap_grad_sync=
+    True)``) cuts the model at: each segment gets its own ``jax.vjp`` so
+    bucket collectives can issue between segment backwards.  They are
+    the SAME functions ``gpt_forward`` composes, so the overlapped
+    build's per-op arithmetic is definitionally identical to the
+    monolithic one — only collective placement moves."""
+    if axis_name is None:
+        emb = jnp.take(embed_w, tokens, axis=0)  # (B, S, H)
+    else:
+        emb = vocab_parallel_embedding(tokens, embed_w, axis_name=axis_name)
+    x = _add_pos_embed(emb.transpose(1, 0, 2), pos_w, config, cp_axis)
+    x = x.astype(config.compute_dtype)
+    if config.sequence_parallel and axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            scatter_to_sequence_parallel_region,
+        )
+
+        x = scatter_to_sequence_parallel_region(x, axis_name)
+    return x
+
+
+def _layers_segment(layers_p, x, config: GPTConfig, axis_name, cp_axis,
+                    ep_axis, return_kv=False):
+    """Forward segment 2: the stacked-layer ``lax.scan`` — returns
+    ``(x, ys)`` exactly as the scan does.  Because layers are scanned
+    over a stacked leading axis, every ``layers.*`` leaf's gradient
+    materializes only when the WHOLE scan backward finishes: the scan
+    is one readiness stage, not L of them."""
+    tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    n_local_heads = config.num_attention_heads // tp
+    layer = partial(
+        _layer, config=config, axis_name=axis_name,
+        n_local_heads=n_local_heads, cp_axis=cp_axis, ep_axis=ep_axis,
+        collect_kv=return_kv,
+    )
+    if config.checkpoint_layers:
+        layer = remat_layer(layer, config.remat_policy)
+
+    # _layer's (carry, lp) -> (x, aux) is exactly the scan contract
+    return jax.lax.scan(layer, x, layers_p)
+
+
+def _head_segment(x, ln_scale, ln_bias, config: GPTConfig, axis_name):
+    """Forward segment 3: SP gather, final LayerNorm, copy-to-region.
+    Returns pre-head hidden states ``(S, B, H)``."""
+    if config.sequence_parallel and axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            gather_from_sequence_parallel_region,
+        )
+
+        # tensor_parallel_output_grad=False: the head's dx is psum'd by the
+        # copy-to-region below, so the backward here must split, not
+        # reduce-scatter (reference mappings.py:236-250)
+        x = gather_from_sequence_parallel_region(x, axis_name, False)
+
+    x = fused_layer_norm_affine(
+        x, ln_scale, ln_bias, (config.hidden_size,), config.layernorm_eps
+    )
+    # tied LM head over the (local) vocab shard.  The copy-to-region is
+    # load-bearing: its backward all-reduces dx across vocab shards
+    # (Megatron parallel_lm_logits; reference layers.py:141-156 pairing).
+    if axis_name is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            copy_to_tensor_model_parallel_region,
+        )
+
+        x = copy_to_tensor_model_parallel_region(x, axis_name)
+    return x
+
+
+# Gradient-readiness stage of each top-level param group under the
+# segmented (overlapped) backward: the head backward (stage 0) yields
+# the final-LN cotangents, the scan backward (stage 1) every stacked
+# ``layers.*`` leaf at once, and the embed backward (stage 2) the
+# positions plus the tied embedding's lookup half (its head half
+# arrives at stage 0 but the leaf is only COMPLETE — summable — after
+# stage 2, so the tied embed is last-ready by construction).
+_OVERLAP_STAGES = {"final_ln_scale": 0, "final_ln_bias": 0, "layers": 1,
+                   "embed": 2, "pos_embed": 2}
+
+
 def gpt_forward(
     params, tokens, config: GPTConfig, axis_name: Optional[str] = None,
     cp_axis: Optional[str] = None, ep_axis: Optional[str] = None,
@@ -409,33 +496,10 @@ def gpt_forward(
     if config.moe and config.sequence_parallel:
         raise ValueError("MoE with Megatron sequence parallelism is not supported: "
                          "expert grads would need an extra tp-psum; use cp instead")
-    B, S = tokens.shape
-    tp = 1 if axis_name is None else jax.lax.axis_size(axis_name)
-    n_local_heads = config.num_attention_heads // tp
-
-    if axis_name is None:
-        emb = jnp.take(params["embed"], tokens, axis=0)  # (B, S, H)
-    else:
-        emb = vocab_parallel_embedding(tokens, params["embed"], axis_name=axis_name)
-    x = _add_pos_embed(emb.transpose(1, 0, 2), params, config, cp_axis)
-    x = x.astype(config.compute_dtype)
-
-    if config.sequence_parallel and axis_name is not None:
-        from apex_tpu.transformer.tensor_parallel.mappings import (
-            scatter_to_sequence_parallel_region,
-        )
-
-        x = scatter_to_sequence_parallel_region(x, axis_name)
-
-    layer = partial(
-        _layer, config=config, axis_name=axis_name, n_local_heads=n_local_heads,
-        cp_axis=cp_axis, ep_axis=ep_axis, collect_kv=return_kv,
-    )
-    if config.checkpoint_layers:
-        layer = remat_layer(layer, config.remat_policy)
-
-    # _layer's (carry, lp) -> (x, aux) is exactly the scan contract
-    x, ys = jax.lax.scan(layer, x, params["layers"])
+    x = _embed_segment(params["embed"], params.get("pos_embed"), tokens,
+                       config, axis_name, cp_axis)
+    x, ys = _layers_segment(params["layers"], x, config, axis_name, cp_axis,
+                            ep_axis, return_kv=return_kv)
     if return_kv:
         aux_per_layer, kv_k, kv_v = ys
         kv = (kv_k, kv_v)
@@ -447,28 +511,8 @@ def gpt_forward(
         return vals + (kv,) if return_kv else (
             vals if len(vals) > 1 else vals[0])
 
-    if config.sequence_parallel and axis_name is not None:
-        from apex_tpu.transformer.tensor_parallel.mappings import (
-            gather_from_sequence_parallel_region,
-        )
-
-        # tensor_parallel_output_grad=False: the head's dx is psum'd by the
-        # copy-to-region below, so the backward here must split, not
-        # reduce-scatter (reference mappings.py:236-250)
-        x = gather_from_sequence_parallel_region(x, axis_name, False)
-
-    x = fused_layer_norm_affine(
-        x, params["final_ln_scale"], params["final_ln_bias"], (config.hidden_size,), config.layernorm_eps
-    )
-    # tied LM head over the (local) vocab shard.  The copy-to-region is
-    # load-bearing: its backward all-reduces dx across vocab shards
-    # (Megatron parallel_lm_logits; reference layers.py:141-156 pairing).
-    if axis_name is not None:
-        from apex_tpu.transformer.tensor_parallel.mappings import (
-            copy_to_tensor_model_parallel_region,
-        )
-
-        x = copy_to_tensor_model_parallel_region(x, axis_name)
+    x = _head_segment(x, params["final_ln_scale"], params["final_ln_bias"],
+                      config, axis_name)
     if return_hidden:
         # pre-head activations for the chunked fused CE (fused_ce.py);
         # the copy-to-region above already carries the head's dx
@@ -831,7 +875,8 @@ def _clip_reduce_for(optimizer, clip_grad_norm, specs):
 def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
                          opt_state, params, sync_axes,
                          step_guard=None, guard_state=None,
-                         clip_grad_norm=None, clip_sumsq=None):
+                         clip_grad_norm=None, clip_sumsq=None,
+                         presynced=None):
     """The shared unscale → found_inf vote → predicated step → scale
     update tail of both scaled train steps (reference §3.2 ctx-exit:
     ``apex/amp/handle.py:119-158`` + the model-parallel found_inf
@@ -856,10 +901,14 @@ def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
     from apex_tpu.transformer.amp.grad_scaler import sync_found_inf
 
     if getattr(optimizer, "supports_update_scaled", False):
+        # a presynced handoff (overlap_grad_sync: the bucket wires
+        # already ran inside the backward, UNSCALED there) only exists
+        # for ZeRO engine optimizers, whose update_scaled takes it
+        kw = {} if presynced is None else {"presynced": presynced}
         new_params, new_state, finite = optimizer.update_scaled(
             grads, opt_state, params, scale=scaler_state.loss_scale,
             clip_norm=clip_grad_norm, sumsq_reduce=clip_sumsq,
-            finite_sync=lambda f: sync_found_inf(f, sync_axes),
+            finite_sync=lambda f: sync_found_inf(f, sync_axes), **kw,
         )
     else:
         grads, finite = loss_scaler.unscale(scaler_state, grads)
@@ -877,7 +926,7 @@ def _apply_scaled_update(loss_scaler, scaler_state, grads, optimizer,
 
 def _apply_guarded_update(grads, optimizer, opt_state, params, sync_axes,
                           step_guard, guard_state, clip_grad_norm=None,
-                          clip_sumsq=None):
+                          clip_sumsq=None, presynced=None):
     """Unscaled step-guard tail: the amp ``all_finite`` predicate alone
     (no loss scaler) gates the optimizer commit and feeds the guard —
     fp32/bf16 runs get the same survive-a-NaN-step semantics the fp16
@@ -888,10 +937,11 @@ def _apply_guarded_update(grads, optimizer, opt_state, params, sync_axes,
     from apex_tpu.transformer.amp.grad_scaler import sync_found_inf
 
     if getattr(optimizer, "supports_update_scaled", False):
+        kw = {} if presynced is None else {"presynced": presynced}
         new_params, new_state, finite = optimizer.update_scaled(
             grads, opt_state, params, clip_norm=clip_grad_norm,
             sumsq_reduce=clip_sumsq,
-            finite_sync=lambda f: sync_found_inf(f, sync_axes),
+            finite_sync=lambda f: sync_found_inf(f, sync_axes), **kw,
         )
     else:
         finite = sync_found_inf(all_finite(grads), sync_axes)
@@ -968,6 +1018,9 @@ def _make_gspmd_train_step(
     opt_state_spec,
     donate_state: bool,
     clip_grad_norm,
+    loss_scaler=None,
+    step_guard=None,
+    telemetry=None,
 ):
     """The ``spmd="auto"`` half of :func:`make_train_step`: ONE jitted
     step with ``NamedSharding`` annotations on a named mesh and not a
@@ -1065,13 +1118,18 @@ def _make_gspmd_train_step(
     dshard = NamedSharding(mesh, P(dp_axis, None))
     rshard = NamedSharding(mesh, P())
 
-    def local_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(gpt_loss_spmd)(
-            params, tokens, targets, config)
+    def grads_of(params, tokens, targets, post_loss):
+        loss, grads = jax.value_and_grad(
+            lambda p: post_loss(gpt_loss_spmd(p, tokens, targets, config))
+        )(params)
         # keep the grads on the param layout: this constraint is what
         # turns the dp batch shard into ONE all-reduce per leaf (the
         # pmean of the shard_map program) instead of a deferred gather
         grads = jax.lax.with_sharding_constraint(grads, pshard)
+        return loss, grads
+
+    def local_step(params, opt_state, tokens, targets):
+        loss, grads = grads_of(params, tokens, targets, lambda l: l)
         if clip_grad_norm is not None:
             # global arrays: the plain in-optimizer sumsq IS the global
             # norm — no cross-rank sumsq_reduce hook needed
@@ -1082,11 +1140,60 @@ def _make_gspmd_train_step(
                 grads, opt_state, params)
         return new_params, new_state, loss
 
+    # scaler/guard variants: global arrays make the finite vote a plain
+    # reduction — sync_axes=() turns the shard_map tails' sync_found_inf
+    # into the identity, so _apply_*_update serve both builders and the
+    # scaler hysteresis / guard accounting cannot drift between them
+    def guarded_local_step(params, opt_state, guard_state, tokens, targets):
+        loss, grads = grads_of(params, tokens, targets, lambda l: l)
+        new_params, new_state, new_guard = _apply_guarded_update(
+            grads, optimizer, opt_state, params, (), step_guard,
+            guard_state, clip_grad_norm=clip_grad_norm)
+        return new_params, new_state, new_guard, loss
+
+    def scaled_local_step(params, opt_state, scaler_state, tokens, targets):
+        scaled_loss, grads = grads_of(
+            params, tokens, targets,
+            lambda l: loss_scaler.scale(scaler_state, l))
+        loss = scaled_loss / scaler_state.loss_scale
+        new_params, new_state, new_scaler_state = _apply_scaled_update(
+            loss_scaler, scaler_state, grads, optimizer, opt_state,
+            params, (), clip_grad_norm=clip_grad_norm)
+        return new_params, new_state, new_scaler_state, loss
+
+    def guarded_scaled_local_step(params, opt_state, scaler_state,
+                                  guard_state, tokens, targets):
+        scaled_loss, grads = grads_of(
+            params, tokens, targets,
+            lambda l: loss_scaler.scale(scaler_state, l))
+        loss = scaled_loss / scaler_state.loss_scale
+        new_params, new_state, new_scaler_state, new_guard = \
+            _apply_scaled_update(
+                loss_scaler, scaler_state, grads, optimizer, opt_state,
+                params, (), step_guard=step_guard, guard_state=guard_state,
+                clip_grad_norm=clip_grad_norm)
+        return new_params, new_state, new_scaler_state, new_guard, loss
+
+    fn = {(True, True): guarded_scaled_local_step,
+          (True, False): scaled_local_step,
+          (False, True): guarded_local_step,
+          (False, False): local_step}[
+        (loss_scaler is not None, step_guard is not None)]
+    n_state = int(loss_scaler is not None) + int(step_guard is not None)
+    stats_argnum = None
+    if telemetry is not None:
+        fn = _telemetry_wrap(fn, n_state, loss_scaler is not None,
+                             telemetry)
+        stats_argnum = 2 + n_state
+        n_state += 1
+
     donate = (0, 1) if donate_state else ()
+    if stats_argnum is not None:
+        donate = (*donate, stats_argnum)
     return jax.jit(
-        local_step,
-        in_shardings=(pshard, sshard, dshard, dshard),
-        out_shardings=(pshard, sshard, rshard),
+        fn,
+        in_shardings=(pshard, sshard, *(rshard,) * n_state, dshard, dshard),
+        out_shardings=(pshard, sshard, *(rshard,) * n_state, rshard),
         donate_argnums=donate,
     )
 
@@ -1107,8 +1214,26 @@ def make_train_step(
     grad_sync_dtype=None,
     telemetry=None,
     spmd: str = "shard_map",
+    overlap_grad_sync: bool = False,
 ):
     """Build a jitted tp×dp train step over ``mesh``.
+
+    ``overlap_grad_sync``: issue each gradient bucket's sync collective
+    INSIDE the backward pass, the moment its cotangents materialize,
+    instead of after the whole backward — the backward runs as three
+    ``jax.vjp`` segments (head, stacked-layer scan, embedding) and the
+    ready buckets' reduce-scatters (ZeRO) or quantized pmeans
+    (replicated ``grad_sync_dtype``) are traced between them, so XLA's
+    latency-hiding scheduler can run bucket k's collective concurrently
+    with the remaining backward dots (the reference's
+    ``overlap_grad_sync``/DDP-hook overlap,
+    ``distributed_fused_adam.py:2158``).  The segments are the same
+    functions the monolithic forward composes, so fp32 loss/params are
+    BITWISE identical to the unoverlapped build (pinned in
+    tests/test_distributed_optimizers.py); only collective placement
+    moves.  Requires a dp grad sync to overlap (a ZeRO optimizer or
+    ``grad_sync_dtype``); not wired for MoE, sequence parallelism, cp,
+    or ``spmd='auto'``.
 
     ``spmd``: ``"shard_map"`` (default) builds the explicit-collective
     Megatron program documented below.  ``"auto"`` builds the
@@ -1116,10 +1241,13 @@ def make_train_step(
     annotations from the same ``param_specs`` tree and ZERO explicit
     collectives; XLA's SPMD partitioner places them, so new mesh
     shapes need no new step code.  The auto path supports
-    ``opt_state_spec``/``donate_state``/``clip_grad_norm`` and rejects
-    the explicitly-collective features loudly (ZeRO, hierarchical dp,
-    cp, MoE, SP, flash/fused-CE kernels, scaler/guard/chaos/telemetry
-    — see docs/parallelism.md for the migration map).  Its loss is
+    ``opt_state_spec``/``donate_state``/``clip_grad_norm`` and — since
+    the finite vote needs no collectives on global arrays — the full
+    ``loss_scaler``/``step_guard``/``telemetry`` tails; it rejects the
+    explicitly-collective features loudly (ZeRO, hierarchical dp, cp,
+    MoE, SP, flash/fused-CE kernels, chaos, grad_sync_dtype,
+    overlap_grad_sync — see docs/parallelism.md for the migration
+    map).  Its loss is
     bitwise-equal fp32 to this builder's per step on the same mesh
     (pinned in tests/test_gpt.py), and its lowering is pinned through
     ``analysis.lowered.assert_sharding``/``assert_spmd_collectives``.
@@ -1215,19 +1343,25 @@ def make_train_step(
     if spmd not in ("shard_map", "auto"):
         raise ValueError(f"spmd must be 'shard_map' or 'auto', got {spmd!r}")
     if spmd == "auto":
-        for arg, name in ((cp_axis, "cp_axis"), (loss_scaler, "loss_scaler"),
-                          (step_guard, "step_guard"), (chaos, "chaos"),
-                          (grad_sync_dtype, "grad_sync_dtype"),
-                          (telemetry, "telemetry")):
+        for arg, name in ((cp_axis, "cp_axis"), (chaos, "chaos"),
+                          (grad_sync_dtype, "grad_sync_dtype")):
             if arg is not None:
                 raise NotImplementedError(
                     f"make_train_step(spmd='auto') does not take {name} "
                     "yet; use the shard_map path (the GSPMD step is the "
                     "parity-pinned core, features migrate per "
                     "docs/parallelism.md)")
+        if overlap_grad_sync:
+            raise NotImplementedError(
+                "make_train_step(spmd='auto') does not take "
+                "overlap_grad_sync: the GSPMD path has no explicit "
+                "collectives to reorder (XLA already schedules its "
+                "grad all-reduces against the backward); the knob "
+                "belongs to the shard_map path")
         return _make_gspmd_train_step(
             config, optimizer, mesh, tp_axis, dp_axis, opt_state_spec,
-            donate_state, clip_grad_norm)
+            donate_state, clip_grad_norm, loss_scaler=loss_scaler,
+            step_guard=step_guard, telemetry=telemetry)
 
     from jax.sharding import PartitionSpec as P
 
@@ -1239,10 +1373,11 @@ def make_train_step(
     dp_hier = isinstance(dp_axis, (tuple, list))
     if dp_hier:
         dp_axis = tuple(dp_axis)
-        if len(dp_axis) != 2:
+        if len(dp_axis) not in (2, 3):
             raise ValueError(
-                f"a hierarchical dp_axis is the (outer, inner) pair of "
-                f"mesh axes, got {dp_axis!r}")
+                f"a hierarchical dp_axis is the (outer, inner) pair — or "
+                f"the (dcn, outer, inner) triple — of mesh axes ordered "
+                f"slow to fast, got {dp_axis!r}")
         if config.moe:
             raise NotImplementedError(
                 "MoE expert parallelism over a hierarchical dp split is "
@@ -1295,13 +1430,13 @@ def make_train_step(
             if dp_hier:
                 from apex_tpu.contrib.optimizers import _hierarchical_sync
 
-                # two-hop quantized all-reduce: scatter inner then
-                # outer, mirrored gathers, every payload hop at the
-                # wire dtype — the cross-slice hop carries 1/dp_inner
+                # multi-hop quantized all-reduce: scatter fast to slow,
+                # mirrored gathers, every payload hop at the wire
+                # dtype — each slower hop carries 1/prod(faster sizes)
                 plan = _hierarchical_sync.hierarchical_plan(
                     dp_axis, {a: mesh.shape[a] for a in dp_axis},
                     grad_wire_dtype=grad_sync_dtype)
-                return _hierarchical_sync.quantized_two_hop_pmean(
+                return _hierarchical_sync.quantized_multi_hop_pmean(
                     grads, plan, qspec)
             # quantized all-reduce: reduce-scatter + all-gather, both
             # on the wire dtype (the same scale machinery as ZeRO's
@@ -1333,19 +1468,193 @@ def make_train_step(
         )
     _check_zero_axis(zero_opt, optimizer, dp_axis)
 
+    if overlap_grad_sync:
+        for bad, why in (
+            (config.moe, "MoE (expert grads are dp-sharded sums, not "
+             "bucketed pmean wires)"),
+            (config.sequence_parallel, "sequence parallelism "
+             "(sp_grad_sync is a whole-tree pass after the backward)"),
+            (cp_axis is not None, "context parallelism (cp grads need "
+             "a second pmean after the backward)"),
+        ):
+            if bad:
+                raise NotImplementedError(
+                    f"overlap_grad_sync is not wired for {why}")
+        if dp_axis is None:
+            raise ValueError("overlap_grad_sync overlaps the dp grad "
+                             "sync; this step has dp_axis=None")
+        if not zero_opt and qspec is None:
+            raise ValueError(
+                "overlap_grad_sync needs a per-bucket dp grad sync to "
+                "overlap — a ZeRO optimizer (each bucket's "
+                "reduce-scatter issues as its grads materialize) or "
+                "grad_sync_dtype= (per-bucket quantized pmean); the "
+                "plain replicated pmean is one whole-tree sweep with "
+                "nothing to interleave")
+
     def sync_loss_and_grads(loss, grads):
         """cp behaves as a data axis for grads: each rank differentiated
         its local-chunk loss (ring-travelled k/v cotangents included),
-        so pmean over cp (and dp) recovers the global-mean-loss grads."""
+        so pmean over cp (and dp) recovers the global-mean-loss grads.
+        With ``overlap_grad_sync`` the dp sync already happened inside
+        the backward (per bucket), so only the loss pmean remains."""
         if config.sequence_parallel:
             grads = sp_grad_sync(grads, tp_axis)
         for ax in (cp_axis, dp_axis):
             if ax is not None:
                 loss = jax.lax.pmean(loss, ax)
-                if ax == dp_axis and zero_opt:
+                if ax == dp_axis and (zero_opt or overlap_grad_sync):
                     continue
                 grads = pmean_grads(grads, ax, skip_experts=(ax == dp_axis))
         return loss, grads
+
+    def overlap_value_and_grads(params, tokens, targets, post_loss,
+                                residuals, scale):
+        """The backward-overlapped twin of ``value_and_grad(loss_fn)``:
+        the forward runs as the three ``_*_segment`` functions, each
+        under its own ``jax.vjp``, and the backward is their cotangent
+        chain — after each segment's backward, every bucket whose
+        leaves all have cotangents is packed and its sync collective
+        traced IMMEDIATELY, before the next (earlier) segment's
+        backward.  Gradient readiness on the scan-stacked model has
+        exactly three stages: final-LN leaves after the head backward,
+        every ``layers.*`` leaf after the scan backward, and the (tied)
+        embedding + positions after the embed backward.
+
+        Returns ``(scaled_loss, grads, presynced)``: with a ZeRO
+        optimizer ``grads`` is None and ``presynced`` the per-bucket
+        ``(shards, residuals, wires)`` handoff its ``update*`` consumes
+        in place of the grad tree; on the replicated quantized path
+        ``grads`` is the dp-SYNCED (still loss-scaled) grad tree and
+        ``presynced`` None.  Every per-bucket operation is the same
+        function the unoverlapped build calls on the same values, so
+        the arithmetic is bitwise identical — only collective placement
+        in the trace moves."""
+        from apex_tpu.optimizers import bucketing
+
+        t = targets.transpose(1, 0)  # (S, B)
+
+        def seg_embed(embed_w, pos_w):
+            return _embed_segment(embed_w, pos_w, tokens, config, tp_axis,
+                                  cp_axis)
+
+        def seg_layers(layers_p, x):
+            return _layers_segment(layers_p, x, config, tp_axis, cp_axis,
+                                   ep_axis)
+
+        def seg_head(ln_scale, ln_bias, embed_w, x):
+            h = _head_segment(x, ln_scale, ln_bias, config, tp_axis)
+            return jnp.mean(lm_head_loss(h, embed_w, t, config, tp_axis))
+
+        unknown = sorted(set(params) - set(_OVERLAP_STAGES))
+        if unknown:
+            raise NotImplementedError(
+                f"overlap_grad_sync does not know the gradient-readiness "
+                f"stage of param group(s) {unknown}")
+
+        x0, vjp_embed = jax.vjp(seg_embed, params["embed"],
+                                params.get("pos_embed"))
+        (x1, ys), vjp_layers = jax.vjp(seg_layers, params["layers"], x0)
+        loss, vjp_head = jax.vjp(seg_head, params["final_ln_scale"],
+                                 params["final_ln_bias"], params["embed"],
+                                 x1)
+        scaled_loss, vjp_post = jax.vjp(post_loss, loss)
+
+        leaves, treedef = jax.tree.flatten(params)
+        idx_tree = jax.tree.unflatten(treedef, list(range(len(leaves))))
+        stages = [0] * len(leaves)
+        for key, sub in idx_tree.items():
+            for li in jax.tree.leaves(sub):
+                stages[li] = _OVERLAP_STAGES[key]
+        cot = [None] * len(leaves)
+
+        def fill(key, val):
+            for li, v in zip(jax.tree.leaves(idx_tree[key]),
+                             jax.tree.leaves(val)):
+                cot[li] = v
+
+        if zero_opt:
+            plan = optimizer._plan_of_local(params)
+            by_stage = bucketing.buckets_by_stage(plan, stages, 3)
+            n = len(plan.buckets)
+            g_shards, res_new, wires = [None] * n, [None] * n, [None] * n
+
+            def wire(stage):
+                for bi in by_stage[stage]:
+                    res = residuals[bi] if optimizer._quantized else None
+                    g_shards[bi], res_new[bi], wires[bi] = \
+                        optimizer.bucket_grad_wire(
+                            plan.buckets[bi], cot, scale=scale,
+                            residual=res)
+        else:
+            # replicated quantized pmean, one bucket at a time — the
+            # grads stay SCALED on the wire exactly as on the
+            # unoverlapped path (the downstream update tail unscales)
+            from apex_tpu.contrib.optimizers import _quantized_sync
+
+            if dp_hier:
+                from apex_tpu.contrib.optimizers import _hierarchical_sync
+
+                hplan = _hierarchical_sync.hierarchical_plan(
+                    dp_axis, {a: mesh.shape[a] for a in dp_axis},
+                    grad_wire_dtype=grad_sync_dtype)
+                world = 1
+                for s in hplan.traced_sizes():
+                    world = world * s
+            else:
+                hplan, world = None, mesh.shape[dp_axis]
+            plan = bucketing.plan_of(params, shard_pad=world)
+            by_stage = bucketing.buckets_by_stage(plan, stages, 3)
+            synced = [None] * len(plan.buckets)
+
+            def wire(stage):
+                for bi in by_stage[stage]:
+                    h = bucketing.pack_bucket(plan.buckets[bi], cot,
+                                              jnp.float32)
+                    if hplan is not None:
+                        synced[bi] = (_hierarchical_sync
+                                      .quantized_multi_hop_pmean_bucket(
+                                          h, hplan, qspec))
+                    else:
+                        synced[bi] = _quantized_sync.quantized_pmean_bucket(
+                            h, dp_axis, qspec, world)
+
+        (seed,) = vjp_post(jnp.ones_like(scaled_loss))
+        d_ln_scale, d_ln_bias, d_embed_head, d_x1 = vjp_head(seed)
+        fill("final_ln_scale", d_ln_scale)
+        fill("final_ln_bias", d_ln_bias)
+        wire(0)
+        d_layers, d_x0 = vjp_layers((d_x1, jax.tree.map(jnp.zeros_like,
+                                                        ys)))
+        fill("layers", d_layers)
+        wire(1)
+        d_embed_lookup, d_pos = vjp_embed(d_x0)
+        fill("embed", d_embed_head + d_embed_lookup)
+        if "pos_embed" in params:
+            fill("pos_embed", d_pos)
+        wire(2)
+
+        if zero_opt:
+            return scaled_loss, None, (tuple(g_shards), tuple(res_new),
+                                       tuple(wires))
+        return scaled_loss, bucketing.unpack(plan, synced), None
+
+    def value_and_grads(params, opt_state, tokens, targets, post_loss,
+                        scale=None):
+        """The one grads seam all four step variants share:
+        ``(scaled_loss, grads, presynced)``.  Monolithic
+        ``value_and_grad`` with ``presynced=None`` normally; the
+        segmented overlapped backward when ``overlap_grad_sync``."""
+        if not overlap_grad_sync:
+            def loss_fn(p):
+                return post_loss(gpt_loss(p, tokens, targets, config,
+                                          tp_axis, cp_axis, ep_axis))
+
+            scaled_loss, grads = jax.value_and_grad(loss_fn)(params)
+            return scaled_loss, grads, None
+        return overlap_value_and_grads(
+            params, tokens, targets, post_loss,
+            getattr(opt_state, "residual", ()), scale)
 
     if chaos is not None and step_guard is None:
         raise ValueError("chaos NaN injection needs step_guard (the "
@@ -1389,47 +1698,49 @@ def make_train_step(
         sync_axes.extend(dp_axis if dp_hier else (dp_axis,))
 
     def local_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(gpt_loss)(
-            params, tokens, targets, config, tp_axis, cp_axis, ep_axis
-        )
+        loss, grads, presynced = value_and_grads(
+            params, opt_state, tokens, targets, lambda l: l)
         loss, grads = sync_loss_and_grads(loss, grads)
+        kw = {} if presynced is None else {"presynced": presynced}
         if clip_grad_norm is not None:
             new_params, new_state = optimizer.update(
                 grads, opt_state, params, clip_norm=clip_grad_norm,
-                sumsq_reduce=clip_reduce)
+                sumsq_reduce=clip_reduce, **kw)
         else:
-            new_params, new_state = optimizer.update(grads, opt_state, params)
+            new_params, new_state = optimizer.update(grads, opt_state,
+                                                     params, **kw)
         return new_params, new_state, loss
 
     def guarded_local_step(params, opt_state, guard_state, tokens, targets):
         fault = chaos.grad_fault(guard_state.step) if chaos is not None else None
 
-        def loss_fn(p):
-            l = gpt_loss(p, tokens, targets, config, tp_axis, cp_axis, ep_axis)
+        def post_loss(l):
             return l * fault if fault is not None else l
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss, grads, presynced = value_and_grads(
+            params, opt_state, tokens, targets, post_loss)
         loss = chaos_wedge(loss, guard_state.step)
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state, new_guard = _apply_guarded_update(
             grads, optimizer, opt_state, params, sync_axes,
             step_guard, guard_state, clip_grad_norm=clip_grad_norm,
-            clip_sumsq=clip_reduce,
+            clip_sumsq=clip_reduce, presynced=presynced,
         )
         return new_params, new_state, new_guard, loss
 
     def scaled_local_step(params, opt_state, scaler_state, tokens, targets):
-        def scaled_loss_fn(p):
-            l = gpt_loss(p, tokens, targets, config, tp_axis, cp_axis, ep_axis)
+        def post_loss(l):
             return loss_scaler.scale(scaler_state, l)
 
-        scaled_loss, grads = jax.value_and_grad(scaled_loss_fn)(params)
+        scaled_loss, grads, presynced = value_and_grads(
+            params, opt_state, tokens, targets, post_loss,
+            scale=scaler_state.loss_scale)
         loss = scaled_loss / scaler_state.loss_scale
         loss, grads = sync_loss_and_grads(loss, grads)
         new_params, new_state, new_scaler_state = _apply_scaled_update(
             loss_scaler, scaler_state, grads, optimizer, opt_state, params,
             sync_axes, clip_grad_norm=clip_grad_norm,
-            clip_sumsq=clip_reduce,
+            clip_sumsq=clip_reduce, presynced=presynced,
         )
         return new_params, new_state, new_scaler_state, loss
 
@@ -1437,13 +1748,14 @@ def make_train_step(
                                   guard_state, tokens, targets):
         fault = chaos.grad_fault(guard_state.step) if chaos is not None else None
 
-        def scaled_loss_fn(p):
-            l = gpt_loss(p, tokens, targets, config, tp_axis, cp_axis, ep_axis)
+        def post_loss(l):
             if fault is not None:
                 l = l * fault
             return loss_scaler.scale(scaler_state, l)
 
-        scaled_loss, grads = jax.value_and_grad(scaled_loss_fn)(params)
+        scaled_loss, grads, presynced = value_and_grads(
+            params, opt_state, tokens, targets, post_loss,
+            scale=scaler_state.loss_scale)
         loss = scaled_loss / scaler_state.loss_scale
         loss = chaos_wedge(loss, guard_state.step)
         loss, grads = sync_loss_and_grads(loss, grads)
@@ -1453,6 +1765,7 @@ def make_train_step(
                 params, sync_axes,
                 step_guard=step_guard, guard_state=guard_state,
                 clip_grad_norm=clip_grad_norm, clip_sumsq=clip_reduce,
+                presynced=presynced,
             )
         return new_params, new_state, new_scaler_state, new_guard, loss
 
@@ -1654,7 +1967,8 @@ def make_pp_train_step(
         tokens = mb["tokens"]
         B, S = tokens.shape
         emb = vocab_parallel_embedding(tokens, shared["embed"], axis_name=tp_axis)
-        x = _add_pos_embed(emb.transpose(1, 0, 2), shared, config, cp_axis)
+        x = _add_pos_embed(emb.transpose(1, 0, 2), shared.get("pos_embed"),
+                           config, cp_axis)
         x = x.astype(config.compute_dtype)
         if sp:
             from apex_tpu.transformer.tensor_parallel.mappings import (

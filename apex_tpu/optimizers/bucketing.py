@@ -55,6 +55,7 @@ __all__ = [
     "BucketLeaf", "BucketSpec", "BucketPlan", "Buckets", "plan_of",
     "plan_of_shapes", "padded_total", "pack", "pack_bucket", "unpack",
     "per_leaf_reduce", "seg_values", "seg_broadcast", "seg_ids",
+    "buckets_by_stage",
 ]
 
 
@@ -300,6 +301,21 @@ def seg_ids(plan: BucketPlan, bucket: BucketSpec) -> np.ndarray:
     if bucket.pad:
         parts.append(np.full(bucket.pad, plan.n_leaves, np.int32))
     return np.concatenate(parts) if parts else np.zeros(0, np.int32)
+
+
+def buckets_by_stage(plan: BucketPlan, leaf_stages: Sequence[int],
+                     n_stages: int) -> List[List[int]]:
+    """Group bucket indices by gradient-readiness stage for the
+    backward-overlapped sync: a bucket can only be packed and wired
+    once EVERY leaf in it has a cotangent, so its stage is the max of
+    its leaves' (``leaf_stages`` indexed by ``leaf_id``).  Each stage's
+    list keeps ascending bucket order — the stable (readiness,
+    bucket_index) wire order of ``make_train_step(overlap_grad_sync=
+    True)``."""
+    out: List[List[int]] = [[] for _ in range(n_stages)]
+    for bi, b in enumerate(plan.buckets):
+        out[max(leaf_stages[bl.leaf_id] for bl in b.leaves)].append(bi)
+    return out
 
 
 def seg_broadcast(bucket: BucketSpec, per_leaf: Sequence):

@@ -45,6 +45,10 @@ DCN_AXIS = "dcn"
 # registry (discover_axis_registry) knows them like every other axis.
 DATA_OUTER_AXIS = "dp_out"
 DATA_INNER_AXIS = "dp_in"
+#: the canonical three-level dp split, slow to fast — the ``dp_axes=``
+#: spelling of a multi-pod deployment (cross-DCN x cross-slice x
+#: intra-slice); the two-level spelling is its ``[1:]`` suffix
+HIER_DP_AXES = (DCN_AXIS, DATA_OUTER_AXIS, DATA_INNER_AXIS)
 AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
 

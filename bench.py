@@ -754,26 +754,53 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
     targets = jnp.roll(tokens, -1, axis=1)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params0))
 
-    def time_mode(optimizer, state, sspec, use_mesh=None, dp_axis="dp"):
+    def time_mode(optimizer, state, sspec, use_mesh=None, dp_axis="dp",
+                  overlap=False):
+        import contextlib
+
+        from apex_tpu.observability import tracing
+
         m = mesh if use_mesh is None else use_mesh
         step = make_train_step(cfg, optimizer, m, donate_state=True,
-                               opt_state_spec=sspec, dp_axis=dp_axis)
+                               opt_state_spec=sspec, dp_axis=dp_axis,
+                               overlap_grad_sync=overlap)
+        run = step
+        if overlap:
+            # emit the wire-plan markers while the dispatch span is
+            # live: tracing.overlap_fraction then reports the span
+            # concurrency of the sync plan against step dispatch — the
+            # host-observable overlap column (the collectives run on
+            # device; PR 14's zero-overhead contract forbids per-hop
+            # host timing inside the step)
+            def dispatch(*a):
+                r = step(*a)
+                tracing.emit_sync_plan(optimizer)
+                return r
+
+            run = tracing.TracedStep(dispatch, name="train.step.dispatch")
         params = jax.tree.map(lambda x: x.copy(), params0)
         live = _per_device_bytes(params, pspecs, m) + \
             _per_device_bytes(state, sspec, m)
         params, state, loss = step(params, state, tokens, targets)
         block(loss)
         n = 1 if _SMOKE else iters
-        t0 = time.perf_counter()
-        for _ in range(n):
-            params, state, loss = step(params, state, tokens, targets)
-        block(loss)
-        dt = (time.perf_counter() - t0) / n
-        return {
-            "tokens_per_sec": round(tokens.size / dt, 0),
-            "ms_per_step": round(dt * 1e3, 2),
-            "live_bytes_per_device_mb": round(live / 2 ** 20, 1),
-        }
+        scope = tracing.TracingScope() if overlap else \
+            contextlib.nullcontext()
+        with scope as tracer:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                params, state, loss = run(params, state, tokens, targets)
+            block(loss)
+            dt = (time.perf_counter() - t0) / n
+            rec = {
+                "tokens_per_sec": round(tokens.size / dt, 0),
+                "ms_per_step": round(dt * 1e3, 2),
+                "live_bytes_per_device_mb": round(live / 2 ** 20, 1),
+            }
+            if overlap:
+                rec["overlap_fraction"] = round(
+                    tracing.overlap_fraction(tracer), 3)
+        return rec
 
     out = {"dp": dp, "params_m": round(n_params / 1e6, 1),
            "batch": int(tokens.shape[0])}
@@ -841,6 +868,75 @@ def bench_zero_gpt124(iters=8, dp=None, layers=12, hidden=768, heads=12,
         out[label]["cross_slice_wire_cut"] = round(
             out[flat_label]["wire_bytes_per_step"]
             / wb["hops"]["dp_out"]["grad_sync"], 1)
+
+    # backward-overlapped sync modes (overlap_grad_sync=True): the
+    # SAME wire plans with each bucket's hop-1 collective issued as its
+    # grads materialize inside the segmented backward.  Loss/params are
+    # bitwise vs the unoverlapped builds (tests/
+    # test_distributed_optimizers.py pins it); what moves is the trace
+    # placement, reported as the overlap_fraction span-concurrency
+    # column and the ms_per_step delta.
+    # --smoke builds only overlap_3level below: it compiles the deepest
+    # overlap path (segmented backward + three requantizing hops), a
+    # strict superset of the flat and two-level builds, and each
+    # overlap mode is a full extra train-step compile.
+    if not _SMOKE:
+        _progress("zero_gpt124: overlap_flat...")
+        zopt = DistributedFusedAdam(lr=3e-4, weight_decay=0.1,
+                                    axis_name="dp")
+        zstate = zopt.init(params0, world_size=dp)
+        out["overlap_flat"] = time_mode(zopt, zstate,
+                                        zopt.state_partition_spec(),
+                                        overlap=True)
+        out["overlap_flat"]["speedup_vs_unoverlapped"] = round(
+            out["zero_fp32_master"]["ms_per_step"]
+            / max(out["overlap_flat"]["ms_per_step"], 1e-9), 3)
+
+        _progress("zero_gpt124: overlap_hier_int8...")
+        zopt = DistributedFusedAdam(lr=3e-4, weight_decay=0.1,
+                                    dp_axes=("dp_out", "dp_in"),
+                                    grad_sync_dtype="int8")
+        zstate = zopt.init(params0, world_size=dp,
+                           axis_sizes={"dp_out": dp_out, "dp_in": dp_in})
+        out["overlap_hier_int8"] = time_mode(
+            zopt, zstate, zopt.state_partition_spec(), use_mesh=mesh_h,
+            dp_axis=("dp_out", "dp_in"), overlap=True)
+        out["overlap_hier_int8"]["speedup_vs_unoverlapped"] = round(
+            out["hier_int8_sync"]["ms_per_step"]
+            / max(out["overlap_hier_int8"]["ms_per_step"], 1e-9), 3)
+
+    # three-level (dcn, dp_out, dp_in) hop pipeline: the dcn hop moves
+    # exactly 1/(dp_in*dp_out) of the flat plan's bytes at equal wire
+    # dtype — the cross_dcn_wire_cut column.  dp=8 models the
+    # two-datacenter pod as (2, 2, 2); a single chip degenerates to
+    # the (1, 1, 1) mesh, which still compiles the three-hop path
+    # (--smoke covers it on CPU).
+    dcn = 2 if dp % 4 == 0 else 1
+    d3_out = 2 if (dp // dcn) % 2 == 0 else 1
+    d3_in = dp // (dcn * d3_out)
+    mesh3 = Mesh(np.array(devs[:dp]).reshape(dcn, d3_out, d3_in, 1),
+                 ("dcn", "dp_out", "dp_in", "tp"))
+    zopt = DistributedFusedAdam(lr=3e-4, weight_decay=0.1,
+                                dp_axes=("dcn", "dp_out", "dp_in"),
+                                grad_sync_dtype="int8")
+    zstate = zopt.init(params0, world_size=dp,
+                       axis_sizes={"dcn": dcn, "dp_out": d3_out,
+                                   "dp_in": d3_in})
+    _progress(f"zero_gpt124: overlap_3level "
+              f"(dcn={dcn}, dp_out={d3_out}, dp_in={d3_in})...")
+    out["overlap_3level"] = time_mode(
+        zopt, zstate, zopt.state_partition_spec(), use_mesh=mesh3,
+        dp_axis=("dcn", "dp_out", "dp_in"), overlap=True)
+    wb = zopt.wire_bytes_per_step()
+    out["overlap_3level"]["wire_bytes_per_step"] = wb["grad_sync"]
+    out["overlap_3level"]["wire_bytes_per_hop"] = wb["hops"]
+    out["overlap_3level"]["cross_dcn_grad_sync_bytes"] = \
+        wb["hops"]["dcn"]["grad_sync"]
+    # the 3-level headline: slowest-hop bytes vs the flat int8 plan —
+    # exactly dp_in * dp_out at any model size, scales included
+    out["overlap_3level"]["cross_dcn_wire_cut"] = round(
+        out["zero_int8_sync"]["wire_bytes_per_step"]
+        / wb["hops"]["dcn"]["grad_sync"], 1)
 
     # the compressed-sync headline: grad-sync wire bytes vs the
     # default-wire ZeRO mode (bf16 buckets sync bf16)

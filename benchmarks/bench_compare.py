@@ -48,7 +48,9 @@ HEADLINE_LEAVES = (
     "mfu_vs_measured_roofline",
     "tokens_per_sec",
     "cross_slice_wire_cut",
+    "cross_dcn_wire_cut",
     "wire_cut_vs_default",
+    "overlap_fraction",
 )
 
 

@@ -1442,3 +1442,314 @@ class TestStepBuilderSeam:
         opt = DistributedFusedAdam(lr=1e-3, axis_name="data")  # wrong axis
         with pytest.raises(ValueError, match="dp"):
             make_train_step(cfg, opt, mesh)
+
+
+# ------------------------------------------------- 3-level (dcn) sync
+DCN_AXES = ("dcn", "dp_out", "dp_in")
+DCN_SIZES = {"dcn": 2, "dp_out": 2, "dp_in": 2}
+
+
+def dcn_mesh(devices8):
+    return Mesh(np.array(devices8).reshape(2, 2, 2), DCN_AXES)
+
+
+class TestThreeLevelGradSync:
+    """The (dcn, dp_out, dp_in) three-hop split: flat-parity bitwise on
+    dyadic grads, the three-hop residual telescoping with the dcn hop's
+    requantization error provably in the residual, the exact
+    ``1/(dp_in·dp_out)`` cross-DCN wire fraction, and validation."""
+
+    def test_wide_fp32_bitwise_vs_flat_dp8(self, devices8):
+        """Three hops reassociate the dp sum as ((a+b)+(c+d))+… — on
+        exactly-representable grads that is exact either way, so the
+        (2, 2, 2) engine equals flat dp=8 BITWISE over 4 steps, the
+        same acceptance the two-level split carries at dp=4."""
+        params = make_mixed_tree()
+        flat = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                    axis_name="dp")
+        s_f = flat.init(params, world_size=DP)
+        hier = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                    dp_axes=DCN_AXES)
+        s_h = hier.init(params, world_size=DP, axis_sizes=DCN_SIZES)
+        assert hier.hier_plan.world == DP
+        mesh_f = Mesh(np.array(devices8), ("dp",))
+        mesh_h = dcn_mesh(devices8)
+
+        # one jitted step per engine, reused across the loop — the
+        # shared zero_step retraces per call, which dominates this
+        # test's wall time; both sides run the SAME jitted pipeline so
+        # the bitwise comparison stays apples-to-apples
+        def stepper(dist, mesh):
+            sspec = dist.state_partition_spec()
+            return jax.jit(jax.shard_map(
+                lambda p, s, gg: dist.update(gg, s, p),
+                mesh=mesh, in_specs=(P(), sspec, P()),
+                out_specs=(P(), sspec), check_vma=False))
+
+        step_f, step_h = stepper(flat, mesh_f), stepper(hier, mesh_h)
+        p_f = p_h = params
+        rng = np.random.RandomState(51)
+        for _ in range(4):
+            g = exact_grads(rng, params)
+            p_f, s_f = step_f(p_f, s_f, g)
+            p_h, s_h = step_h(p_h, s_h, g)
+        assert_bitwise(p_f, p_h)
+        for a, b in zip(jax.tree.leaves(s_f), jax.tree.leaves(s_h)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_three_hop_requantization_telescopes_bitwise(self, devices8):
+        """The crafted dyadic-scale identity at THREE hops:
+        transmitted + Σ_r residual_r == Σ_r h_r bitwise on the
+        (2, 2, 2) mesh.  Per dcn group, dp_out slice 0 carries the
+        (126, 128)·scale dp_in pins and dp_out slice 1 is all zeros,
+        which pins every hop's shared scale dyadic: hop 1 gets
+        s₁ = 2·scale (254/127); hop 2 sees per-block amaxes 254·scale
+        from slice 0 and 0 from slice 1, so s₂ = 2·scale and the
+        requantization 254/2 = 127 ≤ bound 127 is EXACT; hop 3 (dcn)
+        sums 254 + 254 → s₃ = 4·scale, and its requantization rounds
+        the pinned 254/4 = 63.5 up then clips to the 63 bound — leaving
+        exactly ±2·scale per dcn rank, the cross-DCN hop's error landing
+        in the residual."""
+        from apex_tpu.contrib.optimizers import _hierarchical_sync as hsync
+        from apex_tpu.contrib.optimizers import _quantized_sync as qs
+
+        spec = qs.qspec_of("int8")
+        plan = hsync.hierarchical_plan(DCN_AXES, DCN_SIZES)
+        mesh = dcn_mesh(devices8)
+        N = 8 * qs.QBLOCK  # 8 blocks/rank; dcn chunk = 1 block
+        rng = np.random.RandomState(0)
+
+        def craft(scale):
+            h = (rng.randint(-100, 101, size=(8, N)) * scale
+                 ).astype(np.float32)
+            for d in range(8):  # d = dcn*4 + dp_out*2 + dp_in
+                if (d // 2) % 2 == 1:  # dp_out slice 1: silent
+                    h[d] = 0.0
+                    continue
+                pin = 126.0 if d % 2 == 0 else 128.0
+                for b in range(N // qs.QBLOCK):
+                    h[d, b * qs.QBLOCK] = pin * scale * (-1.0) ** b
+            return h
+
+        def one(h_stack):
+            def f(h):
+                h = h.reshape(-1)
+                shard, res = hsync.quantized_multi_hop_reduce_scatter(
+                    h, plan, spec)
+                full = hsync.multi_hop_all_gather(shard, plan)
+                return full[None], res[None]
+
+            out = jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=P(DCN_AXES),
+                out_specs=(P(DCN_AXES), P(DCN_AXES)),
+                check_vma=False))(h_stack)
+            return map(np.asarray, out)
+
+        for scale in (1.0, 4.0):
+            h = craft(scale)
+            t, res = one(jnp.asarray(h))
+            lhs = t[0] + res.sum(axis=0)
+            rhs = h.sum(axis=0)
+            np.testing.assert_array_equal(
+                lhs.view(np.uint32), rhs.view(np.uint32))
+            # hop-1 error engaged (odd rng ints halve inexactly)...
+            assert np.abs(res).max() > 0
+            # ...hop 2 is exact by construction, and the hop-3 (dcn)
+            # requantization error telescopes: rank (0,0,0) owns block
+            # 0, where hop 1 is exact (126/2, 128/2 integral), hop 2 is
+            # exact (254/2 = 127 at the 127 bound), and hop 3 clips
+            # 63.5 → 63 — exactly +2·scale in its residual
+            assert abs(res[0, 0] - 2.0 * scale) < 1e-6
+
+    def test_cross_dcn_wire_bytes_exact_fraction(self):
+        """The acceptance Fraction: the slowest (dcn) hop carries
+        EXACTLY 1/(dp_in·dp_out) of the flat plan's grad-sync bytes at
+        the same wire dtype — scales included, as exact rationals, not
+        a float ratio."""
+        from fractions import Fraction
+
+        params = {"w": jnp.zeros((8 * 1024,), jnp.float32)}
+        flat = DistributedFusedAdam(lr=1e-3, axis_name="dp",
+                                    grad_sync_dtype="int8")
+        flat.init(params, world_size=DP)
+        h3 = DistributedFusedAdam(lr=1e-3, dp_axes=DCN_AXES,
+                                  grad_sync_dtype="int8")
+        h3.init(params, world_size=DP, axis_sizes=DCN_SIZES)
+        wf = flat.wire_bytes_per_step()
+        w3 = h3.wire_bytes_per_step()
+        assert set(w3["hops"]) == set(DCN_AXES)
+        base = wf["hops"]["dp"]
+        cut = Fraction(1, DCN_SIZES["dp_in"] * DCN_SIZES["dp_out"])
+        for key in ("grad_payload", "grad_scales", "grad_sync",
+                    "param_sync"):
+            assert Fraction(w3["hops"]["dcn"][key], base[key]) == cut
+            assert Fraction(w3["hops"]["dp_out"][key], base[key]) \
+                == Fraction(1, DCN_SIZES["dp_in"])
+            assert w3["hops"]["dp_in"][key] == base[key]
+
+    def test_three_level_validation(self, devices8):
+        params = make_tree()
+        with pytest.raises(ValueError, match="two or three"):
+            DistributedFusedAdam(lr=1e-3, dp_axes=("a", "b", "c", "d"))
+        with pytest.raises(ValueError, match="distinct"):
+            DistributedFusedAdam(lr=1e-3, dp_axes=("dcn", "dp", "dp"))
+        opt = DistributedFusedAdam(lr=1e-3, dp_axes=DCN_AXES)
+        with pytest.raises(ValueError, match="axis_sizes"):
+            opt.init(params, world_size=8,
+                     axis_sizes={"dcn": 2, "dp_out": 2})
+        with pytest.raises(ValueError, match="world_size"):
+            DistributedFusedAdam(lr=1e-3, dp_axes=DCN_AXES).init(
+                params, world_size=4, axis_sizes=DCN_SIZES)
+
+
+# --------------------------------------------- backward-overlapped sync
+class TestOverlappedGradSync:
+    """``make_train_step(overlap_grad_sync=True)``: each bucket's sync
+    collective is traced inside the backward, between the segment vjps
+    — the SAME per-bucket ops on the SAME values as the unoverlapped
+    build, merely reordered in the trace.  So fp32 losses and params
+    are pinned BITWISE against ``overlap_grad_sync=False`` (Adam and
+    LAMB, flat and hierarchical), and the quantized wires too (the
+    error-feedback chain sees identical inputs).  The interleaved
+    lowering itself is pinned in tests/test_lowered_invariants.py."""
+
+    CFG = dict(vocab_size=64, hidden_size=32, num_layers=2,
+               num_attention_heads=4, max_seq_len=16,
+               compute_dtype=jnp.float32, checkpoint_layers=False)
+
+    def _pair(self, devices8, make_opt, topo, scaler=None,
+              grad_sync_dtype=None, steps=5):
+        """Run overlap on/off through the real step builder; assert
+        loss lists equal and params bitwise."""
+        from apex_tpu.models.gpt import (
+            GPTConfig, init_params, make_train_step,
+        )
+
+        cfg = GPTConfig(**self.CFG)
+        params0 = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        data = [jnp.asarray(rng.randint(0, 64, size=(8, 16)))
+                for _ in range(steps)]
+        devs = np.array(devices8)
+        if topo == "flat":
+            mesh = Mesh(devs.reshape(8, 1), ("dp", "tp"))
+            dp_axis, sizes = "dp", None
+        elif topo == "hier":
+            mesh = Mesh(devs.reshape(2, 4, 1), ("dp_out", "dp_in", "tp"))
+            dp_axis, sizes = HIER_AXES, {"dp_out": 2, "dp_in": 4}
+        else:  # "dcn"
+            mesh = Mesh(devs.reshape(2, 2, 2, 1),
+                        ("dcn", "dp_out", "dp_in", "tp"))
+            dp_axis, sizes = DCN_AXES, dict(DCN_SIZES)
+
+        def run(overlap):
+            opt = make_opt(dp_axis)
+            if hasattr(opt, "state_partition_spec"):
+                state = opt.init(params0, world_size=DP,
+                                 axis_sizes=sizes)
+            else:
+                state = opt.init(params0)
+            kw = {"loss_scaler": scaler} if scaler else {}
+            step = make_train_step(cfg, opt, mesh, dp_axis=dp_axis,
+                                   overlap_grad_sync=overlap,
+                                   grad_sync_dtype=grad_sync_dtype,
+                                   donate_state=True, **kw)
+            p = jax.tree.map(lambda x: x.copy(), params0)
+            sc = scaler.init() if scaler else None
+            losses = []
+            for tok in data:
+                tgt = jnp.roll(tok, -1, axis=1)
+                if scaler:
+                    p, state, sc, loss = step(p, state, sc, tok, tgt)
+                else:
+                    p, state, loss = step(p, state, tok, tgt)
+                losses.append(float(loss))
+            return losses, p
+
+        base, ovl = run(False), run(True)
+        assert ovl[0] == base[0], \
+            f"{topo}: losses diverged {base[0]} vs {ovl[0]}"
+        assert_bitwise(ovl[1], base[1], err=f"{topo}: ")
+
+    @pytest.mark.parametrize("topo", ["flat", "hier"])
+    @pytest.mark.parametrize("opt_cls", [DistributedFusedAdam,
+                                         DistributedFusedLAMB])
+    def test_fp32_bitwise_vs_unoverlapped(self, devices8, topo, opt_cls):
+        """The headline acceptance: 5 fp32 steps, flat dp=8 and the
+        (2, 4) hierarchical split, Adam and LAMB — losses equal,
+        params bitwise."""
+        def mk(dp_axis):
+            kw = ({"dp_axes": dp_axis} if isinstance(dp_axis, tuple)
+                  else {"axis_name": dp_axis})
+            return opt_cls(lr=1e-3, weight_decay=0.01,
+                           bucket_cap_mb=0.02, **kw)
+
+        self._pair(devices8, mk, topo)
+
+    def test_fp32_bitwise_three_level(self, devices8):
+        """The (dcn, dp_out, dp_in) pipeline: per-hop wires issued
+        inside the backward, still bitwise vs the unoverlapped trace."""
+        self._pair(devices8,
+                   lambda ax: DistributedFusedAdam(
+                       lr=1e-3, bucket_cap_mb=0.02, dp_axes=ax),
+                   "dcn")
+
+    @pytest.mark.parametrize("topo,wire", [
+        ("flat", "int8"),
+        # the dcn leg re-proves what flat-int8 + the fp32 three-level
+        # pair already pin — extra assurance, slow tier
+        pytest.param("dcn", "int8", marks=pytest.mark.slow),
+        ("flat", "float8_e5m2")])
+    def test_quantized_wire_bitwise(self, devices8, topo, wire):
+        """The compressed wires: identical per-bucket quantize →
+        scatter → dequantize chains on identical cotangents, so the
+        overlap build is bitwise too — stronger than the PR 6
+        convergence band the wire itself is held to."""
+        def mk(dp_axis):
+            kw = ({"dp_axes": dp_axis} if isinstance(dp_axis, tuple)
+                  else {"axis_name": dp_axis})
+            return DistributedFusedAdam(lr=1e-3, bucket_cap_mb=0.02,
+                                        grad_sync_dtype=wire, **kw)
+
+        self._pair(devices8, mk, topo)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("topo", ["flat", "dcn"])
+    def test_replicated_quantized_overlap_bitwise(self, devices8, topo):
+        """The non-ZeRO per-bucket path (``grad_sync_dtype=`` on a
+        replicated optimizer): quantized pmean per bucket inside the
+        backward, bitwise vs the post-backward sweep."""
+        self._pair(devices8, lambda ax: FusedAdam(lr=1e-3), topo,
+                   grad_sync_dtype="int8")
+
+    @pytest.mark.slow
+    def test_scaled_lamb_overlap_bitwise(self, devices8):
+        """Loss scaling composes: the wires carry SCALED cotangents
+        (unscale folds into the update tail), so the scaler variant is
+        bitwise too — hierarchical LAMB, the hardest composition."""
+        from apex_tpu.amp import DynamicLossScaler
+
+        self._pair(devices8,
+                   lambda ax: DistributedFusedLAMB(
+                       lr=1e-3, bucket_cap_mb=0.02, dp_axes=ax),
+                   "hier", scaler=DynamicLossScaler(init_scale=2.0 ** 10))
+
+    def test_overlap_validation(self, devices8):
+        """The knob fails loudly where there is nothing to overlap:
+        GSPMD (no explicit collectives), dp_axis=None (no dp sync),
+        and a replicated optimizer without a per-bucket wire."""
+        from apex_tpu.models.gpt import GPTConfig, make_train_step
+
+        cfg = GPTConfig(**self.CFG)
+        devs = np.array(devices8)
+        mesh = Mesh(devs.reshape(8, 1), ("dp", "tp"))
+        with pytest.raises(NotImplementedError, match="GSPMD"):
+            make_train_step(cfg, FusedAdam(lr=1e-3), mesh,
+                            spmd="auto", overlap_grad_sync=True)
+        with pytest.raises(ValueError, match="dp_axis=None"):
+            make_train_step(cfg, FusedAdam(lr=1e-3),
+                            Mesh(devs.reshape(8, 1), ("x", "tp")),
+                            dp_axis=None, overlap_grad_sync=True)
+        with pytest.raises(ValueError, match="per-bucket dp grad sync"):
+            make_train_step(cfg, FusedAdam(lr=1e-3), mesh,
+                            overlap_grad_sync=True)

@@ -252,6 +252,60 @@ class TestHierarchicalElasticChain:
         np.testing.assert_allclose(hier_losses + flat_losses, ref[2:6],
                                    rtol=0.05)
 
+    def test_three_level_checkpoint_restores_flat_and_two_level(
+            self, rig, tmp_path, devices8):
+        """A checkpoint saved on the (dcn, dp_out, dp_in) = (2, 2, 2)
+        mesh restores into a flat dp=8 optimizer AND a two-level
+        (2, 4) one bitwise, with no special case: shard ownership is
+        the flat chunk-per-rank layout under ONE ``padded_total``
+        formula at every hop depth, and the index records only the dp
+        world."""
+        mesh3 = Mesh(np.array(devices8).reshape(2, 2, 2, 1),
+                     ("dcn", "dp_out", "dp_in", "tp"))
+        axes3 = ("dcn", "dp_out", "dp_in")
+        sizes3 = {"tp": 1, "dcn": 2, "dp_out": 2, "dp_in": 2}
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        opt3 = DistributedFusedAdam(
+            lr=1e-2, weight_decay=0.01, dp_axes=axes3,
+            grad_sync_dtype="int8")
+        state = opt3.init(params, world_size=8,
+                          param_specs=param_specs(CFG), axis_sizes=sizes3)
+        step3 = make_train_step(CFG, opt3, mesh3, dp_axis=axes3)
+        params, state, _ = step3(params, state, *batch(0))
+        save_elastic_checkpoint(
+            tmp_path, 1, params=params, opt_state=state, optimizer=opt3,
+            world_size=8, mesh_axes={"tp": 1})
+
+        # flat dp=8 restore: same world, bitwise, no reshard
+        opt_f, _, step_f, _ = rig("zero_int8", 8)
+        r = restore_elastic_checkpoint(
+            tmp_path, optimizer=opt_f, world_size=8, mesh_axes={"tp": 1})
+        assert r is not None and r.saved_world == 8 and not r.resharded
+        for a, b in zip(jax.tree.leaves(state),
+                        jax.tree.leaves(r.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        _, _, loss = step_f(r.params, r.opt_state, *batch(1))
+        assert np.isfinite(float(loss))
+
+        # two-level (2, 4) restore: same world, bitwise, no reshard
+        mesh2 = Mesh(np.array(devices8).reshape(2, 4, 1),
+                     ("dp_out", "dp_in", "tp"))
+        opt2 = DistributedFusedAdam(
+            lr=1e-2, weight_decay=0.01, dp_axes=("dp_out", "dp_in"),
+            grad_sync_dtype="int8")
+        opt2.init(params, world_size=8, param_specs=param_specs(CFG),
+                  axis_sizes={"tp": 1, "dp_out": 2, "dp_in": 4})
+        r2 = restore_elastic_checkpoint(
+            tmp_path, optimizer=opt2, world_size=8, mesh_axes={"tp": 1})
+        assert r2 is not None and not r2.resharded
+        for a, b in zip(jax.tree.leaves(state),
+                        jax.tree.leaves(r2.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        step2 = make_train_step(CFG, opt2, mesh2,
+                                dp_axis=("dp_out", "dp_in"))
+        _, _, loss2 = step2(r2.params, r2.opt_state, *batch(1))
+        assert np.isfinite(float(loss2))
+
     def test_hier_checkpoint_restores_flat_without_special_case(
             self, rig, tmp_path, devices8):
         """A checkpoint SAVED on the hierarchical mesh restores into a

@@ -403,3 +403,107 @@ class TestGspmdStepParity:
         self._compare(mesh, lambda: FusedAdam(lr=1e-2),
                       self._adam_sspec(), loss_atol=1.5e-6,
                       param_atol=5e-4, bitwise_losses=False)
+
+
+class TestGspmdStepTails:
+    """The loss_scaler / StepGuard / telemetry tails on the
+    ``spmd="auto"`` path: on global arrays the finite vote is a plain
+    reduction (``sync_axes=()`` makes ``sync_found_inf`` the identity),
+    so the SAME ``_apply_*_update`` tails serve both builders — pinned
+    here as shard_map-oracle parity at dp=4, the mesh where the plain
+    losses are already bitwise."""
+
+    STEPS = 5
+
+    def _mesh(self, devices8):
+        return Mesh(np.array(devices8[:4]).reshape(4, 1), ("dp", "tp"))
+
+    def _sspec(self):
+        from apex_tpu.optimizers.fused_adam import AdamState
+
+        specs = param_specs(CFG)
+        return AdamState(step=P(), exp_avg=specs, exp_avg_sq=specs,
+                         master=None)
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(8, 16)))
+        return tokens, jnp.roll(tokens, -1, axis=1)
+
+    def _run(self, mesh, spmd, **step_kw):
+        from apex_tpu.models.gpt import make_train_step
+
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        state = opt.init(params)
+        step = make_train_step(CFG, opt, mesh,
+                               opt_state_spec=self._sspec(), spmd=spmd,
+                               **step_kw)
+        tokens, targets = self._data()
+        extras = []
+        if "loss_scaler" in step_kw:
+            extras.append(step_kw["loss_scaler"].init())
+        if "step_guard" in step_kw:
+            extras.append(step_kw["step_guard"].init())
+        if "telemetry" in step_kw:
+            extras.append(step_kw["telemetry"].init())
+        losses = []
+        for _ in range(self.STEPS):
+            out = step(params, state, *extras, tokens, targets)
+            params, state, *extras, loss = out
+            losses.append(float(loss))
+        return losses, params, extras
+
+    def _parity(self, devices8, **step_kw):
+        mesh = self._mesh(devices8)
+        lo, po, eo = self._run(mesh, "shard_map", **step_kw)
+        lg, pg, eg = self._run(mesh, "auto", **step_kw)
+        assert lo == lg, f"losses diverged: {lo} vs {lg}"
+        for (ka, a), b in zip(
+                jax.tree_util.tree_flatten_with_path(po)[0],
+                jax.tree_util.tree_leaves(pg)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=0, atol=5e-4,
+                err_msg=f"{jax.tree_util.keystr(ka)}")
+        return eo, eg
+
+    def test_loss_scaler_parity(self, devices8):
+        """All-finite fp32 run: identical scaler trajectory (growth
+        schedule included) and bitwise losses vs the oracle."""
+        from apex_tpu.amp import DynamicLossScaler
+
+        scaler = DynamicLossScaler(init_scale=2.0 ** 6, growth_interval=2)
+        eo, eg = self._parity(devices8, loss_scaler=scaler)
+        assert float(eo[0].loss_scale) == float(eg[0].loss_scale)
+        assert float(eg[0].loss_scale) > 2.0 ** 6  # growth engaged
+
+    def test_step_guard_parity(self, devices8):
+        from apex_tpu.resilience import StepGuard
+
+        guard = StepGuard(max_consecutive_bad=3)
+        eo, eg = self._parity(devices8, step_guard=guard)
+        assert int(eo[0].total_skipped) == int(eg[0].total_skipped) == 0
+
+    def test_telemetry_parity(self, devices8):
+        """Telemetry rides the auto path: same losses as the oracle's
+        instrumented run, and the window really observed the steps."""
+        from apex_tpu.observability import stepstats
+
+        tel = stepstats.StepTelemetry()
+        eo, eg = self._parity(devices8, telemetry=tel)
+        assert int(eg[0].steps) == self.STEPS
+        assert int(eo[0].steps) == self.STEPS
+        assert np.isfinite(float(eg[0].loss_last))
+
+    def test_scaled_guarded_parity(self, devices8):
+        """The composed tail (scaler + guard) — the full fp16-style
+        harness on plain jit + NamedSharding."""
+        from apex_tpu.amp import DynamicLossScaler
+        from apex_tpu.resilience import StepGuard
+
+        scaler = DynamicLossScaler(init_scale=2.0 ** 6)
+        guard = StepGuard(max_consecutive_bad=3)
+        eo, eg = self._parity(devices8, loss_scaler=scaler,
+                              step_guard=guard)
+        assert float(eo[0].loss_scale) == float(eg[0].loss_scale)
+        assert int(eo[1].total_skipped) == int(eg[1].total_skipped) == 0

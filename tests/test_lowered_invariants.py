@@ -288,6 +288,112 @@ class TestHierarchicalZeroTrainStep:
         lw.assert_donation_covers(low, params, state, compiled=True)
 
 
+class TestOverlappedInterleaving:
+    """The backward-overlap tentpole pin (ISSUE 18): with
+    ``overlap_grad_sync=True`` at least one pair of consecutive grad
+    reduce-scatters has backward ``dot_general`` compute BETWEEN them
+    in program order (bucket k's sync is in flight while a later
+    segment's backward still runs — the shape the latency-hiding
+    scheduler overlaps), while the knob off keeps the old
+    all-at-the-end shape with zero dots between any pair.  The
+    per-bucket collective count/dtype pins of PR 12/16 must hold
+    UNCHANGED under overlap — only placement moves.
+
+    The config needs final-LN leaves that fill a whole bucket tile
+    (hidden 512: bias + scale = 1024 fp32 elements) so a pure
+    head-stage bucket exists; with tiny hidden sizes the final-LN
+    leaves share a bucket with layer leaves and every bucket becomes
+    ready at the same backward stage — nothing to interleave."""
+
+    OVL_CFG = GPTConfig(vocab_size=64, hidden_size=512, num_layers=2,
+                        num_attention_heads=4, max_seq_len=16,
+                        compute_dtype=jnp.float32,
+                        checkpoint_layers=False)
+
+    def _flat(self, devices8, overlap, **opt_kw):
+        params = init_params(self.OVL_CFG, jax.random.PRNGKey(0))
+        opt = DistributedFusedAdam(lr=1e-2, axis_name="dp",
+                                   bucket_cap_mb=TINY_CAP_MB, **opt_kw)
+        state = opt.init(params, world_size=DP)
+        step = make_train_step(self.OVL_CFG, opt, _mesh(devices8),
+                               donate_state=True,
+                               overlap_grad_sync=overlap)
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, self.OVL_CFG.vocab_size,
+                                         size=(DP, 16)))
+        return (step.lower(params, state, tokens,
+                           jnp.roll(tokens, -1, axis=1)), opt)
+
+    def test_flat_overlap_interleaves_scatters_with_backward(
+            self, devices8):
+        low, opt = self._flat(devices8, True)
+        n = len(opt._plan.buckets)
+        txt = low.as_text()
+        mesh = _mesh(devices8)
+        # the PR 12 count pin holds under overlap: still exactly one
+        # f32 scatter per bucket on dp — only trace placement moved
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp",), mesh,
+                                  minimum=n, maximum=n, dtype="f32")
+        gaps = lw.assert_interleaved(txt, "reduce_scatter", axes=("dp",),
+                                     mesh=mesh, gaps="any")
+        assert len(gaps) == n - 1
+
+    def test_flat_unoverlapped_scatters_all_after_backward(
+            self, devices8):
+        low, _opt = self._flat(devices8, False)
+        lw.assert_interleaved(low.as_text(), "reduce_scatter",
+                              axes=("dp",), mesh=_mesh(devices8),
+                              gaps="none")
+
+    def test_int8_overlap_interleaves_on_the_compressed_wire(
+            self, devices8):
+        low, opt = self._flat(devices8, True, grad_sync_dtype="int8")
+        n = len(opt._plan.buckets)
+        txt = low.as_text()
+        mesh = _mesh(devices8)
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp",), mesh,
+                                  minimum=n, maximum=n, dtype="i8")
+        lw.assert_collective_dtype(txt, "reduce_scatter", "f32",
+                                   mode="none")
+        lw.assert_interleaved(txt, "reduce_scatter", axes=("dp",),
+                              mesh=mesh, dtype="i8", gaps="any")
+
+    def test_hier_overlap_interleaves_per_hop(self, devices8):
+        params = init_params(self.OVL_CFG, jax.random.PRNGKey(0))
+        opt = DistributedFusedAdam(lr=1e-2, dp_axes=HIER_AXES,
+                                   bucket_cap_mb=TINY_CAP_MB)
+        state = opt.init(params, world_size=4,
+                         axis_sizes={"dp_out": 2, "dp_in": 2, "tp": 1})
+        step = make_train_step(self.OVL_CFG, opt, _hier_mesh(devices8),
+                               dp_axis=HIER_AXES, donate_state=True,
+                               overlap_grad_sync=True)
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, self.OVL_CFG.vocab_size,
+                                         size=(4, 16)))
+        low = step.lower(params, state, tokens,
+                         jnp.roll(tokens, -1, axis=1))
+        n = len(opt._plan.buckets)
+        txt = low.as_text()
+        mesh = _hier_mesh(devices8)
+        # both hops keep their per-bucket counts under overlap...
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_in",),
+                                  mesh, minimum=n, maximum=n, dtype="f32")
+        lw.assert_collective_axes(txt, "reduce_scatter", ("dp_out",),
+                                  mesh, minimum=n, maximum=n, dtype="f32")
+        # ...and each hop's scatter stream interleaves with backward
+        for hop in (("dp_in",), ("dp_out",)):
+            lw.assert_interleaved(txt, "reduce_scatter", axes=hop,
+                                  mesh=mesh, gaps="any")
+
+    def test_checker_self_consistency(self):
+        with pytest.raises(ValueError, match="at least two"):
+            lw.interleave_gaps("module {}")
+        with pytest.raises(ValueError, match="gaps"):
+            lw.assert_interleaved(
+                'x = "stablehlo.reduce_scatter"(a)\n'
+                'y = "stablehlo.reduce_scatter"(b)\n', gaps="bogus")
+
+
 class TestHierarchicalQuantizedReplicatedStep:
     """``make_train_step(grad_sync_dtype=..., dp_axis=(outer, inner))``
     on a NON-ZeRO optimizer: the replicated dp pmean becomes the
@@ -792,11 +898,9 @@ class TestGspmdTrainStep:
     def test_rejects_explicit_collective_features(self, devices8):
         mesh = Mesh(np.array(devices8).reshape(4, 2), ("dp", "tp"))
         opt = FusedAdam(lr=1e-2)
-        with pytest.raises(NotImplementedError, match="telemetry"):
-            from apex_tpu.observability import StepTelemetry
-
+        with pytest.raises(NotImplementedError, match="GSPMD"):
             make_train_step(CFG, opt, mesh, spmd="auto",
-                            telemetry=StepTelemetry())
+                            overlap_grad_sync=True)
         with pytest.raises(NotImplementedError, match="ZeRO"):
             make_train_step(CFG, DistributedFusedAdam(lr=1e-2,
                                                       axis_name="dp"),

@@ -310,15 +310,42 @@ class TestTracedStep:
                     {"bucket": 1, "hop": "dp_in", "payload_bytes": 8},
                 ]
 
-        assert tracing.emit_sync_plan(FakeOpt()) == 0  # tracing off
+        # tracing off
+        assert tracing.emit_sync_plan(FakeOpt()) == \
+            {"markers": 0, "overlap_fraction": 0.0}
         with tracing.TracingScope() as tr:
-            assert tracing.emit_sync_plan(FakeOpt()) == 3
-            assert tracing.emit_sync_plan(object()) == 0  # no plan
+            out = tracing.emit_sync_plan(FakeOpt())
+            assert out["markers"] == 3
+            # markers emitted outside any dispatch span: no concurrency
+            assert out["overlap_fraction"] == 0.0
+            assert tracing.emit_sync_plan(object()) == \
+                {"markers": 0, "overlap_fraction": 0.0}  # no plan
         names = [s["name"] for s in tr.spans()]
         assert names == ["zero_sync.bucket0.hop_dp_in",
                          "zero_sync.bucket0.hop_dp_out",
                          "zero_sync.bucket1.hop_dp_in"]
         assert tr.spans()[1]["attrs"]["payload_bytes"] == 5
+
+    def test_overlap_fraction_counts_markers_inside_dispatch(self):
+        class FakeOpt:
+            def sync_plan_hops(self):
+                return [{"bucket": 0, "hop": "dp"},
+                        {"bucket": 1, "hop": "dp"}]
+
+        assert tracing.overlap_fraction() == 0.0  # tracing off
+        with tracing.TracingScope() as tr:
+            # two markers inside a live dispatch span...
+            wrapped = tracing.TracedStep(
+                lambda: tracing.emit_sync_plan(FakeOpt()),
+                name="train.step.dispatch")
+            inside = wrapped()
+            assert inside["markers"] == 2
+            assert inside["overlap_fraction"] == 1.0
+            # ...then two more outside any dispatch window
+            out = tracing.emit_sync_plan(FakeOpt())
+            assert out["markers"] == 2
+            assert out["overlap_fraction"] == pytest.approx(0.5)
+            assert tracing.overlap_fraction(tr) == pytest.approx(0.5)
 
 
 # ------------------------------------------------------------ parity band
