@@ -233,8 +233,9 @@ class BlockShapeTilingViolation(Rule):
 
 class VmemFootprintOverBudget(Rule):
     """APX304: the provable VMEM footprint of one ``pallas_call`` —
-    Σ block-shape bytes across its BlockSpecs plus its scratch shapes —
-    exceeds the budget.
+    Σ block-shape bytes across its BlockSpecs plus its scratch shapes,
+    plus the score-sized f32 temporaries its kernel body provably keeps
+    live — exceeds the budget.
 
     VMEM is ~16 MiB/core and Mosaic reports an overrun only when the
     kernel actually compiles for the chip; interpret-mode CPU tests
@@ -243,10 +244,18 @@ class VmemFootprintOverBudget(Rule):
     dataflow lattice, dynamic dims price at 0, BlockSpec elements price
     at 4 bytes (dtype is the array's, invisible here) and scratch at
     its declared dtype — and Mosaic double-buffers grid-revisited
-    blocks, so the true requirement is larger still.  A warning, not an
-    error: the budget is configurable (``VmemFootprintOverBudget(
-    budget_bytes=...)``, CLI ``--vmem-budget-mib``) for targets with
-    different VMEM.
+    blocks, so the true requirement is larger still.  When the kernel
+    function resolves statically (a direct name or a
+    ``functools.partial(fn, ...)`` first argument), each
+    last-dim-contracting ``dot_general`` in its body — the flash
+    ``s = q·kᵀ`` / ``dp = do·vᵀ`` score pattern — prices two
+    (sublane × sublane) f32 temporaries (the dot result and the
+    elementwise tile derived from it), sized from the two largest
+    distinct literal BlockSpec sublane dims: at large blocks these
+    temporaries, not the declared buffers, dominate the backward
+    kernels' footprint.  A warning, not an error: the budget is
+    configurable (``VmemFootprintOverBudget(budget_bytes=...)``, CLI
+    ``--vmem-budget-mib``) for targets with different VMEM.
     """
 
     rule_id = "APX304"
@@ -270,15 +279,20 @@ class VmemFootprintOverBudget(Rule):
                         and last_name(node.func) == "pallas_call"):
                     continue
                 total, priced, skipped = self._footprint(ctx, node, aliases)
-                if priced and total > self.budget_bytes:
+                temp_bytes, temps = self._score_temp_bytes(ctx, node, aliases)
+                if priced and total + temp_bytes > self.budget_bytes:
                     about = "" if not skipped else \
                         f" (+{skipped} buffer(s) with dynamic dims, " \
                         f"unpriced — the true footprint is larger)"
+                    scored = "" if not temps else \
+                        f" plus {temps} score-sized f32 kernel " \
+                        f"temporaries"
                     yield self.finding(
                         ctx, node,
                         f"pallas_call VMEM footprint ≥ "
-                        f"{total / 2**20:.1f} MiB across {priced} "
-                        f"block/scratch buffer(s){about}, over the "
+                        f"{(total + temp_bytes) / 2**20:.1f} MiB across "
+                        f"{priced} "
+                        f"block/scratch buffer(s){scored}{about}, over the "
                         f"{self.budget_bytes / 2**20:.0f} MiB budget: "
                         f"Mosaic rejects the allocation only when the "
                         f"kernel first compiles on the chip")
@@ -308,6 +322,87 @@ class VmemFootprintOverBudget(Rule):
             total += _prod(dims) * (size or 4)
             priced += 1
         return total, priced, skipped
+
+    def _score_temp_bytes(self, ctx: ModuleContext, call: ast.Call,
+                          aliases):
+        """(bytes, temp_count) for the score-sized f32 temporaries the
+        kernel body provably keeps live: 2 per last-dim-contracting
+        ``dot_general`` (the dot result + the elementwise tile derived
+        from it — the flash ``s``/``p`` and ``dp``/``ds`` pairs), each
+        sized as the product of the two largest distinct literal
+        BlockSpec sublane dims (the (bq, bk) score tile).  (0, 0) when
+        the kernel function or the sublane dims are out of static
+        reach — a lower bound, like the rest of the rule."""
+        fn_def = self._kernel_fn(ctx, call, aliases)
+        if fn_def is None:
+            return 0, 0
+        dots = self._score_dots(fn_def)
+        if not dots:
+            return 0, 0
+        sublanes = set()
+        for spec in BlockSpecIndexMapArity._blockspecs(call, aliases):
+            dims = dataflow.literal_dims(_shape_node(spec), aliases)
+            if dims and len(dims) >= 2:
+                sublanes.add(dims[-2])
+        sublanes.discard(0)
+        if not sublanes:
+            return 0, 0
+        top = sorted(sublanes, reverse=True)
+        elems = top[0] * (top[1] if len(top) > 1 else top[0])
+        temps = 2 * dots
+        return temps * elems * 4, temps
+
+    @staticmethod
+    def _kernel_fn(ctx: ModuleContext, call: ast.Call, aliases
+                   ) -> Optional[ast.FunctionDef]:
+        """The kernel FunctionDef the pallas_call invokes — its first
+        positional argument, resolved through a local alias and/or one
+        ``functools.partial(fn, ...)`` wrapper (the repo idiom for
+        binding scale/blocks).  None for dynamic spellings."""
+        fn = call.args[0] if call.args else None
+        if isinstance(fn, ast.Name):
+            fn = aliases.get(fn.id, fn)
+        if (isinstance(fn, ast.Call) and last_name(fn.func) == "partial"
+                and fn.args):
+            fn = fn.args[0]
+        if not isinstance(fn, ast.Name):
+            return None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) and node.name == fn.id:
+                return node
+        return None
+
+    @staticmethod
+    def _score_dots(fn_def: ast.FunctionDef) -> int:
+        """``dot_general`` calls in the kernel body (nested ``pl.when``
+        bodies included) whose dimension_numbers literally contract dim
+        1 of BOTH 2-D operands — ``(((1,), (1,)), ...)``, the
+        row-block × col-blockᵀ score pattern.  The pv/dv/dq-style dots
+        (``(1,)×(0,)`` / ``(0,)×(0,)``) produce block-shaped results
+        already priced via specs/scratch and are not counted."""
+
+        def _is_dim1(node) -> bool:
+            return (isinstance(node, (ast.Tuple, ast.List))
+                    and len(node.elts) == 1
+                    and isinstance(node.elts[0], ast.Constant)
+                    and node.elts[0].value == 1)
+
+        n = 0
+        for node in ast.walk(fn_def):
+            if not (isinstance(node, ast.Call)
+                    and last_name(node.func) == "dot_general"
+                    and len(node.args) >= 3):
+                continue
+            dims = node.args[2]
+            if not (isinstance(dims, (ast.Tuple, ast.List)) and dims.elts):
+                continue
+            contract = dims.elts[0]
+            if (isinstance(contract, (ast.Tuple, ast.List))
+                    and len(contract.elts) == 2
+                    and _is_dim1(contract.elts[0])
+                    and _is_dim1(contract.elts[1])):
+                n += 1
+        return n
 
 
 def _prod(dims: List[int]) -> int:

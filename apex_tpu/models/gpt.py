@@ -88,6 +88,12 @@ class GPTConfig:
     # var) so an A/B never mutates process-global state under an
     # already-traced step function.
     fused_ce_impl: Optional[str] = None
+    # Context-parallel ring attention only: issue each next hop's
+    # ppermute BEFORE the current chunk's flash compute so the ICI hop
+    # hides behind the per-chunk kernels (ring_attention's ``overlap``
+    # knob — fp32-bitwise either way, so this is a pure schedule A/B).
+    # Ignored when no cp axis is active.
+    cp_overlap: bool = False
 
     def __post_init__(self):
         # validate at construction so every path (incl. checkpoint-
@@ -303,7 +309,8 @@ def _attention(x, p, config: GPTConfig, axis_name, n_local_heads, cp_axis=None,
 
         # the ring walks matched head counts; GQA repeats before it
         k, v = repeat_kv_heads(q, k, v)
-        ctx = ring_attention(q, k, v, cp_axis, causal=True).astype(v.dtype)
+        ctx = ring_attention(q, k, v, cp_axis, causal=True,
+                             overlap=config.cp_overlap).astype(v.dtype)
     elif config.use_flash_attention:
         from apex_tpu.ops.attention import flash_attention
 

@@ -434,17 +434,21 @@ class TracedStep:
         return getattr(self._fn, name)
 
 
-def overlap_fraction(tracer: Optional[Tracer] = None) -> float:
+def overlap_fraction(tracer: Optional[Tracer] = None,
+                     prefix: str = "zero_sync.bucket") -> float:
     """Span-concurrency of the wire plan against dispatch: the
-    fraction of ``zero_sync.bucket*`` instant markers in the tracer's
+    fraction of ``prefix``-named instant markers in the tracer's
     buffer whose timestamp falls INSIDE some ``*step.dispatch`` span's
     ``[ts, ts + dur]`` interval.  A marker emitted while a dispatch is
     in flight is a sync whose host-side bookkeeping overlapped the
     step — the host-observable proxy for the compiled step's
     compute/communication overlap (the collectives themselves run on
     device, where per-hop host timing would need forbidden host
-    transfers).  0.0 with no tracer, no markers, or no dispatch
-    spans."""
+    transfers).  ``prefix`` defaults to the ZeRO wire plan's
+    ``zero_sync.bucket`` markers (:func:`emit_sync_plan`); ring
+    attention's bench section passes ``"ring_attn.hop"`` to measure
+    its hop plan against the same dispatch windows.  0.0 with no
+    tracer, no markers, or no dispatch spans."""
     tracer = tracer if tracer is not None else _TRACER
     if tracer is None:
         return 0.0
@@ -452,7 +456,7 @@ def overlap_fraction(tracer: Optional[Tracer] = None) -> float:
     windows = [(s["ts"], s["ts"] + s["dur_us"] / 1e6) for s in spans
                if s["name"].endswith("step.dispatch")]
     marks = [s["ts"] for s in spans
-             if s["ph"] == "i" and s["name"].startswith("zero_sync.bucket")]
+             if s["ph"] == "i" and s["name"].startswith(prefix)]
     if not marks or not windows:
         return 0.0
     inside = sum(1 for ts in marks

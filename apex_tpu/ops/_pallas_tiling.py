@@ -12,9 +12,47 @@ import jax.numpy as jnp
 
 LANES = 128
 
+#: per-``pallas_call`` VMEM budget (bytes) — the analyzer's APX304
+#: default (~16 MiB/core); block pickers clamp candidates against it
+#: instead of discovering the overflow when Mosaic first compiles the
+#: kernel on the chip.
+VMEM_BUDGET = 16 * 2 ** 20
+
 
 def sublane(dtype) -> int:
     """The dtype's sublane tile.  Unknown itemsizes (f64 under
     jax_enable_x64 in CPU/interpret numerics checks — no TPU tile
     exists) fall back to the minimum 8 rather than crashing."""
     return {4: 8, 2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 8)
+
+
+def flash_vmem_bytes(block_q: int, block_k: int, head_dim: int,
+                     phase: str = "fwd") -> int:
+    """APX304-style lower-bound VMEM footprint (bytes) of one flash
+    attention ``pallas_call`` at ``(block_q, block_k)``.
+
+    The same pricing the analyzer applies: BlockSpec elements at
+    4 B/element, f32 scratch at 4 B — plus the score-sized (bq, bk) f32
+    temporaries the kernel body keeps live (2 in the forward: s, p;
+    ~4 in each backward kernel: s, p, dp, ds), which dominate at large
+    blocks.  ``phase="bwd"`` prices the larger of the dq / dkv calls.
+    Shared between ``flash_attention_pallas._pick_block`` (clamping
+    candidates up front) and the tests that pin the clamp.
+    """
+    bq, bk, d = int(block_q), int(block_k), int(head_dim)
+    if phase == "fwd":
+        # blocks: q, out (bq·d each), k, v (bk·d each), lse (bq·1);
+        # scratch: m, l (bq·LANES each), acc (bq·d) — all f32
+        blocks = 2 * bq * d + 2 * bk * d + bq
+        scratch = 2 * bq * LANES + bq * d
+        temps = 2 * bq * bk
+        return 4 * (blocks + scratch + temps)
+    if phase != "bwd":
+        raise ValueError(f"phase must be 'fwd' or 'bwd', got {phase!r}")
+    # dq call: q, do, dq out, acc scratch (bq·d each), k, v (bk·d each),
+    # lse, delta (bq·1 each); dkv call: q, do (bq·d), k, v, dk, dv outs
+    # and two accumulators (bk·d each), lse, delta (bq·1 each)
+    dq_call = 4 * bq * d + 2 * bk * d + 2 * bq
+    dkv_call = 2 * bq * d + 6 * bk * d + 2 * bq
+    temps = 4 * bq * bk
+    return 4 * (max(dq_call, dkv_call) + temps)

@@ -35,6 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._pallas_tiling import LANES as _LANES
+from apex_tpu.ops._pallas_tiling import VMEM_BUDGET as _VMEM_BUDGET
+from apex_tpu.ops._pallas_tiling import flash_vmem_bytes as _flash_vmem_bytes
 from apex_tpu.ops._pallas_tiling import sublane as _sublane
 
 NEG_INF = -1e30
@@ -56,44 +58,76 @@ _DIM_SEMANTICS = (
 
 
 # ------------------------------------------------------------ block tuning
-# Measured per-shape block targets, keyed (seq_q, head_dim, dtype name)
-# -> (block_q, block_k).  Populated from benchmarks/flash_sweep.py runs
-# on real hardware (each entry's provenance is recorded in
-# benchmarks/RESULTS.md); consulted by flash_attention_pallas when the
-# caller passes no explicit blocks, before the _pick_block static
-# heuristic (VERDICT r4 task 4: sweep results feed per-shape defaults).
+# Measured per-shape block targets, keyed (seq_q, head_dim, dtype name,
+# phase) -> (block_q, block_k), phase ∈ {"fwd", "bwd"}.  The phases have
+# different VMEM envelopes — the backward kernels keep ~4 (bq, bk) f32
+# score temporaries live vs the forward's 2 — so one (bq, bk) cannot
+# serve both.  Populated from benchmarks/flash_sweep.py runs on real
+# hardware (each entry's provenance is recorded in benchmarks/
+# RESULTS.md); consulted by the fwd/bwd entry points when the caller
+# passes no explicit blocks, before the _pick_block static heuristic.
+# Legacy 3-tuple (seq_q, head_dim, dtype) keys are read as fwd-only.
 _TUNED_BLOCKS: dict = {}
 
+_PHASES = ("fwd", "bwd")
 
-def tuned_blocks(seq_q, head_dim, dtype):
-    """(block_q, block_k) measured best for this shape, or None."""
-    return _TUNED_BLOCKS.get(
-        (int(seq_q), int(head_dim), jnp.dtype(dtype).name))
+
+def tuned_blocks(seq_q, head_dim, dtype, phase="fwd"):
+    """(block_q, block_k) measured best for this shape and phase, or
+    None.  ``phase="fwd"`` also reads legacy 3-tuple entries (tables
+    installed before the per-phase split are forward measurements)."""
+    if phase not in _PHASES:
+        raise ValueError(f"phase must be one of {_PHASES}, got {phase!r}")
+    key = (int(seq_q), int(head_dim), jnp.dtype(dtype).name)
+    hit = _TUNED_BLOCKS.get(key + (phase,))
+    if hit is None and phase == "fwd":
+        hit = _TUNED_BLOCKS.get(key)
+    return hit
 
 
 def set_tuned_blocks(table) -> None:
-    """Install sweep-measured block targets: ``{(S, D, dtype): (bq,
-    bk)}`` or an iterable of ``[[S, D, dtype], [bq, bk]]`` pairs (the
-    exact JSON flash_sweep.py prints as ``tuned_blocks_table``).  The
-    dtype key is normalized through ``jnp.dtype`` so ``jnp.bfloat16``,
-    ``'bfloat16'``, and ``np.dtype`` all land on the same entry."""
+    """Install sweep-measured block targets: ``{(S, D, dtype[, phase]):
+    (bq, bk)}`` or an iterable of ``[[S, D, dtype[, phase]], [bq, bk]]``
+    pairs (the exact JSON flash_sweep.py prints as
+    ``tuned_blocks_table``).  Three-element keys — the pre-per-phase
+    format — install as ``"fwd"`` entries: old sweeps measured the
+    forward dispatcher's path.  The dtype key is normalized through
+    ``jnp.dtype`` so ``jnp.bfloat16``, ``'bfloat16'``, and ``np.dtype``
+    all land on the same entry."""
     items = table.items() if hasattr(table, "items") else table
     for key, val in items:
-        s, d, name = key
+        if len(key) == 3:
+            (s, d, name), phase = key, "fwd"
+        else:
+            s, d, name, phase = key
+        if phase not in _PHASES:
+            raise ValueError(
+                f"tuned-block phase must be one of {_PHASES}, got {phase!r}")
         bq, bk = val
-        _TUNED_BLOCKS[(int(s), int(d), jnp.dtype(name).name)] = (
+        _TUNED_BLOCKS[(int(s), int(d), jnp.dtype(name).name, str(phase))] = (
             int(bq), int(bk))
 
 
-def _pick_block(seq, target, align=_LANES):
+def _pick_block(seq, target, align=_LANES, fits=None):
     """Largest divisor of ``seq`` ≤ target, preferring ``align``-aligned
     divisors (128 for the lane dim, the dtype sublane tile — 8 fp32 /
     16 bf16, via ``_sublane`` — for sublanes) — but only when the
     aligned candidate is at least half the largest divisor: a misaligned
     tile wastes ≤ (align−1) padded lanes, while a much smaller tile
     multiplies grid steps and k/v refetches (e.g. seq=640, target=512:
-    320 misaligned beats 128 aligned)."""
+    320 misaligned beats 128 aligned).
+
+    ``fits``: optional predicate over a candidate block — candidates it
+    rejects are dropped BEFORE the size preference runs.  The callers
+    pass the APX304 VMEM footprint formula
+    (:func:`apex_tpu.ops._pallas_tiling.flash_vmem_bytes` ≤ budget) so
+    an over-large target clamps to the biggest block that provably fits
+    instead of overflowing when Mosaic first compiles at long seq.
+    When NO candidate fits the smallest divisor (1) is returned — the
+    least-over-budget choice; Mosaic gets the final word either way."""
     divisors = [b for b in range(1, min(target, seq) + 1) if seq % b == 0]
+    if fits is not None:
+        divisors = [b for b in divisors if fits(b)] or [1]
     best = divisors[-1]
     aligned = [b for b in divisors if b % align == 0]
     if aligned and 2 * aligned[-1] >= best:
@@ -178,8 +212,38 @@ def _kv_row(b, heads, kv_heads):
     return (b // heads) * kv_heads + (b % heads) // group
 
 
+def _resolve_targets(sq, sk, d, dtype, block_q, block_k, phase, default):
+    """Per-phase block TARGETS: explicit args win, then the phase's
+    tuned entry (self-attention shapes only — a block_k tuned for
+    Sk == Sq must not leak onto cross-attention key lengths), then the
+    static default (fwd 1024 / bwd 512 — the VMEM envelopes differ)."""
+    if (block_q is None or block_k is None) and sk == sq:
+        tuned = tuned_blocks(sq, d, dtype, phase=phase)
+        if tuned is not None:
+            block_q = block_q if block_q is not None else tuned[0]
+            block_k = block_k if block_k is not None else tuned[1]
+    return block_q or default, block_k or default
+
+
+def _clamped_blocks(sq, sk, d, dtype, block_q, block_k, phase):
+    """(bq, bk) divisor blocks for the targets, jointly clamped so the
+    APX304-priced footprint of the resulting pallas_call stays inside
+    the VMEM budget: pick bq by preference alone, clamp bk against it,
+    then re-clamp bq against the chosen bk (a no-op unless the pair
+    was over budget)."""
+
+    def fits(b_q, b_k):
+        return _flash_vmem_bytes(b_q, b_k, d, phase) <= _VMEM_BUDGET
+
+    bq = _pick_block(sq, block_q, align=_sublane(dtype))
+    bk = _pick_block(sk, block_k, fits=lambda b: fits(bq, b))
+    bq = _pick_block(sq, block_q, align=_sublane(dtype),
+                     fits=lambda b: fits(b, bk))
+    return bq, bk
+
+
 def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
-                     block_q=1024, block_k=1024, interpret=False,
+                     block_q=None, block_k=None, interpret=False,
                      out_dtype=None, kv_bias=None, heads=1, kv_heads=None):
     """q: (BH, Sq, D); k/v: (B·kv_heads, Sk, D).  Returns
     (out, lse (BH, Sq, 1)).
@@ -191,17 +255,42 @@ def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
     the kernel reads each q head's group-shared k/v block directly (no
     materialized head repeat in HBM).
 
+    ``block_q``/``block_k`` default to the shape's tuned ``"fwd"`` entry
+    (self-attention shapes) else 1024; either way the candidates are
+    clamped against the shared VMEM footprint formula.
     ``out_dtype`` defaults to q.dtype; ring attention requests f32 so
     cross-chunk accumulation never rounds through bf16."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     kv_heads = kv_heads or heads
     out_dtype = out_dtype or q.dtype
-    bq = _pick_block(Sq, block_q, align=_sublane(q.dtype))
-    bk = _pick_block(Sk, block_k)
-    nq, nk = Sq // bq, Sk // bk
-    grid = (BH, nq, nk)
+    block_q, block_k = _resolve_targets(
+        Sq, Sk, D, q.dtype, block_q, block_k, "fwd", 1024)
+    bq, bk = _clamped_blocks(Sq, Sk, D, q.dtype, block_q, block_k, "fwd")
     has_bias = kv_bias is not None
+
+    inputs = (q, k, v) if not has_bias else (q, k, v, kv_bias)
+    call = _fwd_call(BH, Sq, Sk, D, heads, kv_heads, float(scale), causal,
+                     q_offset, k_offset, bq, bk, has_bias, interpret,
+                     jnp.dtype(out_dtype).name)
+    # jax.disable_jit(False): pallas_call cannot bind eagerly (its bind
+    # params carry a dict), so the kernel stays one jitted op even when a
+    # caller runs the surrounding program op-by-op under disable_jit().
+    with jax.disable_jit(False):
+        out, lse = call(*inputs)
+    return out, lse
+
+
+@functools.lru_cache(maxsize=512)
+def _fwd_call(BH, Sq, Sk, D, heads, kv_heads, scale, causal,
+              q_offset, k_offset, bq, bk, has_bias, interpret,
+              out_dtype_name):
+    """The fwd ``pallas_call``, memoized on its static configuration —
+    every argument is static by construction (they bake into the kernel
+    closure), so eager callers (a ring chunk per hop, interpret-mode
+    tests) reuse one traced kernel instead of rebuilding fresh index-map
+    closures — and with them the whole compile — per invocation."""
+    nq, nk = Sq // bq, Sk // bk
 
     kv_spec = pl.BlockSpec(
         (1, bk, D),
@@ -213,26 +302,27 @@ def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
         kv_spec,
         kv_spec,
     ]
-    inputs = (q, k, v)
     if has_bias:
         in_specs.append(
             pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // heads, 0, j), memory_space=pltpu.VMEM)
         )
-        inputs = inputs + (kv_bias,)
 
-    out, lse = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal, has_bias=has_bias,
-            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
+            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk,
+            nk=nk,
         ),
-        grid=grid,
+        grid=(BH, nq, nk),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, D), out_dtype),
+            jax.ShapeDtypeStruct((BH, Sq, D), jnp.dtype(out_dtype_name)),
             jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -242,8 +332,7 @@ def flash_fwd_pallas(q, k, v, scale, causal, q_offset, k_offset,
         ],
         compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
-    )(*inputs)
-    return out, lse
+    )
 
 
 # ----------------------------------------------------------------- backward
@@ -370,13 +459,19 @@ def _dkv_kernel(*refs, scale, causal, has_bias, q_offset, k_offset,
 
 
 def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
-                     block_q=512, block_k=512, interpret=False, delta=None,
+                     block_q=None, block_k=None, interpret=False, delta=None,
                      out_dtype=None, kv_bias=None, heads=1, kv_heads=None):
-    # 512 (not the forward's 1024): the bwd kernels keep ~4 (bq, bk) f32
-    # score-sized temporaries live, so smaller tiles stay inside VMEM.
+    # default 512 (not the forward's 1024): the bwd kernels keep ~4
+    # (bq, bk) f32 score-sized temporaries live, so smaller tiles stay
+    # inside VMEM — the same envelope the "bwd" tuned entries and the
+    # footprint clamp price exactly.
     """q/out/do (BH, Sq, D); k/v (B·kv_heads, Sk, D); lse (BH, Sq, 1).
     Returns (dq, dk, dv) with dk/dv shaped like k/v.
 
+    ``block_q``/``block_k`` default to the shape's tuned ``"bwd"`` entry
+    (self-attention shapes) else 512 — the backward consults its OWN
+    per-phase table, never a forward measurement — and candidates are
+    clamped against the bwd VMEM footprint formula.
     ``delta`` (rowsum of do·out over the FULL row) may be passed in when
     ``out`` covers more keys than this call sees — ring attention's
     backward, where each chunk-pair call sees only the local k/v chunk.
@@ -393,8 +488,9 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
     dq_dtype = out_dtype or q.dtype
     dk_dtype = out_dtype or k.dtype
     dv_dtype = out_dtype or v.dtype
-    bq = _pick_block(Sq, block_q, align=_sublane(q.dtype))
-    bk = _pick_block(Sk, block_k)
+    block_q, block_k = _resolve_targets(
+        Sq, Sk, D, q.dtype, block_q, block_k, "bwd", 512)
+    bq, bk = _clamped_blocks(Sq, Sk, D, q.dtype, block_q, block_k, "bwd")
     nq, nk = Sq // bq, Sk // bk
     has_bias = kv_bias is not None
 
@@ -402,6 +498,28 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)
 
+    inputs = (q, k, v, do, lse, delta)
+    if has_bias:
+        inputs = inputs + (kv_bias,)
+    static = (BH, BKV, Sq, Sk, D, heads, kv_heads, float(scale), causal,
+              q_offset, k_offset, bq, bk, has_bias, interpret)
+    dq_call = _dq_pallas_call(*static, jnp.dtype(dq_dtype).name)
+    dkv_call = _dkv_pallas_call(*static, jnp.dtype(dk_dtype).name,
+                                jnp.dtype(dv_dtype).name)
+    # jax.disable_jit(False): see flash_fwd_pallas — pallas_call cannot
+    # bind eagerly, so both backward kernels stay jitted ops.
+    with jax.disable_jit(False):
+        dq = dq_call(*inputs)
+        dk, dv = dkv_call(*inputs)
+    return dq, dk, dv
+
+
+@functools.lru_cache(maxsize=512)
+def _dq_pallas_call(BH, BKV, Sq, Sk, D, heads, kv_heads, scale, causal,
+                    q_offset, k_offset, bq, bk, has_bias, interpret,
+                    dq_dtype_name):
+    """The dq ``pallas_call``, memoized like :func:`_fwd_call`."""
+    nq, nk = Sq // bq, Sk // bk
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec(
         (1, bk, D),
@@ -411,26 +529,34 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
     r_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
 
     in_specs = [q_spec, k_spec, k_spec, q_spec, r_spec, r_spec]
-    inputs = (q, k, v, do, lse, delta)
     if has_bias:
         in_specs.append(
             pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // heads, 0, j), memory_space=pltpu.VMEM)
         )
-        inputs = inputs + (kv_bias,)
 
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, has_bias=has_bias,
-            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
+            q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk,
+            nk=nk,
         ),
         grid=(BH, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), dq_dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), jnp.dtype(dq_dtype_name)),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
-    )(*inputs)
+    )
+
+
+@functools.lru_cache(maxsize=512)
+def _dkv_pallas_call(BH, BKV, Sq, Sk, D, heads, kv_heads, scale, causal,
+                     q_offset, k_offset, bq, bk, has_bias, interpret,
+                     dk_dtype_name, dv_dtype_name):
+    """The dk/dv ``pallas_call``, memoized like :func:`_fwd_call`."""
+    nq, nk = Sq // bq, Sk // bk
+    group = heads // kv_heads
 
     # k-outer grid over the KV rows: index maps see (b, j, t) with
     # t ∈ [0, group·nq) walking q-blocks of every q head in the group
@@ -456,7 +582,7 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
             pl.BlockSpec((1, 1, bk), lambda b, j, t: (b // kv_heads, 0, j), memory_space=pltpu.VMEM)
         )
 
-    dk, dv = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, has_bias=has_bias,
             q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk,
@@ -466,8 +592,8 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
         in_specs=in_specsT,
         out_specs=[kT_spec, kT_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((BKV, Sk, D), dk_dtype),
-            jax.ShapeDtypeStruct((BKV, Sk, D), dv_dtype),
+            jax.ShapeDtypeStruct((BKV, Sk, D), jnp.dtype(dk_dtype_name)),
+            jax.ShapeDtypeStruct((BKV, Sk, D), jnp.dtype(dv_dtype_name)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
@@ -475,8 +601,7 @@ def flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, q_offset, k_offset,
         ],
         compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
-    )(*inputs)
-    return dq, dk, dv
+    )
 
 
 # ---------------------------------------------------------------- dispatch
@@ -502,10 +627,15 @@ def _flash_pallas_fwd(q, k, v, kv_bias, scale, causal, q_offset, k_offset,
 def _flash_pallas_bwd(scale, causal, q_offset, k_offset, block_q, block_k,
                       interpret, heads, kv_heads, res, g):
     q, k, v, kv_bias, out, lse = res
-    # bwd keeps more score-sized f32 temporaries live; cap tiles at 512
+    # the nondiff blocks are the CALLER's (None = untuned): an explicit
+    # block keeps the documented 512 cap (more score-sized f32
+    # temporaries live in the bwd); None defers to flash_bwd_pallas's
+    # own per-phase tuned entry — a forward measurement never leaks
+    # onto the backward's different VMEM envelope
     dq, dk, dv = flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
                                   q_offset, k_offset,
-                                  block_q=min(block_q, 512), block_k=min(block_k, 512),
+                                  block_q=None if block_q is None else min(block_q, 512),
+                                  block_k=None if block_k is None else min(block_k, 512),
                                   interpret=interpret, kv_bias=kv_bias,
                                   heads=heads, kv_heads=kv_heads)
     # the mask bias is data, not a trainable input: zero cotangent
@@ -542,16 +672,12 @@ def flash_attention_pallas(q, k, v, causal=True, softmax_scale=None,
         from apex_tpu.ops.attention import padding_bias
 
         bias = padding_bias(kv_mask)[:, None, :]
-    if (block_q is None or block_k is None) and k.shape[2] == Sq:
-        # self-attention shapes only: the sweep measures Sk == Sq, and a
-        # block_k tuned for that must not leak onto cross-attention
-        # calls with a different key length
-        tuned = tuned_blocks(Sq, D, q.dtype)
-        if tuned is not None:
-            block_q = block_q or tuned[0]
-            block_k = block_k or tuned[1]
+    # the RAW (possibly-None) blocks thread through the custom_vjp's
+    # nondiff args: each phase resolves its own tuned entry at its own
+    # entry point, so a forward-tuned (bq, bk) never leaks onto the
+    # backward kernels' different VMEM envelope
     out = _flash_pallas(qf, kf, vf, bias, scale, causal, q_offset, k_offset,
-                        block_q or 1024, block_k or 1024, interpret, H, Hkv)
+                        block_q, block_k, interpret, H, Hkv)
     return out.reshape(B, H, Sq, D)
 
 

@@ -49,6 +49,39 @@ def _use_pallas(q, k, impl: str) -> bool:
     return pallas_flash_available(q, k)
 
 
+def _scan_block_k(S, D, dtype):
+    """k-block for the scan-composite chunk path: the chunk shape's
+    tuned ``"fwd"`` entry when a sweep installed one (cp runs must not
+    ignore measured defaults), else 16 sublane tiles of the dtype —
+    256 for bf16, 128 for fp32 — the memory/step tradeoff the old
+    hard-coded 256 encoded for bf16 only."""
+    from apex_tpu.ops._pallas_tiling import sublane
+    from apex_tpu.ops.flash_attention_pallas import tuned_blocks
+
+    tuned = tuned_blocks(S, D, dtype, phase="fwd")
+    if tuned is not None:
+        return tuned[1]
+    return 16 * sublane(dtype)
+
+
+# Chunk math as one jitted op when the surrounding program runs
+# op-by-op under jax.disable_jit() (the pallas path gets this for free
+# from pallas_call's own jit): a chunk is the ring's atomic unit — the
+# schedule property disable_jit() exists to pin here is the RING-level
+# op order, and the jit cache makes every identically-shaped chunk
+# reuse one compiled program, deterministic across both schedules.
+# Under normal tracing the inline path below is taken — the traced
+# program is byte-identical to pre-wrapper builds.
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def _scan_chunk_fwd_jit(q, k, v, scale, causal, block_k):
+    return _attend_fwd_scan(q, k, v, scale, causal, 0, 0, block_k=block_k)
+
+
+@partial(jax.jit, static_argnums=(6, 7))
+def _scan_chunk_bwd_jit(q, k, v, do, lse, delta, scale, causal):
+    return flash_bwd_from_lse(q, k, v, do, lse, delta, scale, causal)
+
+
 def _chunk_fwd(q, k, v, scale, causal, impl, interpret):
     """(out f32, lse f32 (B,H,S)) for one chunk pair, zero offsets."""
     B, H, S, D = q.shape
@@ -61,7 +94,11 @@ def _chunk_fwd(q, k, v, scale, causal, impl, interpret):
             interpret=interpret, out_dtype=jnp.float32,
         )
         return out.reshape(B, H, S, D), lse.reshape(B, H, S)
-    return _attend_fwd_scan(q, k, v, scale, causal, 0, 0, block_k=256)
+    block_k = _scan_block_k(S, D, q.dtype)  # resolved OUTSIDE the jit
+    if jax.config.jax_disable_jit:
+        with jax.disable_jit(False):
+            return _scan_chunk_fwd_jit(q, k, v, scale, causal, block_k)
+    return _attend_fwd_scan(q, k, v, scale, causal, 0, 0, block_k=block_k)
 
 
 def _chunk_bwd(q, k, v, do, lse, delta, scale, causal, impl, interpret):
@@ -83,6 +120,9 @@ def _chunk_bwd(q, k, v, do, lse, delta, scale, causal, impl, interpret):
         )
         shp = (B, H, S, D)
         return dq.reshape(shp), dk.reshape(shp), dv.reshape(shp)
+    if jax.config.jax_disable_jit:
+        with jax.disable_jit(False):
+            return _scan_chunk_bwd_jit(q, k, v, do, lse, delta, scale, causal)
     return flash_bwd_from_lse(q, k, v, do, lse, delta, scale, causal)
 
 
@@ -94,13 +134,15 @@ def _merge(out, lse, out_b, lse_b):
     return out * w_old + out_b * w_b, lse_new
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring(q, k, v, axis_name, causal, scale, impl, interpret):
-    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl, interpret)
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring(q, k, v, axis_name, causal, scale, impl, interpret, overlap):
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl,
+                            interpret, overlap)
     return out.astype(q.dtype)
 
 
-def _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl, interpret):
+def _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl, interpret,
+                   overlap=False):
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     B, H, S, D = q.shape
@@ -116,37 +158,67 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl, interpret):
         return (jnp.zeros((B, H, S, D), jnp.float32),
                 jnp.full((B, H, S), NEG_INF, jnp.float32))
 
-    def step(carry, r):
-        kc, vc, out, lse = carry
+    def chunk(kc, vc, r):
         src = (rank + r) % cp  # whose chunk we hold at step r
         if causal:
             # 0: src < rank (full), 1: src == rank (diag), 2: masked
             case = jnp.clip(jnp.sign(src - rank) + 1, 0, 2)
-            out_b, lse_b = jax.lax.switch(
+            return jax.lax.switch(
                 case, (full_case, diag_case, masked_case), kc, vc
             )
-        else:
-            out_b, lse_b = full_case(kc, vc)
-        out, lse = _merge(out, lse, out_b, lse_b)
-        kc = jax.lax.ppermute(kc, axis_name, perm)
-        vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (kc, vc, out, lse), None
+        return full_case(kc, vc)
 
     out0 = jnp.zeros((B, H, S, D), jnp.float32)
     lse0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
-    (_, _, out, lse), _ = jax.lax.scan(step, (k, v, out0, lse0), jnp.arange(cp))
+
+    if not overlap:
+        def step(carry, r):
+            kc, vc, out, lse = carry
+            out_b, lse_b = chunk(kc, vc, r)
+            out, lse = _merge(out, lse, out_b, lse_b)
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+            return (kc, vc, out, lse), None
+
+        (_, _, out, lse), _ = jax.lax.scan(
+            step, (k, v, out0, lse0), jnp.arange(cp))
+        return out, lse
+
+    # Overlapped: the ring unrolls (cp is static) and hop r+1's ppermute
+    # issues BEFORE chunk r's compute, so XLA's latency-hiding scheduler
+    # can run the ICI hop behind the per-chunk flash kernels — the
+    # classic double-buffered ring.  The compute consumes the SAME
+    # values in the SAME merge order as the scan path (the permute only
+    # moves data; r promotes to the same int32 arithmetic), so fp32
+    # out/lse are bitwise equal.  The final hop's rotation — whose
+    # result the scan discards — is skipped entirely.
+    kc, vc, out, lse = k, v, out0, lse0
+    for r in range(cp):
+        if r + 1 < cp:
+            kn = jax.lax.ppermute(kc, axis_name, perm)
+            vn = jax.lax.ppermute(vc, axis_name, perm)
+        out_b, lse_b = chunk(kc, vc, r)
+        out, lse = _merge(out, lse, out_b, lse_b)
+        if r + 1 < cp:
+            kc, vc = kn, vn
     return out, lse
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, impl, interpret):
-    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl, interpret)
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, impl, interpret, overlap):
+    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale, impl,
+                              interpret, overlap)
     return out.astype(q.dtype), (q, k, v, out, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, scale, impl, interpret, res, g):
+def _ring_vjp_bwd(axis_name, causal, scale, impl, interpret, overlap, res, g):
     """The backward ring: q/do/lse/delta stay home; (k, v, dk, dv)
     travel the ring and arrive home after cp steps with every device's
-    contribution accumulated."""
+    contribution accumulated.  With ``overlap`` the ring unrolls:
+    hop r+1's (k, v) rotation issues before chunk r's compute, and the
+    dk/dv accumulators rotate AFTER chunk r accumulates into them (a
+    data dependency — but their hop is then in flight during chunk
+    r+1's compute).  All cp accumulator rotations are required either
+    way: each moves the accumulator one hop toward home."""
     q, k, v, out, lse = res
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -166,29 +238,51 @@ def _ring_vjp_bwd(axis_name, causal, scale, impl, interpret, res, g):
         z = jnp.zeros((B, H, S, D), jnp.float32)
         return z, z, z
 
-    def step(carry, r):
-        kc, vc, dk_acc, dv_acc, dq_acc = carry
+    def chunk(kc, vc, r):
         src = (rank + r) % cp
         if causal:
             case = jnp.clip(jnp.sign(src - rank) + 1, 0, 2)
-            dq_b, dk_b, dv_b = jax.lax.switch(
+            return jax.lax.switch(
                 case, (full_case, diag_case, masked_case), kc, vc
             )
-        else:
-            dq_b, dk_b, dv_b = full_case(kc, vc)
+        return full_case(kc, vc)
+
+    z = jnp.zeros((B, H, S, D), jnp.float32)
+
+    if not overlap:
+        def step(carry, r):
+            kc, vc, dk_acc, dv_acc, dq_acc = carry
+            dq_b, dk_b, dv_b = chunk(kc, vc, r)
+            dq_acc = dq_acc + dq_b
+            dk_acc = dk_acc + dk_b
+            dv_acc = dv_acc + dv_b
+            kc, vc, dk_acc, dv_acc = (
+                jax.lax.ppermute(t, axis_name, perm)
+                for t in (kc, vc, dk_acc, dv_acc)
+            )
+            return (kc, vc, dk_acc, dv_acc, dq_acc), None
+
+        (_, _, dk, dv, dq), _ = jax.lax.scan(
+            step, (k, v, z, z, z), jnp.arange(cp)
+        )
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    kc, vc = k, v
+    dk_acc = dv_acc = dq_acc = z
+    for r in range(cp):
+        if r + 1 < cp:  # k/v double buffer: next hop rides under chunk r
+            kn = jax.lax.ppermute(kc, axis_name, perm)
+            vn = jax.lax.ppermute(vc, axis_name, perm)
+        dq_b, dk_b, dv_b = chunk(kc, vc, r)
         dq_acc = dq_acc + dq_b
         dk_acc = dk_acc + dk_b
         dv_acc = dv_acc + dv_b
-        kc, vc, dk_acc, dv_acc = (
-            jax.lax.ppermute(t, axis_name, perm) for t in (kc, vc, dk_acc, dv_acc)
-        )
-        return (kc, vc, dk_acc, dv_acc, dq_acc), None
-
-    z = jnp.zeros((B, H, S, D), jnp.float32)
-    (_, _, dk, dv, dq), _ = jax.lax.scan(
-        step, (k, v, z, z, z), jnp.arange(cp)
-    )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        if r + 1 < cp:
+            kc, vc = kn, vn
+    return (dq_acc.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
 
 
 _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -203,6 +297,7 @@ def ring_attention(
     softmax_scale: Optional[float] = None,
     impl: str = "auto",
     interpret: bool = False,
+    overlap: bool = False,
 ):
     """Exact attention with sequence sharded over ``axis_name``.
 
@@ -214,8 +309,17 @@ def ring_attention(
 
     ``impl``: "pallas" / "scan" / "auto" (Pallas kernels per chunk pair
     on TPU when shapes allow).
+
+    ``overlap``: unroll the ring and issue hop r+1's ``ppermute``
+    before chunk r's compute (fwd AND bwd), double-buffering the
+    rotating k/v so the ICI hop hides behind the per-chunk kernels.
+    Same chunk order, same merge order, same values — fp32 outputs and
+    grads are BITWISE equal to the serial schedule; flip it per run to
+    A/B the overlap (default off: the serial ``lax.scan`` compiles a
+    cp-independent program body).
     """
     if impl not in ("auto", "pallas", "scan"):
         raise ValueError(f"impl must be 'auto', 'pallas', or 'scan'; got {impl!r}")
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    return _ring(q, k, v, axis_name, causal, scale, impl, interpret)
+    return _ring(q, k, v, axis_name, causal, scale, impl, interpret,
+                 bool(overlap))

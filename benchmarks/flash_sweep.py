@@ -6,13 +6,23 @@ This sweep measures, on the real chip:
 
 1. the bf16 matmul roofline (the MFU denominator),
 2. fwd and fwd+bwd TFLOP/s of the Pallas flash kernel per
-   (D, S, block_q, block_k) combination,
+   (D, S, block_q, block_k) combination — the fwd-only best feeds the
+   ``"fwd"`` tuned entry, the fwd+bwd best the ``"bwd"`` entry (the
+   phases have different VMEM envelopes, so one (bq, bk) cannot serve
+   both),
 3. the arithmetic-intensity bound for each shape (is it memory-bound?),
 
-and prints one JSON line per config with the best blocks and % of
-roofline, plus a summary recommending per-shape defaults.
+over the long-seq shapes (4096/8192) AND their ring-attention chunk
+shapes (Sq/cp for cp ∈ {2, 4} — the per-chunk-pair calls context
+parallelism actually dispatches), and prints one JSON line per config
+with the best blocks and % of roofline, plus a
+per-(shape, phase) ``tuned_blocks_table`` line that
+``install_tuned_blocks.py`` ships into the kernel source.
 
     python benchmarks/flash_sweep.py [--quick]
+    python benchmarks/flash_sweep.py --quick --interpret   # CPU smoke:
+        # tiny shapes through the Pallas interpreter, still emits a
+        # valid tuned_blocks_table line (tests/test_bench_smoke.py)
 """
 
 import argparse
@@ -105,7 +115,9 @@ def main():
                     help="tiny shapes for the CPU smoke test")
     args = ap.parse_args()
 
-    small = args.tiny or args.interpret  # interpret mode = CPU: no 8k matmuls
+    # interpret mode = CPU: no 8k matmuls, and real shapes through the
+    # interpreter take minutes — the smoke contract is tiny shapes
+    small = args.tiny or args.interpret
     roof = measure_roofline(n=256, iters=4) if small else measure_roofline()
     print(json.dumps({"roofline_tflops": round(roof, 1)}), flush=True)
 
@@ -116,11 +128,19 @@ def main():
         (2, 12, 4096, 64),
         (1, 8, 8192, 64),
     ]
+    # ring-attention chunk shapes: context parallelism dispatches the
+    # flash kernels per chunk PAIR at Sq/cp, so those are the shapes a
+    # cp run's tuned lookup actually keys on (batch scaled up to keep
+    # the grid busy, like a real cp rank's B·H)
+    ring = [(B * cp, H, S // cp, D)
+            for (B, H, S, D) in shapes if S >= 4096
+            for cp in (2, 4)]
+    shapes += [s for s in ring if s not in shapes]
     blocks = [256, 512, 1024, 2048]
     if args.quick:
         shapes = shapes[:2]
         blocks = [512, 1024]
-    if args.tiny:
+    if small:
         shapes = [(1, 2, 256, 64)]
         blocks = [128, 256]
 
@@ -136,7 +156,7 @@ def main():
                 continue
             try:
                 tflops, ms = bench_flash(B, H, S, D, bq, bk, fwd_only,
-                                         iters=1 if args.tiny else 8,
+                                         iters=1 if small else 8,
                                          interpret=args.interpret)
             except Exception as e:  # noqa: BLE001 — a block combo can exceed VMEM
                 print(json.dumps({"shape": [B, H, S, D], "fwd_only": fwd_only,
@@ -160,16 +180,20 @@ def main():
     # call memory-bound honestly
     print(json.dumps({"summary": results}), flush=True)
 
-    # table-ready per-shape defaults: best fwd+bwd combo per shape
-    # (falling back to the fwd-only best when only fwd ran), in the
-    # list-of-pairs format set_tuned_blocks accepts directly:
+    # table-ready per-(shape, phase) defaults in the list-of-pairs
+    # format set_tuned_blocks accepts directly:
     #   set_tuned_blocks(json.loads(line)["tuned_blocks_table"])
+    # The fwd-only best becomes the "fwd" entry (what the forward
+    # dispatcher keys on); the fwd+bwd best becomes the "bwd" entry —
+    # the backward kernels consult their own phase, so a fast-forward
+    # block choice never drags the backward over its VMEM envelope.
     table = {}
     for r in results:
         B, H, S, D = r["shape"]
-        if (S, D) not in table or not r["fwd_only"]:
-            table[(S, D)] = [r["bq"], r["bk"]]
-    pairs = [[[s, d, "bfloat16"], v] for (s, d), v in table.items()]
+        phase = "fwd" if r["fwd_only"] else "bwd"
+        table[(S, D, phase)] = [r["bq"], r["bk"]]
+    pairs = [[[s, d, "bfloat16", phase], v]
+             for (s, d, phase), v in sorted(table.items())]
     print(json.dumps({"tuned_blocks_table": pairs}), flush=True)
 
 

@@ -9,6 +9,12 @@ only in a runtime ``set_tuned_blocks`` call someone has to remember.
     python benchmarks/install_tuned_blocks.py /tmp/runbook/flash_sweep.out \
         --provenance "v5e-lite 2026-07-31 flash_sweep"
 
+Keys are per-phase ``(S, D, dtype, phase)`` with phase ∈ {"fwd", "bwd"}
+(the forward and backward kernels consult separate entries).  Old flat
+3-element keys — in the sweep output OR already installed in the
+source literal — migrate as ``"fwd"`` entries: pre-split sweeps
+measured the forward dispatcher's path.
+
 Idempotent: re-running with the same sweep output produces the same file.
 """
 
@@ -62,20 +68,30 @@ def main():
     except (SyntaxError, ValueError) as e:
         raise SystemExit(
             f"could not parse the existing _TUNED_BLOCKS literal: {e}")
-    entries = {
-        (int(s), int(d), str(dtype)): (int(bq), int(bk))
-        for (s, d, dtype), (bq, bk) in existing.items()
-    }
+    def norm_key(key):
+        """(S, D, dtype, phase) — 3-element keys (the pre-per-phase
+        format, from old sweeps or an old installed literal) are
+        forward measurements."""
+        if len(key) == 3:
+            s, d, dtype = key
+            phase = "fwd"
+        else:
+            s, d, dtype, phase = key
+        if phase not in ("fwd", "bwd"):
+            raise SystemExit(f"bad tuned-block phase {phase!r} in {key!r}")
+        return (int(s), int(d), str(dtype), str(phase))
+
+    entries = {norm_key(k): (int(bq), int(bk))
+               for k, (bq, bk) in existing.items()}
     for key, val in read_table(args.sweep_output):
-        s, d, dtype = key
         bq, bk = val
-        entries[(int(s), int(d), str(dtype))] = (int(bq), int(bk))
+        entries[norm_key(key)] = (int(bq), int(bk))
     if not entries:
         raise SystemExit("tuned_blocks_table was empty")
 
     body = "".join(
-        f"    ({s}, {d}, {dtype!r}): ({bq}, {bk}),\n"
-        for (s, d, dtype), (bq, bk) in sorted(entries.items())
+        f"    ({s}, {d}, {dtype!r}, {phase!r}): ({bq}, {bk}),\n"
+        for (s, d, dtype, phase), (bq, bk) in sorted(entries.items())
     )
     new_literal = (
         f"_TUNED_BLOCKS: dict = {{\n"

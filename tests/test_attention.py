@@ -309,6 +309,107 @@ class TestRingAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=2e-4, atol=2e-5)
 
 
+class TestRingOverlap:
+    """``overlap=True`` (unrolled ring, hop r+1's ppermute issued before
+    chunk r's compute) consumes the same values in the same merge order
+    as the serial scan schedule, so fp32 out/dq/dk/dv are BITWISE equal
+    op-by-op — pinned under ``disable_jit`` where each primitive runs
+    alone and any difference is a reordering bug, never rounding.  The
+    jitted pair is additionally pinned at 1-ulp scale: XLA fuses the
+    while-loop body and the unrolled straight-line program differently
+    (FMA contraction, iteration-0 constant folding), which no two
+    differently-shaped equal-math programs escape — but that residue
+    must stay at rounding scale, never a schedule-divergence scale."""
+
+    @staticmethod
+    def _fwd_bwd(kw):
+        # one vjp pass: the fwd output AND all three grads from a single
+        # ring traversal (the matrix runs op-by-op under disable_jit, so
+        # a second fwd-only traversal would double the dominant cost);
+        # the cos(out) cotangent varies per element, deterministically
+        def fwd_bwd(q, k, v):
+            out, vjp = jax.vjp(
+                lambda q, k, v: ring_attention(q, k, v, "cp", **kw),
+                q, k, v)
+            return out, vjp(jnp.cos(out))
+
+        return fwd_bwd
+
+    def _run(self, cp, causal, impl, overlap, devices8):
+        B, H, D = 1, 2, 16
+        q, k, v = qkv(7, B=B, H=H, S=64 * cp, D=D)
+        mesh = Mesh(np.array(devices8[:cp]), ("cp",))
+        kw = dict(causal=causal, impl=impl, interpret=True, overlap=overlap)
+        specs = (P(None, None, "cp", None),) * 3
+        out, grads = jax.shard_map(
+            self._fwd_bwd(kw), mesh=mesh, in_specs=specs,
+            out_specs=(specs[0], specs), check_vma=False,
+        )(q, k, v)
+        return out, grads
+
+    def _run_vmap(self, cp, causal, impl, overlap):
+        # the ring emulated by vmap(axis_name="cp") over a chunk axis:
+        # collectives see the same named axis, but each primitive runs
+        # ONCE on batched arrays instead of per-device — the only way
+        # the op-by-op matrix fits the fast tier.  Not available to the
+        # pallas impl: a batched lax.switch evaluates every branch's
+        # jaxpr eagerly, outside flash's disable_jit(False) window, and
+        # pallas_call cannot execute eagerly.
+        B, H, D = 1, 2, 16
+        q, k, v = qkv(7, B=B, H=H, S=64 * cp, D=D)
+        kw = dict(causal=causal, impl=impl, interpret=True, overlap=overlap)
+
+        def split(x):  # (B, H, S, D) -> (cp, B, H, S/cp, D)
+            return jnp.moveaxis(
+                x.reshape(B, H, cp, x.shape[2] // cp, D), 2, 0)
+
+        f = jax.vmap(self._fwd_bwd(kw), axis_name="cp", axis_size=cp)
+        return f(split(q), split(k), split(v))
+
+    def _assert_bitwise(self, serial, overlapped):
+        out_s, g_s = serial
+        out_o, g_o = overlapped
+        np.testing.assert_array_equal(np.asarray(out_s), np.asarray(out_o))
+        for name, a, b in zip(("dq", "dk", "dv"), g_s, g_o):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{name} diverged between serial and overlapped")
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("cp", [2, 4])
+    def test_bitwise_parity_fwd_bwd_scan(self, cp, causal):
+        with jax.disable_jit():
+            serial = self._run_vmap(cp, causal, "scan", False)
+            overlapped = self._run_vmap(cp, causal, "scan", True)
+        self._assert_bitwise(serial, overlapped)
+
+    @pytest.mark.parametrize("causal", [
+        True, pytest.param(False, marks=pytest.mark.slow)])
+    @pytest.mark.parametrize("cp", [
+        2, pytest.param(4, marks=pytest.mark.slow)])
+    def test_bitwise_parity_fwd_bwd_pallas(self, cp, causal, devices8):
+        # eager shard_map pays per-device sequential dispatch (~15-45 s
+        # per combo), so tier-1 keeps only cp=2 × causal=True — whose
+        # lax.switch full-block case already exercises the unmasked
+        # kernel — and the rest ride the slow tier (the full cp∈{2,4} ×
+        # causal matrix stays fast above via the scan vmap harness)
+        with jax.disable_jit():
+            serial = self._run(cp, causal, "pallas", False, devices8)
+            overlapped = self._run(cp, causal, "pallas", True, devices8)
+        self._assert_bitwise(serial, overlapped)
+
+    @pytest.mark.parametrize("causal", [
+        True, pytest.param(False, marks=pytest.mark.slow)])
+    def test_jitted_parity_rounding_scale(self, causal, devices8):
+        out_s, g_s = self._run(2, causal, "scan", False, devices8)
+        out_o, g_o = self._run(2, causal, "scan", True, devices8)
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_o),
+                                   rtol=1e-6, atol=1e-7)
+        for a, b in zip(g_s, g_o):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
 class TestPallasFlashAttention:
     """Pallas kernel parity vs the naive oracle, interpret mode on CPU."""
 
@@ -343,9 +444,9 @@ class TestPallasFlashAttention:
         seen = []
         orig = fap._pick_block
 
-        def spy(seq, target, align=fap._LANES):
+        def spy(seq, target, align=fap._LANES, **kw):
             seen.append(target)
-            return orig(seq, target, align)
+            return orig(seq, target, align, **kw)
 
         monkeypatch.setattr(fap, "_pick_block", spy)
         out = fap.flash_attention_pallas(q, k, v, causal=True, interpret=True)
@@ -377,6 +478,84 @@ class TestPallasFlashAttention:
         assert fap.tuned_blocks(1024, 64, jnp.bfloat16) == (512, 256)
         fap.set_tuned_blocks({(2048, 128, jnp.float32): (256, 512)})
         assert fap.tuned_blocks(2048, 128, "float32") == (256, 512)
+
+    def test_tuned_blocks_per_phase_lookup(self, monkeypatch):
+        """Per-phase keys resolve per phase; legacy 3-tuple entries are
+        fwd-only; a bad phase fails loudly at both ends."""
+        from apex_tpu.ops import flash_attention_pallas as fap
+
+        monkeypatch.setattr(fap, "_TUNED_BLOCKS", {})
+        fap.set_tuned_blocks({
+            (256, 64, "float32", "fwd"): (128, 128),
+            (256, 64, "float32", "bwd"): (64, 64),
+        })
+        assert fap.tuned_blocks(256, 64, jnp.float32, phase="fwd") == (128, 128)
+        assert fap.tuned_blocks(256, 64, jnp.float32, phase="bwd") == (64, 64)
+        # legacy flat key: a pre-split sweep measured the forward path
+        monkeypatch.setattr(fap, "_TUNED_BLOCKS", {})
+        fap.set_tuned_blocks({(256, 64, "float32"): (128, 128)})
+        assert fap.tuned_blocks(256, 64, jnp.float32, phase="fwd") == (128, 128)
+        assert fap.tuned_blocks(256, 64, jnp.float32, phase="bwd") is None
+        with pytest.raises(ValueError, match="phase"):
+            fap.tuned_blocks(256, 64, jnp.float32, phase="backward")
+        with pytest.raises(ValueError, match="phase"):
+            fap.set_tuned_blocks({(256, 64, "float32", "backward"): (8, 8)})
+
+    def test_bwd_consults_its_own_phase_entry(self, monkeypatch):
+        """The backward kernels must key the tuned table on their OWN
+        phase — a fast-forward block choice (fwd 128) must not leak into
+        the backward (tuned to 64 here), and vice versa."""
+        from apex_tpu.ops import flash_attention_pallas as fap
+
+        monkeypatch.setattr(fap, "_TUNED_BLOCKS", {})
+        fap.set_tuned_blocks({
+            (256, 64, "float32", "fwd"): (128, 128),
+            (256, 64, "float32", "bwd"): (64, 64),
+        })
+        resolved = []
+        orig = fap._clamped_blocks
+
+        def spy(sq, sk, d, dtype, bq, bk, phase):
+            r = orig(sq, sk, d, dtype, bq, bk, phase)
+            resolved.append((phase,) + r)
+            return r
+
+        monkeypatch.setattr(fap, "_clamped_blocks", spy)
+        q, k, v = self._inputs()
+
+        def loss(q):
+            o = fap.flash_attention_pallas(q, k, v, causal=True,
+                                           interpret=True)
+            return jnp.sum(o.astype(jnp.float32))
+
+        jax.grad(loss)(q)
+        assert ("fwd", 128, 128) in resolved
+        assert ("bwd", 64, 64) in resolved
+        # the custom_vjp residual fwd runs too; no call may cross phases
+        assert all(r in (("fwd", 128, 128), ("bwd", 64, 64))
+                   for r in resolved)
+
+    def test_clamped_blocks_respect_vmem_budget(self):
+        """_pick_block must never hand Mosaic a block pair whose
+        APX304-priced footprint exceeds the VMEM budget — the long-seq
+        defaults (target 1024/512) clamp instead of overflowing."""
+        from apex_tpu.ops import flash_attention_pallas as fap
+        from apex_tpu.ops._pallas_tiling import VMEM_BUDGET, flash_vmem_bytes
+
+        for phase, target in (("fwd", 1024), ("bwd", 512)):
+            for S in (2048, 4096, 8192):
+                for D in (64, 128):
+                    bq, bk = fap._clamped_blocks(S, S, D, jnp.bfloat16,
+                                                 target, target, phase)
+                    assert S % bq == 0 and S % bk == 0
+                    assert flash_vmem_bytes(bq, bk, D, phase) <= VMEM_BUDGET, \
+                        (phase, S, D, bq, bk)
+        # an explicitly over-budget request clamps too (2048² fwd at
+        # D=64 prices ~38 MiB — more than double the 16 MiB budget)
+        bq, bk = fap._clamped_blocks(2048, 2048, 64, jnp.bfloat16,
+                                     2048, 2048, "fwd")
+        assert flash_vmem_bytes(bq, bk, 64, "fwd") <= VMEM_BUDGET
+        assert (bq, bk) != (2048, 2048)
 
     @pytest.mark.parametrize("causal", [True, False])
     @pytest.mark.slow

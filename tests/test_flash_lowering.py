@@ -77,12 +77,22 @@ def test_odd_seq_bf16_lowers_for_tpu():
 
 
 def test_tuned_blocks_lower_for_tpu():
-    """Whatever the sweep installed must lower for its own shape."""
+    """Whatever the sweep installed must lower for its own shape and
+    phase (keys are per-phase ``(S, D, dtype, phase)``; legacy 3-element
+    keys are forward entries)."""
     table = dict(fap._TUNED_BLOCKS)
     if not table:
         pytest.skip("no tuned blocks installed yet")
-    for (S, D, dtype), (bq, bk) in table.items():
+    for key, (bq, bk) in table.items():
+        S, D, dtype = key[:3]
+        phase = key[3] if len(key) == 4 else "fwd"
         q = jax.ShapeDtypeStruct((4, S, D), jnp.dtype(dtype))
-        _lower(lambda q, k, v: fap.flash_fwd_pallas(
-            q, k, v, 1.0 / D ** 0.5, True, 0, 0,
-            block_q=bq, block_k=bk, heads=4), q, q, q)
+        if phase == "fwd":
+            _lower(lambda q, k, v: fap.flash_fwd_pallas(
+                q, k, v, 1.0 / D ** 0.5, True, 0, 0,
+                block_q=bq, block_k=bk, heads=4), q, q, q)
+        else:
+            r = jax.ShapeDtypeStruct((4, S, 1), jnp.float32)
+            _lower(lambda q, k, v, o, lse, do: fap.flash_bwd_pallas(
+                q, k, v, o, lse, do, 1.0 / D ** 0.5, True, 0, 0,
+                block_q=bq, block_k=bk, heads=4), q, q, q, q, r, q)

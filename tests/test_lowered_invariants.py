@@ -1238,3 +1238,42 @@ class TestDivergenceRuleProof:
         # and the uniform spelling passes: both ranks launching is fine
         lw.assert_same_collective_schedule(rank0.as_text(),
                                            as_rank(0).as_text())
+
+
+class TestRingOverlapLowering:
+    """The overlapped ring's lowering shape, pinned at the StableHLO
+    tier: ``overlap=True`` unrolls the ring and issues hop r+1's
+    ppermute before chunk r's compute, so ``collective_permute`` sites
+    interleave with the per-chunk matmuls — the latency-hiding
+    scheduler has compute to hide every hop behind.  The serial scan
+    traces its two permutes back-to-back at the end of the loop body
+    (no dots between any consecutive pair).  ``impl="scan"`` keeps the
+    chunk matmuls visible as ``dot_general`` (Pallas kernel bodies are
+    opaque to the HLO text)."""
+
+    def _lowering(self, devices8, overlap):
+        from apex_tpu.transformer.context_parallel import ring_attention
+
+        cp = 4
+        mesh = Mesh(np.array(devices8[:cp]), ("cp",))
+        q = jnp.zeros((1, 2, cp * 64, 16), jnp.float32)
+        f = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "cp", causal=False,
+                                           impl="scan", overlap=overlap),
+            mesh=mesh, in_specs=(P(None, None, "cp", None),) * 3,
+            out_specs=P(None, None, "cp", None), check_vma=False)
+        return jax.jit(f).lower(q, q, q)
+
+    def test_overlap_permutes_interleave_with_chunk_dots(self, devices8):
+        low = self._lowering(devices8, True)
+        # unrolled: cp-1 = 3 hops x (k, v), the final rotation elided
+        lw.count_collectives(low, "collective_permute", minimum=6,
+                             maximum=6)
+        gaps = lw.assert_interleaved(low, "collective_permute", gaps="any")
+        # hop r+1's pair issues before chunk r's dots, so at least one
+        # chunk's matmuls sit between consecutive permute sites
+        assert max(gaps) >= 1
+
+    def test_serial_permutes_trace_back_to_back(self, devices8):
+        low = self._lowering(devices8, False)
+        lw.assert_interleaved(low, "collective_permute", gaps="none")

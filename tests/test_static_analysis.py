@@ -2046,6 +2046,63 @@ class TestVmemFootprintOverBudget:
             """, tmp_path, [VmemFootprintOverBudget()])
         assert got == []
 
+    def test_positive_bwd_score_dots_price_temporaries(self, tmp_path):
+        """The backward-kernel class: declared buffers well under
+        budget (~2.5 MiB), but two last-dim-contracting dots (the
+        s = q·kᵀ / dp = do·vᵀ score pattern) keep four
+        (2048 × 1024) f32 temporaries live — 32 MiB the spec sum never
+        sees.  The kernel resolves through the functools.partial
+        binding idiom."""
+        got = run("""
+            import functools
+
+            import jax
+            from jax.experimental import pallas as pl
+
+            def _bwd_body(q_ref, k_ref, dq_ref, *, scale):
+                s = jax.lax.dot_general(
+                    q_ref[...], k_ref[...], (((1,), (1,)), ((), ())))
+                dp = jax.lax.dot_general(
+                    dq_ref[...], k_ref[...], (((1,), (1,)), ((), ())))
+                dq_ref[...] = (s * dp) * scale
+
+            def launch(q, k, dq):
+                kernel = functools.partial(_bwd_body, scale=0.125)
+                return pl.pallas_call(
+                    kernel, grid=(4,),
+                    in_specs=[pl.BlockSpec((2048, 128), lambda i: (i, 0)),
+                              pl.BlockSpec((1024, 128), lambda i: (0, 0))],
+                    out_specs=pl.BlockSpec((2048, 128), lambda i: (i, 0)),
+                )(q, k, dq)
+            """, tmp_path, [VmemFootprintOverBudget()])
+        assert rule_ids(got) == ["APX304"]
+        assert "4 score-sized f32 kernel temporaries" in got[0].message
+
+    def test_negative_non_score_dots_not_priced(self, tmp_path):
+        """pv/dv-style ``(1,)×(0,)`` dots produce block-shaped results
+        the specs already price — the same launch stays clean."""
+        got = run("""
+            import functools
+
+            import jax
+            from jax.experimental import pallas as pl
+
+            def _pv_body(p_ref, v_ref, o_ref, *, scale):
+                o_ref[...] = jax.lax.dot_general(
+                    p_ref[...], v_ref[...],
+                    (((1,), (0,)), ((), ()))) * scale
+
+            def launch(p, v, o):
+                kernel = functools.partial(_pv_body, scale=0.125)
+                return pl.pallas_call(
+                    kernel, grid=(4,),
+                    in_specs=[pl.BlockSpec((2048, 128), lambda i: (i, 0)),
+                              pl.BlockSpec((1024, 128), lambda i: (0, 0))],
+                    out_specs=pl.BlockSpec((2048, 128), lambda i: (i, 0)),
+                )(p, v, o)
+            """, tmp_path, [VmemFootprintOverBudget()])
+        assert got == []
+
     def test_budget_is_configurable(self, tmp_path):
         """The same small kernel flags under a 128 KiB budget — the
         constructor knob the CLI's --vmem-budget-mib drives."""
