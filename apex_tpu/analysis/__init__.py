@@ -83,6 +83,16 @@ machine-checked invariants):
   a compiled step — the per-step sync barrier
   ``apex_tpu.observability.stepstats`` (the allowed async-fetch
   spelling) exists to remove.
+- **APX114/115/116** host-concurrency races (``rules_threading`` +
+  the ``dataflow.ThreadIndex`` thread-reachability fixpoint): a
+  shared attribute mutated lock-free from a thread-reachable method
+  while another site holds the lock (the GoodputAccountant persist
+  race), a lock-order inversion in the static acquisition graph
+  (ABBA deadlock naming both sites), and a timeout-less blocking
+  call under a lock a signal-/watchdog-reachable path also acquires
+  (the drain-deadlock class).  Acquittal seam:
+  ``apex_tpu.resilience.locks.assert_lock_held``; runtime sanitizer:
+  ``instrument_locks()``.
 - **APX112** unseamed dispatch timing (``rules_host_sync``): a
   ``time.time()``/``perf_counter()``/``monotonic()`` delta spanning a
   proven step dispatch with no ``block_until_ready``/host-read/
@@ -135,6 +145,10 @@ from apex_tpu.analysis.rules_precision import (
     PageTableGatherUnclamped, QuantizedSyncStateDtype,
     ScratchAccumDtypeMismatch, UnclampedTakeAlongAxis,
 )
+from apex_tpu.analysis.rules_threading import (
+    BlockingCallUnderContendedLock, LockOrderInversion,
+    SharedMutationWithoutLock,
+)
 from apex_tpu.analysis.rules_tiling import (
     BlockShapeTilingViolation, BlockSpecIndexMapArity,
     HardCodedSublaneAlignment, VmemFootprintOverBudget,
@@ -181,6 +195,9 @@ def default_rules(vmem_budget_bytes=None):
         PageTableGatherUnclamped(),
         KvPoolScatterBypassesSeam(),
         Fp32ConstantInBf16Path(),
+        SharedMutationWithoutLock(),
+        LockOrderInversion(),
+        BlockingCallUnderContendedLock(),
     )
 
 

@@ -91,8 +91,8 @@ def main(argv=None) -> int:
                     help="print per-rule wall time (plus the shared "
                          "<load>/<link> phases) to stderr, slowest "
                          "first, then a per-family rollup (trace/io "
-                         "APX1xx, distributed APX2xx, kernel APX3xx, "
-                         "numerics APX4xx)")
+                         "APX1xx, concurrency APX114-116, distributed "
+                         "APX2xx, kernel APX3xx, numerics APX4xx)")
     ap.add_argument("--timing-json", default=None, metavar="FILE",
                     help="also write the raw timings dict (rule id -> "
                          "seconds, plus <load>/<link>) as JSON to FILE "
@@ -153,11 +153,15 @@ def main(argv=None) -> int:
             print(f"timing: {name:10s} {secs:8.3f}s", file=sys.stderr)
         families = {"APX1": "trace/io", "APX2": "distributed",
                     "APX3": "kernel", "APX4": "numerics"}
+        concurrency = {"APX114", "APX115", "APX116"}
         rollup: dict = {}
         for name, secs in timings.items():
-            fam = families.get(name[:4],
-                               "shared" if name.startswith("<") else
-                               "other")
+            if name in concurrency:
+                fam = "concurrency"
+            else:
+                fam = families.get(name[:4],
+                                   "shared" if name.startswith("<")
+                                   else "other")
             rollup[fam] = rollup.get(fam, 0.0) + secs
         for fam, secs in sorted(rollup.items(), key=lambda kv: -kv[1]):
             print(f"timing: family {fam:12s} {secs:8.3f}s",

@@ -563,6 +563,7 @@ def _load_module_task(args):
 
         dataflow.scope_index(loaded)
         dataflow.taint_index(loaded)
+        dataflow.thread_index(loaded)
     return loaded
 
 
@@ -643,6 +644,9 @@ def analyze_paths(paths: Iterable[str], rules: Iterable[Rule],
     # ... and the host-divergence taint lattice runs ITS cross-module
     # fixpoint (imported taint-returning helpers, taint cycles)
     dataflow.link_taint(ctxs)
+    # ... and the thread-reachability index links thread targets and
+    # on_*-callback seams handed across module boundaries
+    dataflow.link_threads(ctxs)
     if timings is not None:
         timings["<link>"] = _time.monotonic() - t0
     for rule in rules:

@@ -1077,6 +1077,364 @@ class TaintIndex:
         return None
 
 
+# ------------------------------------------------------ thread reachability
+#: Constructors whose function-valued ``target=`` starts a new host
+#: thread (``threading.Thread``/``Timer``); matched by last dotted
+#: component like the trace entrypoints.
+_THREAD_CTORS = {"Thread", "Timer"}
+
+#: Entry kinds, in reporting-priority order: ``signal`` (an async
+#: signal handler — may run between any two bytecodes of the main
+#: thread), ``callback`` (an ``on_*`` seam — the watchdog/preemption
+#: hooks, invoked FROM a monitor thread), ``thread`` (an explicit
+#: ``Thread(target=...)``), ``executor`` (``pool.submit``).
+_ENTRY_KIND_ORDER = ("signal", "callback", "thread", "executor")
+
+
+class ThreadIndex:
+    """Per-module thread-reachability: which functions can execute on a
+    host thread OTHER than the main one — the fact the concurrency
+    rules (APX114/115/116) are driven by.
+
+    Entry discovery (seeds): ``threading.Thread(target=f)`` /
+    ``Timer(t, f)``, executor ``.submit(f, ...)``, ``signal.signal(SIG,
+    f)`` handlers, and any ``on_*=`` keyword callback (the watchdog/
+    preemption/supervisor hook seams — ``on_fire``/``on_wedge``/
+    ``on_preempt`` run on the monitor thread or inside a signal
+    handler).  Each reachable function carries its entry KINDS with
+    human-readable reasons; reachability propagates through nested
+    defs and the module-local call graph exactly like the traced
+    index, and :func:`link_threads` runs the same import-resolved
+    cross-module fixpoint.
+
+    Quiet-on-unknown holds in the inverse direction here: an
+    over-approximated entry (an ``on_*`` callback that happens to run
+    on the main thread) only ENABLES the rules, and each rule demands
+    independent evidence of shared-state discipline (a lock held at
+    some OTHER access site) before it fires."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        # share the axis-scope index's alias maps so Thread(target=g)
+        # resolves `g = partial(f, ...)` the same way everywhere
+        self._fn_aliases = scope_index(ctx)._fn_aliases
+        #: qualname -> {kind: reason}
+        self.reachable: Dict[str, Dict[str, str]] = {}
+        #: Lambda node -> {kind: reason} (lambda targets, by identity)
+        self.lambda_reachable: Dict[ast.Lambda, Dict[str, str]] = {}
+        #: (value_node, kind, reason, scope) per entry site — kept for
+        #: :meth:`exports` (a target resolving through an import)
+        self._entry_values: List[Tuple[ast.AST, str, str, str]] = []
+        self._seed()
+        self._fixpoint()
+
+    def size(self) -> int:
+        return (sum(len(k) for k in self.reachable.values())
+                + sum(len(k) for k in self.lambda_reachable.values()))
+
+    # ------------------------------------------------------------ seeding
+    def _add(self, qualname: str, kinds: Dict[str, str]) -> bool:
+        cur = self.reachable.setdefault(qualname, {})
+        before = len(cur)
+        for k, r in kinds.items():
+            cur.setdefault(k, r)
+        return len(cur) != before
+
+    def _add_lambda(self, lam: ast.Lambda, kinds: Dict[str, str]) -> bool:
+        cur = self.lambda_reachable.setdefault(lam, {})
+        before = len(cur)
+        for k, r in kinds.items():
+            cur.setdefault(k, r)
+        return len(cur) != before
+
+    def _seed_value(self, value: ast.AST, kind: str, reason: str,
+                    scope: str) -> None:
+        self._entry_values.append((value, kind, reason, scope))
+        if isinstance(value, ast.Lambda):
+            self._add_lambda(value, {kind: reason})
+            return
+        if isinstance(value, ast.Call) and _is_partial(value) and value.args:
+            self._seed_value(value.args[0], kind, reason, scope)
+            return
+        name = None
+        if isinstance(value, ast.Name):
+            name = self._fn_aliases.get(value.id, value.id)
+        elif isinstance(value, ast.Attribute):
+            name = last_name(value)
+        if name is None:
+            return
+        resolved = self.ctx.resolve_function(name, scope)
+        if resolved is not None:
+            self._add(resolved, {kind: reason})
+        elif isinstance(value, ast.Attribute):
+            # a BOUND METHOD reference (acc.spill, self._persist): no
+            # lexical match — mark every class method of that name
+            # (over-approximate; the rules demand independent locking
+            # evidence before firing, so breadth only ENABLES them)
+            for qn in self._method_qualnames(name):
+                self._add(qn, {kind: reason})
+
+    def _method_qualnames(self, name: str) -> List[str]:
+        suffix = "." + name
+        return [qn for qn in self.ctx.functions if qn.endswith(suffix)]
+
+    def _seed(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Assign):
+                # wd.on_wedge = handler / self.on_fire = hook: the
+                # assignment spelling of the callback seam
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and tgt.attr.startswith("on_"):
+                        scope = self.ctx.enclosing_qualname(node)
+                        scope = "" if scope == "<module>" else scope
+                        self._seed_value(
+                            node.value, "callback",
+                            f"assigned to the `{tgt.attr}` callback "
+                            f"seam", scope)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            scope = self.ctx.enclosing_qualname(node)
+            scope = "" if scope == "<module>" else scope
+            name = last_name(node.func)
+            if name in _THREAD_CTORS:
+                target = _kwarg(node, "target")
+                if target is None and name == "Timer" and len(node.args) > 1:
+                    target = node.args[1]
+                if target is not None:
+                    self._seed_value(target, "thread",
+                                     f"threading.{name}(target=...)", scope)
+            elif name == "submit" and isinstance(node.func, ast.Attribute) \
+                    and node.args:
+                self._seed_value(node.args[0], "executor",
+                                 "executor .submit(...)", scope)
+            elif name == "signal" and isinstance(node.func, ast.Attribute) \
+                    and len(node.args) >= 2:
+                self._seed_value(node.args[1], "signal",
+                                 "installed as a signal handler "
+                                 "(signal.signal)", scope)
+            for kw in node.keywords:
+                if kw.arg and kw.arg.startswith("on_"):
+                    self._seed_value(kw.value, "callback",
+                                     f"passed as the `{kw.arg}=` "
+                                     f"callback seam", scope)
+
+    # ----------------------------------------------------------- fixpoint
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for lam, kinds in list(self.lambda_reachable.items()):
+                scope = self.ctx.enclosing_qualname(lam)
+                scope = "" if scope == "<module>" else scope
+                if self._propagate_body(lam.body, scope, kinds):
+                    changed = True
+            for qn in list(self.reachable):
+                kinds = self.reachable[qn]
+                info = self.ctx.functions.get(qn)
+                if info is None or not kinds:
+                    continue
+                derived = {k: f"reached from thread entry {qn} ({r})"
+                           for k, r in kinds.items()}
+                for other_qn in self.ctx.functions:
+                    if other_qn.startswith(qn + "."):
+                        if self._add(other_qn, derived):
+                            changed = True
+                if self._propagate_body(info.node, qn, derived):
+                    changed = True
+
+    def _propagate_body(self, body: ast.AST, scope: str,
+                        kinds: Dict[str, str]) -> bool:
+        changed = False
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = last_name(sub.func)
+            if callee is None:
+                continue
+            resolved = self.ctx.resolve_function(
+                self._fn_aliases.get(callee, callee), scope)
+            if resolved is not None:
+                if self._add(resolved, kinds):
+                    changed = True
+            elif isinstance(sub.func, ast.Attribute):
+                # method call with no lexical match (rec.dump(...)):
+                # mark same-named class methods (see _seed_value)
+                for qn in self._method_qualnames(callee):
+                    if self._add(qn, kinds):
+                        changed = True
+        return changed
+
+    # ------------------------------------------------------- cross-module
+    def exports(self) -> List[Tuple[str, str, str, str]]:
+        """(module, func, kind, reason) seeds this module plants into
+        OTHER modules: entry targets resolving through imports
+        (``Thread(target=other.f)``) and cross-module calls inside
+        thread-reachable bodies."""
+        out: List[Tuple[str, str, str, str]] = []
+        scope_idx = scope_index(self.ctx)
+        for value, kind, reason, scope in self._entry_values:
+            hits: List[Tuple[str, str, FrozenSet]] = []
+            scope_idx._export_value(value, set(), scope, hits)
+            for mod, attr, _ss in hits:
+                out.append((mod, attr, kind, reason))
+            if isinstance(value, ast.Attribute) \
+                    and isinstance(value.value, ast.Name):
+                self._export_bound_method(
+                    value.value.id, value.attr, kind, reason, out)
+        for qn, kinds in self.reachable.items():
+            info = self.ctx.functions.get(qn)
+            if info is None or not kinds:
+                continue
+            self._export_calls(info.node, qn, kinds, out)
+        for lam, kinds in self.lambda_reachable.items():
+            scope = self.ctx.enclosing_qualname(lam)
+            scope = "" if scope == "<module>" else scope
+            self._export_calls(lam, scope, kinds, out)
+        return out
+
+    def _export_bound_method(self, var: str, meth: str, kind: str,
+                             reason: str,
+                             out: List[Tuple[str, str, str, str]]) -> None:
+        """``Thread(target=acc.spill)`` where ``acc = Acc()`` and
+        ``Acc`` is imported: export (module of Acc, ``Acc.spill``).
+        The instance-construction assignment is matched anywhere in
+        the module (flow-insensitive, like every alias map here)."""
+        for node in ast.walk(self.ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == var
+                    and isinstance(node.value, ast.Call)):
+                continue
+            d = dotted_name(node.value.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if len(parts) == 1:
+                scope = self.ctx.enclosing_qualname(node)
+                scope = "" if scope == "<module>" else scope
+                tgt = scope_index(self.ctx)._import_target(parts[0], scope)
+                if tgt is not None:
+                    out.append((tgt[0], f"{tgt[1]}.{meth}", kind, reason))
+            elif parts[0] in self.ctx.import_aliases:
+                mod = ".".join([self.ctx.import_aliases[parts[0]]]
+                               + parts[1:-1])
+                out.append((mod, f"{parts[-1]}.{meth}", kind, reason))
+
+    def _export_calls(self, body: ast.AST, scope: str,
+                      kinds: Dict[str, str],
+                      out: List[Tuple[str, str, str, str]]) -> None:
+        derived = {k: f"called (cross-module) from thread-reachable "
+                      f"{self.ctx.module_name or self.ctx.path}:{scope} "
+                      f"({r})" for k, r in kinds.items()}
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted_name(sub.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if len(parts) == 1:
+                tgt = scope_index(self.ctx)._import_target(parts[0], scope)
+                if tgt is not None:
+                    for k, r in derived.items():
+                        out.append((*tgt, k, r))
+                continue
+            head, attr = parts[:-1], parts[-1]
+            if head[0] in self.ctx.import_aliases:
+                mod = ".".join([self.ctx.import_aliases[head[0]]] + head[1:])
+            elif head[0] in self.ctx.from_imports:
+                m0, a0 = self.ctx.from_imports[head[0]]
+                mod = ".".join([f"{m0}.{a0}" if m0 else a0] + head[1:])
+            else:
+                continue  # x.y() on a non-import: a method call, not a
+                # cross-module function — self.foo() must not export
+            for k, r in derived.items():
+                out.append((mod, attr, k, r))
+
+    def mark_external(self, qualname: str, kinds: Dict[str, str]) -> bool:
+        """Seed a function's thread-entry kinds from ANOTHER module and
+        re-run the local fixpoint; True if anything new was recorded."""
+        if qualname not in self.ctx.functions:
+            return False
+        if not self._add(qualname, dict(kinds)):
+            return False
+        self._fixpoint()
+        return True
+
+    # -------------------------------------------------------------- query
+    def kinds_of(self, qualname: str) -> Dict[str, str]:
+        """Entry kinds of one function (``{}`` = main-thread-only as
+        far as this pass can see)."""
+        return self.reachable.get(qualname, {})
+
+    def kinds_at(self, node: ast.AST) -> Dict[str, str]:
+        """Entry kinds of the innermost thread-reachable function (or
+        lambda) lexically enclosing ``node``."""
+        fn = self.ctx.enclosing_function(node)
+        while fn is not None:
+            if isinstance(fn, ast.Lambda):
+                kinds = self.lambda_reachable.get(fn)
+            else:
+                kinds = self.reachable.get(self.ctx.enclosing_qualname(fn))
+            if kinds:
+                return kinds
+            fn = self.ctx.enclosing_function(fn)
+        return {}
+
+    def thread_reason(self, node: ast.AST) -> Optional[str]:
+        """Why the code around ``node`` can run off the main thread, or
+        None — highest-priority entry kind first (signal > callback >
+        thread > executor)."""
+        kinds = self.kinds_at(node)
+        for k in _ENTRY_KIND_ORDER:
+            if k in kinds:
+                return kinds[k]
+        return None
+
+
+def thread_index(ctx: ModuleContext) -> ThreadIndex:
+    """The (cached) thread-reachability index of one module.  For
+    multi-file runs, :func:`link_threads` must run first so entry
+    targets and thread-side callees that live in other modules are
+    linked in (same contract as the traced/scope/taint indexes)."""
+    idx = getattr(ctx, "_thread_index", None)
+    if idx is None:
+        idx = ThreadIndex(ctx)
+        ctx._thread_index = idx
+    return idx
+
+
+def link_threads(ctxs: Dict[str, Optional[ModuleContext]]) -> None:
+    """Cross-module thread-reachability fixpoint, mirroring
+    :func:`link_axis_scopes`: a function handed to ``Thread(target=)``
+    /``signal.signal``/an ``on_*`` seam in another module — or called
+    from thread-reachable code there — is thread-reachable here too.
+    Monotone (kinds are only ever added); ambiguous module names (None
+    entries) are never linked through; each module's export list is
+    recomputed only when its reachable count grew."""
+    live = [c for c in ctxs.values() if c is not None]
+    for c in live:
+        thread_index(c)
+    memo: Dict[int, Tuple[int, list]] = {}
+    changed = True
+    while changed:
+        changed = False
+        for c in live:
+            idx = thread_index(c)
+            n = idx.size()
+            if memo.get(id(c), (-1,))[0] != n:
+                memo[id(c)] = (n, idx.exports())
+            for mod, attr, kind, reason in memo[id(c)][1]:
+                target = ctxs.get(mod)
+                if target is None or target is c:
+                    continue
+                if thread_index(target).mark_external(attr, {kind: reason}):
+                    changed = True
+
+
 def taint_index(ctx: ModuleContext) -> TaintIndex:
     """The (cached) taint index of one module.  For multi-file runs,
     :func:`link_taint` must run first so imported taint-returning

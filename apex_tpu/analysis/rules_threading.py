@@ -1,0 +1,568 @@
+"""Host-concurrency rules (the GoodputAccountant/flightrec class,
+scaled into an analyzer tier).
+
+The system is genuinely multi-threaded on the host: the StepWatchdog
+heartbeat thread, the PreemptionHandler signal path, the
+AsyncCheckpointer worker, the supervisor, the fleet frontend, and the
+metrics registry all mutate shared state concurrently — and the repo
+has burned review passes hand-finding the races (the goodput persist
+race PR 10 fixed, the flightrec dump-vs-checkpoint race PR 14 fixed,
+histogram re-registration clashes).  These rules turn that review tax
+into a CI gate, driven by :class:`~apex_tpu.analysis.dataflow.
+ThreadIndex` (which functions can run off the main thread) and a
+static lock-region model.
+
+- **APX114**: a shared ``self.`` attribute is MUTATED from a
+  thread-reachable method with no enclosing lock region, while at
+  least one OTHER access site of the same attribute IS locked — the
+  exact GoodputAccountant shape: the class declares lock discipline
+  for this state (somebody takes the lock) and one thread-side writer
+  skips it.  The asymmetry requirement is the false-positive killer:
+  a class with no lock at all, or uniformly unlocked access, stays
+  quiet (that is a design choice, not a missed site).
+- **APX115**: lock-order inversion — the static lock-acquisition
+  graph (lock B acquired while A is held; elsewhere A while B) has a
+  cycle.  Both sites are named; with the watchdog or a signal handler
+  on one side this is the classic ABBA deadlock that presents as a
+  wedged pod, not a stack trace.
+- **APX116**: a blocking call (a no-timeout ``.join()``/``.get()``/
+  ``.wait()``, ``block_until_ready``, ``wait_until_finished``,
+  checkpoint I/O, a host collective) executes while HOLDING a lock
+  that a signal-handler- or watchdog-callback-reachable function also
+  acquires — the drain-deadlock shape PR 8's re-entrancy guard fixed
+  by hand: the async path fires mid-block, queues behind the held
+  lock, and the process hangs in its own cleanup.
+
+Lock-region model (shared by all three): a lock is an attribute or
+module-level name assigned a ``threading.Lock``/``RLock``/
+``Condition``/``Semaphore`` (or an ``apex_tpu.resilience.locks``
+monitored lock), identified by ``Class.attr`` / module name — identity
+is BY NAME, not by object (two instances of one class share an id;
+documented limit).  A site is "locked" when lexically inside ``with
+self._lock:`` (RLock-aware: nested re-entry of the same id adds
+nothing) or between an ``.acquire()``/``.release()`` pair on the same
+id in the same function.  Acquittal seam: a call to
+:func:`~apex_tpu.resilience.locks.assert_lock_held` in the enclosing
+function pins the site to the runtime lock contract ("my caller holds
+it") and acquits APX114/APX116 — mirroring ``assert_uniform`` for the
+divergence tier.
+
+Known limits (documented, deliberate): the lock-acquisition graph and
+the shared-attribute model are module-local (cross-module thread
+REACHABILITY is linked, cross-module lock graphs are not); lock
+identity is by name; ``acquire``/``release`` pairing is line-ranged
+within one function (a release on another path is not modeled); and
+attribute mutation through a local alias (``d = self._acc; d[k] = v``)
+is out of reach.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from apex_tpu.analysis import dataflow
+from apex_tpu.analysis.core import (
+    ModuleContext, Rule, Finding, dotted_name, last_name,
+)
+
+#: Constructors that mint a lock object (matched by last dotted
+#: component: ``threading.Lock``, ``Lock``, and the runtime seam's
+#: ``monitored_lock`` all hit).
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore", "monitored_lock"}
+
+#: The acquittal marker (apex_tpu.resilience.locks.assert_lock_held):
+#: seeing one in the enclosing function acquits APX114/APX116 at that
+#: site — the code is saying "my caller holds the lock by contract,
+#: and here is where that contract is checked at runtime".
+_LOCK_SEAMS = {"assert_lock_held"}
+
+#: Mutating method names: calling one of these ON a shared attribute
+#: counts as a write to it (``self._ring.append``, ``self._acc.update``).
+_MUTATOR_METHODS = {"append", "appendleft", "extend", "insert", "add",
+                    "update", "setdefault", "pop", "popleft", "popitem",
+                    "remove", "discard", "clear", "sort", "reverse"}
+
+#: Entry kinds whose acquirers make a held lock "contended by an async
+#: interrupt" for APX116 (a signal handler may run between any two
+#: bytecodes; an on_* callback runs on the watchdog/monitor thread).
+_ASYNC_KINDS = ("signal", "callback")
+
+
+def _acquitted(ctx: ModuleContext, node: ast.AST) -> bool:
+    scope = ctx.enclosing_function(node) or ctx.tree
+    for sub in ast.walk(scope):
+        if isinstance(sub, ast.Call) \
+                and last_name(sub.func) in _LOCK_SEAMS:
+            return True
+    return False
+
+
+def _enclosing_class(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = ctx.parent(cur)
+    return None
+
+
+def _declared_locks(ctx: ModuleContext) -> Set[str]:
+    """Canonical ids of every lock the module declares: ``self.X =
+    threading.Lock()`` in class C → ``C.X``; ``NAME = Lock()`` at
+    module level → ``NAME``.  Cached on the ctx (every rule asks)."""
+    cached = getattr(ctx, "_declared_locks", None)
+    if cached is not None:
+        return cached
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and last_name(node.value.func) in _LOCK_CTORS):
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Attribute) \
+                and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id == "self":
+            cls = _enclosing_class(ctx, node)
+            out.add(f"{cls}.{tgt.attr}" if cls else tgt.attr)
+        elif isinstance(tgt, ast.Name) \
+                and ctx.enclosing_function(node) is None:
+            out.add(tgt.id)
+    ctx._declared_locks = out
+    return out
+
+
+def _lock_id(ctx: ModuleContext, expr: ast.AST,
+             declared: Set[str]) -> Optional[str]:
+    """Canonical lock id of an expression at a use site (``with
+    self._lock:``, ``self._lock.acquire()`` receiver), or None when it
+    is not a recognizable lock: a declared id, or — fallback for locks
+    constructed out of static reach — a name containing ``lock``/
+    ``mutex``."""
+    d = dotted_name(expr)
+    if d is None:
+        return None
+    if d.startswith("self."):
+        attr = d[len("self."):]
+        if "." in attr:
+            return None  # self.a.b: nested attribute, out of reach
+        cls = _enclosing_class(ctx, expr)
+        lid = f"{cls}.{attr}" if cls else attr
+    else:
+        lid = d
+    leaf = lid.split(".")[-1].lower()
+    if lid in declared or "lock" in leaf or "mutex" in leaf:
+        return lid
+    return None
+
+
+def _acquire_ranges(ctx: ModuleContext, fn: ast.AST,
+                    declared: Set[str]) -> Dict[str, Tuple[int, int]]:
+    """lock id -> (first ``.acquire()`` line, last ``.release()`` line)
+    inside one function — the explicit-pairing half of the lock-region
+    model.  An acquire with no matching release yields nothing (the
+    region never closes statically; claiming any extent would be a
+    guess)."""
+    acq: Dict[str, int] = {}
+    rel: Dict[str, int] = {}
+    for sub in ast.walk(fn):
+        if not (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)):
+            continue
+        if ctx.enclosing_function(sub) is not fn:
+            continue
+        meth = sub.func.attr
+        if meth not in ("acquire", "release"):
+            continue
+        lid = _lock_id(ctx, sub.func.value, declared)
+        if lid is None:
+            continue
+        if meth == "acquire":
+            acq[lid] = min(acq.get(lid, sub.lineno), sub.lineno)
+        else:
+            rel[lid] = max(rel.get(lid, sub.lineno), sub.lineno)
+    return {lid: (a, rel[lid]) for lid, a in acq.items() if lid in rel}
+
+
+def _held_locks(ctx: ModuleContext, node: ast.AST,
+                declared: Set[str]) -> Dict[str, ast.AST]:
+    """lock id -> acquisition site for every lock provably held at
+    ``node``: enclosing ``with`` items plus ``acquire``/``release``
+    line ranges of the enclosing function."""
+    out: Dict[str, ast.AST] = {}
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                lid = _lock_id(ctx, item.context_expr, declared)
+                if lid is not None:
+                    out.setdefault(lid, cur)
+        cur = ctx.parent(cur)
+    fn = ctx.enclosing_function(node)
+    if fn is not None:
+        line = getattr(node, "lineno", 0)
+        for lid, (a, r) in _acquire_ranges(ctx, fn, declared).items():
+            if a < line < r:
+                out.setdefault(lid, fn)
+    return out
+
+
+# ------------------------------------------------------------ site model
+class _Site:
+    __slots__ = ("node", "qualname", "write", "locked", "thread_reason")
+
+    def __init__(self, node, qualname, write, locked, thread_reason):
+        self.node = node
+        self.qualname = qualname
+        self.write = write
+        self.locked = locked            # frozenset of held lock ids
+        self.thread_reason = thread_reason
+
+
+def _attr_sites(ctx: ModuleContext, cls_node: ast.ClassDef,
+                declared: Set[str]) -> Dict[str, List[_Site]]:
+    """attr name -> access sites over one class body: direct loads,
+    stores/augmented stores (including through one subscript hop),
+    and mutator-method calls."""
+    tidx = dataflow.thread_index(ctx)
+    sites: Dict[str, List[_Site]] = {}
+
+    def record(attr_node: ast.Attribute, write: bool) -> None:
+        if not (isinstance(attr_node.value, ast.Name)
+                and attr_node.value.id == "self"):
+            return
+        attr = attr_node.attr
+        cls = _enclosing_class(ctx, attr_node)
+        if cls is None or f"{cls}.{attr}" in declared:
+            return  # the lock itself is not shared STATE
+        qn = ctx.enclosing_qualname(attr_node)
+        held = frozenset(_held_locks(ctx, attr_node, declared))
+        sites.setdefault(attr, []).append(_Site(
+            attr_node, qn, write, held, tidx.thread_reason(attr_node)))
+
+    for node in ast.walk(cls_node):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Subscript):
+                    tgt = tgt.value
+                if isinstance(tgt, ast.Attribute):
+                    record(tgt, write=True)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_METHODS \
+                and isinstance(node.func.value, ast.Attribute):
+            record(node.func.value, write=True)
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and not (isinstance(ctx.parent(node), ast.Attribute)
+                         or isinstance(ctx.parent(node), ast.Call)
+                         and ctx.parent(node).func is node):
+            record(node, write=False)
+    return sites
+
+
+class SharedMutationWithoutLock(Rule):
+    """APX114: a thread-reachable method mutates a shared attribute
+    with no enclosing lock region, while another access site of the
+    same attribute IS locked.
+
+    The GoodputAccountant shape: the main-thread mutators take
+    ``self._lock``, but ``finalize("wedge")`` — reachable from the
+    watchdog's ``on_wedge`` callback, i.e. the monitor thread — writes
+    the same accumulators bare.  The interleaving corrupts exactly
+    when it matters (mid-wedge, mid-preemption), on the box you are
+    not watching.  Both halves of the evidence are required: the
+    mutation must be reachable off the main thread (ThreadIndex), and
+    some OTHER site must hold a lock for this attribute (proving the
+    class considers the state lock-protected — uniformly unlocked
+    classes are a design choice, not a finding)."""
+
+    rule_id = "APX114"
+    severity = "error"
+    fix_hint = ("take the same lock the other access sites hold "
+                "(`with self._lock:` around the mutation — RLock if "
+                "the locked paths re-enter), or document the contract "
+                "with apex_tpu.resilience.locks.assert_lock_held(lock) "
+                "if the caller already holds it")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        tidx = dataflow.thread_index(ctx)
+        if not tidx.reachable and not tidx.lambda_reachable:
+            return
+        declared = _declared_locks(ctx)
+        if not declared:
+            return
+        for cls_node in ast.walk(ctx.tree):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            for attr, sites in sorted(
+                    _attr_sites(ctx, cls_node, declared).items()):
+                locked_sites = [s for s in sites if s.locked]
+                if not locked_sites:
+                    continue
+                for s in sites:
+                    if not s.write or s.locked \
+                            or s.thread_reason is None:
+                        continue
+                    if _acquitted(ctx, s.node):
+                        continue
+                    other = next((o for o in locked_sites
+                                  if o.node is not s.node), None)
+                    if other is None:
+                        continue
+                    lock = sorted(other.locked)[0]
+                    yield self.finding(
+                        ctx, s.node,
+                        f"`self.{attr}` is mutated with no lock held in "
+                        f"`{s.qualname}`, which can run off the main "
+                        f"thread ({s.thread_reason}), while "
+                        f"`{other.qualname}` (line {other.node.lineno}) "
+                        f"accesses it under `{lock}` — the unlocked "
+                        f"thread-side write races every locked reader "
+                        f"and corrupts the shared state exactly when "
+                        f"the async path fires")
+                    break  # one finding per attribute: the fix is one
+                    # lock region, not N findings for N statements
+
+
+# ------------------------------------------------------ lock-order graph
+def _local_acquires(ctx: ModuleContext, declared: Set[str]
+                    ) -> Dict[str, Set[str]]:
+    """qualname -> lock ids the function body DIRECTLY acquires."""
+    out: Dict[str, Set[str]] = {}
+    for qn, info in ctx.functions.items():
+        ids: Set[str] = set()
+        for sub in ast.walk(info.node):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    lid = _lock_id(ctx, item.context_expr, declared)
+                    if lid is not None:
+                        ids.add(lid)
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "acquire":
+                lid = _lock_id(ctx, sub.func.value, declared)
+                if lid is not None:
+                    ids.add(lid)
+        if ids:
+            out[qn] = ids
+    return out
+
+
+def _transitive_acquires(ctx: ModuleContext,
+                         declared: Set[str]) -> Dict[str, Set[str]]:
+    """qualname -> lock ids acquired by the function or any
+    module-local callee (fixpoint over the call graph)."""
+    acq = {qn: set(ids)
+           for qn, ids in _local_acquires(ctx, declared).items()}
+    changed = True
+    while changed:
+        changed = False
+        for qn, info in ctx.functions.items():
+            for sub in ast.walk(info.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee = last_name(sub.func)
+                if callee is None:
+                    continue
+                resolved = ctx.resolve_function(callee, qn)
+                if resolved is None or resolved == qn:
+                    continue
+                callee_ids = acq.get(resolved)
+                if not callee_ids:
+                    continue
+                cur = acq.setdefault(qn, set())
+                before = len(cur)
+                cur |= callee_ids
+                if len(cur) != before:
+                    changed = True
+    return acq
+
+
+def _acquisition_edges(ctx: ModuleContext, declared: Set[str]
+                       ) -> Dict[Tuple[str, str], ast.AST]:
+    """(held, acquired) -> first site where lock ``acquired`` is taken
+    while ``held`` is held — directly, or through a module-local call
+    whose (transitive) body takes it."""
+    trans = _transitive_acquires(ctx, declared)
+    edges: Dict[Tuple[str, str], ast.AST] = {}
+
+    def add(held: Dict[str, ast.AST], acquired: Set[str],
+            site: ast.AST) -> None:
+        for h in held:
+            for a in acquired:
+                if a != h:
+                    edges.setdefault((h, a), site)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            ids = {lid for item in node.items
+                   for lid in [_lock_id(ctx, item.context_expr, declared)]
+                   if lid is not None}
+            if ids:
+                add(_held_locks(ctx, node, declared), ids, node)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "acquire":
+                lid = _lock_id(ctx, node.func.value, declared)
+                if lid is not None:
+                    held = _held_locks(ctx, node, declared)
+                    held.pop(lid, None)  # RLock re-entry is not an edge
+                    add(held, {lid}, node)
+        if isinstance(node, ast.Call):
+            callee = last_name(node.func)
+            if callee is None:
+                continue
+            qn = ctx.enclosing_qualname(node)
+            resolved = ctx.resolve_function(
+                callee, "" if qn == "<module>" else qn)
+            callee_ids = trans.get(resolved) if resolved else None
+            if callee_ids:
+                held = _held_locks(ctx, node, declared)
+                add(held, callee_ids - set(held), node)
+    return edges
+
+
+class LockOrderInversion(Rule):
+    """APX115: the module's static lock-acquisition graph has a cycle —
+    somewhere lock B is taken while A is held, and somewhere else A
+    while B is held.
+
+    With both orders live, two threads interleaving at the wrong
+    moment deadlock permanently (each holds the lock the other
+    wants); with the watchdog or a signal handler on one side the hang
+    presents as a wedged step the watchdog itself cannot report,
+    because it is a party to the deadlock.  Edges follow module-local
+    calls (a helper that takes B, called under A, is an A→B edge at
+    the call site), so the cycle is found even when no function
+    spells both ``with`` statements."""
+
+    rule_id = "APX115"
+    severity = "error"
+    fix_hint = ("pick ONE global acquisition order for the two locks "
+                "and re-nest the minority site (release before taking "
+                "the other, or hoist the second acquisition out of the "
+                "region); wrap both with apex_tpu.resilience.locks."
+                "monitored_lock and run the suite with "
+                "instrument_locks() to catch the inversion at runtime")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        declared = _declared_locks(ctx)
+        if len(declared) < 2 and "lock" not in ctx.source.lower():
+            return
+        edges = _acquisition_edges(ctx, declared)
+        seen: Set[Tuple[str, str]] = set()
+        for (a, b), site in sorted(
+                edges.items(),
+                key=lambda kv: getattr(kv[1], "lineno", 0)):
+            rev = edges.get((b, a))
+            if rev is None or (b, a) in seen:
+                continue
+            seen.add((a, b))
+            yield self.finding(
+                ctx, site,
+                f"lock-order inversion: `{b}` is acquired while "
+                f"`{a}` is held here (line {site.lineno}), but line "
+                f"{rev.lineno} ({ctx.enclosing_qualname(rev)}) "
+                f"acquires `{a}` while holding `{b}` — two threads "
+                f"interleaving across these sites deadlock "
+                f"permanently, each holding the lock the other wants")
+
+
+# ------------------------------------------------------- blocking calls
+def _no_timeout(call: ast.Call) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return False
+    return not call.args
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    """Why this call can block indefinitely, or None.  ``join``/``get``
+    /``wait`` only count bare and timeout-less (``d.get(k)`` and
+    ``t.join(2.0)`` are fine); the named seams block by contract."""
+    name = last_name(call.func)
+    if name in ("join", "get", "wait") \
+            and isinstance(call.func, ast.Attribute) and _no_timeout(call):
+        return f"timeout-less `.{name}()`"
+    if name == "block_until_ready":
+        return "`block_until_ready()` (device sync)"
+    if name == "wait_until_finished":
+        return "`wait_until_finished()` (checkpoint drain)"
+    if name in ("save_checkpoint", "load_checkpoint"):
+        return f"checkpoint I/O (`{name}`)"
+    if name in ("process_allgather", "check_uniform"):
+        return f"host collective (`{name}`)"
+    return None
+
+
+class BlockingCallUnderContendedLock(Rule):
+    """APX116: a blocking call runs while holding a lock that a
+    signal-handler- or watchdog-callback-reachable function also
+    acquires.
+
+    The drain-deadlock shape PR 8's re-entrancy guard fixed by hand:
+    the main thread holds the lock across a checkpoint drain, the
+    preemption signal (or the watchdog's ``on_wedge``) fires
+    mid-block, its handler queues behind the held lock, and the
+    process hangs inside its own cleanup — the supervisor sees a
+    silent non-exit, not a crash.  The contention evidence is
+    required: blocking under a lock nobody else async-acquires is
+    merely slow, not a deadlock, and stays quiet."""
+
+    rule_id = "APX116"
+    severity = "warning"
+    fix_hint = ("move the blocking call out of the lock region "
+                "(snapshot the state under the lock, block after "
+                "release), give the wait a timeout, or route the async "
+                "path through a re-entrancy guard (the "
+                "PreemptionHandler.drain Event pattern) so it never "
+                "queues behind this lock")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        tidx = dataflow.thread_index(ctx)
+        declared = _declared_locks(ctx)
+        if not declared:
+            return
+        # locks acquired by signal/callback-reachable functions
+        contended: Dict[str, str] = {}
+        for qn, ids in _transitive_acquires(ctx, declared).items():
+            kinds = tidx.kinds_of(qn)
+            for k in _ASYNC_KINDS:
+                if k in kinds:
+                    for lid in ids:
+                        contended.setdefault(
+                            lid, f"`{qn}` ({kinds[k]})")
+        if not contended:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _blocking_reason(node)
+            if why is None:
+                continue
+            held = _held_locks(ctx, node, declared)
+            for lid in sorted(held):
+                other = contended.get(lid)
+                if other is None:
+                    continue
+                # the async acquirer being THIS function is not
+                # contention — it cannot interrupt itself
+                if other.startswith(
+                        f"`{ctx.enclosing_qualname(node)}`"):
+                    continue
+                if _acquitted(ctx, node):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"blocking call ({why}) while holding `{lid}`, "
+                    f"which {other} also acquires from a signal/"
+                    f"watchdog path: if the async path fires "
+                    f"mid-block it queues behind this lock and the "
+                    f"process hangs in its own cleanup — a silent "
+                    f"non-exit, not a crash")
+                break

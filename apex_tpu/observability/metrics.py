@@ -98,12 +98,20 @@ class _Metric:
     # ------------------------------------------------------------ export
     def samples(self) -> Iterator[Tuple[str, Dict[str, str], float]]:
         """``(sample_name, labels, value)`` triples (histograms expand
-        to the cumulative bucket/sum/count series)."""
+        to the cumulative bucket/sum/count series).
+
+        The expansion MATERIALIZES under the lock: ``_expand`` reads
+        mutable child state (a histogram's ``counts``/``sum``/
+        ``count``), and yielding lazily would interleave those reads
+        with a watchdog-thread ``observe`` — a torn scrape where
+        ``_bucket`` rows disagree with ``_count`` (APX114's shape,
+        caught by this module's two-thread hammer test)."""
         with self._lock:
-            items = list(self._children.items())
-        for key, child in items:
-            labels = dict(zip(self.labelnames, key))
-            yield from self._expand(labels, child)
+            out: List[Tuple[str, Dict[str, str], float]] = []
+            for key, child in self._children.items():
+                labels = dict(zip(self.labelnames, key))
+                out.extend(self._expand(labels, child))
+        return iter(out)
 
 
 class Counter(_Metric):
@@ -334,18 +342,25 @@ class MetricsRegistry:
         """Prometheus text exposition (0.0.4): HELP/TYPE headers plus
         every sample, ``rank`` label added to each.  Label values and
         HELP text are escaped per the spec — one un-escaped quote in an
-        error-derived label would invalidate the WHOLE scrape."""
+        error-derived label would invalidate the WHOLE scrape.
+
+        The whole exposition is assembled under the registry lock (one
+        re-entrant lock shared by every metric), so the scrape is a
+        CONSISTENT point-in-time snapshot: a watchdog-thread ``inc``
+        or a registry insert mid-scrape waits, instead of mutating the
+        dicts this iterates or tearing a histogram mid-expansion."""
         rank = str(_rank())
         out: List[str] = []
-        for m in self.metrics():
-            if m.help:
-                out.append(f"# HELP {m.name} {_esc_help(m.help)}")
-            out.append(f"# TYPE {m.name} {m.kind}")
-            for name, labels, value in m.samples():
-                lbl = ",".join(
-                    f'{k}="{_esc_label(v)}"' for k, v in
-                    sorted({**labels, "rank": rank}.items()))
-                out.append(f"{name}{{{lbl}}} {_fmt_val(value)}")
+        with self._lock:
+            for m in self.metrics():
+                if m.help:
+                    out.append(f"# HELP {m.name} {_esc_help(m.help)}")
+                out.append(f"# TYPE {m.name} {m.kind}")
+                for name, labels, value in m.samples():
+                    lbl = ",".join(
+                        f'{k}="{_esc_label(v)}"' for k, v in
+                        sorted({**labels, "rank": rank}.items()))
+                    out.append(f"{name}{{{lbl}}} {_fmt_val(value)}")
         return "\n".join(out) + "\n"
 
     def snapshot_jsonl(self, path, **extra) -> int:
@@ -360,25 +375,32 @@ class MetricsRegistry:
         ts = round(time.time(), 3)
         rank = _rank()
         lines = []
-        for m in self.metrics():
-            for name, labels, value in m.samples():
-                lines.append(json.dumps({
-                    "ts": ts, "rank": rank, **ctx,
-                    "metric": name, "type": m.kind,
-                    "labels": labels, "value": value, **extra,
-                }, sort_keys=True, default=str))
-            if isinstance(m, Histogram):
-                # exemplars: the identity (trace id, request id) of
-                # individual samples — one line each, drained so a
-                # sample's identity rides exactly one snapshot.  This
-                # is what makes a p99 outlier in the series JOINABLE
-                # to its request's trace spans.
-                for labels, ex in m.drain_exemplars():
+        # assemble under the registry lock for a consistent snapshot
+        # (concurrent inserts/incs wait); the file write + fsync below
+        # happens OUTSIDE it — disk I/O under a lock the watchdog and
+        # preemption threads also take is the APX116 drain-deadlock
+        # shape this repo's analyzer exists to flag
+        with self._lock:
+            for m in self.metrics():
+                for name, labels, value in m.samples():
                     lines.append(json.dumps({
                         "ts": ts, "rank": rank, **ctx,
-                        "metric": f"{m.name}_exemplar", "type": "exemplar",
-                        "labels": labels, **ex, **extra,
+                        "metric": name, "type": m.kind,
+                        "labels": labels, "value": value, **extra,
                     }, sort_keys=True, default=str))
+                if isinstance(m, Histogram):
+                    # exemplars: the identity (trace id, request id) of
+                    # individual samples — one line each, drained so a
+                    # sample's identity rides exactly one snapshot.
+                    # This is what makes a p99 outlier in the series
+                    # JOINABLE to its request's trace spans.
+                    for labels, ex in m.drain_exemplars():
+                        lines.append(json.dumps({
+                            "ts": ts, "rank": rank, **ctx,
+                            "metric": f"{m.name}_exemplar",
+                            "type": "exemplar",
+                            "labels": labels, **ex, **extra,
+                        }, sort_keys=True, default=str))
         if lines:
             with open(path, "a") as f:
                 f.write("\n".join(lines) + "\n")

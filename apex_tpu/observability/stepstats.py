@@ -38,6 +38,7 @@ in tests/test_lowered_invariants.py).
 """
 
 import contextlib
+import threading
 from collections import deque
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
@@ -268,14 +269,26 @@ class AsyncFetcher:
     The loop must not pass a ``put`` tree onward into a donating step
     call (the stats protocol swaps in fresh
     :meth:`StepTelemetry.init` buffers at each fetch) — the fetcher
-    holds the only live reference until harvest."""
+    holds the only live reference until harvest.
+
+    **Threading model**: ``put`` and ``ready`` are LOOP-THREAD-ONLY —
+    they are the hot path's non-blocking halves, and the step loop is
+    the only producer.  ``flush`` may additionally be called from the
+    preemption/watchdog exit paths concurrently with the loop: it
+    detaches the whole pending queue ATOMICALLY under the internal
+    lock (each entry is harvested exactly once, each caller's batch
+    stays FIFO) and converts to numpy outside the lock, so a loop-
+    thread ``ready`` racing an exit-path ``flush`` never double-
+    harvests or drops a window.  ``len()`` is a racy snapshot."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._pending: deque = deque()
 
     def put(self, kind: str, step: int, tree) -> None:
         jax.tree.map(_start_copy, tree)
-        self._pending.append((kind, int(step), tree))
+        with self._lock:
+            self._pending.append((kind, int(step), tree))
 
     def __len__(self) -> int:
         return len(self._pending)
@@ -284,18 +297,21 @@ class AsyncFetcher:
         return jax.tree.map(np.asarray, tree)
 
     def ready(self) -> List[Tuple[str, int, Any]]:
-        out = []
-        while self._pending:
-            kind, step, tree = self._pending[0]
-            if not all(_is_ready(x) for x in jax.tree.leaves(tree)):
-                break
-            self._pending.popleft()
-            out.append((kind, step, self._to_np(tree)))
-        return out
+        harvested = []
+        while True:
+            with self._lock:
+                if not self._pending:
+                    break
+                kind, step, tree = self._pending[0]
+                if not all(_is_ready(x)
+                           for x in jax.tree.leaves(tree)):
+                    break
+                self._pending.popleft()
+            harvested.append((kind, step, tree))
+        return [(k, s, self._to_np(t)) for k, s, t in harvested]
 
     def flush(self) -> List[Tuple[str, int, Any]]:
-        out = []
-        while self._pending:
-            kind, step, tree = self._pending.popleft()
-            out.append((kind, step, self._to_np(tree)))
-        return out
+        with self._lock:
+            drained, self._pending = self._pending, deque()
+        return [(kind, step, self._to_np(tree))
+                for kind, step, tree in drained]

@@ -1,0 +1,315 @@
+"""Tests for ``apex_tpu.resilience.locks`` — the runtime lock-order
+sanitizer (APX115's runtime twin) and the ``assert_lock_held``
+acquittal seam.
+
+The centerpiece is the chaos pair: the SAME two-lock inversion,
+provoked across the watchdog ``on_fire`` thread and the main thread,
+(a) raises a structured :class:`LockOrderViolation` naming both locks
+and carrying both stacks when instrumented, and (b) genuinely
+deadlocks (proven under an ``acquire(timeout=)`` guard — both sides
+time out, each holding the lock the other wants) when NOT
+instrumented.  Together they prove the sanitizer catches a real hang,
+not a false alarm.
+"""
+
+import threading
+
+import pytest
+
+from apex_tpu.resilience.elastic import StepWatchdog
+from apex_tpu.resilience.locks import (
+    LockContractError,
+    LockOrderViolation,
+    assert_lock_held,
+    instrument_locks,
+    instrumentation_enabled,
+    monitored_lock,
+    reset_lock_monitor,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitor():
+    reset_lock_monitor()
+    yield
+    reset_lock_monitor()
+
+
+class TestMonitoredLock:
+    def test_behaves_like_a_lock_uninstrumented(self):
+        lk = monitored_lock("plain")
+        assert not instrumentation_enabled()
+        assert lk.acquire(blocking=False)
+        assert lk.locked()
+        assert not lk.acquire(blocking=False)  # non-reentrant kind
+        lk.release()
+        assert not lk.locked()
+        with lk:
+            assert lk.held_by_current_thread()
+
+    def test_rlock_kind_is_reentrant(self):
+        lk = monitored_lock("re", kind="rlock")
+        with lk:
+            with lk:
+                assert lk.held_by_current_thread()
+        assert not lk.locked()
+
+    def test_bad_kind_is_loud(self):
+        with pytest.raises(ValueError, match="kind"):
+            monitored_lock("x", kind="mutex")
+
+    def test_instrument_returns_previous_state(self):
+        assert instrument_locks(True) is False
+        assert instrument_locks(False) is True
+        assert not instrumentation_enabled()
+
+    def test_consistent_order_never_raises(self):
+        a, b = monitored_lock("a"), monitored_lock("b")
+        instrument_locks(True)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        # same order from another thread: still fine
+        errors = []
+
+        def same_order():
+            try:
+                with a:
+                    with b:
+                        pass
+            except Exception as e:  # pragma: no cover - fail loudly
+                errors.append(e)
+
+        t = threading.Thread(target=same_order)
+        t.start()
+        t.join()
+        assert not errors
+
+    def test_inversion_raises_naming_both_locks_and_stacks(self):
+        a, b = monitored_lock("ckpt.lock"), monitored_lock("stats.lock")
+        instrument_locks(True)
+
+        def establish_forward_order():
+            with a:
+                with b:
+                    pass
+
+        establish_forward_order()
+        with pytest.raises(LockOrderViolation) as ei:
+            with b:
+                with a:
+                    pass
+        msg = str(ei.value)
+        assert "ckpt.lock" in msg and "stats.lock" in msg
+        assert "this acquisition" in msg and "prior acquisition" in msg
+        # both stacks are carried: the historical one shows the
+        # function that established the forward order
+        assert "establish_forward_order" in ei.value.prior_stack
+        assert ei.value.this_stack
+
+    def test_rlock_reentry_is_not_an_inversion(self):
+        r = monitored_lock("r", kind="rlock")
+        instrument_locks(True)
+        with r:
+            with r:   # re-entry: no (r, r) edge, no violation
+                pass
+
+    def test_release_out_of_acquire_order_is_tolerated(self):
+        a, b = monitored_lock("a"), monitored_lock("b")
+        instrument_locks(True)
+        a.acquire()
+        b.acquire()
+        a.release()   # release the OUTER lock first
+        b.release()
+        with a:       # held-stack bookkeeping survived
+            pass
+
+
+class TestAssertLockHeld:
+    def test_monitored_lock_held_passes_not_held_raises(self):
+        lk = monitored_lock("contract")
+        with lk:
+            assert_lock_held(lk)
+        with pytest.raises(LockContractError, match="contract"):
+            assert_lock_held(lk)
+
+    def test_monitored_lock_held_by_other_thread_raises(self):
+        lk = monitored_lock("other")
+        lk2 = threading.Event()
+        done = threading.Event()
+
+        def holder():
+            with lk:
+                lk2.set()
+                done.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert lk2.wait(5)
+        try:
+            with pytest.raises(LockContractError):
+                assert_lock_held(lk)   # held, but not by THIS thread
+        finally:
+            done.set()
+            t.join()
+
+    def test_plain_lock_and_rlock(self):
+        pl = threading.Lock()
+        with pytest.raises(LockContractError):
+            assert_lock_held(pl)
+        with pl:
+            assert_lock_held(pl)   # locked() is the best a Lock offers
+        rl = threading.RLock()
+        with pytest.raises(LockContractError):
+            assert_lock_held(rl)
+        with rl:
+            assert_lock_held(rl)
+
+
+# ------------------------------------------------------------- chaos pair
+class _InversionRig:
+    """The two-lock inversion provoked across the watchdog ``on_fire``
+    thread and the main thread: main establishes/holds ``ckpt`` then
+    wants ``stats``; the watchdog's fire path takes ``stats`` then
+    wants ``ckpt``.  ``make_locks`` injects monitored or plain locks so
+    the instrumented and un-instrumented runs share one program."""
+
+    def __init__(self, ckpt_lock, stats_lock):
+        self.ckpt, self.stats = ckpt_lock, stats_lock
+        self.main_holds_ckpt = threading.Event()
+        self.fire_holds_stats = threading.Event()
+        self.main_attempt_done = threading.Event()
+        self.fire_error = []
+        self.fire_deadlocked = []
+
+    def on_fire(self, info):
+        """Runs on the watchdog thread (the test seam replaces
+        ``os._exit``): stats -> ckpt, the REVERSE of main's order.
+
+        The cross-acquire timeouts are asymmetric (fire 0.5s, main
+        2.0s) and each side keeps holding its own lock until the other
+        side's attempt is acknowledged, so BOTH timeouts are provable
+        — the other lock is held for the attempt's whole window — and
+        the proof never races the moment a timer expires."""
+        try:
+            got_stats = self.stats.acquire(timeout=5)
+            assert got_stats
+            try:
+                self.fire_holds_stats.set()
+                self.main_holds_ckpt.wait(5)
+                # deadlock point: main holds ckpt (and keeps holding it
+                # until main_attempt_done) and wants stats
+                if self.ckpt.acquire(timeout=0.5):
+                    self.ckpt.release()
+                else:
+                    self.fire_deadlocked.append(True)
+                    # keep stats held until main's (longer) attempt has
+                    # definitely run its course against a held lock
+                    self.main_attempt_done.wait(10)
+            finally:
+                self.stats.release()
+        except LockOrderViolation as e:
+            self.fire_error.append(e)
+
+    def establish_forward_order(self):
+        """The program's NORMAL path: ckpt -> stats, uncontended.
+        Under the sanitizer this is what records the forward edge, so
+        the later reversed acquisition on the watchdog thread is the
+        one that closes the cycle (deterministically — not a race over
+        which thread records its half first)."""
+        with self.ckpt:
+            with self.stats:
+                pass
+
+    def run_main_side(self):
+        """ckpt -> stats on the main thread, interleaved with the
+        fire path via events.  Returns True if the stats acquire
+        timed out (main's half of the deadlock)."""
+        self.establish_forward_order()
+        got = self.ckpt.acquire(timeout=5)
+        assert got
+        try:
+            self.main_holds_ckpt.set()
+            self.fire_holds_stats.wait(5)
+            if self.stats.acquire(timeout=2.0):
+                self.stats.release()
+                return False
+            return True
+        finally:
+            self.main_attempt_done.set()
+            self.ckpt.release()
+
+
+def _fire_watchdog(rig):
+    """Arm a watchdog with a tiny deadline and never beat it, so its
+    monitor thread fires ``on_fire`` (the test seam) — the inversion
+    really crosses the watchdog thread, not a synthetic Thread."""
+    wd = StepWatchdog(deadline_sec=0.05, poll_sec=0.01,
+                      on_fire=rig.on_fire)
+    wd.start()
+    return wd
+
+
+class TestLockInversionChaos:
+    def test_instrumented_inversion_raises_with_both_stacks(self):
+        """Sanitizer armed: the watchdog-thread fire path's stats ->
+        ckpt acquisition closes the cycle against main's ckpt -> stats
+        and raises BEFORE wedging — naming both locks and carrying
+        both threads' stacks."""
+        instrument_locks(True)
+        rig = _InversionRig(monitored_lock("ckpt.lock"),
+                            monitored_lock("stats.lock"))
+        wd = _fire_watchdog(rig)
+        try:
+            main_timed_out = rig.run_main_side()
+        finally:
+            wd.stop()
+        assert rig.fire_error, "sanitizer did not raise on the inversion"
+        err = rig.fire_error[0]
+        msg = str(err)
+        assert "ckpt.lock" in msg and "stats.lock" in msg
+        assert "apex_tpu-step-watchdog" in msg  # the violating thread
+        assert err.prior_stack and err.this_stack
+        assert "run_main_side" in err.prior_stack
+        assert "on_fire" in err.this_stack
+        # the violation fired before the fire path ever blocked on
+        # ckpt, so it never reached the deadlock point...
+        assert not rig.fire_deadlocked
+        # ...and main's stats acquire succeeded once the fire path
+        # unwound (no hang anywhere)
+        assert main_timed_out is False
+
+    def test_uninstrumented_same_program_deadlocks(self):
+        """The control: identical program, plain ``threading.Lock``s,
+        no sanitizer — BOTH sides time out at the deadlock point, each
+        holding the lock the other wants.  This is the real hang the
+        instrumented run converted into a structured error (bounded
+        here only by the acquire timeouts the rig wears)."""
+        assert not instrumentation_enabled()
+        rig = _InversionRig(threading.Lock(), threading.Lock())
+        wd = _fire_watchdog(rig)
+        try:
+            main_timed_out = rig.run_main_side()
+        finally:
+            wd.stop()
+        assert not rig.fire_error
+        assert main_timed_out, "main side acquired stats — no deadlock?"
+        assert rig.fire_deadlocked, \
+            "fire side acquired ckpt — no deadlock?"
+
+    def test_uninstrumented_monitored_locks_also_deadlock(self):
+        """monitored_lock WITHOUT instrument_locks() must behave
+        exactly like the primitive — including deadlocking — so
+        production code can keep the named wrappers permanently and
+        arm the sanitizer only in debug/chaos runs."""
+        assert not instrumentation_enabled()
+        rig = _InversionRig(monitored_lock("ckpt.lock"),
+                            monitored_lock("stats.lock"))
+        wd = _fire_watchdog(rig)
+        try:
+            main_timed_out = rig.run_main_side()
+        finally:
+            wd.stop()
+        assert not rig.fire_error
+        assert main_timed_out and rig.fire_deadlocked

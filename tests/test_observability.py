@@ -140,6 +140,61 @@ class TestMetricsRegistry:
         payload = json.loads(records[-1].getMessage().split(" ", 1)[1])
         assert payload == {"a": 1, "run_id": "corr", "step": 5}
 
+    def test_scrape_is_a_consistent_snapshot_under_hammer(self):
+        """Two-thread hammer for the torn-scrape race: a writer thread
+        (the watchdog shape) observes a CONSTANT value into a
+        histogram and bumps a counter while the main thread scrapes.
+        Every observation lands v=1.0 in the (0.5, 1.5) bucket, so a
+        consistent snapshot must satisfy bucket{le=1.5} == count and
+        sum == count EXACTLY — the pre-fix lazy expansion (children
+        copied under the lock, buckets/sum/count read outside it)
+        tears mid-observe and breaks the invariant."""
+        import re
+        import sys
+        import threading
+
+        reg = metrics.MetricsRegistry()
+        h = reg.histogram("apex_hammer_seconds", buckets=(0.5, 1.5))
+        c = reg.counter("apex_hammer_total")
+        stop = threading.Event()
+        # shrink the GIL switch interval so the writer interleaves
+        # into any unlocked window (the pre-fix tear reproduces in
+        # ~20k scrapes at 1µs; at the 5ms default it hides for hours)
+        prev_switch = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)
+
+        def writer():
+            while not stop.is_set():
+                h.observe(1.0)
+                c.inc()
+                reg.gauge(f"apex_g_{threading.get_ident() % 7}").set(1)
+
+        t = threading.Thread(target=writer, name="hammer-writer")
+        t.start()
+        try:
+            for _ in range(300):
+                txt = reg.prometheus_text()
+
+                def val(pat, txt=txt):
+                    m = re.search(pat + r"\S* (\S+)", txt)
+                    return None if m is None else float(m.group(1))
+
+                count = val(r"apex_hammer_seconds_count")
+                if count is None:
+                    continue  # scrape ran before the first observe
+                # torn scrape: the cumulative buckets, the +Inf
+                # bucket, _sum and _count disagree with each other
+                assert val(
+                    r'apex_hammer_seconds_bucket\{le="1\.5"') == count, txt
+                assert val(
+                    r'apex_hammer_seconds_bucket\{le="\+Inf"') == count, txt
+                assert val(r"apex_hammer_seconds_sum") \
+                    == pytest.approx(count), txt
+        finally:
+            stop.set()
+            t.join()
+            sys.setswitchinterval(prev_switch)
+
     def test_nvtx_range_suffix(self):
         from apex_tpu.utils.profiler import nvtx_range
 
@@ -239,6 +294,45 @@ class TestAsyncFetcher:
         f.put("x", 0, {"a": 1.5})
         (_, _, tree), = f.ready()
         assert float(tree["a"]) == 1.5
+
+    def test_concurrent_flush_never_drops_or_doubles(self):
+        """The exit-path race (APX114's shape, fixed by the internal
+        lock): the loop thread harvests with ready() while an exit
+        path (preemption drain, watchdog) calls flush() concurrently.
+        Every window must be harvested by EXACTLY ONE caller, and
+        each caller's batch must stay FIFO by step."""
+        import threading
+
+        for _ in range(20):
+            f = stepstats.AsyncFetcher()
+            n = 200
+            for i in range(n):
+                f.put("w", i, {"v": float(i)})
+            batches = {}
+            barrier = threading.Barrier(2)
+
+            def harvest(name, fn):
+                barrier.wait()
+                out = []
+                for _ in range(50):
+                    out.extend(fn())
+                batches[name] = out
+
+            t1 = threading.Thread(
+                target=harvest, args=("loop", f.ready))
+            t2 = threading.Thread(
+                target=harvest, args=("exit", f.flush))
+            t1.start(); t2.start(); t1.join(); t2.join()
+            leftover = f.flush()
+            steps_loop = [s for _, s, _ in batches["loop"]]
+            steps_exit = [s for _, s, _ in batches["exit"]]
+            steps_left = [s for _, s, _ in leftover]
+            # exactly-once: the three disjoint batches cover 0..n-1
+            all_steps = sorted(steps_loop + steps_exit + steps_left)
+            assert all_steps == list(range(n))
+            # per-caller FIFO
+            assert steps_loop == sorted(steps_loop)
+            assert steps_exit == sorted(steps_exit)
 
 
 # ------------------------------------------------------------------ parity

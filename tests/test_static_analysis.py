@@ -62,6 +62,11 @@ from apex_tpu.analysis.rules_precision import (
     ScratchAccumDtypeMismatch,
     UnclampedTakeAlongAxis,
 )
+from apex_tpu.analysis.rules_threading import (
+    BlockingCallUnderContendedLock,
+    LockOrderInversion,
+    SharedMutationWithoutLock,
+)
 from apex_tpu.analysis.rules_tiling import (
     BlockShapeTilingViolation,
     BlockSpecIndexMapArity,
@@ -3694,3 +3699,522 @@ class TestSarifPartialFingerprints:
             """), "b.py")
         assert len(fps) == 2
         assert fps[0][0] != fps[1][0]
+
+
+# -------------------------------------- APX114 thread-unsafe shared writes
+class TestSharedMutationWithoutLock:
+    def test_positive_thread_target_mutates_locked_attr(self, tmp_path):
+        got = run("""
+            import threading
+
+            class Acc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._tokens = 0
+                    threading.Thread(target=self._persist).start()
+
+                def add(self, n):
+                    with self._lock:
+                        self._tokens += n
+
+                def _persist(self):
+                    self._tokens = 0
+            """, tmp_path, [SharedMutationWithoutLock()])
+        assert rule_ids(got) == ["APX114"]
+        assert "_tokens" in got[0].message
+        assert "Acc.add" in got[0].message       # the locked other site
+        assert "_persist" in got[0].symbol
+
+    def test_positive_prefix_goodput_accountant_shape(self, tmp_path):
+        """The literal PR 10 review finding, as a regression fixture:
+        the main-thread mutators take ``self._lock``, but ``finalize``
+        — reachable from the watchdog's ``on_wedge=`` callback seam,
+        i.e. the monitor thread — writes the same accumulators bare.
+        The rule must flag the pre-fix spelling forever (the post-fix
+        live tree stays clean via TestRepoIsClean)."""
+        got = run("""
+            import threading
+
+            class StepWatchdog:
+                def check(self):
+                    pass
+
+            class GoodputAccountant:
+                def __init__(self, path):
+                    self._lock = threading.RLock()
+                    self._path = path
+                    self._productive_s = 0.0
+                    self._lost_s = 0.0
+                    self._events = []
+
+                def record_step(self, seconds):
+                    with self._lock:
+                        self._productive_s += seconds
+                        self._persist()
+
+                def record_loss(self, seconds, why):
+                    with self._lock:
+                        self._lost_s += seconds
+                        self._events.append(why)
+                        self._persist()
+
+                def _persist(self):
+                    pass
+
+                def finalize(self, why):
+                    # pre-fix: no lock — but this runs on the WATCHDOG
+                    # thread via on_wedge while record_step runs on main
+                    self._lost_s += 1.0
+                    self._events.append(why)
+                    self._persist()
+
+            def install(acc):
+                wd = StepWatchdog()
+                wd.on_wedge = lambda info: acc.finalize("wedge")
+                threading.Thread(target=wd.check).start()
+                return wd
+            """, tmp_path, [SharedMutationWithoutLock()])
+        assert "APX114" in rule_ids(got)
+        assert any("finalize" in f.symbol for f in got)
+
+    def test_positive_prefix_flightrec_dump_shape(self, tmp_path):
+        """The PR 14 review finding: ``record_event`` appends to the
+        ring under ``self._lock`` on the main thread, while the dump
+        path — reached from the watchdog's ``on_wedge`` — drained the
+        same ring with NO lock (the dump-vs-checkpoint torn-read/lost-
+        event race, fixed by copying under the lock in ``snapshot``)."""
+        got = run("""
+            import threading
+
+            class FlightRecorder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._events = []
+
+                def record_event(self, e):
+                    with self._lock:
+                        self._events.append(e)
+
+                def dump(self, reason):
+                    # pre-fix: read+clear outside the lock, on the
+                    # watchdog thread, racing main-thread record_event
+                    rec = list(self._events)
+                    self._events.clear()
+                    return rec
+
+            def install(rec, watchdog):
+                watchdog.arm(on_wedge=lambda info: rec.dump("wedge"))
+            """, tmp_path, [SharedMutationWithoutLock()])
+        assert "APX114" in rule_ids(got)
+        assert any("dump" in f.symbol for f in got)
+
+    def test_positive_cross_module_thread_target(self, tmp_path):
+        """The thread entry lives in ANOTHER module: main.py starts a
+        Thread on worker.Acc._persist's bound method via the instance
+        it builds — the link_threads fixpoint must carry thread-
+        reachability across the import edge."""
+        (tmp_path / "worker.py").write_text(textwrap.dedent("""
+            import threading
+
+            class Acc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def add(self):
+                    with self._lock:
+                        self._n += 1
+
+                def spill(self):
+                    self._n = 0
+            """))
+        (tmp_path / "main.py").write_text(textwrap.dedent("""
+            import threading
+            from worker import Acc
+
+            def launch():
+                acc = Acc()
+                threading.Thread(target=acc.spill).start()
+            """))
+        got = analyze_paths([str(tmp_path / "worker.py"),
+                             str(tmp_path / "main.py")],
+                            [SharedMutationWithoutLock()], set(AXES))
+        assert "APX114" in rule_ids(got)
+
+    def test_negative_all_sites_locked(self, tmp_path):
+        got = run("""
+            import threading
+
+            class Acc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._tokens = 0
+                    threading.Thread(target=self._persist).start()
+
+                def add(self, n):
+                    with self._lock:
+                        self._tokens += n
+
+                def _persist(self):
+                    with self._lock:
+                        self._tokens = 0
+            """, tmp_path, [SharedMutationWithoutLock()])
+        assert got == []
+
+    def test_negative_no_lock_discipline_declared(self, tmp_path):
+        """A class with NO locked site for the attribute is a design
+        choice (maybe GIL-atomic, maybe wrong — but there is no
+        declared discipline being violated): quiet."""
+        got = run("""
+            import threading
+
+            class Flag:
+                def __init__(self):
+                    self.hit = False
+                    threading.Thread(target=self._mark).start()
+
+                def _mark(self):
+                    self.hit = True
+            """, tmp_path, [SharedMutationWithoutLock()])
+        assert got == []
+
+    def test_negative_acquitted_by_assert_lock_held(self, tmp_path):
+        """The assert_lock_held seam: the mutator's contract is "my
+        caller holds the lock", checked at runtime — acquitted."""
+        got = run("""
+            import threading
+            from apex_tpu.resilience.locks import assert_lock_held
+
+            class Acc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._tokens = 0
+                    threading.Thread(target=self._persist).start()
+
+                def add(self, n):
+                    with self._lock:
+                        self._tokens += n
+
+                def _persist(self):
+                    assert_lock_held(self._lock)
+                    self._tokens = 0
+            """, tmp_path, [SharedMutationWithoutLock()])
+        assert got == []
+
+    def test_negative_acquire_release_pairing_counts_as_locked(
+            self, tmp_path):
+        got = run("""
+            import threading
+
+            class Acc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._tokens = 0
+                    threading.Thread(target=self._persist).start()
+
+                def add(self, n):
+                    with self._lock:
+                        self._tokens += n
+
+                def _persist(self):
+                    self._lock.acquire()
+                    try:
+                        self._tokens = 0
+                    finally:
+                        self._lock.release()
+            """, tmp_path, [SharedMutationWithoutLock()])
+        assert got == []
+
+    def test_negative_main_thread_only_class(self, tmp_path):
+        """No thread entry anywhere in the module: quiet even with
+        asymmetric locking (single-threaded code may lock for re-use
+        from threaded callers it does not itself create)."""
+        got = run("""
+            import threading
+
+            class Acc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._tokens = 0
+
+                def add(self, n):
+                    with self._lock:
+                        self._tokens += n
+
+                def reset(self):
+                    self._tokens = 0
+            """, tmp_path, [SharedMutationWithoutLock()])
+        assert got == []
+
+
+# ------------------------------------------- APX115 lock-order inversions
+class TestLockOrderInversion:
+    def test_positive_abba_names_both_sites(self, tmp_path):
+        got = run("""
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def forward():
+                with A:
+                    with B:
+                        pass
+
+            def backward():
+                with B:
+                    with A:
+                        pass
+            """, tmp_path, [LockOrderInversion()])
+        assert rule_ids(got) == ["APX115"]
+        msg = got[0].message
+        assert "`A`" in msg and "`B`" in msg
+        assert "backward" in msg or "forward" in msg  # the other site
+
+    def test_positive_inversion_through_helper_call(self, tmp_path):
+        """One side never spells both with-statements: it calls a
+        module-local helper whose body takes the second lock — the
+        acquisition graph must follow the call edge."""
+        got = run("""
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._alock = threading.Lock()
+                    self._block = threading.Lock()
+
+                def _grab_a(self):
+                    with self._alock:
+                        return 1
+
+                def one(self):
+                    with self._block:
+                        return self._grab_a()
+
+                def two(self):
+                    with self._alock:
+                        with self._block:
+                            return 2
+            """, tmp_path, [LockOrderInversion()])
+        assert rule_ids(got) == ["APX115"]
+
+    def test_negative_consistent_order(self, tmp_path):
+        got = run("""
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def one():
+                with A:
+                    with B:
+                        pass
+
+            def two():
+                with A:
+                    with B:
+                        pass
+            """, tmp_path, [LockOrderInversion()])
+        assert got == []
+
+    def test_negative_rlock_reentry_is_not_a_cycle(self, tmp_path):
+        got = run("""
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+            """, tmp_path, [LockOrderInversion()])
+        assert got == []
+
+
+# --------------------------------- APX116 blocking under a contended lock
+class TestBlockingCallUnderContendedLock:
+    def test_positive_queue_get_under_signal_contended_lock(
+            self, tmp_path):
+        got = run("""
+            import signal
+            import threading
+
+            class H:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+                    signal.signal(signal.SIGTERM, self._on_sig)
+
+                def _on_sig(self, signum, frame):
+                    with self._lock:
+                        pass
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get()
+            """, tmp_path, [BlockingCallUnderContendedLock()])
+        assert rule_ids(got) == ["APX116"]
+        assert "_on_sig" in got[0].message
+        assert "signal" in got[0].message
+
+    def test_positive_checkpoint_io_under_watchdog_callback_lock(
+            self, tmp_path):
+        got = run("""
+            import threading
+
+            def save_checkpoint(path, state):
+                pass
+
+            class Saver:
+                def __init__(self, wd):
+                    self._lock = threading.Lock()
+                    self.state = {}
+                    wd.arm(on_wedge=self._note)
+
+                def _note(self, info):
+                    with self._lock:
+                        self.state["wedged"] = info
+
+                def save(self, path):
+                    with self._lock:
+                        save_checkpoint(path, self.state)
+            """, tmp_path, [BlockingCallUnderContendedLock()])
+        assert rule_ids(got) == ["APX116"]
+
+    def test_negative_timeout_bounded_wait(self, tmp_path):
+        got = run("""
+            import signal
+            import threading
+
+            class H:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+                    signal.signal(signal.SIGTERM, self._on_sig)
+
+                def _on_sig(self, signum, frame):
+                    with self._lock:
+                        pass
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get(timeout=5.0)
+            """, tmp_path, [BlockingCallUnderContendedLock()])
+        assert got == []
+
+    def test_negative_uncontended_lock_is_merely_slow(self, tmp_path):
+        """Blocking under a lock NO async path acquires: not a
+        deadlock, stays quiet."""
+        got = run("""
+            import threading
+
+            class H:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get()
+            """, tmp_path, [BlockingCallUnderContendedLock()])
+        assert got == []
+
+    def test_negative_dict_get_is_not_blocking(self, tmp_path):
+        got = run("""
+            import signal
+            import threading
+
+            class H:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._d = {}
+                    signal.signal(signal.SIGTERM, self._on_sig)
+
+                def _on_sig(self, signum, frame):
+                    with self._lock:
+                        pass
+
+                def read(self, k):
+                    with self._lock:
+                        return self._d.get(k)
+            """, tmp_path, [BlockingCallUnderContendedLock()])
+        assert got == []
+
+    def test_negative_acquitted_by_assert_lock_held(self, tmp_path):
+        got = run("""
+            import signal
+            import threading
+            from apex_tpu.resilience.locks import assert_lock_held
+
+            class H:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+                    signal.signal(signal.SIGTERM, self._on_sig)
+
+                def _on_sig(self, signum, frame):
+                    with self._lock:
+                        pass
+
+                def drain(self):
+                    with self._lock:
+                        assert_lock_held(self._lock)
+                        return self._q.get()
+            """, tmp_path, [BlockingCallUnderContendedLock()])
+        assert got == []
+
+
+# ------------------------------------------ concurrency-tier CLI plumbing
+class TestConcurrencyTierCli:
+    FIXTURE = textwrap.dedent("""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def one():
+            with A:
+                with B:
+                    pass
+
+        def two():
+            with B:
+                with A:
+                    pass
+        """)
+
+    def _run_cli(self, args, cwd):
+        import os as _os
+
+        env = dict(_os.environ, PYTHONPATH=str(REPO))
+        return subprocess.run(
+            [sys.executable, "-m", "apex_tpu.analysis", *args],
+            cwd=str(cwd), env=env, capture_output=True, text=True,
+            timeout=600)
+
+    def test_only_rules_scopes_to_the_concurrency_tier(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        r = self._run_cli(
+            ["mod.py", "--no-baseline",
+             "--only-rules", "APX114,APX115,APX116"], tmp_path)
+        assert r.returncode == 1 and "APX115" in r.stdout
+        r = self._run_cli(["mod.py", "--no-baseline",
+                           "--only-rules", "APX101"], tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_timing_rollup_has_a_concurrency_family(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.FIXTURE)
+        out = tmp_path / "timing.json"
+        r = self._run_cli(["mod.py", "--no-baseline", "--timing",
+                           "--timing-json", str(out)], tmp_path)
+        assert r.returncode == 1
+        timings = json.loads(out.read_text())
+        for rid in ("APX114", "APX115", "APX116"):
+            assert rid in timings
+        assert "timing: family concurrency" in r.stderr
+        # APX11x must NOT also be double-counted under trace/io
+        concurrency = sum(timings[r] for r in
+                          ("APX114", "APX115", "APX116"))
+        assert concurrency >= 0.0
